@@ -1,0 +1,29 @@
+"""ceph_tpu — a TPU-native distributed storage framework.
+
+A brand-new, idiomatic JAX/XLA/Pallas rebuild of the capabilities of Ceph
+(reference: markhpc/ceph @ v15 "octopus" rc, surveyed in SURVEY.md).  The
+centerpiece is an erasure-code engine whose Reed-Solomon GF(2^8)
+encode/decode and fused crc32c checksumming run as Pallas kernels on TPU,
+behind a plugin API mirroring Ceph's ``ErasureCodeInterface``
+(reference: src/erasure-code/ErasureCodeInterface.h).
+
+Subpackages
+-----------
+- ``ops``      — GF(2^8) arithmetic, RS matrices, Pallas kernels, crc32c.
+- ``ec``       — codec interface, plugin registry, profiles, plugins.
+- ``osd``      — EC backend (write/read/recovery state machines), stores.
+- ``msg``      — async messenger, typed messages, fault injection.
+- ``crush``    — deterministic hierarchical placement (straw2-style).
+- ``mon``      — thin control plane: maps, epochs, profiles, health.
+- ``client``   — librados-style client API, objecter, striper.
+- ``parallel`` — device-mesh sharded encode/decode via shard_map.
+- ``models``   — flagship end-to-end pipelines (bench + graft entry).
+- ``common``   — config options, perf counters, admin socket, log.
+"""
+
+__version__ = "0.1.0"
+
+# Version handshake for the erasure-code plugin registry (analog of
+# ``__erasure_code_version`` checked against CEPH_GIT_NICE_VER in
+# reference src/erasure-code/ErasureCodePlugin.cc:124-182).
+PLUGIN_API_VERSION = "1"

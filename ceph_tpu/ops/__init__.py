@@ -1,0 +1,3 @@
+"""Compute primitives: GF(2^8) math, RS matrices, Pallas kernels, crc32c."""
+
+from . import gf8  # noqa: F401

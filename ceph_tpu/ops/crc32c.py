@@ -1,0 +1,204 @@
+"""crc32c (Castagnoli) — host implementation, GF(2) combine math, JAX kernel.
+
+Reference equivalents:
+- ``ceph_crc32c(seed, data, len)`` with runtime arch dispatch
+  (src/common/crc32c.cc:17-53): here a native C++ slicing-by-8 via ctypes
+  (utils/native.py) with a numpy fallback.
+- ``ceph_crc32c_zeros`` fast path: here ``crc32c_zeros`` via GF(2) operator
+  powers (square-and-multiply), which also yields ``crc32c_combine`` — the
+  identity that makes crc parallelizable on TPU.
+- Per-shard crc verification on every full-chunk read
+  (src/osd/ECBackend.cc:1080-1093) and cumulative per-shard HashInfo
+  (src/osd/ECUtil.cc:172) consume this module.
+
+Chaining convention: ``crc32c(B, seed=crc32c(A)) == crc32c(A + B)``.
+
+TPU design: crc is bit-serial, but the register update is linear over
+GF(2), so a buffer is split into S segments whose registers are computed in
+parallel (each word step is a constant 32x32 GF(2) matrix applied via 32
+unrolled mask-XOR ops on uint32 lanes) and then merged with precomputed
+shift operators — the same math as zlib's crc32_combine, vectorized.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils import native
+
+_POLY_REFLECTED = np.uint32(0x82F63B78)
+_ALL_ONES = np.uint32(0xFFFFFFFF)
+
+
+@functools.lru_cache(maxsize=1)
+def _table() -> np.ndarray:
+    tbl = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = (c >> np.uint32(1)) ^ (_POLY_REFLECTED * (c & np.uint32(1)))
+        tbl[i] = c
+    return tbl
+
+
+def crc32c_py(data: bytes, seed: int = 0) -> int:
+    """Pure-python/numpy bytewise crc32c (slow; fallback + golden model)."""
+    tbl = _table()
+    c = np.uint32(~np.uint32(seed) & _ALL_ONES)
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    for b in arr:
+        c = tbl[(c ^ b) & np.uint32(0xFF)] ^ (c >> np.uint32(8))
+    return int(~c & _ALL_ONES)
+
+
+def crc32c(data, seed: int = 0) -> int:
+    """crc32c of a bytes-like/uint8-array, native-accelerated when possible."""
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
+    else:
+        data = bytes(data)
+    lib = native.get_lib()
+    if lib is not None:
+        return int(lib.ec_crc32c(seed & 0xFFFFFFFF, data, len(data)))
+    return crc32c_py(data, seed)
+
+
+# ---------------------------------------------------------------------------
+# GF(2) operator algebra.  A 32x32 matrix over GF(2) is stored as 32 uint32
+# columns: matvec(M, v) = XOR of M[i] over set bits i of v.
+# ---------------------------------------------------------------------------
+
+
+def _matvec(M: np.ndarray, v: int) -> int:
+    bits = (int(v) >> np.arange(32)) & 1
+    sel = np.where(bits.astype(bool), M, np.uint32(0))
+    return int(np.bitwise_xor.reduce(sel))
+
+
+def _matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    return np.array([_matvec(A, int(b)) for b in B], dtype=np.uint32)
+
+
+@functools.lru_cache(maxsize=1)
+def _shift8() -> np.ndarray:
+    """Operator advancing the (reflected) crc register by one zero byte."""
+    tbl = _table()
+    cols = np.zeros(32, dtype=np.uint32)
+    for i in range(32):
+        c = np.uint32(1 << i)
+        cols[i] = tbl[c & np.uint32(0xFF)] ^ (c >> np.uint32(8))
+    return cols
+
+
+@functools.lru_cache(maxsize=64)
+def _shift8_pow2(p: int) -> np.ndarray:
+    """Operator for 2**p zero bytes."""
+    if p == 0:
+        return _shift8()
+    M = _shift8_pow2(p - 1)
+    return _matmul(M, M)
+
+
+@functools.lru_cache(maxsize=4096)
+def shift_operator(nbytes: int) -> np.ndarray:
+    """Operator for ``nbytes`` zero bytes (square-and-multiply)."""
+    assert nbytes >= 0
+    M = np.array([np.uint32(1 << i) for i in range(32)], dtype=np.uint32)  # I
+    p = 0
+    while nbytes:
+        if nbytes & 1:
+            M = _matmul(_shift8_pow2(p), M)
+        nbytes >>= 1
+        p += 1
+    return M
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc(A||B) from crc(A), crc(B), len(B) — zlib crc32_combine math."""
+    return _matvec(shift_operator(len2), crc1) ^ crc2
+
+
+def crc32c_zeros(crc: int, nbytes: int) -> int:
+    """crc of ``nbytes`` zero bytes with seed ``crc``
+    (analog of ceph_crc32c_zeros, src/common/crc32c.cc)."""
+    return (~_matvec(shift_operator(nbytes), ~crc & 0xFFFFFFFF)) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# JAX batched crc over equal-length chunks.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_words_crc(n_chunks: int, n_words: int, seg_words: int):
+    import jax
+    import jax.numpy as jnp
+
+    assert n_words % seg_words == 0, (n_words, seg_words)
+    S = n_words // seg_words
+    W = seg_words
+    m32_cols = np.asarray(shift_operator(4), dtype=np.uint32)      # (32,)
+    # Merge operators: segment i (0-based) shifts by (S-1-i)*seg_bytes.
+    merge = np.stack([shift_operator((S - 1 - i) * W * 4)
+                      for i in range(S)]).astype(np.uint32)        # (S, 32)
+    # Conditioning constant: register contribution of the leading ~0 init
+    # propagated over the whole length.
+    init_term = np.uint32(_matvec(shift_operator(n_words * 4), 0xFFFFFFFF))
+
+    @jax.jit
+    def run(words):  # (C, n_words) uint32 -> (C,) uint32
+        words3 = words.reshape(n_chunks, S, W)
+
+        def word_step(w, state):
+            x = state ^ words3[:, :, w]
+            acc = jnp.zeros_like(x)
+            for i in range(32):  # static 32x32 matvec, unrolled
+                acc = acc ^ ((jnp.uint32(0) - ((x >> i) & 1))
+                             & jnp.uint32(m32_cols[i]))
+            return acc
+
+        state0 = jnp.zeros((n_chunks, S), dtype=jnp.uint32)
+        regs = jax.lax.fori_loop(0, W, word_step, state0)          # (C, S)
+
+        # Merge: XOR_i merge[i] . regs[:, i]
+        total = jnp.zeros((n_chunks,), dtype=jnp.uint32)
+        for b in range(32):
+            bit = (regs >> b) & 1                                  # (C, S)
+            sel = (jnp.uint32(0) - bit) & jnp.asarray(merge[:, b]) # (C, S)
+            total = total ^ jax.lax.reduce(
+                sel, np.uint32(0), jax.lax.bitwise_xor, (1,))
+        return ~(total ^ init_term)
+
+    return run
+
+
+def crc32c_words_jax(words, seg_words: int = 256):
+    """crc32c of each row of a (C, W) uint32 word array, on-device.
+
+    uint32 words (little-endian byte order) are the framework's native
+    on-device chunk representation.  W must be a multiple of ``seg_words``
+    (falls back to seg_words=1 otherwise).  Returns (C,) uint32.
+    """
+    C, W = words.shape
+    if W % seg_words:
+        seg_words = 1
+    return _compiled_words_crc(C, W, seg_words)(words)
+
+
+def crc32c_chunks_jax(chunks, seg_bytes: int = 1024):
+    """crc32c of each row of a (C, L) uint8 array, on-device.
+
+    L must be a multiple of 4; prefer crc32c_words_jax to avoid the
+    uint8->uint32 relayout on device.  Returns (C,) uint32.
+    """
+    import jax
+    import jax.numpy as jnp
+    C, L = chunks.shape
+    if L % 4:
+        raise ValueError(f"length {L} not 4-byte aligned")
+    words = jax.lax.bitcast_convert_type(
+        chunks.reshape(C, L // 4, 4), jnp.uint32)
+    seg_words = seg_bytes // 4 if seg_bytes % 4 == 0 else 1
+    return crc32c_words_jax(words, seg_words=seg_words)

@@ -1,0 +1,296 @@
+"""GF(2^8) arithmetic core (host side, numpy).
+
+This is the math layer that the reference outsourced to external submodules
+(gf-complete / jerasure / ISA-L, all empty submodules in the snapshot — see
+reference .gitmodules and SURVEY.md §2).  Everything here is rebuilt from
+first principles:
+
+- exp/log tables over GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1
+  (0x11D), the same field used by jerasure w=8 and ISA-L.
+- full 256x256 multiplication table for fast vectorized numpy host encode
+  (the host fallback / CPU baseline for the Pallas kernels).
+- Reed-Solomon generator matrices: systematic Vandermonde (the analog of
+  jerasure's ``reed_sol_van``, reference
+  src/erasure-code/jerasure/ErasureCodeJerasure.h:81) and Cauchy (the analog
+  of ``cauchy_good`` / ISA-L's gf_gen_cauchy1_matrix, reference
+  src/erasure-code/isa/ErasureCodeIsa.cc:384-387).
+- Gauss-Jordan matrix inversion over GF(2^8) (the analog of ISA-L's
+  ``gf_invert_matrix``, used by the decode path at reference
+  src/erasure-code/isa/ErasureCodeIsa.cc:275).
+
+All matrices are numpy uint8 arrays.  Coding matrix convention: ``C`` has
+shape (m, k); parity_i = XOR_j C[i, j] * data_j in GF(2^8).  The full
+systematic generator is ``[I_k; C]`` with shape (k+m, k).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomial for GF(2^8): x^8 + x^4 + x^3 + x^2 + 1.
+POLY = 0x11D
+# The SWAR kernels use the low byte (the reduction term XORed in when the
+# high bit falls off during a carryless doubling).
+POLY_LOW = POLY & 0xFF  # 0x1D
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """exp/log tables.  2 is a primitive element of GF(2^8)/0x11D."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= POLY
+    # Duplicate so exp[log a + log b] never needs a mod.
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+@functools.lru_cache(maxsize=1)
+def mul_table() -> np.ndarray:
+    """Full 256x256 GF(2^8) product table (64 KiB).
+
+    ``mul_table()[a, b] == gf_mul(a, b)``.  This is the workhorse of the
+    numpy host encode: a GF "matmul" becomes gathers + XOR-reduce.
+    """
+    a = np.arange(256).reshape(256, 1)
+    b = np.arange(256).reshape(1, 256)
+    out = GF_EXP[(GF_LOG[a] + GF_LOG[b])].astype(np.uint8)
+    out[0, :] = 0
+    out[:, 0] = 0
+    return out
+
+
+def gf_mul(a, b):
+    """Elementwise GF(2^8) multiply of arrays/scalars (uint8)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = GF_EXP[GF_LOG[a] + GF_LOG[b]]
+    zero = (a == 0) | (b == 0)
+    if out.ndim == 0:
+        return np.uint8(0) if zero else out
+    out = np.where(zero, np.uint8(0), out)
+    return out
+
+
+def gf_inv(a: int) -> int:
+    """Multiplicative inverse in GF(2^8); a must be nonzero."""
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    return int(GF_EXP[255 - GF_LOG[a]])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("gf_div by 0")
+    if a == 0:
+        return 0
+    return int(GF_EXP[GF_LOG[a] - GF_LOG[b] + 255])
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] * n) % 255])
+
+
+# ---------------------------------------------------------------------------
+# Matrix algebra over GF(2^8)
+# ---------------------------------------------------------------------------
+
+
+def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8).  A: (r, n), B: (n, c) -> (r, c).
+
+    XOR is addition; the mul table supplies products.  Used host-side for
+    small coding matrices only — bulk data goes through gf_mat_encode or the
+    JAX/Pallas kernels.
+    """
+    A = np.asarray(A, dtype=np.uint8)
+    B = np.asarray(B, dtype=np.uint8)
+    tbl = mul_table()
+    # products[r, n, c]; XOR-reduce the middle axis.
+    prod = tbl[A[:, :, None], B[None, :, :]]
+    return np.bitwise_xor.reduce(prod, axis=1)
+
+
+def gf_matrix_invert(A: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^8).
+
+    Raises ValueError if singular.  Mirrors the role of ISA-L's
+    ``gf_invert_matrix`` in the decode path (reference
+    src/erasure-code/isa/ErasureCodeIsa.cc:275).
+    """
+    A = np.array(A, dtype=np.uint8)
+    n = A.shape[0]
+    if A.shape != (n, n):
+        raise ValueError("square matrix required")
+    aug = np.concatenate([A, np.eye(n, dtype=np.uint8)], axis=1)
+    tbl = mul_table()
+    for col in range(n):
+        # Pivot search.
+        pivot = -1
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot < 0:
+            raise ValueError("singular matrix over GF(2^8)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # Scale pivot row to 1.
+        inv_p = gf_inv(int(aug[col, col]))
+        aug[col] = tbl[inv_p, aug[col]]
+        # Eliminate other rows.
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] = aug[r] ^ tbl[aug[r, col], aug[col]]
+    return aug[:, n:].copy()
+
+
+# ---------------------------------------------------------------------------
+# Reed-Solomon generator matrices
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=128)
+def vandermonde_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic RS coding matrix from an extended Vandermonde matrix.
+
+    Build V[(k+m), k] with V[i, j] = i^j (gf_pow, 0^0 = 1), then
+    right-multiply by inv(V[:k]) so the top k rows become the identity; the
+    bottom m rows are the returned (m, k) coding matrix.  Equivalent (up to
+    row/column scaling) to jerasure's reed_sol_van construction the
+    reference delegates to (src/erasure-code/jerasure/ErasureCodeJerasure.cc
+    :158-172); MDS for k+m <= 256.
+    """
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for GF(2^8)")
+    V = np.zeros((k + m, k), dtype=np.uint8)
+    for i in range(k + m):
+        for j in range(k):
+            V[i, j] = gf_pow(i, j)
+    top_inv = gf_matrix_invert(V[:k])
+    G = gf_matmul(V, top_inv)
+    assert np.array_equal(G[:k], np.eye(k, dtype=np.uint8))
+    return G[k:].copy()
+
+
+@functools.lru_cache(maxsize=128)
+def cauchy_matrix(k: int, m: int) -> np.ndarray:
+    """Cauchy coding matrix: C[i, j] = 1 / ((i + k) ^ j) in GF(2^8).
+
+    Analog of ``cauchy_good`` / ISA-L's gf_gen_cauchy1_matrix (reference
+    src/erasure-code/isa/ErasureCodeIsa.cc:384-387).  Any square submatrix
+    of a Cauchy matrix is invertible, so the code is MDS by construction.
+    """
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for GF(2^8)")
+    C = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            C[i, j] = gf_inv((i + k) ^ j)
+    return C
+
+
+def generator_matrix(k: int, m: int, technique: str = "reed_sol_van") -> np.ndarray:
+    """Full systematic generator [I_k; C], shape (k+m, k)."""
+    if technique in ("reed_sol_van", "vandermonde", "reed_sol_r6_op", "liberation",
+                     "blaum_roth", "liber8tion"):
+        C = vandermonde_matrix(k, m)
+    elif technique in ("cauchy_good", "cauchy_orig", "cauchy"):
+        C = cauchy_matrix(k, m)
+    elif technique == "xor":
+        if m != 1:
+            raise ValueError("xor technique requires m=1")
+        C = np.ones((1, k), dtype=np.uint8)
+    else:
+        raise ValueError(f"unknown technique {technique!r}")
+    return np.concatenate([np.eye(k, dtype=np.uint8), C], axis=0)
+
+
+def decode_matrix(generator: np.ndarray, k: int,
+                  present_rows: "list[int]") -> np.ndarray:
+    """Inverse mapping from k surviving chunks back to the k data chunks.
+
+    ``present_rows``: indices (into the k+m generator rows) of the k chunks
+    chosen to decode from.  Returns D (k, k) with data = D x present_chunks.
+    Host-side, tiny; cached per erasure signature by the caller (the analog
+    of ErasureCodeIsaTableCache, reference
+    src/erasure-code/isa/ErasureCodeIsaTableCache.cc).
+    """
+    if len(present_rows) != k:
+        raise ValueError(f"need exactly k={k} rows, got {len(present_rows)}")
+    sub = generator[np.asarray(present_rows, dtype=np.int64)]
+    return gf_matrix_invert(sub)
+
+
+# ---------------------------------------------------------------------------
+# Bulk encode/decode on the host (numpy reference + CPU fallback)
+# ---------------------------------------------------------------------------
+
+
+def gf_mat_encode(C: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[i] = XOR_j C[i, j] * data[j]  over GF(2^8).
+
+    C: (m, k) uint8; data: (k, L) uint8 -> (m, L) uint8.  This is the
+    reference semantics of ISA-L's ``ec_encode_data`` (the call at reference
+    src/erasure-code/isa/ErasureCodeIsa.cc:119-131), implemented with the
+    full product table and numpy gathers.  Used as the golden model for the
+    JAX/Pallas kernels and as the host fallback.
+    """
+    C = np.asarray(C, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    m, k = C.shape
+    assert data.shape[0] == k, (C.shape, data.shape)
+    tbl = mul_table()
+    out = np.zeros((m, data.shape[1]), dtype=np.uint8)
+    for i in range(m):
+        acc = out[i]
+        for j in range(k):
+            c = int(C[i, j])
+            if c == 0:
+                continue
+            if c == 1:
+                acc ^= data[j]
+            else:
+                acc ^= tbl[c, data[j]]
+    return out
+
+
+def encode_stripe(data: np.ndarray, k: int, m: int,
+                  technique: str = "reed_sol_van") -> np.ndarray:
+    """Convenience: (k, L) data chunks -> (k+m, L) all chunks."""
+    G = generator_matrix(k, m, technique)
+    parity = gf_mat_encode(G[k:], data)
+    return np.concatenate([np.asarray(data, dtype=np.uint8), parity], axis=0)
+
+
+def decode_stripe(chunks: "dict[int, np.ndarray]", k: int, m: int,
+                  technique: str = "reed_sol_van") -> np.ndarray:
+    """Recover the (k, L) data chunks from any k available chunks.
+
+    ``chunks`` maps chunk index (0..k+m-1) to its (L,) buffer.  Reference
+    behavior: ECBackend decodes from ``minimum_to_decode`` shards
+    (src/osd/ECBackend.cc:1594-1631) then reconstructs via the plugin.
+    """
+    G = generator_matrix(k, m, technique)
+    avail = sorted(chunks.keys())
+    if len(avail) < k:
+        raise ValueError(f"need {k} chunks, have {len(avail)}")
+    rows = avail[:k]
+    D = decode_matrix(G, k, rows)
+    stacked = np.stack([np.asarray(chunks[r], dtype=np.uint8) for r in rows])
+    return gf_mat_encode(D, stacked)

@@ -1,0 +1,137 @@
+"""Pallas TPU kernels for Reed-Solomon GF(2^8) encode/decode.
+
+The hot loop of the whole framework: the per-stripe GF matmul that the
+reference runs on the CPU via ISA-L/jerasure (call site
+src/osd/ECUtil.cc:120 → plugin encode_chunks, e.g.
+src/erasure-code/isa/ErasureCodeIsa.cc:119-131).  Here it is one Pallas
+kernel over packed uint32 lanes using the bit-sliced SWAR formulation (see
+ops/gf_jax.py for the math); the coding matrix is static so the
+multiply-by-constant chains are fully unrolled at trace time into dense VPU
+int32 ops, and the grid tiles the chunk length through VMEM.
+
+Layout: data (k, W) uint32 — 4 field elements per lane.  Grid over W in
+blocks; each block holds all k input rows and produces all m output rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import gf8
+from .gf_jax import bytes_to_u32, gf_double_u32, u32_to_bytes
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _make_kernel(C: np.ndarray):
+    """Build a kernel closure with the (m, k) coding matrix baked in."""
+    C = np.asarray(C, dtype=np.uint8)
+    m, k = C.shape
+
+    def kernel(in_ref, out_ref):
+        acc: list = [None] * m
+        for j in range(k):
+            col = C[:, j]
+            if not col.any():
+                continue
+            xp = in_ref[j, :]
+            max_bit = max(int(c).bit_length() for c in col)
+            for b in range(max_bit):
+                for i in range(m):
+                    if (int(col[i]) >> b) & 1:
+                        acc[i] = xp if acc[i] is None else acc[i] ^ xp
+                if b + 1 < max_bit:
+                    xp = gf_double_u32(xp)
+        for i in range(m):
+            if acc[i] is None:
+                out_ref[i, :] = jnp.zeros_like(out_ref[i, :])
+            else:
+                out_ref[i, :] = acc[i]
+
+    return kernel
+
+
+# Per-block word budget: k+m rows of BW uint32 lanes must fit VMEM (~16 MB)
+# with double buffering.  BW=32768 → (8+3) rows * 128 KiB ≈ 1.4 MB/block.
+_BLOCK_W = 32768
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_pallas_matmul(c_bytes: bytes, m: int, k: int, W: int,
+                            interpret: bool):
+    C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
+    kernel = _make_kernel(C)
+    bw = min(_BLOCK_W, W)
+    # W is guaranteed a multiple of 128 by the wrapper; shrink bw to divide W.
+    while W % bw:
+        bw //= 2
+    grid = (W // bw,)
+
+    @jax.jit
+    def run(data_u32):  # (k, W) uint32 -> (m, W) uint32
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, W), jnp.uint32),
+            grid=grid,
+            in_specs=[pl.BlockSpec((k, bw), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((m, bw), lambda i: (0, i)),
+            interpret=interpret,
+        )(data_u32)
+
+    return run
+
+
+def gf_mat_encode_pallas_u32(C: np.ndarray, data_u32: jax.Array,
+                             interpret: "bool | None" = None) -> jax.Array:
+    """Static-matrix GF matmul via Pallas: (k, W) uint32 -> (m, W) uint32.
+
+    uint32 lanes are the framework's native chunk representation (see
+    ops/gf_jax.py perf note).  W must be a multiple of 128 lanes (512 bytes
+    — the codec layer pads chunks to stripe alignment, mirroring SIMD_ALIGN
+    padding at reference src/erasure-code/ErasureCode.cc:42,151-186).
+    Off-TPU the kernel runs in interpret mode so tests exercise the same
+    code path.
+    """
+    C = np.ascontiguousarray(C, dtype=np.uint8)
+    m, k = C.shape
+    assert data_u32.shape[0] == k, (C.shape, data_u32.shape)
+    W = data_u32.shape[-1]
+    if W % 128:
+        raise ValueError(f"chunk word-length {W} must be a multiple of 128")
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _compiled_pallas_matmul(C.tobytes(), m, k, W, interpret)(data_u32)
+
+
+def gf_mat_encode_pallas(C: np.ndarray, data: jax.Array,
+                         interpret: "bool | None" = None) -> jax.Array:
+    """uint8 wrapper: (k, L) -> (m, L); L must be a multiple of 512."""
+    if data.shape[-1] % 512:
+        raise ValueError(f"chunk length {data.shape[-1]} must be a multiple of 512")
+    out = gf_mat_encode_pallas_u32(C, bytes_to_u32(data), interpret=interpret)
+    return u32_to_bytes(out)
+
+
+def encode_pallas(data: jax.Array, k: int, m: int,
+                  technique: str = "reed_sol_van",
+                  interpret: "bool | None" = None) -> jax.Array:
+    """(k, L) data chunks -> (m, L) parity chunks on TPU."""
+    C = gf8.generator_matrix(k, m, technique)[k:]
+    return gf_mat_encode_pallas(C, data, interpret=interpret)
+
+
+def decode_pallas(C_decode: np.ndarray, present: jax.Array,
+                  interpret: "bool | None" = None) -> jax.Array:
+    """Apply a host-computed (k, k) decode matrix to k surviving chunks."""
+    return gf_mat_encode_pallas(C_decode, present, interpret=interpret)

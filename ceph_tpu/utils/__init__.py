@@ -1,0 +1,1 @@
+"""Utility helpers: native library binding, misc."""

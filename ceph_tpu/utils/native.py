@@ -1,0 +1,72 @@
+"""Lazy build + ctypes binding for the native host library (native/ec_native.cpp).
+
+The reference dispatches crc32c and EC inner loops to arch-specific native
+code at runtime (src/common/crc32c.cc:17-53 function-pointer dispatch); we do
+the same one level up: if a compiler is available we build the .so on first
+use and bind via ctypes, otherwise callers fall back to numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "ec_native.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libec_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    for flags in (["-O3", "-march=native"], ["-O3"]):
+        cmd = ["g++", *flags, "-shared", "-fPIC", "-o", _SO, _SRC]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if r.returncode == 0:
+            return True
+    return False
+
+
+def get_lib():
+    """Return the loaded ctypes library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.ec_crc32c.restype = ctypes.c_uint32
+        lib.ec_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                  ctypes.c_size_t]
+        PP = ctypes.POINTER(ctypes.c_char_p)
+        lib.ec_encode_swar.restype = None
+        lib.ec_encode_swar.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int, PP, PP, ctypes.c_size_t]
+        lib.ec_region_xor.restype = None
+        lib.ec_region_xor.argtypes = [PP, ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_size_t]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

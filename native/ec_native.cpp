@@ -1,0 +1,120 @@
+// Native host-side EC + checksum primitives for ceph_tpu.
+//
+// Plays two roles:
+//  1. Fast host fallback for environments without a TPU (the analog of the
+//     reference's in-tree SIMD helpers, e.g. src/erasure-code/isa/xor_op.cc
+//     and the arch-dispatched crc32c at src/common/crc32c.cc:17-53).
+//  2. The CPU baseline that bench.py compares the TPU kernels against
+//     (stand-in for ISA-L's ec_encode_data, which lives in an empty
+//     submodule in the reference snapshot).
+//
+// Built by ceph_tpu/utils/native.py with: g++ -O3 -march=native -shared -fPIC.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32c — Castagnoli, reflected poly 0x82F63B78, slicing-by-8.
+// Semantics match ceph_crc32c(seed, data, len): chainable, so
+// crc32c(crc32c(0, A), B) == crc32c(0, A||B).
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_tbl[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  for (int i = 0; i < 256; i++) {
+    uint32_t c = (uint32_t)i;
+    for (int b = 0; b < 8; b++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    crc_tbl[0][i] = c;
+  }
+  for (int t = 1; t < 8; t++)
+    for (int i = 0; i < 256; i++)
+      crc_tbl[t][i] = crc_tbl[0][crc_tbl[t - 1][i] & 0xff] ^ (crc_tbl[t - 1][i] >> 8);
+  crc_init_done = true;
+}
+
+uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, size_t len) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = ~seed;
+  while (len && ((uintptr_t)data & 7)) {
+    c = crc_tbl[0][(c ^ *data++) & 0xff] ^ (c >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint64_t w;
+    std::memcpy(&w, data, 8);
+    w ^= c;
+    c = crc_tbl[7][w & 0xff] ^ crc_tbl[6][(w >> 8) & 0xff] ^
+        crc_tbl[5][(w >> 16) & 0xff] ^ crc_tbl[4][(w >> 24) & 0xff] ^
+        crc_tbl[3][(w >> 32) & 0xff] ^ crc_tbl[2][(w >> 40) & 0xff] ^
+        crc_tbl[1][(w >> 48) & 0xff] ^ crc_tbl[0][(w >> 56) & 0xff];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) c = crc_tbl[0][(c ^ *data++) & 0xff] ^ (c >> 8);
+  return ~c;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) SWAR encode — poly 0x11D, 8 field elements per uint64 lane.
+// out[i] = XOR_j C[i*k+j] * data[j], the ec_encode_data contract
+// (reference src/erasure-code/isa/ErasureCodeIsa.cc:119-131).
+// len must be a multiple of 8.  m <= 8, k <= 32 (framework enforces).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t gf_double64(uint64_t x) {
+  uint64_t msb = (x >> 7) & 0x0101010101010101ull;
+  return ((x << 1) & 0xFEFEFEFEFEFEFEFEull) ^ (msb * 0x1Dull);
+}
+
+void ec_encode_swar(const uint8_t* C, int m, int k,
+                    const uint8_t* const* data, uint8_t* const* out,
+                    size_t len) {
+  // Precompute select masks: mask[j][b][i] = all-ones iff bit b of C[i][j].
+  static thread_local uint64_t mask[32][8][8];
+  for (int j = 0; j < k; j++)
+    for (int b = 0; b < 8; b++)
+      for (int i = 0; i < m; i++)
+        mask[j][b][i] = (uint64_t)0 - (uint64_t)((C[i * k + j] >> b) & 1);
+
+  size_t words = len / 8;
+  for (size_t w = 0; w < words; w++) {
+    uint64_t acc[8] = {0};
+    for (int j = 0; j < k; j++) {
+      uint64_t x;
+      std::memcpy(&x, data[j] + w * 8, 8);
+      for (int b = 0; b < 8; b++) {
+        for (int i = 0; i < m; i++) acc[i] ^= x & mask[j][b][i];
+        x = gf_double64(x);
+      }
+    }
+    for (int i = 0; i < m; i++) std::memcpy(out[i] + w * 8, &acc[i], 8);
+  }
+}
+
+// XOR of k regions into out — the m=1 fast path (analog of the reference's
+// region_xor at src/erasure-code/isa/xor_op.cc).
+void ec_region_xor(const uint8_t* const* data, int k, uint8_t* out,
+                   size_t len) {
+  size_t words = len / 8;
+  for (size_t w = 0; w < words; w++) {
+    uint64_t acc = 0;
+    for (int j = 0; j < k; j++) {
+      uint64_t x;
+      std::memcpy(&x, data[j] + w * 8, 8);
+      acc ^= x;
+    }
+    std::memcpy(out + w * 8, &acc, 8);
+  }
+  for (size_t i = words * 8; i < len; i++) {
+    uint8_t acc = 0;
+    for (int j = 0; j < k; j++) acc ^= data[j][i];
+    out[i] = acc;
+  }
+}
+
+}  // extern "C"

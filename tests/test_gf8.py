@@ -1,0 +1,122 @@
+"""Unit tests for the GF(2^8) host math core.
+
+Modeled on the reference's per-plugin encode/decode round-trip tests
+(src/test/erasure-code/TestErasureCodeJerasure.cc:57 ``encode_decode``,
+TestErasureCodeIsa.cc) — but exercising the math layer directly.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf8
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    a, b, c = rng.integers(0, 256, size=(3, 512)).astype(np.uint8)
+    # Commutativity and associativity of multiply.
+    assert np.array_equal(gf8.gf_mul(a, b), gf8.gf_mul(b, a))
+    assert np.array_equal(
+        gf8.gf_mul(a, gf8.gf_mul(b, c)), gf8.gf_mul(gf8.gf_mul(a, b), c))
+    # Distributivity over XOR (field addition).
+    assert np.array_equal(
+        gf8.gf_mul(a, b ^ c), gf8.gf_mul(a, b) ^ gf8.gf_mul(a, c))
+    # Identity and annihilator.
+    assert np.array_equal(gf8.gf_mul(a, 1), a)
+    assert np.all(gf8.gf_mul(a, 0) == 0)
+
+
+def test_inverse_all_elements():
+    for a in range(1, 256):
+        inv = gf8.gf_inv(a)
+        assert int(gf8.gf_mul(a, inv)) == 1
+
+
+def test_mul_table_matches_gf_mul():
+    tbl = gf8.mul_table()
+    rng = np.random.default_rng(1)
+    a, b = rng.integers(0, 256, size=(2, 1000)).astype(np.uint8)
+    assert np.array_equal(tbl[a, b], gf8.gf_mul(a, b))
+
+
+def _slow_mul(a: int, b: int) -> int:
+    """Independent Russian-peasant carryless multiply mod 0x11D."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= gf8.POLY
+        b >>= 1
+    return r
+
+
+def test_known_products():
+    assert int(gf8.gf_mul(2, 128)) == 0x1D  # poly 0x11D reduction
+    assert gf8.gf_pow(2, 255) == 1
+    rng = np.random.default_rng(9)
+    for a, b in rng.integers(0, 256, size=(200, 2)):
+        assert int(gf8.gf_mul(a, b)) == _slow_mul(int(a), int(b))
+
+
+def test_matrix_invert_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 4, 8):
+        # Random invertible matrix: retry until nonsingular.
+        while True:
+            A = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                Ainv = gf8.gf_matrix_invert(A)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf8.gf_matmul(A, Ainv), np.eye(n, dtype=np.uint8))
+        assert np.array_equal(gf8.gf_matmul(Ainv, A), np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    A = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf8.gf_matrix_invert(A)
+
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy_good"])
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (8, 3), (10, 4)])
+def test_mds_property_exhaustive_erasures(k, m, technique):
+    """Every C(k+m, m) erasure pattern must be decodable — the analog of the
+    reference's exhaustive erasure verification
+    (src/test/erasure-code/ceph_erasure_code_benchmark.cc:202-249)."""
+    G = gf8.generator_matrix(k, m, technique)
+    rng = np.random.default_rng(3)
+    L = 64
+    data = rng.integers(0, 256, size=(k, L)).astype(np.uint8)
+    chunks = gf8.gf_mat_encode(G, data)  # (k+m, L), systematic
+    assert np.array_equal(chunks[:k], data)
+    n_patterns = 0
+    for erased in itertools.combinations(range(k + m), m):
+        avail = {i: chunks[i] for i in range(k + m) if i not in erased}
+        rec = gf8.decode_stripe(avail, k, m, technique)
+        assert np.array_equal(rec, data), f"erasure {erased} failed"
+        n_patterns += 1
+        if n_patterns >= 400:  # cap the largest combos for test runtime
+            break
+
+
+def test_encode_stripe_decode_stripe():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(8, 256)).astype(np.uint8)
+    chunks = gf8.encode_stripe(data, 8, 3)
+    # Lose two data and one parity chunk.
+    avail = {i: chunks[i] for i in range(11) if i not in (0, 5, 9)}
+    rec = gf8.decode_stripe(avail, 8, 3)
+    assert np.array_equal(rec, data)
+
+
+def test_xor_technique():
+    data = np.arange(32, dtype=np.uint8).reshape(4, 8)
+    G = gf8.generator_matrix(4, 1, "xor")
+    chunks = gf8.gf_mat_encode(G, data)
+    assert np.array_equal(chunks[4], data[0] ^ data[1] ^ data[2] ^ data[3])

@@ -1,0 +1,73 @@
+"""JAX SWAR GF matmul vs the numpy golden model (bit-exactness required)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf8, gf_jax
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (10, 4)])
+def test_swar_encode_matches_numpy(k, m):
+    rng = np.random.default_rng(10)
+    C = gf8.vandermonde_matrix(k, m)
+    data = rng.integers(0, 256, size=(k, 4096)).astype(np.uint8)
+    want = gf8.gf_mat_encode(C, data)
+    got = np.asarray(gf_jax.gf_mat_encode(C, data))
+    assert np.array_equal(got, want)
+
+
+def test_swar_cauchy_matches_numpy():
+    rng = np.random.default_rng(11)
+    C = gf8.cauchy_matrix(6, 3)
+    data = rng.integers(0, 256, size=(6, 1024)).astype(np.uint8)
+    assert np.array_equal(
+        np.asarray(gf_jax.gf_mat_encode(C, data)), gf8.gf_mat_encode(C, data))
+
+
+def test_swar_identity_and_zero_rows():
+    data = np.arange(2 * 256, dtype=np.uint8).reshape(2, 256)
+    C = np.array([[1, 0], [0, 0], [0, 2]], dtype=np.uint8)
+    got = np.asarray(gf_jax.gf_mat_encode(C, data))
+    assert np.array_equal(got[0], data[0])
+    assert np.all(got[1] == 0)
+    assert np.array_equal(got[2], gf8.gf_mul(np.uint8(2), data[1]))
+
+
+def test_swar_decode_roundtrip():
+    """Full encode → erase → decode via SWAR matmuls only."""
+    k, m = 8, 3
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, size=(k, 2048)).astype(np.uint8)
+    G = gf8.generator_matrix(k, m)
+    chunks = np.asarray(gf_jax.gf_mat_encode(G, data))
+    erased = (1, 4, 9)
+    rows = [i for i in range(k + m) if i not in erased][:k]
+    D = gf8.decode_matrix(G, k, rows)
+    rec = np.asarray(gf_jax.gf_mat_encode(D, chunks[np.asarray(rows)]))
+    assert np.array_equal(rec, data)
+
+
+def test_traced_matmul_matches_static():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(13)
+    C = gf8.cauchy_matrix(5, 2)
+    data = rng.integers(0, 256, size=(5, 512)).astype(np.uint8)
+    got = np.asarray(gf_jax.gf_mat_encode_traced(jnp.asarray(C), data))
+    assert np.array_equal(got, gf8.gf_mat_encode(C, data))
+
+
+def test_jit_cache_variants():
+    rng = np.random.default_rng(14)
+    data = rng.integers(0, 256, size=(4, 256)).astype(np.uint8)
+    for _ in range(2):  # second call hits the LRU cache
+        C = gf8.vandermonde_matrix(4, 2)
+        got = np.asarray(gf_jax.gf_mat_encode_jit(C, data))
+        assert np.array_equal(got, gf8.gf_mat_encode(C, data))
+
+
+def test_pack_unpack_roundtrip():
+    import jax.numpy as jnp
+    x = np.arange(64, dtype=np.uint8).reshape(2, 32)
+    u = gf_jax.bytes_to_u32(jnp.asarray(x))
+    back = np.asarray(gf_jax.u32_to_bytes(u))
+    assert np.array_equal(back, x)
