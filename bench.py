@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Headline benchmark: fused RS(k=8,m=3) encode + crc32c over 1 MiB stripes.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+
+- value: data throughput (GiB/s of input data) of the flagship fused
+  encode+crc pipeline (ceph_tpu.models.make_encode_step) on the default
+  JAX backend, batch of 8 stripes resident on device.
+- baseline: the same work on the host via the native C++ library
+  (SWAR encode + slicing-by-8 crc32c, single thread) — the stand-in for
+  the reference's ISA-L/jerasure CPU path (BASELINE.md protocol:
+  k=8, m=3, 1 MiB stripe = 128 KiB chunks).
+- vs_baseline = value / baseline.
+
+Robustness: if the TPU backend cannot initialize within a timeout (tunnel
+down), falls back to the JAX CPU backend so a result line is always
+produced (the JSON then reflects CPU-vs-native throughput).
+"""
+
+from __future__ import annotations
+
+
+import ctypes
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+K, M = 8, 3
+CHUNK_BYTES = 128 * 1024       # 1 MiB stripe / k=8
+BATCH = 8
+TRIALS = 30
+
+
+def _init_jax_with_timeout(timeout_s: float = 90.0):
+    """Initialize the default backend; fall back to CPU if it hangs/fails.
+
+    The probe runs in a SUBPROCESS: a wedged accelerator init inside this
+    process would hold JAX's backend lock forever, making any in-process
+    fallback impossible.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    import jax
+
+    if not ok:
+        # Accelerator unreachable; force CPU in a way that survives a
+        # sitecustomize that already imported jax.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from ceph_tpu.utils.platform import honor_jax_platforms_env
+        honor_jax_platforms_env()
+    return jax, jax.devices()[0].platform
+
+
+def bench_device() -> "tuple[float, str]":
+    jax, platform = _init_jax_with_timeout()
+    from ceph_tpu.models import example_batch, make_encode_step
+
+    step = make_encode_step(K, M)
+    data = jax.device_put(example_batch(BATCH, K, CHUNK_BYTES))
+    # Warm-up compile.
+    parity, crcs = step(data)
+    parity.block_until_ready()
+
+    best = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        parity, crcs = step(data)
+        parity.block_until_ready()
+        best.append(time.perf_counter() - t0)
+    dt = float(np.median(best))
+    nbytes = BATCH * K * CHUNK_BYTES
+    return nbytes / dt / 2 ** 30, platform
+
+
+def bench_native_baseline() -> float:
+    """Single-thread C++ SWAR encode + crc32c over the same work."""
+    from ceph_tpu.ops import gf8
+    from ceph_tpu.utils import native
+
+    lib = native.get_lib()
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(K, CHUNK_BYTES), dtype=np.uint8) \
+        .astype(np.uint8)
+    out = np.zeros((M, CHUNK_BYTES), dtype=np.uint8)
+    C = np.ascontiguousarray(gf8.generator_matrix(K, M)[K:])
+
+    if lib is None:
+        # Degenerate numpy fallback baseline.
+        t0 = time.perf_counter()
+        for _ in range(4):
+            gf8.gf_mat_encode(C, data)
+        return K * CHUNK_BYTES * 4 / (time.perf_counter() - t0) / 2 ** 30
+
+    dptrs = (ctypes.c_char_p * K)(*[data[j].ctypes.data for j in range(K)])
+    optrs = (ctypes.c_char_p * M)(*[out[i].ctypes.data for i in range(M)])
+    cbuf = C.tobytes()
+
+    crc_ptrs = [ctypes.cast(data[j].ctypes.data, ctypes.c_char_p)
+                for j in range(K)]
+    crc_ptrs += [ctypes.cast(out[i].ctypes.data, ctypes.c_char_p)
+                 for i in range(M)]
+
+    def one_pass():
+        lib.ec_encode_swar(cbuf, M, K, dptrs, optrs, CHUNK_BYTES)
+        for p in crc_ptrs:
+            lib.ec_crc32c(0, p, CHUNK_BYTES)
+
+    one_pass()  # warm
+    reps = 8  # ~1 MiB stripes x8 ~ same work per trial as the device batch
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            one_pass()
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    return K * CHUNK_BYTES * reps / dt / 2 ** 30
+
+
+def main() -> int:
+    baseline = bench_native_baseline()
+    value, platform = bench_device()
+    print(json.dumps({
+        "metric": f"ec_encode_crc32c_k{K}m{M}_1MiB_stripe_{platform}",
+        "value": round(value, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(value / baseline, 2) if baseline > 0 else None,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Erasure-code subsystem: codec interface, base class, plugin registry.
+
+Rebuild of reference src/erasure-code (see SURVEY.md §2.1).
+"""
+
+from .interface import (ErasureCodeError, ErasureCodeInterface,  # noqa: F401
+                        Profile)
+from .registry import (DEFAULT_PLUGINS, ErasureCodePluginRegistry,  # noqa: F401
+                       factory_from_profile)

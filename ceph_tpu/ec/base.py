@@ -1,0 +1,147 @@
+"""Shared codec logic — the rebuild of Ceph's ErasureCode base class.
+
+Reference: src/erasure-code/ErasureCode.{h,cc}: profile parsing helpers,
+chunk padding/alignment (SIMD_ALIGN=32 at ErasureCode.cc:42; here chunks
+align to 512 B so packed-uint32 device kernels always see whole 128-lane
+tiles), ``encode_prepare`` pad-and-split (ErasureCode.cc:151-186), default
+``encode`` = prepare → encode_chunks (ErasureCode.cc:188), default decode
+zero-fills missing chunks then calls decode_chunks (ErasureCode.cc:212),
+and chunk remapping.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from .interface import (ChunkMap, ErasureCodeError, ErasureCodeInterface,
+                        Profile, SubChunkPlan)
+
+# Chunk alignment in bytes.  The reference aligns to SIMD_ALIGN=32 for CPU
+# vector units; TPU kernels want whole (8 sublane, 128 lane) uint32 tiles,
+# i.e. 512-byte chunks minimum.
+CHUNK_ALIGN = 512
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Base class: geometry, padding, default encode/decode plumbing."""
+
+    def __init__(self) -> None:
+        self._profile: Profile = {}
+        self.k = 0
+        self.m = 0
+
+    # --- profile helpers (analog of ErasureCode::parse / to_int) -------------
+
+    def _parse_int(self, profile: Profile, key: str, default: int) -> int:
+        val = profile.get(key, default)
+        try:
+            out = int(val)
+        except (TypeError, ValueError):
+            raise ErasureCodeError(
+                f"erasure-code profile: {key}={val!r} is not an integer")
+        return out
+
+    def _sanity(self) -> None:
+        if self.k < 1:
+            raise ErasureCodeError(f"k={self.k} must be >= 1")
+        if self.m < 1:
+            raise ErasureCodeError(f"m={self.m} must be >= 1")
+        if self.k + self.m > 256:
+            raise ErasureCodeError(
+                f"k+m={self.k + self.m} exceeds GF(2^8) limit of 256")
+
+    def get_profile(self) -> Profile:
+        return dict(self._profile)
+
+    # --- geometry ------------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_coding_chunk_count(self) -> int:
+        return self.m
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """ceil(stripe_width / k) rounded up to CHUNK_ALIGN
+        (reference ErasureCode::get_chunk_size padding rules)."""
+        if stripe_width <= 0:
+            return CHUNK_ALIGN
+        per = (stripe_width + self.k - 1) // self.k
+        return (per + CHUNK_ALIGN - 1) // CHUNK_ALIGN * CHUNK_ALIGN
+
+    # --- decode planning (reference ErasureCode::_minimum_to_decode) ---------
+
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> SubChunkPlan:
+        want = set(want_to_read)
+        avail = set(available)
+        full = [(0, self.get_sub_chunk_count())]
+        if want <= avail:
+            return {i: list(full) for i in sorted(want)}
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"cannot decode: want {sorted(want)}, only "
+                f"{sorted(avail)} available, need {self.k}")
+        # Prefer chunks we want anyway, then lowest indices (mirrors the
+        # deterministic pick in the reference).
+        pick = sorted(want & avail) + sorted(avail - want)
+        return {i: list(full) for i in sorted(pick[: self.k])}
+
+    def minimum_to_decode_with_cost(self, want_to_read: Sequence[int],
+                                    available: Mapping[int, int]) -> SubChunkPlan:
+        """Pick the k cheapest available chunks (want-first on ties) —
+        reference ErasureCode::minimum_to_decode_with_cost."""
+        want = set(want_to_read)
+        if want <= set(available):
+            return {i: [(0, self.get_sub_chunk_count())] for i in sorted(want)}
+        if len(available) < self.k:
+            raise ErasureCodeError("not enough available chunks")
+        order = sorted(available, key=lambda c: (available[c], c not in want, c))
+        return {i: [(0, self.get_sub_chunk_count())]
+                for i in sorted(order[: self.k])}
+
+    # --- encode path (reference ErasureCode::encode_prepare + encode) --------
+
+    def encode_prepare(self, data: "bytes | np.ndarray") -> np.ndarray:
+        """Pad ``data`` to k*chunk_size and split into (k, chunk_size)
+        (reference ErasureCode.cc:151-186)."""
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data.astype(np.uint8, copy=False)
+        cs = self.get_chunk_size(buf.shape[0])
+        padded = np.zeros(self.k * cs, dtype=np.uint8)
+        padded[: buf.shape[0]] = buf
+        return padded.reshape(self.k, cs)
+
+    def encode(self, want_to_encode: Sequence[int],
+               data: "bytes | np.ndarray") -> ChunkMap:
+        chunks = self.encode_prepare(data)
+        parity = self.encode_chunks(chunks)
+        allc = np.concatenate([chunks, parity], axis=0)
+        bad = [i for i in want_to_encode if not 0 <= i < self.get_chunk_count()]
+        if bad:
+            raise ErasureCodeError(f"want_to_encode out of range: {bad}")
+        return {i: allc[i] for i in want_to_encode}
+
+    # --- decode path (reference ErasureCode::_decode) ------------------------
+
+    def decode(self, want_to_read: Sequence[int], chunks: ChunkMap,
+               chunk_size: int) -> ChunkMap:
+        have = {i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()}
+        for i, c in have.items():
+            if c.shape[0] != chunk_size:
+                raise ErasureCodeError(
+                    f"chunk {i} size {c.shape[0]} != {chunk_size}")
+        missing_want = [i for i in want_to_read if i not in have]
+        if not missing_want:
+            return {i: have[i] for i in want_to_read}
+        if len(have) < self.k:
+            raise ErasureCodeError(
+                f"cannot decode {sorted(missing_want)} from "
+                f"{len(have)} < k={self.k} chunks")
+        out = self.decode_chunks(list(want_to_read), have)
+        return {i: (have[i] if i in have else out[i]) for i in want_to_read}

@@ -1,0 +1,134 @@
+"""Erasure-code codec contract — the rebuild of Ceph's ErasureCodeInterface.
+
+Reference: src/erasure-code/ErasureCodeInterface.h:170 (abstract class), with
+the chunk/stripe model documented at ErasureCodeInterface.h:36-140:
+
+    object → stripes of ``stripe_width = k * chunk_size`` → k data chunks +
+    m coding chunks per stripe; chunk i of every stripe concatenates into
+    shard i.  Array codes additionally split each chunk into sub-chunks
+    (get_sub_chunk_count, ErasureCodeInterface.h:259) so repairs can read
+    fractions of a chunk (CLAY).
+
+Differences from the reference, by design (TPU-first):
+- Buffers are numpy uint8 arrays (host) — the bufferlist role; plugins may
+  additionally expose a device-resident batched path over packed uint32
+  (see JaxRS.encode_device) which the OSD hot path uses to amortize
+  host↔TPU transfers across placement groups.
+- Profiles are ``dict[str, str]`` exactly like the reference's
+  ErasureCodeProfile string map.
+- Errors are exceptions, not int error codes.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Mapping, Sequence
+
+import numpy as np
+
+# Type aliases for readability.
+Profile = dict  # str -> str, the reference's ErasureCodeProfile
+ChunkMap = dict  # chunk index -> np.ndarray(uint8)
+# minimum_to_decode result: chunk index -> list of (sub_chunk_offset, count),
+# matching ErasureCodeInterface.h:297's map<int, vector<pair<int,int>>>.
+SubChunkPlan = dict
+
+
+class ErasureCodeError(Exception):
+    """Codec-level failure (bad profile, undecodable, ...)."""
+
+
+class ErasureCodeInterface(abc.ABC):
+    """Abstract codec.  Method-for-method port of the reference contract."""
+
+    # --- identity / geometry -------------------------------------------------
+
+    @abc.abstractmethod
+    def init(self, profile: Profile) -> None:
+        """Parse and validate ``profile``; fully initialize the codec.
+        (reference :188)"""
+
+    @abc.abstractmethod
+    def get_profile(self) -> Profile:
+        """The profile as completed by init (defaults filled in)."""
+
+    @abc.abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m.  (reference :227)"""
+
+    @abc.abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k.  (reference :234)"""
+
+    @abc.abstractmethod
+    def get_coding_chunk_count(self) -> int:
+        """m.  (reference :241)"""
+
+    def get_sub_chunk_count(self) -> int:
+        """Sub-chunks per chunk; 1 unless an array code (reference :259)."""
+        return 1
+
+    @abc.abstractmethod
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size for an object/stripe of ``stripe_width`` bytes,
+        including padding/alignment.  (reference :278)"""
+
+    # --- decode planning -----------------------------------------------------
+
+    @abc.abstractmethod
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> SubChunkPlan:
+        """Smallest set of chunks (with sub-chunk ranges) that must be read
+        to serve ``want_to_read`` given ``available``.  (reference :297)
+
+        Raises ErasureCodeError if undecodable.
+        """
+
+    def minimum_to_decode_with_cost(self, want_to_read: Sequence[int],
+                                    available: Mapping[int, int]) -> SubChunkPlan:
+        """Like minimum_to_decode but ``available`` maps chunk -> cost;
+        default ignores costs.  (reference :326)"""
+        return self.minimum_to_decode(want_to_read, list(available.keys()))
+
+    # --- encode / decode -----------------------------------------------------
+
+    @abc.abstractmethod
+    def encode(self, want_to_encode: Sequence[int],
+               data: "bytes | np.ndarray") -> ChunkMap:
+        """Pad+split ``data`` into k chunks, compute m coding chunks, return
+        the requested subset.  (reference :365)"""
+
+    @abc.abstractmethod
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        """(k, chunk_size) -> (m, chunk_size); raw codec math, no padding.
+        (reference :370)"""
+
+    @abc.abstractmethod
+    def decode(self, want_to_read: Sequence[int], chunks: ChunkMap,
+               chunk_size: int) -> ChunkMap:
+        """Reconstruct ``want_to_read`` chunk indices from ``chunks``.
+        (reference :407)"""
+
+    @abc.abstractmethod
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        """Raw reconstruction from available chunks (all same size).
+        (reference :411)"""
+
+    # --- layout --------------------------------------------------------------
+
+    def get_chunk_mapping(self) -> "list[int]":
+        """Optional remapping: position i in the acting set holds chunk
+        mapping[i].  Empty = identity.  (reference :448)"""
+        return []
+
+    def decode_concat(self, chunks: ChunkMap) -> np.ndarray:
+        """Decode data chunks and concatenate in order — the read path's
+        convenience entry (reference :460)."""
+        k = self.get_data_chunk_count()
+        want = list(range(k))
+        sizes = {c.shape[0] for c in chunks.values()}
+        if len(sizes) != 1:
+            raise ErasureCodeError(f"mixed chunk sizes {sizes}")
+        out = self.decode(want, chunks, sizes.pop())
+        return np.concatenate([out[i] for i in want])

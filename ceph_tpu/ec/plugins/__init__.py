@@ -1,0 +1,12 @@
+"""Built-in erasure-code plugins.
+
+Each module is a plugin: it must expose ``__erasure_code_version__`` and
+``__erasure_code_init__(registry, name)`` (see ec/registry.py for the
+handshake, mirroring reference src/erasure-code/ErasureCodePlugin.cc).
+
+- jax_rs    — flagship TPU Reed-Solomon (Vandermonde/Cauchy/RAID-6).
+- xor       — minimal example codec (API fixture analog).
+- lrc       — locally-repairable layered code.
+- isa       — ISA-L profile compatibility (executes via jax_rs).
+- jerasure  — jerasure profile compatibility (executes via jax_rs).
+"""

@@ -1,0 +1,28 @@
+"""isa — profile-compatibility plugin mapping ISA-L profiles onto JaxRS.
+
+Accepts the reference isa plugin's profile surface
+(src/erasure-code/isa/ErasureCodeIsa.cc: techniques ``reed_sol_van``
+default and ``cauchy``; k=7 m=3 defaults) so existing ec-profiles and
+bench invocations run unchanged, executing on the TPU backend.
+"""
+
+from __future__ import annotations
+
+from ..interface import Profile
+from .jax_rs import JaxRS
+
+__erasure_code_version__ = "1"
+
+
+class ErasureCodeIsaCompat(JaxRS):
+    DEFAULT_K = 7
+    DEFAULT_M = 3
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeIsaCompat:
+        codec = ErasureCodeIsaCompat()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

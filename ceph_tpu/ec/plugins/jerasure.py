@@ -1,0 +1,36 @@
+"""jerasure — profile-compatibility plugin mapping jerasure profiles onto JaxRS.
+
+Accepts the reference jerasure plugin's profile surface (7 techniques,
+``packetsize`` knob, k=2 m=1 defaults — src/erasure-code/jerasure/
+ErasureCodeJerasure.h:81-240) so existing ec-profiles run unchanged on the
+TPU backend.  ``packetsize`` only shaped the CPU bit-matrix schedules; it
+is parsed and recorded but has no TPU meaning.
+"""
+
+from __future__ import annotations
+
+from ..interface import Profile
+from .jax_rs import JaxRS
+
+__erasure_code_version__ = "1"
+
+
+class ErasureCodeJerasureCompat(JaxRS):
+    DEFAULT_K = 2
+    DEFAULT_M = 1
+
+    def init(self, profile: Profile) -> None:
+        # Parse for validation parity; value intentionally unused on TPU.
+        self._parse_int(profile, "packetsize", 2048)
+        super().init(profile)
+        self._profile.setdefault("packetsize",
+                                 str(profile.get("packetsize", 2048)))
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeJerasureCompat:
+        codec = ErasureCodeJerasureCompat()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

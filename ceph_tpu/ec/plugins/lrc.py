@@ -1,0 +1,319 @@
+"""lrc — locally-repairable layered code (rebuild of the reference lrc plugin).
+
+Reference: src/erasure-code/lrc/ErasureCodeLrc.{h,cc}.  A code is a list of
+*layers*, each a (chunks_map, sub-profile) pair over a global chunk layout:
+
+- ``mapping`` string over all chunk positions: 'D' = user data, anything
+  else = some layer's parity output (reference ErasureCodeLrc.h:51-61).
+- each layer's ``chunks_map``: 'D' = layer input, 'c' = layer parity
+  output, '_' = not in layer.  Later layers may consume earlier layers'
+  outputs (a local layer typically covers a group containing one global
+  parity).
+- ``k/m/l`` shorthand generates mapping+layers (reference ``parse_kml``):
+  (k+m) must divide into groups of l payload positions; each group is
+  prefixed with one local XOR-style parity; the m global parities are
+  distributed round-robin one-per-group at the front of each group's
+  payload, e.g. k=4 m=2 l=3 → mapping ``"__DD__DD"`` with layers
+  ``["_cDD_cDD", "cDDD____", "____cDDD"]`` (matches the reference docs).
+
+Decode walks layers reusing chunks recovered by earlier passes
+(reference ErasureCodeLrc.cc:777-860); ``minimum_to_decode`` prefers the
+cheapest (most local) layer that can repair the loss
+(reference ErasureCodeLrc.cc:566).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+import numpy as np
+
+from ..base import ErasureCode
+from ..interface import ChunkMap, ErasureCodeError, Profile
+
+__erasure_code_version__ = "1"
+
+
+class _Layer:
+    """One layer: positions, sub-codec, and the local index bookkeeping."""
+
+    def __init__(self, chunks_map: str, sub_profile: Profile, registry):
+        self.chunks_map = chunks_map
+        self.data_pos = [i for i, ch in enumerate(chunks_map) if ch == "D"]
+        self.coding_pos = [i for i, ch in enumerate(chunks_map) if ch == "c"]
+        self.positions = self.data_pos + self.coding_pos
+        prof = dict(sub_profile)
+        prof.setdefault("plugin", "jax_rs")
+        prof["k"] = str(len(self.data_pos))
+        prof["m"] = str(len(self.coding_pos))
+        self.codec = registry.factory(prof["plugin"], prof)
+
+    def encode(self, chunks: "dict[int, np.ndarray]") -> None:
+        """Fill this layer's coding positions from its data positions."""
+        data = np.stack([chunks[p] for p in self.data_pos])
+        parity = self.codec.encode_chunks(data)
+        for n, p in enumerate(self.coding_pos):
+            chunks[p] = parity[n]
+
+    def try_recover(self, chunks: "dict[int, np.ndarray]") -> "list[int]":
+        """Recover any of this layer's missing chunks if possible; returns
+        the global positions recovered."""
+        present_local = {n: chunks[p] for n, p in enumerate(self.positions)
+                         if p in chunks}
+        missing_local = [n for n, p in enumerate(self.positions)
+                         if p not in chunks]
+        if not missing_local or len(present_local) < len(self.data_pos):
+            return []
+        try:
+            out = self.codec.decode_chunks(missing_local, present_local)
+        except ErasureCodeError:
+            return []
+        recovered = []
+        for n in missing_local:
+            chunks[self.positions[n]] = out[n]
+            recovered.append(self.positions[n])
+        return recovered
+
+
+def parse_kml(k: int, m: int, l: int) -> "tuple[str, list]":
+    """Generate mapping + layers from k/m/l (reference parse_kml)."""
+    if l < 2:
+        raise ErasureCodeError(f"l={l} must be >= 2")
+    if (k + m) % l:
+        raise ErasureCodeError(
+            f"k+m={k + m} must be a multiple of l={l}")
+    n_groups = (k + m) // l
+    width = k + m + n_groups
+    # Group g occupies positions [g*(l+1), (g+1)*(l+1)): local parity first,
+    # then l payload slots.
+    payload = []  # global position of each payload slot, in order
+    for g in range(n_groups):
+        base = g * (l + 1)
+        payload.extend(range(base + 1, base + 1 + l))
+    # Distribute m global parities round-robin, one per group front slot.
+    global_parity: "list[int]" = []
+    offset = 0
+    while len(global_parity) < m:
+        for g in range(n_groups):
+            if len(global_parity) >= m:
+                break
+            global_parity.append(g * (l + 1) + 1 + offset)
+        offset += 1
+    data_pos = [p for p in payload if p not in global_parity][:k]
+
+    mapping = "".join("D" if p in data_pos else "_" for p in range(width))
+    glayer = "".join(
+        "D" if p in data_pos else ("c" if p in global_parity else "_")
+        for p in range(width))
+    layers = [[glayer, ""]]
+    for g in range(n_groups):
+        base = g * (l + 1)
+        lmap = "".join(
+            "c" if p == base else ("D" if base < p < base + l + 1 else "_")
+            for p in range(width))
+        layers.append([lmap, ""])
+    return mapping, layers
+
+
+class ErasureCodeLrc(ErasureCode):
+    def __init__(self) -> None:
+        super().__init__()
+        self.mapping = ""
+        self.layers: "list[_Layer]" = []
+
+    def init(self, profile: Profile) -> None:
+        from ..registry import ErasureCodePluginRegistry
+        registry = ErasureCodePluginRegistry.instance()
+
+        if "mapping" in profile or "layers" in profile:
+            if "mapping" not in profile or "layers" not in profile:
+                raise ErasureCodeError(
+                    "lrc: mapping and layers must be given together")
+            mapping = str(profile["mapping"])
+            layers_spec = profile["layers"]
+            if isinstance(layers_spec, str):
+                layers_spec = json.loads(layers_spec)
+        else:
+            k = self._parse_int(profile, "k", 4)
+            m = self._parse_int(profile, "m", 2)
+            l = self._parse_int(profile, "l", 3)
+            mapping, layers_spec = parse_kml(k, m, l)
+
+        self.mapping = mapping
+        width = len(mapping)
+        self.layers = []
+        for entry in layers_spec:
+            if isinstance(entry, (list, tuple)):
+                cmap, sub = entry[0], (entry[1] if len(entry) > 1 else "")
+            else:
+                cmap, sub = entry, ""
+            if len(cmap) != width:
+                raise ErasureCodeError(
+                    f"lrc: layer map {cmap!r} length != mapping {mapping!r}")
+            sub_profile = self._parse_sub_profile(sub, profile)
+            self.layers.append(_Layer(cmap, sub_profile, registry))
+
+        self.k = mapping.count("D")
+        self.m = width - self.k
+        self._sanity()
+        covered = set()
+        for layer in self.layers:
+            covered.update(layer.coding_pos)
+        uncovered = [p for p in range(width)
+                     if mapping[p] != "D" and p not in covered]
+        if uncovered:
+            raise ErasureCodeError(
+                f"lrc: parity positions {uncovered} produced by no layer")
+        prof = dict(profile)
+        prof.update(plugin="lrc", mapping=mapping,
+                    layers=json.dumps([[l.chunks_map, ""] for l in self.layers]))
+        self._profile = prof
+
+    @staticmethod
+    def _parse_sub_profile(sub, parent: Profile) -> Profile:
+        """Layer sub-profile: dict, or "plugin key=val ..." string
+        (reference layer syntax, e.g. "jerasure k=4 m=2")."""
+        if isinstance(sub, dict):
+            return dict(sub)
+        out: Profile = {}
+        parts = str(sub).split()
+        if parts and "=" not in parts[0]:
+            out["plugin"] = {"jerasure": "jax_rs", "isa": "jax_rs"}.get(
+                parts[0], parts[0])
+            parts = parts[1:]
+        for p in parts:
+            if "=" in p:
+                key, val = p.split("=", 1)
+                out[key] = val
+        if "technique" in parent and "technique" not in out:
+            out["technique"] = parent["technique"]
+        return out
+
+    # --- geometry: LRC data chunks are the 'D' positions ---------------------
+
+    def get_chunk_mapping(self) -> "list[int]":
+        """Data is written to the 'D' positions of ``mapping``; expose the
+        position-of-chunk-i list (reference get_chunk_mapping)."""
+        data_pos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        other = [i for i, ch in enumerate(self.mapping) if ch != "D"]
+        return data_pos + other
+
+    # --- encode --------------------------------------------------------------
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"got {data_chunks.shape[0]} chunks, k={self.k}")
+        data_pos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        chunks: "dict[int, np.ndarray]" = {
+            p: data_chunks[n] for n, p in enumerate(data_pos)}
+        for layer in self.layers:
+            missing_inputs = [p for p in layer.data_pos if p not in chunks]
+            if missing_inputs:
+                raise ErasureCodeError(
+                    f"lrc: layer {layer.chunks_map!r} inputs {missing_inputs} "
+                    f"not yet produced — bad layer order")
+            layer.encode(chunks)
+        parity_pos = [p for p in range(len(self.mapping))
+                      if self.mapping[p] != "D"]
+        return np.stack([chunks[p] for p in parity_pos])
+
+    def encode(self, want_to_encode: Sequence[int], data) -> ChunkMap:
+        """Global-position chunk map (data at 'D' positions)."""
+        prepared = self.encode_prepare(data)
+        parity = self.encode_chunks(prepared)
+        data_pos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        parity_pos = [p for p in range(len(self.mapping))
+                      if self.mapping[p] != "D"]
+        allc: "dict[int, np.ndarray]" = {}
+        for n, p in enumerate(data_pos):
+            allc[p] = prepared[n]
+        for n, p in enumerate(parity_pos):
+            allc[p] = parity[n]
+        bad = [i for i in want_to_encode if i not in allc]
+        if bad:
+            raise ErasureCodeError(f"want_to_encode out of range: {bad}")
+        return {i: allc[i] for i in want_to_encode}
+
+    # --- decode --------------------------------------------------------------
+
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        have = {i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()}
+        # Iterate layers until no progress (reference walks layers reusing
+        # earlier recoveries, ErasureCodeLrc.cc:777-860).
+        while any(i not in have for i in want_to_read):
+            progress = []
+            for layer in self.layers:
+                progress.extend(layer.try_recover(have))
+            if not progress:
+                missing = [i for i in want_to_read if i not in have]
+                raise ErasureCodeError(
+                    f"lrc: chunks {missing} unrecoverable from "
+                    f"{sorted(chunks)}")
+        return {i: have[i] for i in want_to_read}
+
+    def decode(self, want_to_read: Sequence[int], chunks: ChunkMap,
+               chunk_size: int) -> ChunkMap:
+        return self.decode_chunks(want_to_read,
+                                  {i: np.asarray(c, dtype=np.uint8)
+                                   for i, c in chunks.items()})
+
+    def decode_concat(self, chunks: ChunkMap) -> np.ndarray:
+        data_pos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        out = self.decode_chunks(data_pos, chunks)
+        return np.concatenate([out[p] for p in data_pos])
+
+    # --- planning: prefer the most local layer -------------------------------
+
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> "dict":
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return {i: [(0, 1)] for i in sorted(want)}
+        # Simulate layer recovery, preferring smaller layers first
+        # (reference _minimum_to_decode picks the cheapest layer,
+        # ErasureCodeLrc.cc:566).  A layer is only worth repairing if it
+        # recovers a chunk we still need — repairing unrelated losses would
+        # add reads and defeat LRC's locality.  If no layer recovers a
+        # needed chunk directly, fall back to any recoverable layer (its
+        # outputs may be inputs to the layer that can, e.g. a local group
+        # restoring a global parity before the global layer runs).
+        have = set(avail)
+        reads: "set[int]" = set(want & avail)
+        ordered = sorted(self.layers, key=lambda la: len(la.positions))
+        while not want <= have:
+            candidates = []  # (recovers_needed, layer, missing, present)
+            for layer in ordered:
+                missing_in_layer = [p for p in layer.positions
+                                    if p not in have]
+                if not missing_in_layer:
+                    continue
+                present = [p for p in layer.positions if p in have]
+                if len(present) < len(layer.data_pos):
+                    continue
+                recovers_needed = any(p in want for p in missing_in_layer)
+                candidates.append(
+                    (recovers_needed, layer, missing_in_layer, present))
+            pick = next((c for c in candidates if c[0]),
+                        candidates[0] if candidates else None)
+            if pick is None:
+                raise ErasureCodeError(
+                    f"lrc: cannot plan decode of {sorted(want - have)} "
+                    f"from {sorted(avail)}")
+            _, layer, missing_in_layer, present = pick
+            reads.update(present[: len(layer.data_pos)])
+            have.update(missing_in_layer)
+        return {i: [(0, 1)] for i in sorted(reads & avail)}
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeLrc:
+        codec = ErasureCodeLrc()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

@@ -1,0 +1,67 @@
+"""xor — minimal no-dependency example codec (k data + 1 XOR parity).
+
+The analog of the reference's API fixture plugin ErasureCodeExample
+(src/test/erasure-code/ErasureCodeExample.h, XOR parity): the simplest
+complete implementation of the codec contract, used by registry tests and
+as a template for out-of-tree plugins.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ...utils import native
+from ..base import ErasureCode
+from ..interface import ChunkMap, ErasureCodeError, Profile
+
+__erasure_code_version__ = "1"
+
+
+class ErasureCodeXor(ErasureCode):
+    def init(self, profile: Profile) -> None:
+        self.k = self._parse_int(profile, "k", 2)
+        self.m = 1
+        if "m" in profile and int(profile["m"]) != 1:
+            raise ErasureCodeError("xor plugin supports m=1 only")
+        self._sanity()
+        prof = dict(profile)
+        prof.update(plugin="xor", k=str(self.k), m="1")
+        self._profile = prof
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        lib = native.get_lib()
+        if lib is not None and data_chunks.flags.c_contiguous:
+            import ctypes
+            out = np.zeros(data_chunks.shape[1], dtype=np.uint8)
+            ptrs = (ctypes.c_char_p * self.k)(
+                *[data_chunks[j].ctypes.data for j in range(self.k)])
+            lib.ec_region_xor(ptrs, self.k,
+                              out.ctypes.data_as(ctypes.c_char_p), out.nbytes)
+            return out[None, :]
+        return np.bitwise_xor.reduce(data_chunks, axis=0)[None, :]
+
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        if len(chunks) < self.k:
+            raise ErasureCodeError(
+                f"xor decode needs {self.k} of {self.k + 1} chunks")
+        missing = [i for i in range(self.k + 1) if i not in chunks]
+        out: ChunkMap = {i: np.asarray(c, dtype=np.uint8)
+                         for i, c in chunks.items()}
+        if missing:
+            (lost,) = missing  # at most one with m=1
+            out[lost] = np.bitwise_xor.reduce(
+                np.stack([out[i] for i in out]), axis=0)
+        return {i: out[i] for i in want_to_read}
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeXor:
+        codec = ErasureCodeXor()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

@@ -1,0 +1,173 @@
+"""Erasure-code plugin registry — the rebuild of ErasureCodePluginRegistry.
+
+Reference: src/erasure-code/ErasureCodePlugin.{h,cc}.  The reference
+``dlopen``s ``libec_<name>.so``, checks the ``__erasure_code_version``
+symbol against the build version, then calls the ``__erasure_code_init``
+entry point which registers a factory (ErasureCodePlugin.cc:124-182).
+
+Here a plugin is a Python module: built-ins under
+``ceph_tpu.ec.plugins.<name>``; out-of-tree plugins load from
+``<directory>/<name>.py`` (the ``erasure_code_dir`` option, reference
+src/common/options.cc:558).  Handshake, mirrored exactly:
+
+- module attribute ``__erasure_code_version__`` must equal
+  ``ceph_tpu.PLUGIN_API_VERSION`` (version-mismatch fixture coverage),
+- module function ``__erasure_code_init__(registry, name)`` must call
+  ``registry.add(name, factory)`` (missing-entry-point / fail-to-register /
+  fail-to-initialize fixture coverage, matching the hostile .so fixtures in
+  reference src/test/erasure-code/ErasureCodePlugin*.cc),
+- loads run under a watchdog timeout (the analog of testing
+  ErasureCodePluginHangs.cc's sleep-in-init).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import importlib
+import importlib.util
+import os
+import threading
+from typing import Callable, Optional
+
+from .. import PLUGIN_API_VERSION
+from .interface import ErasureCodeError, ErasureCodeInterface, Profile
+
+Factory = Callable[[Profile], ErasureCodeInterface]
+
+# Default preload set (analog of option ``osd_erasure_code_plugins``,
+# reference src/common/options.cc:2598, default "jerasure lrc isa").
+DEFAULT_PLUGINS = ("jax_rs", "xor", "lrc", "isa", "jerasure")
+
+
+class ErasureCodePluginRegistry:
+    """Process-wide singleton mapping plugin name -> factory."""
+
+    _instance: "Optional[ErasureCodePluginRegistry]" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._factories: "dict[str, Factory]" = {}
+        self._lock = threading.Lock()
+        self.disable_dlclose = False  # parity knob; unused (no dlopen)
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # --- registration (called by plugin entry points) ------------------------
+
+    def add(self, name: str, factory: Factory) -> None:
+        with self._lock:
+            if name in self._factories:
+                raise ErasureCodeError(f"plugin {name!r} already registered")
+            self._factories[name] = factory
+
+    def get(self, name: str) -> Optional[Factory]:
+        with self._lock:
+            return self._factories.get(name)
+
+    def names(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._factories)
+
+    # --- loading -------------------------------------------------------------
+
+    def _import_plugin_module(self, name: str, directory: Optional[str]):
+        if directory:
+            path = os.path.join(directory, f"{name}.py")
+            if not os.path.exists(path):
+                raise ErasureCodeError(
+                    f"load dlopen({path}): file not found")
+            spec = importlib.util.spec_from_file_location(
+                f"ceph_tpu_ec_plugin_{name}", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)  # type: ignore[union-attr]
+            return mod
+        try:
+            return importlib.import_module(f"ceph_tpu.ec.plugins.{name}")
+        except ImportError as e:
+            raise ErasureCodeError(f"load: plugin {name!r} not found: {e}")
+
+    def load(self, name: str, directory: Optional[str] = None,
+             timeout: Optional[float] = None) -> Factory:
+        """Import + handshake + run the plugin entry point.
+
+        ``timeout`` guards against plugins that hang in init (reference
+        hostile fixture ErasureCodePluginHangs.cc sleeps 10 s).
+        """
+        existing = self.get(name)
+        if existing is not None:
+            return existing
+
+        def _do_load() -> Factory:
+            mod = self._import_plugin_module(name, directory)
+            version = getattr(mod, "__erasure_code_version__", None)
+            if version is None:
+                raise ErasureCodeError(
+                    f"load: {name!r} has no __erasure_code_version__")
+            if version != PLUGIN_API_VERSION:
+                raise ErasureCodeError(
+                    f"load: {name!r} version {version!r} != expected "
+                    f"{PLUGIN_API_VERSION!r}")
+            entry = getattr(mod, "__erasure_code_init__", None)
+            if entry is None:
+                raise ErasureCodeError(
+                    f"load: {name!r} has no __erasure_code_init__ entry point")
+            try:
+                entry(self, name)
+            except ErasureCodeError:
+                # Lost a benign race: another thread loaded the same plugin
+                # between our get() and the entry point's add().
+                raced = self.get(name)
+                if raced is not None:
+                    return raced
+                raise
+            factory = self.get(name)
+            if factory is None:
+                raise ErasureCodeError(
+                    f"load: {name!r} init did not register a factory")
+            return factory
+
+        if timeout is None:
+            return _do_load()
+        # No context manager: ThreadPoolExecutor.__exit__ joins the worker,
+        # which would block for the full duration of a hung plugin — the
+        # exact failure the timeout exists to bound.
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = ex.submit(_do_load)
+        try:
+            return fut.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            raise ErasureCodeError(
+                f"load: plugin {name!r} timed out after {timeout}s")
+        finally:
+            ex.shutdown(wait=False)
+
+    def preload(self, plugins: "tuple[str, ...]" = DEFAULT_PLUGINS,
+                directory: Optional[str] = None) -> "list[str]":
+        """Load a set of plugins at daemon start (reference
+        global_init_preload_erasure_code, src/global/global_init.cc:567-611).
+        Any failure propagates (a daemon must not boot half-loaded);
+        returns the plugin names for log parity."""
+        for name in plugins:
+            self.load(name, directory=directory)
+        return list(plugins)
+
+    def factory(self, name: str, profile: Profile,
+                directory: Optional[str] = None) -> ErasureCodeInterface:
+        """Instantiate + init a codec from a profile (reference
+        ErasureCodePluginRegistry::factory, ErasureCodePlugin.cc:90)."""
+        f = self.load(name, directory=directory)
+        codec = f(dict(profile))
+        return codec
+
+
+def factory_from_profile(profile: Profile,
+                         directory: Optional[str] = None) -> ErasureCodeInterface:
+    """Instantiate from a profile's own ``plugin`` key (the OSD-side path:
+    pool ec-profile -> PGBackend build, reference PGBackend.cc:532-569)."""
+    name = profile.get("plugin", "jax_rs")
+    return ErasureCodePluginRegistry.instance().factory(name, profile, directory)

@@ -1,0 +1,4 @@
+"""Flagship end-to-end pipelines (bench + graft entry points)."""
+
+from .pipeline import (example_batch, make_decode_step,  # noqa: F401
+                       make_encode_step)

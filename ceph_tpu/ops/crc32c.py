@@ -159,11 +159,14 @@ def _compiled_words_crc(n_chunks: int, n_words: int, seg_words: int):
                              & jnp.uint32(m32_cols[i]))
             return acc
 
-        state0 = jnp.zeros((n_chunks, S), dtype=jnp.uint32)
+        # zeros_like keeps shard_map varying-axis types consistent when this
+        # kernel runs inside a shard_map region (plain jnp.zeros would be
+        # device-invariant and fail the scan carry type check).
+        state0 = jnp.zeros_like(words3[:, :, 0])
         regs = jax.lax.fori_loop(0, W, word_step, state0)          # (C, S)
 
         # Merge: XOR_i merge[i] . regs[:, i]
-        total = jnp.zeros((n_chunks,), dtype=jnp.uint32)
+        total = jnp.zeros_like(regs[:, 0])
         for b in range(32):
             bit = (regs >> b) & 1                                  # (C, S)
             sel = (jnp.uint32(0) - bit) & jnp.asarray(merge[:, b]) # (C, S)

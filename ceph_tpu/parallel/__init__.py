@@ -1,0 +1,3 @@
+"""Device-mesh parallelism: sharded EC pipelines over (pg, shard) meshes."""
+
+from .distributed import DistributedEC, default_geometry, make_mesh  # noqa: F401

@@ -1,0 +1,193 @@
+"""Mesh-sharded distributed EC: the ICI/DCN data plane.
+
+This is the TPU-native replacement for the reference's shard fan-out over
+the cluster messenger (primary → k+m-1 MOSDECSubOpWrite sends,
+src/osd/ECBackend.cc:2074-2084, and recovery reads →
+objects_read_and_reconstruct, ECBackend.cc:2345): when shards live on
+devices of one slice, the fan-out becomes sharded arrays + XLA collectives
+riding ICI, and the host messenger (ceph_tpu.msg) is only used across
+hosts.
+
+Mesh axes:
+- ``pg``    — placement-group batch parallelism: independent stripe groups
+  on independent device groups (the cross-PG batching of SURVEY.md §7.6).
+- ``shard`` — chunk parallelism: device d of the shard ring stores chunk d
+  (data chunks on devices 0..k-1, parity on k..k+m-1), mirroring the
+  distinguished acting-set positions of EC pools.
+
+Collective design (shard axis of size s = k+m):
+- **encode**: every device computes its local partial products
+  C[:, d] * x_d, then an XOR ring all-reduce — (s-1) ``ppermute`` hops of
+  ``acc = shift(acc) ^ partial`` — lands the full parity sums everywhere;
+  parity devices keep their row, data devices keep their chunk.  Bandwidth
+  per hop is m*W words on ICI, the collective analog of the reference's
+  m sub-write messages.
+- **reconstruct**: ``all_gather`` the survivor mask's chunks along the
+  shard ring, then each device applies the host-cached decode matrix to
+  rebuild its own chunk (only erased positions actually change).
+- per-shard crc32c runs locally on each device after encode
+  (the handle_sub_read/write hash checks, ECBackend.cc:1080-1093).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import crc32c as crc_ops
+from ..ops import gf8, gf_jax
+
+
+def make_mesh(n_devices: int, shard_size: int) -> Mesh:
+    """(pg, shard) mesh over the first n_devices; shard axis = k+m."""
+    if n_devices % shard_size:
+        raise ValueError(f"{n_devices} devices not divisible by "
+                         f"shard axis {shard_size}")
+    devs = np.array(jax.devices()[:n_devices]).reshape(
+        n_devices // shard_size, shard_size)
+    return Mesh(devs, ("pg", "shard"))
+
+
+def default_geometry(n_devices: int) -> "tuple[int, int, int]":
+    """Pick (k, m, shard_axis) for a device count: largest shard ring that
+    divides n, with m parity ~ 1/3 (mirrors common k=2m pools)."""
+    for s in (8, 4, 2):
+        if n_devices % s == 0 and n_devices >= s:
+            m = max(1, s // 3)
+            return s - m, m, s
+    raise ValueError(f"unsupported device count {n_devices}")
+
+
+def _pick_seg_words(W: int) -> int:
+    """Segment length for the parallel crc: ~sqrt(W) divisor of W, keeping
+    both the scan length and the host-side merge-operator count modest."""
+    target = max(1, int(W ** 0.5))
+    for seg in range(target, 0, -1):
+        if W % seg == 0:
+            return seg
+    return 1
+
+
+class DistributedEC:
+    """Sharded EC write/read pipeline over a (pg, shard) mesh."""
+
+    def __init__(self, mesh: Mesh, k: int, m: int,
+                 technique: str = "reed_sol_van"):
+        s = mesh.shape["shard"]
+        if s != k + m:
+            raise ValueError(f"shard axis {s} != k+m={k + m}")
+        self.mesh, self.k, self.m, self.technique = mesh, k, m, technique
+        self._G = gf8.generator_matrix(k, m, technique)
+
+    # --- write: encode + per-shard crc --------------------------------------
+
+    def write_step(self):
+        """jitted fn: data (B, s, W) uint32 [B sharded over pg, chunk dim
+        over shard; parity positions' input ignored] -> (shards, crcs)
+        with the same sharding."""
+        k, m, s = self.k, self.m, self.k + self.m
+        C = self._G[k:]
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=P("pg", "shard", None),
+            out_specs=(P("pg", "shard", None), P("pg", "shard")),
+        )
+        def step(data):  # local view: (B/pg, 1, W)
+            x = data[:, 0, :]  # (b, W)
+            d = jax.lax.axis_index("shard")
+            # Partial parity products from this device's data chunk:
+            # coeff[i] = C[i, d] for data devices, 0 on parity devices.
+            Cpad = jnp.asarray(
+                np.concatenate([C, np.zeros((m, m), np.uint8)], axis=1))
+            coeff = Cpad[:, d]  # (m,) uint8, traced index
+            partial = _scale_rows(coeff, x)  # (m, b, W)
+            perm = [(i, (i + 1) % s) for i in range(s)]
+
+            def hop(acc, _):
+                return jax.lax.ppermute(acc, "shard", perm) ^ partial, None
+
+            acc, _ = jax.lax.scan(hop, partial, None, length=s - 1)
+            parity_row = acc[jnp.clip(d - k, 0, m - 1)]  # (b, W)
+            mine = jnp.where(d < k, x, parity_row)
+            crcs = crc_ops.crc32c_words_jax(
+                mine, seg_words=_pick_seg_words(mine.shape[-1]))
+            return mine[:, None, :], crcs[:, None]
+
+        return jax.jit(step)
+
+    # --- read repair: all-gather survivors, decode locally -------------------
+
+    def reconstruct_step(self, erased: "tuple[int, ...]"):
+        """jitted fn for a static erasure signature: shards (B, s, W) with
+        garbage at erased positions -> repaired (B, s, W)."""
+        k, m, s = self.k, self.m, self.k + self.m
+        rows = tuple(i for i in range(s) if i not in erased)[:k]
+        D = gf8.decode_matrix(self._G, k, list(rows))     # (k, k)
+        # Rebuild matrix for every position: data rows from D, parity rows
+        # re-encoded: R = G @ D, shape (s, k); R[i] applied to survivors
+        # gives chunk i.
+        R = gf8.gf_matmul(self._G, D)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=P("pg", "shard", None),
+            out_specs=P("pg", "shard", None),
+        )
+        def step(shards):  # local: (b, 1, W)
+            mine = shards[:, 0, :]
+            d = jax.lax.axis_index("shard")
+            gathered = jax.lax.all_gather(mine, "shard", axis=1)  # (b, s, W)
+            survivors = gathered[:, np.asarray(rows), :]          # (b, k, W)
+            Rj = jnp.asarray(R)[d]                                # (k,) uint8
+            # chunk_d = XOR_j R[d, j] * survivor_j
+            rebuilt = _dot_row(Rj, survivors)
+            if erased:
+                is_erased = (jnp.asarray(np.asarray(erased, np.int32)) == d).any()
+            else:
+                is_erased = jnp.zeros((), bool)
+            out = jnp.where(is_erased, rebuilt, mine)
+            return out[:, None, :]
+
+        return jax.jit(step)
+
+    # --- sharding helpers ----------------------------------------------------
+
+    def data_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("pg", "shard", None))
+
+
+def _scale_rows(coeff, x):
+    """(m,) uint8 traced coefficients × (b, W) uint32 chunk → (m, b, W):
+    per-row GF scalar multiply via the 8-step doubling ladder."""
+    m = coeff.shape[0]
+    acc = jnp.zeros((m,) + x.shape, jnp.uint32)
+    xp = x
+    c32 = coeff.astype(jnp.uint32)
+    for b in range(8):
+        bit = (c32 >> b) & 1                       # (m,)
+        mask = (jnp.uint32(0) - bit)[:, None, None]
+        acc = acc ^ (mask & xp[None])
+        if b < 7:
+            xp = gf_jax.gf_double_u32(xp)
+    return acc
+
+
+def _dot_row(coeff, chunks):
+    """(k,) uint8 traced row × (b, k, W) uint32 → (b, W) GF inner product."""
+    k = chunks.shape[1]
+    acc = jnp.zeros((chunks.shape[0], chunks.shape[2]), jnp.uint32)
+    c32 = coeff.astype(jnp.uint32)
+    for j in range(k):
+        xp = chunks[:, j, :]
+        for b in range(8):
+            bit = (c32[j] >> b) & 1
+            mask = jnp.uint32(0) - bit
+            acc = acc ^ (mask & xp)
+            if b < 7:
+                xp = gf_jax.gf_double_u32(xp)
+    return acc

@@ -1,0 +1,29 @@
+"""JAX platform-selection hygiene.
+
+In TPU-attached environments a sitecustomize may (a) import jax at
+interpreter startup and (b) force ``jax_platforms`` to the TPU plugin,
+overriding the user's ``JAX_PLATFORMS`` env var.  Entry points that must
+honor the env contract (tests, CLI tools, bench fallback paths) call
+``honor_jax_platforms_env()`` before first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-assert JAX_PLATFORMS from the environment onto the jax config.
+
+    No-op when the env var is unset (the attached accelerator wins).
+    Must run before the first backend initialization in the process.
+    """
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", env)
+    except Exception:
+        pass  # backends already initialized; nothing safe to do

@@ -4,13 +4,25 @@ Mirrors the reference's "reproducible without a real cluster" test posture
 (SURVEY.md §4): tier 1-3 tests run on the JAX CPU backend with
 --xla_force_host_platform_device_count=8 so sharding/collective code paths
 execute for real without TPU hardware.
+
+Note: in TPU-attached environments a sitecustomize may import jax at
+interpreter startup with a TPU platform pinned, so setting os.environ here
+is not enough — the jax config object itself must be updated (and before
+any backend is initialized, which conftest import time guarantees).
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()

@@ -1,0 +1,4 @@
+"""Hostile fixture: wrong API version."""
+__erasure_code_version__ = "0-bogus"
+def __erasure_code_init__(registry, name):
+    registry.add(name, lambda p: None)

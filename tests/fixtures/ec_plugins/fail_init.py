@@ -1,0 +1,4 @@
+"""Hostile fixture: entry point raises (FailToInitialize analog)."""
+__erasure_code_version__ = "1"
+def __erasure_code_init__(registry, name):
+    raise RuntimeError("deliberate init failure")

@@ -1,0 +1,4 @@
+"""Hostile fixture: entry point runs but never registers (FailToRegister)."""
+__erasure_code_version__ = "1"
+def __erasure_code_init__(registry, name):
+    pass

@@ -1,0 +1,5 @@
+"""Hostile fixture: hangs in init (analog of ErasureCodePluginHangs.cc)."""
+import time
+__erasure_code_version__ = "1"
+def __erasure_code_init__(registry, name):
+    time.sleep(5)

@@ -1,0 +1,2 @@
+"""Hostile fixture: no entry point (MissingEntryPoint analog)."""
+__erasure_code_version__ = "1"

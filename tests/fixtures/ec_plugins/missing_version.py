@@ -1,0 +1,3 @@
+"""Hostile fixture: no version symbol (MissingVersion analog)."""
+def __erasure_code_init__(registry, name):
+    registry.add(name, lambda p: None)

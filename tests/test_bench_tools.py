@@ -1,0 +1,71 @@
+"""Benchmark CLI + graft entry + bench pipeline smoke tests (CPU)."""
+
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "tools", "ec_benchmark.py"),
+                        *argv], capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout.strip()
+
+
+def test_cli_encode_output_format():
+    out = _run_cli("-P", "jax_rs", "-w", "encode", "-i", "2",
+                   "-s", "65536", "-p", "k=4", "-p", "m=2")
+    seconds, kib = out.split("\t")
+    assert float(seconds) > 0
+    assert kib == "128"  # 64 KiB * 2 iterations
+
+
+def test_cli_decode_exhaustive_verifies():
+    out = _run_cli("-P", "jax_rs", "-w", "decode", "-N", "exhaustive",
+                   "-e", "2", "-s", "65536", "-p", "k=3", "-p", "m=2")
+    seconds, kib = out.split("\t")
+    # C(5,1)+C(5,2) = 15 patterns * 64 KiB
+    assert kib == "960"
+
+
+def test_cli_fixed_erased_list():
+    out = _run_cli("-P", "jax_rs", "-w", "decode", "--erased", "0",
+                   "--erased", "4", "-i", "3", "-s", "65536",
+                   "-p", "k=4", "-p", "m=2")
+    assert float(out.split("\t")[0]) >= 0
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    parity, crcs = fn(*args)
+    assert parity.shape == (4, 3, 16384)
+    assert crcs.shape == (4, 11)
+    # crcs bit-exact vs host.
+    from ceph_tpu.ops import crc32c as C
+    d = np.asarray(args[0])
+    assert int(crcs[0, 0]) == C.crc32c(d[0, 0].tobytes())
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_encode_decode_steps_roundtrip():
+    from ceph_tpu.models import example_batch, make_decode_step, make_encode_step
+    import jax.numpy as jnp
+    data = jnp.asarray(example_batch(2, 4, 4096, seed=7))
+    step = make_encode_step(4, 2)
+    parity, crcs = step(data)
+    allc = np.concatenate([np.asarray(data), np.asarray(parity)], axis=1)
+    rows = (1, 2, 3, 4)  # lose chunk 0 and parity 5
+    dec = make_decode_step(4, 2, rows)
+    rec = np.asarray(dec(jnp.asarray(allc[:, list(rows)])))
+    assert np.array_equal(rec, np.asarray(data))

@@ -1,0 +1,85 @@
+"""Distributed EC over a virtual 8-device CPU mesh (shard_map + collectives).
+
+The multi-chip write/reconstruct path: XOR ring all-reduce encode,
+all-gather repair — verified bit-exact against the host codec.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf8
+from ceph_tpu.parallel import DistributedEC, default_geometry, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(8, shard_size=8)
+
+
+def _host_encode(data_u32: np.ndarray, k: int, m: int) -> np.ndarray:
+    """(B, k, W) -> (B, k+m, W) via the numpy golden model."""
+    B = data_u32.shape[0]
+    out = []
+    G = gf8.generator_matrix(k, m)
+    for b in range(B):
+        chunks = data_u32[b].view(np.uint8).reshape(k, -1)
+        out.append(gf8.gf_mat_encode(G, chunks).view(np.uint32)
+                   .reshape(k + m, -1))
+    return np.stack(out)
+
+
+def test_default_geometry():
+    assert default_geometry(8) == (6, 2, 8)
+    assert default_geometry(4) == (3, 1, 4)
+    assert default_geometry(16) == (6, 2, 8)
+
+
+def test_write_step_matches_host(mesh8):
+    k, m, s = default_geometry(8)
+    dec = DistributedEC(mesh8, k, m)
+    B, W = 4, 64
+    rng = np.random.default_rng(0)
+    data = np.zeros((B, s, W), dtype=np.uint32)
+    data[:, :k] = rng.integers(0, 2**32, size=(B, k, W), dtype=np.uint32)
+
+    step = dec.write_step()
+    arr = jax.device_put(data, dec.data_sharding())
+    shards, crcs = step(arr)
+    shards = np.asarray(shards)
+
+    want = _host_encode(data[:, :k], k, m)
+    assert np.array_equal(shards, want)
+
+    # Per-shard crcs match host crc32c of each chunk.
+    from ceph_tpu.ops import crc32c as C
+    crcs = np.asarray(crcs)
+    for b in range(B):
+        for d in range(s):
+            assert int(crcs[b, d]) == C.crc32c(want[b, d].tobytes())
+
+
+def test_reconstruct_step(mesh8):
+    k, m, s = default_geometry(8)
+    dec = DistributedEC(mesh8, k, m)
+    B, W = 2, 32
+    rng = np.random.default_rng(1)
+    data = np.zeros((B, s, W), dtype=np.uint32)
+    data[:, :k] = rng.integers(0, 2**32, size=(B, k, W), dtype=np.uint32)
+    shards = _host_encode(data[:, :k], k, m)
+
+    erased = (1, s - 1)
+    corrupted = shards.copy()
+    corrupted[:, list(erased)] = 0xDEADBEEF
+
+    rec = dec.reconstruct_step(erased)
+    arr = jax.device_put(corrupted, dec.data_sharding())
+    out = np.asarray(rec(arr))
+    assert np.array_equal(out, shards)
+
+
+def test_shard_axis_mismatch(mesh8):
+    with pytest.raises(ValueError, match="shard axis"):
+        DistributedEC(mesh8, 3, 2)  # k+m=5 != 8

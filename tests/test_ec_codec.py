@@ -1,0 +1,208 @@
+"""Codec API semantics per plugin: round-trips, padding, planning, LRC.
+
+Models reference per-plugin tests (TestErasureCodeJerasure/Isa/Lrc.cc):
+encode/decode with 1-2 erasures, minimum_to_decode, alignment/padding.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import factory_from_profile
+from ceph_tpu.ec.base import CHUNK_ALIGN
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.plugins.lrc import parse_kml
+
+
+def roundtrip(codec, data: bytes, erase):
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    encoded = codec.encode(list(range(n)), data)
+    cs = encoded[0].shape[0]
+    avail = {i: c for i, c in encoded.items() if i not in erase}
+    plan = codec.minimum_to_decode(list(range(k)), list(avail))
+    reads = {i: avail[i] for i in plan}
+    out = codec.decode(list(range(k)), reads, cs)
+    recovered = np.concatenate([out[i] for i in range(k)])[: len(data)]
+    assert recovered.tobytes() == data
+
+
+@pytest.mark.parametrize("profile", [
+    {"plugin": "jax_rs", "k": "4", "m": "2"},
+    {"plugin": "jax_rs", "k": "8", "m": "3", "technique": "cauchy_good"},
+    {"plugin": "jax_rs", "k": "6", "m": "2", "technique": "reed_sol_r6_op"},
+    {"plugin": "isa", "k": "7", "m": "3"},
+    {"plugin": "jerasure", "k": "5", "m": "2", "technique": "liberation"},
+    {"plugin": "xor", "k": "4"},
+])
+def test_encode_decode_erasures(profile):
+    codec = factory_from_profile(profile)
+    data = bytes(np.random.default_rng(0).integers(
+        0, 256, size=3000).astype(np.uint8))
+    m = codec.get_coding_chunk_count()
+    roundtrip(codec, data, erase=())
+    roundtrip(codec, data, erase=(0,))
+    if m >= 2:
+        roundtrip(codec, data, erase=(1, codec.get_data_chunk_count()))
+
+
+def test_padding_and_alignment():
+    codec = factory_from_profile({"plugin": "jax_rs", "k": "3", "m": "2"})
+    for size in (1, 511, 512, 1537, 5000):
+        enc = codec.encode([0, 1, 2, 3, 4], b"x" * size)
+        cs = enc[0].shape[0]
+        assert cs % CHUNK_ALIGN == 0
+        assert cs * 3 >= size
+        # All chunks same size.
+        assert {c.shape[0] for c in enc.values()} == {cs}
+
+
+def test_minimum_to_decode_prefers_wanted():
+    codec = factory_from_profile({"plugin": "jax_rs", "k": "4", "m": "2"})
+    # All wanted available -> exactly the wanted set.
+    plan = codec.minimum_to_decode([0, 1], [0, 1, 2, 3, 4, 5])
+    assert sorted(plan) == [0, 1]
+    # One wanted missing -> k chunks including surviving wanted ones.
+    plan = codec.minimum_to_decode([0, 1], [1, 2, 3, 4])
+    assert len(plan) == 4 and 1 in plan
+    with pytest.raises(ErasureCodeError):
+        codec.minimum_to_decode([0], [1, 2, 3])
+
+
+def test_minimum_to_decode_with_cost_picks_cheapest():
+    codec = factory_from_profile({"plugin": "jax_rs", "k": "2", "m": "2"})
+    plan = codec.minimum_to_decode_with_cost([0], {1: 10, 2: 1, 3: 1})
+    assert sorted(plan) == [2, 3]
+
+
+def test_exhaustive_erasures_jax_rs():
+    """All C(k+m, m) patterns for a mid-size config (the benchmark tool's
+    --erasures-generation exhaustive gate)."""
+    codec = factory_from_profile({"plugin": "jax_rs", "k": "4", "m": "3"})
+    data = bytes(np.random.default_rng(1).integers(
+        0, 256, size=2048).astype(np.uint8))
+    n = codec.get_chunk_count()
+    for e in range(1, 4):
+        for erased in itertools.combinations(range(n), e):
+            roundtrip(codec, data, erase=erased)
+
+
+# --- LRC ---------------------------------------------------------------------
+
+
+def test_parse_kml_reference_example():
+    """k=4 m=2 l=3 must match the reference docs layout."""
+    mapping, layers = parse_kml(4, 2, 3)
+    assert mapping == "__DD__DD"
+    assert layers[0][0] == "_cDD_cDD"
+    assert layers[1][0] == "cDDD____"
+    assert layers[2][0] == "____cDDD"
+
+
+def test_lrc_kml_roundtrip_and_locality():
+    codec = factory_from_profile({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    assert codec.get_data_chunk_count() == 4
+    width = len(codec.mapping)
+    data = bytes(np.random.default_rng(2).integers(
+        0, 256, size=4096).astype(np.uint8))
+    enc = codec.encode(list(range(width)), data)
+    data_pos = [i for i, ch in enumerate(codec.mapping) if ch == "D"]
+
+    # Single data-chunk loss: the local layer should need only l chunks,
+    # fewer than a global decode would read.
+    lost = data_pos[0]
+    avail = [i for i in range(width) if i != lost]
+    plan = codec.minimum_to_decode([lost], avail)
+    assert len(plan) <= 3  # l reads, not k+... (locality win)
+
+    out = codec.decode_chunks([lost], {i: enc[i] for i in plan})
+    assert np.array_equal(out[lost], enc[lost])
+
+    # Two losses incl. a global parity: still recoverable via layers.
+    lost2 = [data_pos[1], 1]
+    avail2 = {i: enc[i] for i in range(width) if i not in lost2}
+    out2 = codec.decode_chunks(lost2, avail2)
+    for p in lost2:
+        assert np.array_equal(out2[p], enc[p])
+
+    # decode_concat returns original data.
+    rec = codec.decode_concat({i: enc[i] for i in range(width)
+                               if i not in (lost,)})
+    assert rec.tobytes()[: len(data)] == data
+
+
+def test_lrc_explicit_layers():
+    codec = factory_from_profile({
+        "plugin": "lrc",
+        "mapping": "__DD__DD",
+        "layers": '[["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]]',
+    })
+    data = b"q" * 2048
+    width = 8
+    enc = codec.encode(list(range(width)), data)
+    out = codec.decode_chunks([2], {i: enc[i] for i in (0, 1, 3)})
+    assert np.array_equal(out[2], enc[2])
+
+
+def test_lrc_unrecoverable():
+    codec = factory_from_profile({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    width = len(codec.mapping)
+    data = b"z" * 1024
+    enc = codec.encode(list(range(width)), data)
+    # Erase an entire group plus a global parity: beyond code strength.
+    lost = [0, 1, 2, 3, 5]
+    avail = {i: enc[i] for i in range(width) if i not in lost}
+    with pytest.raises(ErasureCodeError):
+        codec.decode_chunks(lost, avail)
+
+
+def test_lrc_kml_wider():
+    """BASELINE config 5 shape: k=8 m=4 l=4."""
+    codec = factory_from_profile({"plugin": "lrc", "k": "8", "m": "4", "l": "4"})
+    width = len(codec.mapping)
+    assert codec.get_data_chunk_count() == 8
+    data = bytes(np.random.default_rng(3).integers(
+        0, 256, size=8192).astype(np.uint8))
+    enc = codec.encode(list(range(width)), data)
+    # Lose one chunk per group (local-repairable).
+    groups = width // 5
+    lost = [g * 5 + 2 for g in range(groups)]
+    avail = {i: enc[i] for i in range(width) if i not in lost}
+    out = codec.decode_chunks(lost, avail)
+    for p in lost:
+        assert np.array_equal(out[p], enc[p])
+
+
+def test_chunk_mapping():
+    codec = factory_from_profile({"plugin": "jax_rs", "k": "3", "m": "2"})
+    assert codec.get_chunk_mapping() == []
+    lrc = factory_from_profile({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    mapping = lrc.get_chunk_mapping()
+    assert sorted(mapping) == list(range(8))
+    assert mapping[:4] == [2, 3, 6, 7]  # data positions first
+
+
+def test_profile_validation_errors():
+    with pytest.raises(ErasureCodeError):
+        factory_from_profile({"plugin": "jax_rs", "k": "notanint"})
+    with pytest.raises(ErasureCodeError):
+        factory_from_profile({"plugin": "jax_rs", "k": "4", "m": "2",
+                              "technique": "bogus"})
+    with pytest.raises(ErasureCodeError):
+        factory_from_profile({"plugin": "jax_rs", "k": "4", "m": "2", "w": "16"})
+    with pytest.raises(ErasureCodeError):
+        factory_from_profile({"plugin": "jax_rs", "k": "4", "m": "3",
+                              "technique": "reed_sol_r6_op"})
+    with pytest.raises(ErasureCodeError):
+        factory_from_profile({"plugin": "lrc", "k": "4", "m": "2", "l": "5"})
+
+
+def test_lrc_plan_skips_unneeded_repairs():
+    """Wanting chunk 6 with {1, 6} missing must not read group-0 chunks to
+    repair position 1 (which nobody wants) — locality means <= l reads."""
+    codec = factory_from_profile({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    width = len(codec.mapping)
+    avail = [i for i in range(width) if i not in (1, 6)]
+    plan = codec.minimum_to_decode([6], avail)
+    assert set(plan) <= {4, 5, 7}, plan

@@ -1,0 +1,88 @@
+"""Plugin registry: handshake, factories, hostile fixtures.
+
+Models the reference's registry tests against deliberately broken plugins
+(src/test/erasure-code/TestErasureCodePlugin*.cc + fixture .so plugins).
+"""
+
+import os
+
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry, factory_from_profile
+from ceph_tpu.ec.interface import ErasureCodeError
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "ec_plugins")
+
+
+@pytest.fixture()
+def registry():
+    # Fresh registry per test; do not pollute the process singleton.
+    return ErasureCodePluginRegistry()
+
+
+def test_load_builtin_and_factory(registry):
+    f = registry.load("jax_rs")
+    codec = f({"k": "4", "m": "2"})
+    assert codec.get_chunk_count() == 6
+    assert registry.names() == ["jax_rs"]
+    # Second load returns the cached factory.
+    assert registry.load("jax_rs") is f
+
+
+def test_preload_default_set(registry):
+    loaded = registry.preload()
+    assert set(loaded) == {"jax_rs", "xor", "lrc", "isa", "jerasure"}
+
+
+def test_factory_from_profile_singleton():
+    codec = factory_from_profile({"plugin": "xor", "k": "3"})
+    assert codec.get_profile()["plugin"] == "xor"
+
+
+def test_unknown_plugin(registry):
+    with pytest.raises(ErasureCodeError, match="not found"):
+        registry.load("no_such_plugin")
+
+
+def test_missing_version(registry):
+    with pytest.raises(ErasureCodeError, match="__erasure_code_version__"):
+        registry.load("missing_version", directory=FIXTURES)
+
+
+def test_bad_version(registry):
+    with pytest.raises(ErasureCodeError, match="version"):
+        registry.load("bad_version", directory=FIXTURES)
+
+
+def test_missing_entry_point(registry):
+    with pytest.raises(ErasureCodeError, match="entry point"):
+        registry.load("missing_entry", directory=FIXTURES)
+
+
+def test_fail_to_register(registry):
+    with pytest.raises(ErasureCodeError, match="did not register"):
+        registry.load("fail_register", directory=FIXTURES)
+
+
+def test_fail_to_initialize(registry):
+    with pytest.raises(RuntimeError, match="deliberate"):
+        registry.load("fail_init", directory=FIXTURES)
+
+
+def test_hanging_plugin_times_out(registry):
+    with pytest.raises(ErasureCodeError, match="timed out"):
+        registry.load("hangs", directory=FIXTURES, timeout=0.3)
+
+
+def test_double_add_rejected(registry):
+    registry.add("dup", lambda p: None)
+    with pytest.raises(ErasureCodeError, match="already registered"):
+        registry.add("dup", lambda p: None)
+
+
+def test_hang_timeout_returns_promptly(registry):
+    import time
+    t0 = time.perf_counter()
+    with pytest.raises(ErasureCodeError, match="timed out"):
+        registry.load("hangs2", directory=FIXTURES, timeout=0.3)
+    assert time.perf_counter() - t0 < 2.0, "watchdog did not bound the wait"

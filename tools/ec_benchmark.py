@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""ec_benchmark — drop-in port of the reference benchmark CLI.
+
+Flag-compatible rebuild of ``ceph_erasure_code_benchmark``
+(reference src/test/erasure-code/ceph_erasure_code_benchmark.cc:40-317 and
+src/erasure-code/isa/README:30-46), emitting the same
+``<seconds>\\t<KiB processed>`` line so bench.sh-style sweeps and their
+GiB/s = (KiB/2^20)/seconds math port unchanged
+(qa/workunits/erasure-code/bench.sh fplot).
+
+Workloads:
+- encode: ``iterations`` codec encodes over a ``size``-byte buffer.
+- decode: encode once, then reconstruct under erasures; ``--erasures-
+  generation exhaustive`` walks every C(n, e) pattern for e <= --erasures
+  and verifies content byte-equality (the correctness gate at reference
+  ceph_erasure_code_benchmark.cc:202-249).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.ec import ErasureCodePluginRegistry  # noqa: E402
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-P", "--plugin", default="jax_rs",
+                   help="erasure-code plugin name")
+    p.add_argument("-w", "--workload", default="encode",
+                   choices=["encode", "decode"])
+    p.add_argument("-i", "--iterations", type=int, default=1)
+    p.add_argument("-s", "--size", type=int, default=1024 * 1024,
+                   help="total buffer size in bytes")
+    p.add_argument("-e", "--erasures", type=int, default=1,
+                   help="number of erasures for decode")
+    p.add_argument("--erased", type=int, action="append", default=None,
+                   help="explicit chunk index to erase (repeatable)")
+    p.add_argument("-N", "--erasures-generation", default="random",
+                   choices=["random", "exhaustive"])
+    p.add_argument("-p", "--parameter", action="append", default=[],
+                   metavar="KEY=VALUE", help="profile parameter (repeatable)")
+    p.add_argument("--erasure-code-dir", default=None,
+                   help="out-of-tree plugin directory")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p.parse_args(argv)
+
+
+def make_codec(args):
+    profile = {}
+    for kv in args.parameter:
+        if "=" not in kv:
+            raise SystemExit(f"--parameter {kv!r} is not KEY=VALUE")
+        key, val = kv.split("=", 1)
+        profile[key] = val
+    profile.setdefault("plugin", args.plugin)
+    registry = ErasureCodePluginRegistry.instance()
+    return registry.factory(args.plugin, profile,
+                            directory=args.erasure_code_dir)
+
+
+def run_encode(codec, args) -> "tuple[float, float]":
+    data = np.random.default_rng(0).integers(
+        0, 256, size=args.size).astype(np.uint8)
+    n = codec.get_chunk_count()
+    want = list(range(n))
+    codec.encode(want, data)  # warm caches / compiles outside the clock
+    t0 = time.perf_counter()
+    for _ in range(args.iterations):
+        codec.encode(want, data)
+    seconds = time.perf_counter() - t0
+    return seconds, args.size * args.iterations / 1024
+
+
+def run_decode(codec, args) -> "tuple[float, float]":
+    data = np.random.default_rng(0).integers(
+        0, 256, size=args.size).astype(np.uint8)
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    encoded = codec.encode(list(range(n)), data)
+    cs = encoded[0].shape[0]
+    want = list(range(k))
+
+    patterns: "list[tuple[int, ...]]"
+    if args.erased:
+        patterns = [tuple(args.erased)] * args.iterations
+    elif args.erasures_generation == "exhaustive":
+        patterns = [c for e in range(1, args.erasures + 1)
+                    for c in itertools.combinations(range(n), e)]
+    else:
+        rng = random.Random(0)
+        patterns = [tuple(rng.sample(range(n), args.erasures))
+                    for _ in range(args.iterations)]
+
+    # Warm the decode-matrix/jit caches with the first pattern.
+    first = {i: c for i, c in encoded.items() if i not in patterns[0]}
+    codec.decode(want, {i: first[i]
+                        for i in codec.minimum_to_decode(want, list(first))}, cs)
+
+    verify = args.erasures_generation == "exhaustive"
+    t0 = time.perf_counter()
+    for erased in patterns:
+        avail = {i: c for i, c in encoded.items() if i not in erased}
+        plan = codec.minimum_to_decode(want, list(avail))
+        out = codec.decode(want, {i: avail[i] for i in plan}, cs)
+        if verify:
+            for i in want:
+                if not np.array_equal(out[i], encoded[i]):
+                    raise SystemExit(
+                        f"decode verification FAILED for erasure {erased}, "
+                        f"chunk {i}")
+    seconds = time.perf_counter() - t0
+    return seconds, args.size * len(patterns) / 1024
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    codec = make_codec(args)
+    if args.verbose:
+        print(f"profile: {codec.get_profile()}", file=sys.stderr)
+    if args.workload == "encode":
+        seconds, kib = run_encode(codec, args)
+    else:
+        seconds, kib = run_decode(codec, args)
+    # Reference output format: "<seconds>\t<KiB processed>"
+    # (ceph_erasure_code_benchmark.cc:184,315).
+    print(f"{seconds:.6f}\t{kib:.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
