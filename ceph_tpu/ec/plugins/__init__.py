@@ -9,4 +9,6 @@ handshake, mirroring reference src/erasure-code/ErasureCodePlugin.cc).
 - lrc       — locally-repairable layered code.
 - isa       — ISA-L profile compatibility (executes via jax_rs).
 - jerasure  — jerasure profile compatibility (executes via jax_rs).
+- shec      — shingled erasure code (k, m, c) with reduced recovery I/O.
+- clay      — coupled-layer MSR code with sub-chunk repair.
 """

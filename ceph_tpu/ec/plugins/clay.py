@@ -1,0 +1,392 @@
+"""clay — coupled-layer MSR code (rebuild of the reference clay plugin).
+
+Reference: src/erasure-code/clay/ErasureCodeClay.{h,cc}.  Clay codes (FAST'18
+"Clay Codes: Moulding MDS Codes to Yield Vector Codes") wrap a scalar MDS
+code to obtain an MSR (minimum storage regenerating) code: repairing a
+single lost chunk reads only ``1/q`` of each of the ``d = k+m-1`` helper
+chunks instead of ``k`` whole chunks.
+
+Construction (self-contained; matches the reference's structure, not its
+bytes — the reference delegates scalar GF math to jerasure/isa submodules):
+
+- ``q = d-k+1``; the ``k+m`` chunks (padded with ``nu`` zero "virtual"
+  chunks so ``q`` divides ``n = k+m+nu``, reference ErasureCodeClay.h:35-40)
+  form a ``q x t`` grid, node ``i`` at ``(x=i%q, y=i//q)``.
+- Every chunk splits into ``sub_chunk_no = q^t`` sub-chunks, one per
+  "plane" ``z in [q]^t`` (reference get_sub_chunk_count,
+  ErasureCodeClay.cc:296).
+- Each plane of *uncoupled* symbols U is a codeword of an [n, n-m] MDS
+  code.  Stored *coupled* symbols C relate pairwise: vertex ``v=((x,y),z)``
+  with ``x != z_y`` pairs with ``v*=((z_y,y), z(y->x))`` via
+  ``C[v] = U[v] + g*U[v*]`` (and symmetrically), ``g=2``; dots
+  (``x == z_y``) have ``C = U``.
+- Encode and multi-erasure decode run the layered algorithm (reference
+  decode_layered, ErasureCodeClay.h:96-122): process planes in increasing
+  intersection-score order; per plane compute known U's via the pair
+  transform, MDS-solve the <= m unknown U's, then back out erased C's.
+- Single-failure repair reads only the ``q^(t-1)`` "repair planes"
+  ``{z : z_{y0} = x0}`` from each helper (reference minimum_to_repair /
+  get_repair_subchunks, ErasureCodeClay.cc:325,363); lost sub-chunks on
+  non-repair planes come from the pair relations at zero extra read cost.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ...ops import gf8
+from ..base import ErasureCode
+from ..interface import ChunkMap, ErasureCodeError, Profile
+
+__erasure_code_version__ = "1"
+
+GAMMA = 2  # coupling coefficient; any g not in {0,1} keeps the pair
+           # transform [[1,g],[g,1]] invertible over GF(2^8)
+
+
+class ErasureCodeClay(ErasureCode):
+    def __init__(self) -> None:
+        super().__init__()
+        self.d = 0
+        self.q = 0
+        self.t = 0
+        self.nu = 0          # virtual (shortened, all-zero) chunks
+        self.n = 0           # k + m + nu = q * t
+        self.sub_chunk_no = 1
+        self.C_base = np.zeros((0, 0), dtype=np.uint8)
+        self.G_base = np.zeros((0, 0), dtype=np.uint8)
+        self._theta = 0      # inv(1 + GAMMA^2)
+        self._theta_inv = 0  # 1 + GAMMA^2
+        self._gamma_inv = 0
+        self._express_cache: "dict[tuple, dict]" = {}
+
+    # --- init ---------------------------------------------------------------
+
+    def init(self, profile: Profile) -> None:
+        self.k = self._parse_int(profile, "k", 4)
+        self.m = self._parse_int(profile, "m", 2)
+        self.d = self._parse_int(profile, "d", self.k + self.m - 1)
+        self._sanity()
+        if not self.k <= self.d <= self.k + self.m - 1:
+            raise ErasureCodeError(
+                f"clay: d={self.d} must satisfy k <= d <= k+m-1")
+        self.q = self.d - self.k + 1
+        self.nu = (self.q - (self.k + self.m) % self.q) % self.q
+        self.n = self.k + self.m + self.nu
+        self.t = self.n // self.q
+        self.sub_chunk_no = self.q ** self.t
+        kb = self.n - self.m
+        technique = str(profile.get("scalar_mds", "reed_sol_van"))
+        if technique in ("jerasure", "isa", "shec"):  # reference plugin names
+            technique = "reed_sol_van"
+        self.C_base = gf8.generator_matrix(kb, self.m, technique)[kb:]
+        self.G_base = np.concatenate(
+            [np.eye(kb, dtype=np.uint8), self.C_base], axis=0)
+        self._theta_inv = 1 ^ int(gf8.gf_mul(GAMMA, GAMMA))
+        self._theta = gf8.gf_inv(self._theta_inv)
+        self._gamma_inv = gf8.gf_inv(GAMMA)
+        prof = dict(profile)
+        prof.update(plugin="clay", k=str(self.k), m=str(self.m),
+                    d=str(self.d))
+        self._profile = prof
+
+    # --- geometry -----------------------------------------------------------
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size must split evenly into q^t sub-chunks; sub-chunks are
+        kept 16-byte multiples so vectorized GF ops stay aligned."""
+        per = max(1, -(-max(0, stripe_width) // self.k))
+        gran = self.sub_chunk_no * 16
+        return -(-per // gran) * gran
+
+    # --- grid / plane helpers ------------------------------------------------
+
+    def _node_xy(self, i: int) -> "tuple[int, int]":
+        return i % self.q, i // self.q
+
+    def _zdigit(self, z: int, y: int) -> int:
+        return (z // self.q ** (self.t - 1 - y)) % self.q
+
+    def _zset(self, z: int, y: int, x: int) -> int:
+        p = self.q ** (self.t - 1 - y)
+        return z + (x - self._zdigit(z, y)) * p
+
+    def _ext_to_int(self, i: int) -> int:
+        """External chunk index -> internal grid index (virtual chunks sit
+        between data and parity, reference ErasureCodeClay.h:35-40)."""
+        return i if i < self.k else i + self.nu
+
+    def _int_to_ext(self, i: int) -> "int | None":
+        if i < self.k:
+            return i
+        if i < self.k + self.nu:
+            return None  # virtual
+        return i - self.nu
+
+    def _repair_planes(self, lost_int: int) -> "list[int]":
+        x0, y0 = self._node_xy(lost_int)
+        return sorted(z for z in range(self.sub_chunk_no)
+                      if self._zdigit(z, y0) == x0)
+
+    def _express(self, avail: "tuple[int, ...]",
+                 want: "tuple[int, ...]") -> "dict[int, dict[int, int]]":
+        key = (avail, want)
+        hit = self._express_cache.get(key)
+        if hit is None:
+            try:
+                hit = gf8.gf_express_rows(self.G_base, list(avail), list(want))
+            except ValueError as e:
+                raise ErasureCodeError(f"clay: {e}")
+            self._express_cache[key] = hit
+        return hit
+
+    @staticmethod
+    def _combine(combos: "dict[int, int]", U: np.ndarray,
+                 z: int) -> np.ndarray:
+        tbl = gf8.mul_table()
+        acc = None
+        for src, coeff in combos.items():
+            term = U[src, z] if coeff == 1 else tbl[coeff, U[src, z]]
+            acc = term.copy() if acc is None else acc ^ term
+        if acc is None:
+            acc = np.zeros_like(U[0, 0])
+        return acc
+
+    # --- the layered engine (encode and multi-erasure decode) ----------------
+
+    def _decode_layered(self, C: np.ndarray, erased: "list[int]") -> None:
+        """Fill C[e] for erased internal nodes, in place.
+
+        C: (n, sub_chunk_no, S) with all non-erased entries valid.
+        Reference decode_layered, ErasureCodeClay.h:96-122.
+        """
+        if len(erased) > self.m:
+            raise ErasureCodeError(
+                f"clay: {len(erased)} erasures > m={self.m}")
+        tbl = gf8.mul_table()
+        n, P = self.n, self.sub_chunk_no
+        U = np.zeros_like(C)
+        avail = tuple(i for i in range(n) if i not in erased)
+        erased_set = set(erased)
+        combos = self._express(avail, tuple(erased))
+        exy = [self._node_xy(e) for e in erased]
+        by_score: "dict[int, list[int]]" = {}
+        for z in range(P):
+            s = sum(self._zdigit(z, y) == x for x, y in exy)
+            by_score.setdefault(s, []).append(z)
+        # Planes are processed in groups of equal intersection score.  The
+        # dependencies: computing U in a plane may need a recovered erased C
+        # from a strictly lower score (group done); recovering an erased C
+        # may need either its companion's input C (any plane) or, when the
+        # companion is also erased, the companion's U from the *same* score
+        # group — hence steps 1+2 run for the whole group before step 3.
+        for score in sorted(by_score):
+            group = by_score[score]
+            for z in group:
+                # 1. U at non-erased nodes from the pair transform.
+                for i in avail:
+                    x, y = self._node_xy(i)
+                    zy = self._zdigit(z, y)
+                    if zy == x:
+                        U[i, z] = C[i, z]
+                    else:
+                        comp = y * self.q + zy
+                        z2 = self._zset(z, y, x)
+                        U[i, z] = tbl[self._theta,
+                                      C[i, z] ^ tbl[GAMMA, C[comp, z2]]]
+                # 2. MDS-solve the erased U's of this plane.
+                for e in erased:
+                    U[e, z] = self._combine(combos[e], U, z)
+            for z in group:
+                # 3. Erased C's.
+                for e in erased:
+                    x, y = self._node_xy(e)
+                    zy = self._zdigit(z, y)
+                    if zy == x:
+                        C[e, z] = U[e, z]
+                        continue
+                    comp = y * self.q + zy
+                    z2 = self._zset(z, y, x)
+                    if comp in erased_set:
+                        # Companion plane is in this same score group.
+                        C[e, z] = U[e, z] ^ tbl[GAMMA, U[comp, z2]]
+                    else:
+                        # Companion C is input: U[comp,z2] = C[comp,z2] ^
+                        # g*U[e,z], so C[e,z] = (1^g^2)*U[e,z] ^ g*C[comp,z2].
+                        C[e, z] = tbl[self._theta_inv, U[e, z]] \
+                            ^ tbl[GAMMA, C[comp, z2]]
+
+    def _grid(self, chunk_size: int) -> np.ndarray:
+        S = chunk_size // self.sub_chunk_no
+        return np.zeros((self.n, self.sub_chunk_no, S), dtype=np.uint8)
+
+    # --- encode -------------------------------------------------------------
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"got {data_chunks.shape[0]} chunks, k={self.k}")
+        cs = data_chunks.shape[1]
+        if cs % self.sub_chunk_no:
+            raise ErasureCodeError(
+                f"clay: chunk size {cs} not divisible by sub_chunk_no="
+                f"{self.sub_chunk_no}")
+        C = self._grid(cs)
+        C[: self.k] = data_chunks.reshape(self.k, self.sub_chunk_no, -1)
+        parity = list(range(self.k + self.nu, self.n))
+        self._decode_layered(C, parity)
+        return C[self.k + self.nu:].reshape(self.m, cs)
+
+    # --- planning -----------------------------------------------------------
+
+    @staticmethod
+    def _runs(planes: "list[int]") -> "list[tuple[int, int]]":
+        runs: "list[tuple[int, int]]" = []
+        for p in planes:
+            if runs and runs[-1][0] + runs[-1][1] == p:
+                runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+            else:
+                runs.append((p, 1))
+        return runs
+
+    def _repair_possible(self, missing: "set[int]",
+                         avail: "set[int]") -> bool:
+        return (len(missing) == 1 and self.d == self.k + self.m - 1
+                and avail >= set(range(self.k + self.m)) - missing)
+
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> "dict":
+        want = set(want_to_read)
+        avail = set(available)
+        full = [(0, self.sub_chunk_no)]
+        if want <= avail:
+            return {i: list(full) for i in sorted(want)}
+        missing = want - avail
+        if self._repair_possible(missing, avail):
+            lost = next(iter(missing))
+            runs = self._runs(self._repair_planes(self._ext_to_int(lost)))
+            return {h: list(runs)
+                    for h in range(self.k + self.m) if h != lost}
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"clay: cannot decode {sorted(missing)} from {sorted(avail)}")
+        pick = sorted(want & avail) + sorted(avail - want)
+        return {i: list(full) for i in sorted(pick[: self.k])}
+
+    # --- decode -------------------------------------------------------------
+
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        have = {i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()}
+        if not have:
+            raise ErasureCodeError("clay: no chunks to decode from")
+        cs = next(iter(have.values())).shape[0]
+        if cs % self.sub_chunk_no:
+            raise ErasureCodeError(
+                f"clay: chunk size {cs} not divisible by sub_chunk_no="
+                f"{self.sub_chunk_no}")
+        C = self._grid(cs)
+        erased = []
+        for ext in range(self.k + self.m):
+            i = self._ext_to_int(ext)
+            if ext in have:
+                C[i] = have[ext].reshape(self.sub_chunk_no, -1)
+            else:
+                erased.append(i)
+        self._decode_layered(C, erased)
+        return {w: C[self._ext_to_int(w)].reshape(cs)
+                for w in want_to_read}
+
+    def decode(self, want_to_read: Sequence[int], chunks: ChunkMap,
+               chunk_size: int) -> ChunkMap:
+        have = {i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()}
+        missing = {w for w in want_to_read if w not in have}
+        sizes = {c.shape[0] for c in have.values()}
+        if sizes == {chunk_size} or not missing:
+            return super().decode(want_to_read, have, chunk_size)
+        # Partial buffers: the repair path — helpers sent only the repair
+        # planes (in ascending plane order, per minimum_to_decode's runs).
+        if not self._repair_possible(missing, set(have)):
+            raise ErasureCodeError(
+                f"clay: partial-chunk decode only supports single-failure "
+                f"repair (missing {sorted(missing)})")
+        lost = next(iter(missing))
+        extra = [w for w in want_to_read if w != lost]
+        if extra:
+            # The helpers' buffers here are repair-plane slices, not full
+            # chunks — serving them as chunk_size chunks would silently
+            # truncate.  Repair mode answers only for the lost chunk.
+            raise ErasureCodeError(
+                f"clay: repair mode decodes only the lost chunk {lost}; "
+                f"also asked for {extra}")
+        return {lost: self._repair(lost, have, chunk_size)}
+
+    def _repair(self, lost: int, have: ChunkMap, chunk_size: int) -> np.ndarray:
+        """Recover the full lost chunk from repair-plane sub-chunks only."""
+        tbl = gf8.mul_table()
+        L = self._ext_to_int(lost)
+        x0, y0 = self._node_xy(L)
+        planes = self._repair_planes(L)
+        S = chunk_size // self.sub_chunk_no
+        pos = {z: idx for idx, z in enumerate(planes)}
+        # Repair-plane coupled symbols for every node (virtuals stay zero).
+        Cr = np.zeros((self.n, len(planes), S), dtype=np.uint8)
+        for ext, buf in have.items():
+            b = np.asarray(buf, dtype=np.uint8)
+            if b.shape[0] != len(planes) * S:
+                raise ErasureCodeError(
+                    f"clay: helper {ext} sent {b.shape[0]} bytes, expected "
+                    f"{len(planes) * S}")
+            Cr[self._ext_to_int(ext)] = b.reshape(len(planes), S)
+        # Column y0 (q nodes, including the lost dot) has unknown U;
+        # everything else computes via the pair transform within repair
+        # planes.
+        col = [x + y0 * self.q for x in range(self.q)]
+        rest = tuple(i for i in range(self.n) if i not in col)
+        combos = self._express(rest, tuple(col))
+        Ur = np.zeros_like(Cr)
+        for z in planes:
+            zi = pos[z]
+            for i in rest:
+                x, y = self._node_xy(i)
+                zy = self._zdigit(z, y)
+                if zy == x:
+                    Ur[i, zi] = Cr[i, zi]
+                else:
+                    comp = y * self.q + zy
+                    z2 = self._zset(z, y, x)  # y != y0, so z2 is a repair plane
+                    Ur[i, zi] = tbl[self._theta,
+                                    Cr[i, zi] ^ tbl[GAMMA, Cr[comp, pos[z2]]]]
+            for c in col:
+                Ur[c, zi] = self._combine(combos[c], Ur, zi)
+        # Assemble the lost chunk across all q^t planes.
+        out = np.zeros((self.sub_chunk_no, S), dtype=np.uint8)
+        for z in range(self.sub_chunk_no):
+            zx = self._zdigit(z, y0)
+            if zx == x0:
+                out[z] = Ur[L, pos[z]]  # dot: C = U
+            else:
+                # Pair of (lost, z) is v* = ((z_y0, y0), z*), z* repair plane.
+                zstar = self._zset(z, y0, x0)
+                vstar = zx + y0 * self.q
+                # C[v*] = g*U[lost,z] + U[v*]  =>  U[lost,z]; then
+                # C[lost,z] = U[lost,z] + g*U[v*].
+                ustar = Ur[vstar, pos[zstar]]
+                ulost = tbl[self._gamma_inv, Cr[vstar, pos[zstar]] ^ ustar]
+                out[z] = ulost ^ tbl[GAMMA, ustar]
+        return out.reshape(chunk_size)
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeClay:
+        codec = ErasureCodeClay()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

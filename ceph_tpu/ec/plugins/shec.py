@@ -1,0 +1,196 @@
+"""shec — shingled erasure code (rebuild of the reference shec plugin).
+
+Reference: src/erasure-code/shec/ErasureCodeShec.{h,cc}.  SHEC(k, m, c)
+tolerates any ``c`` concurrent failures while cutting single-failure
+recovery I/O: each of the ``m`` parities covers only a sliding window
+("shingle") of ``l = ceil(k*c/m)`` consecutive data chunks, so repairing
+one lost data chunk reads a window (l chunks + 1 parity) instead of k
+chunks.  Windows overlap so every data chunk is covered by >= c parities.
+
+The reference builds its matrix with ``shec_reedsolomon_coding_matrix`` and
+searches decode plans with ``shec_make_decoding_matrix``
+(ErasureCodeShec.h:107-119), delegating GF math to external jerasure
+primitives (empty submodule in the snapshot).  Here the matrix is Cauchy
+coefficients masked to the shingle windows, and planning/decoding run on
+the generic GF(2^8) row-span machinery (ops/gf8.gf_express_rows) — the
+same engine every other codec uses, so shec decode also batches onto the
+host/TPU encode kernels.
+
+Because a shingled code is not MDS, ``init`` verifies the configured
+(k, m, c) actually tolerates every erasure pattern of size <= c
+(exhaustively for k+m <= 20 — the analog of the reference's
+TestErasureCodeShec_all exhaustive suite baked into init-time sanity).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ...ops import gf8
+from ..base import ErasureCode
+from ..interface import ChunkMap, ErasureCodeError, Profile
+
+__erasure_code_version__ = "1"
+
+
+class ErasureCodeShec(ErasureCode):
+    def __init__(self) -> None:
+        super().__init__()
+        self.c = 0
+        self.l = 0  # shingle width
+        self.windows: "list[list[int]]" = []  # per-parity data columns
+        self.G = np.zeros((0, 0), dtype=np.uint8)  # (k+m, k) systematic
+        self._plan_cache: "dict[tuple, dict]" = {}
+
+    # --- init ---------------------------------------------------------------
+
+    def init(self, profile: Profile) -> None:
+        self.k = self._parse_int(profile, "k", 4)
+        self.m = self._parse_int(profile, "m", 3)
+        self.c = self._parse_int(profile, "c", 2)
+        self._sanity()
+        if not 1 <= self.c <= self.m:
+            raise ErasureCodeError(
+                f"shec: c={self.c} must satisfy 1 <= c <= m={self.m}")
+        if self.m > self.k:
+            raise ErasureCodeError(
+                f"shec: m={self.m} must be <= k={self.k}")
+        self.l = -(-self.k * self.c // self.m)  # ceil(k*c/m)
+        self.windows = []
+        C = np.zeros((self.m, self.k), dtype=np.uint8)
+        for i in range(self.m):
+            start = i * self.k // self.m
+            window = sorted((start + j) % self.k for j in range(self.l))
+            self.windows.append(window)
+            for col in window:
+                C[i, col] = gf8.gf_inv((i + self.k) ^ col)
+        self.G = np.concatenate(
+            [np.eye(self.k, dtype=np.uint8), C], axis=0)
+        self._verify_tolerance()
+        prof = dict(profile)
+        prof.update(plugin="shec", k=str(self.k), m=str(self.m),
+                    c=str(self.c))
+        self._profile = prof
+
+    def _verify_tolerance(self) -> None:
+        """Exhaustively confirm every <=c erasure pattern is recoverable
+        (tractable: C(k+m, c) patterns, k+m <= 20 enforced like the
+        reference's parameter limits)."""
+        n = self.k + self.m
+        if n > 20:
+            raise ErasureCodeError(
+                f"shec: k+m={n} too large (max 20)")
+        allr = list(range(n))
+        for e in range(1, self.c + 1):
+            for erased in itertools.combinations(allr, e):
+                avail = [r for r in allr if r not in erased]
+                try:
+                    gf8.gf_express_rows(self.G, avail, list(erased))
+                except ValueError:
+                    raise ErasureCodeError(
+                        f"shec: (k={self.k}, m={self.m}, c={self.c}) cannot "
+                        f"recover erasure pattern {erased}")
+
+    # --- encode -------------------------------------------------------------
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"got {data_chunks.shape[0]} chunks, k={self.k}")
+        return gf8.gf_mat_encode(self.G[self.k:], data_chunks)
+
+    # --- planning -----------------------------------------------------------
+
+    def _plan(self, want: "frozenset[int]",
+              avail: "frozenset[int]") -> "dict[int, dict[int, int]]":
+        """Choose the smallest read set that can serve ``want`` and return
+        the per-wanted-chunk recovery combinations over it.
+
+        Search order mirrors the reference's decoding-matrix search: try
+        parity subsets from smallest (locality: a single covering shingle)
+        upward, reading only that subset's windows; fall back to all
+        available chunks.  Cached per (want, avail) signature — the analog
+        of ErasureCodeShecTableCache.
+        """
+        key = (want, avail)
+        hit = self._plan_cache.get(key)
+        if hit is not None:
+            return hit
+        missing = want - avail
+        if not missing:
+            plan = {w: {w: 1} for w in want}
+            self._plan_cache[key] = plan
+            return plan
+        avail_data = sorted(r for r in avail if r < self.k)
+        avail_par = sorted(r for r in avail if r >= self.k)
+        best = None
+        for np_ in range(1, len(avail_par) + 1):
+            for parities in itertools.combinations(avail_par, np_):
+                reads = set(parities)
+                for p in parities:
+                    reads.update(c for c in self.windows[p - self.k]
+                                 if c in avail)
+                reads.update(w for w in want if w in avail)
+                if best is not None and len(reads) >= len(best[0]):
+                    continue
+                try:
+                    combos = gf8.gf_express_rows(
+                        self.G, sorted(reads), sorted(want))
+                except ValueError:
+                    continue
+                best = (reads, combos)
+            if best is not None:
+                break
+        if best is None:
+            try:
+                combos = gf8.gf_express_rows(
+                    self.G, sorted(avail), sorted(want))
+            except ValueError:
+                raise ErasureCodeError(
+                    f"shec: cannot decode {sorted(missing)} from "
+                    f"{sorted(avail)}")
+            best = (set(avail), combos)
+        self._plan_cache[key] = best[1]
+        return best[1]
+
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> "dict":
+        combos = self._plan(frozenset(want_to_read), frozenset(available))
+        reads = set()
+        for combo in combos.values():
+            reads.update(combo)
+        return {r: [(0, 1)] for r in sorted(reads)}
+
+    # --- decode -------------------------------------------------------------
+
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        have = {i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()}
+        combos = self._plan(frozenset(want_to_read), frozenset(have))
+        tbl = gf8.mul_table()
+        out: ChunkMap = {}
+        for w in want_to_read:
+            if w in have:
+                out[w] = have[w]
+                continue
+            acc = None
+            for src, coeff in combos[w].items():
+                term = have[src] if coeff == 1 else tbl[coeff, have[src]]
+                acc = term.copy() if acc is None else acc ^ term
+            if acc is None:
+                acc = np.zeros_like(next(iter(have.values())))
+            out[w] = acc
+        return out
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    def factory(profile: Profile) -> ErasureCodeShec:
+        codec = ErasureCodeShec()
+        codec.init(profile)
+        return codec
+
+    registry.add(name, factory)

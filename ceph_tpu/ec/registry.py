@@ -36,7 +36,7 @@ Factory = Callable[[Profile], ErasureCodeInterface]
 
 # Default preload set (analog of option ``osd_erasure_code_plugins``,
 # reference src/common/options.cc:2598, default "jerasure lrc isa").
-DEFAULT_PLUGINS = ("jax_rs", "xor", "lrc", "isa", "jerasure")
+DEFAULT_PLUGINS = ("jax_rs", "xor", "lrc", "isa", "jerasure", "shec", "clay")
 
 
 class ErasureCodePluginRegistry:

@@ -221,6 +221,67 @@ def generator_matrix(k: int, m: int, technique: str = "reed_sol_van") -> np.ndar
     return np.concatenate([np.eye(k, dtype=np.uint8), C], axis=0)
 
 
+def gf_express_rows(generator: np.ndarray, avail_rows: "list[int]",
+                    want_rows: "list[int]") -> "dict[int, dict[int, int]]":
+    """Express codeword coordinates ``want_rows`` as GF(2^8) combinations of
+    coordinates ``avail_rows``.
+
+    A codeword is ``c = G @ w`` for a message ``w``; coordinate i is the
+    inner product of generator row i with ``w``.  Coordinate v is computable
+    from the available coordinates iff generator row v lies in the GF(2^8)
+    row-span of the available rows.  Returns, per wanted row, the
+    ``{avail_row: coefficient}`` combination (zero coefficients omitted), or
+    raises ValueError naming the first unrecoverable row.
+
+    This generalizes ``decode_matrix`` to non-MDS codes (shec shingles,
+    lrc layers) and to recomputing erased *parity* coordinates — the role
+    the reference fills with per-code decoding-matrix searches
+    (e.g. shec_make_decoding_matrix, src/erasure-code/shec/ErasureCodeShec.h
+    :107-119).
+    """
+    G = np.asarray(generator, dtype=np.uint8)
+    tbl = mul_table()
+    navail = len(avail_rows)
+    # Row-reduce the available rows, tracking the combination of original
+    # available coordinates that produced each reduced row.
+    rows = G[np.asarray(avail_rows, dtype=np.int64)].astype(np.uint8)
+    combo = np.eye(navail, dtype=np.uint8)
+    pivots: "list[tuple[int, int]]" = []  # (column, reduced-row index)
+    r = 0
+    for col in range(G.shape[1]):
+        pivot = next((i for i in range(r, navail) if rows[i, col]), None)
+        if pivot is None:
+            continue
+        if pivot != r:
+            rows[[r, pivot]] = rows[[pivot, r]]
+            combo[[r, pivot]] = combo[[pivot, r]]
+        inv_p = gf_inv(int(rows[r, col]))
+        rows[r] = tbl[inv_p, rows[r]]
+        combo[r] = tbl[inv_p, combo[r]]
+        for i in range(navail):
+            if i != r and rows[i, col]:
+                c = rows[i, col]
+                rows[i] = rows[i] ^ tbl[c, rows[r]]
+                combo[i] = combo[i] ^ tbl[c, combo[r]]
+        pivots.append((col, r))
+        r += 1
+    out: "dict[int, dict[int, int]]" = {}
+    for v in want_rows:
+        residual = G[v].astype(np.uint8).copy()
+        coeffs = np.zeros(navail, dtype=np.uint8)
+        for col, ri in pivots:
+            if residual[col]:
+                c = residual[col]
+                residual = residual ^ tbl[c, rows[ri]]
+                coeffs = coeffs ^ tbl[c, combo[ri]]
+        if residual.any():
+            raise ValueError(
+                f"coordinate {v} not recoverable from rows {sorted(avail_rows)}")
+        out[v] = {avail_rows[i]: int(coeffs[i])
+                  for i in range(navail) if coeffs[i]}
+    return out
+
+
 def decode_matrix(generator: np.ndarray, k: int,
                   present_rows: "list[int]") -> np.ndarray:
     """Inverse mapping from k surviving chunks back to the k data chunks.

@@ -31,7 +31,8 @@ def test_load_builtin_and_factory(registry):
 
 def test_preload_default_set(registry):
     loaded = registry.preload()
-    assert set(loaded) == {"jax_rs", "xor", "lrc", "isa", "jerasure"}
+    assert set(loaded) == {"jax_rs", "xor", "lrc", "isa", "jerasure",
+                           "shec", "clay"}
 
 
 def test_factory_from_profile_singleton():

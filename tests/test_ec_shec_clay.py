@@ -1,0 +1,191 @@
+"""shec + clay codec tests.
+
+Mirrors the reference test strategy (SURVEY.md §4): round-trips with
+exhaustive erasure patterns (TestErasureCodeShec_all.cc analog),
+minimum_to_decode locality checks, and clay sub-chunk repair-bandwidth
+verification (reference TestErasureCodeClay.cc).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+@pytest.fixture(scope="module")
+def registry():
+    return ErasureCodePluginRegistry.instance()
+
+
+def _payload(codec, nbytes=4096, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=nbytes, dtype=np.uint8).astype(np.uint8)
+
+
+def _encode_all(codec, data):
+    n = codec.get_chunk_count()
+    return codec.encode(list(range(n)), data)
+
+
+# ---------------------------------------------------------------------------
+# shec
+# ---------------------------------------------------------------------------
+
+
+class TestShec:
+    PROFILES = [
+        {"k": "4", "m": "3", "c": "2"},
+        {"k": "6", "m": "3", "c": "2"},
+        {"k": "8", "m": "4", "c": "3"},
+        {"k": "5", "m": "5", "c": "3"},
+    ]
+
+    @pytest.mark.parametrize("profile", PROFILES,
+                             ids=lambda p: f"k{p['k']}m{p['m']}c{p['c']}")
+    def test_roundtrip_exhaustive_erasures(self, registry, profile):
+        codec = registry.factory("shec", dict(profile))
+        k, m, c = codec.k, codec.m, codec.c
+        data = _payload(codec)
+        chunks = _encode_all(codec, data)
+        n = k + m
+        for e in range(1, c + 1):
+            for erased in itertools.combinations(range(n), e):
+                have = {i: chunks[i] for i in range(n) if i not in erased}
+                out = codec.decode_chunks(list(erased), have)
+                for i in erased:
+                    assert np.array_equal(out[i], chunks[i]), \
+                        f"erasure {erased}, chunk {i}"
+
+    def test_single_failure_reads_fewer_than_k(self, registry):
+        """The point of shec: one lost data chunk repairs from a shingle,
+        not from k chunks."""
+        codec = registry.factory("shec", {"k": "8", "m": "4", "c": "3"})
+        avail = [i for i in range(codec.k + codec.m) if i != 0]
+        plan = codec.minimum_to_decode([0], avail)
+        assert 0 not in plan
+        assert len(plan) < codec.k, plan
+
+    def test_minimum_to_decode_matches_decode(self, registry):
+        codec = registry.factory("shec", {"k": "6", "m": "3", "c": "2"})
+        data = _payload(codec)
+        chunks = _encode_all(codec, data)
+        n = codec.k + codec.m
+        for erased in itertools.combinations(range(n), 2):
+            avail = [i for i in range(n) if i not in erased]
+            plan = codec.minimum_to_decode(list(erased), avail)
+            have = {i: chunks[i] for i in plan}
+            out = codec.decode_chunks(list(erased), have)
+            for i in erased:
+                assert np.array_equal(out[i], chunks[i])
+
+    def test_bad_profiles_rejected(self, registry):
+        for prof in ({"k": "4", "m": "3", "c": "5"},
+                     {"k": "2", "m": "3", "c": "1"},
+                     {"k": "4", "m": "0", "c": "1"}):
+            with pytest.raises(ErasureCodeError):
+                registry.factory("shec", prof)
+
+    def test_decode_concat(self, registry):
+        codec = registry.factory("shec", {"k": "4", "m": "3", "c": "2"})
+        data = _payload(codec, nbytes=10000)
+        chunks = _encode_all(codec, data)
+        del chunks[1], chunks[5]
+        got = codec.decode_concat(chunks)
+        assert np.array_equal(got[: data.shape[0]], data)
+
+
+# ---------------------------------------------------------------------------
+# clay
+# ---------------------------------------------------------------------------
+
+
+class TestClay:
+    PROFILES = [
+        {"k": "4", "m": "2"},                      # q=2, t=3, 8 sub-chunks
+        {"k": "3", "m": "3"},                      # q=3, t=2, 9 sub-chunks
+        {"k": "8", "m": "3"},                      # nu=1 padding, 81 sub-chunks
+        {"k": "4", "m": "2", "scalar_mds": "cauchy_good"},
+    ]
+
+    @pytest.mark.parametrize("profile", PROFILES,
+                             ids=lambda p: "k{}m{}{}".format(
+                                 p["k"], p["m"], p.get("scalar_mds", "")))
+    def test_roundtrip_all_m_erasures(self, registry, profile):
+        codec = registry.factory("clay", dict(profile))
+        k, m = codec.k, codec.m
+        n = k + m
+        cs = codec.get_chunk_size(4096 * k)
+        assert cs % codec.get_sub_chunk_count() == 0
+        data = _payload(codec, nbytes=k * cs)
+        chunks = _encode_all(codec, data)
+        for e in range(1, m + 1):
+            for erased in itertools.combinations(range(n), e):
+                have = {i: chunks[i] for i in range(n) if i not in erased}
+                out = codec.decode_chunks(list(erased), have)
+                for i in erased:
+                    assert np.array_equal(out[i], chunks[i]), \
+                        f"erasure {erased}, chunk {i}"
+
+    def test_sub_chunk_count(self, registry):
+        codec = registry.factory("clay", {"k": "4", "m": "2"})
+        assert codec.get_sub_chunk_count() == 8  # q=2, t=3
+        codec = registry.factory("clay", {"k": "8", "m": "3"})
+        assert codec.get_sub_chunk_count() == 81  # q=3, t=4 (nu=1)
+
+    def test_repair_plan_reads_fraction(self, registry):
+        """Single-failure plan: every helper contributes, but only 1/q of
+        each chunk's sub-chunks (the MSR property)."""
+        codec = registry.factory("clay", {"k": "4", "m": "2"})
+        n, q = codec.k + codec.m, codec.q
+        sub = codec.get_sub_chunk_count()
+        plan = codec.minimum_to_decode([0], list(range(1, n)))
+        assert set(plan) == set(range(1, n))
+        for runs in plan.values():
+            assert sum(c for _, c in runs) == sub // q
+
+    def test_repair_from_subchunks_exact(self, registry):
+        """Repair with only the planned sub-chunk reads, for every possible
+        single lost chunk; result must be byte-identical."""
+        codec = registry.factory("clay", {"k": "4", "m": "2"})
+        n = codec.k + codec.m
+        sub = codec.get_sub_chunk_count()
+        cs = codec.get_chunk_size(4096 * codec.k)
+        S = cs // sub
+        data = _payload(codec, nbytes=codec.k * cs)
+        chunks = _encode_all(codec, data)
+        for lost in range(n):
+            avail = [i for i in range(n) if i != lost]
+            plan = codec.minimum_to_decode([lost], avail)
+            have = {}
+            for h, runs in plan.items():
+                parts = [chunks[h][off * S:(off + cnt) * S]
+                         for off, cnt in runs]
+                have[h] = np.concatenate(parts)
+            out = codec.decode([lost], have, cs)
+            assert np.array_equal(out[lost], chunks[lost]), f"lost={lost}"
+
+    def test_multi_failure_plan_is_full_chunks(self, registry):
+        codec = registry.factory("clay", {"k": "4", "m": "2"})
+        n = codec.k + codec.m
+        plan = codec.minimum_to_decode([0, 1], list(range(2, n)))
+        assert len(plan) == codec.k
+        for runs in plan.values():
+            assert runs == [(0, codec.get_sub_chunk_count())]
+
+    def test_bad_profiles_rejected(self, registry):
+        with pytest.raises(ErasureCodeError):
+            registry.factory("clay", {"k": "4", "m": "2", "d": "6"})
+        with pytest.raises(ErasureCodeError):
+            registry.factory("clay", {"k": "4", "m": "2", "d": "3"})
+
+    def test_decode_concat(self, registry):
+        codec = registry.factory("clay", {"k": "3", "m": "3"})
+        cs = codec.get_chunk_size(9999)
+        data = _payload(codec, nbytes=9999)
+        chunks = _encode_all(codec, data)
+        del chunks[0], chunks[4]
+        got = codec.decode_concat(chunks)
+        assert np.array_equal(got[: data.shape[0]], data)
