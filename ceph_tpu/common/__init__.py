@@ -1,0 +1,13 @@
+"""Common infrastructure: buffers, config, perf counters, logging, admin
+socket, throttles.
+
+Rebuild of reference src/common + src/log (SURVEY.md §2.5, §5): the layer-0/1
+primitives every daemon sits on.
+"""
+
+from .buffer import BufferList  # noqa: F401
+from .config import Config, ConfigObserver  # noqa: F401
+from .options import (LEVEL_ADVANCED, LEVEL_BASIC, LEVEL_DEV,  # noqa: F401
+                      OPTIONS, Option)
+from .perf_counters import PerfCounters, PerfCountersBuilder  # noqa: F401
+from .throttle import Throttle  # noqa: F401

@@ -1,0 +1,140 @@
+"""AdminSocket — runtime introspection over a unix domain socket.
+
+Reference: src/common/admin_socket.h:108.  A daemon exposes registered
+commands ('perf dump', 'config get/set', 'dump_historic_ops', ...) on a
+unix socket; the CLI connects, sends one JSON request, reads one JSON
+reply.  Wire format here: newline-terminated JSON request
+``{"prefix": "...", ...args}`` -> JSON reply; the reference speaks a
+similar single-shot JSON protocol.
+
+Runs a plain thread + blocking socket (daemons' asyncio loops stay
+undisturbed; commands are short).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Callable, Optional
+
+Handler = Callable[[dict], object]
+
+
+class AdminSocketError(Exception):
+    pass
+
+
+class AdminSocket:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._handlers: "dict[str, tuple[Handler, str]]" = {}
+        self._lock = threading.Lock()
+        self._srv: "Optional[socket.socket]" = None
+        self._thread: "Optional[threading.Thread]" = None
+        self._stop = threading.Event()
+        self.register("help", self._help, "list registered commands")
+        self.register("version", lambda _: {"version": "ceph-tpu 1.0"},
+                      "framework version")
+
+    # --- registration --------------------------------------------------------
+
+    def register(self, prefix: str, handler: Handler,
+                 help_text: str = "") -> None:
+        with self._lock:
+            if prefix in self._handlers:
+                raise AdminSocketError(f"command {prefix!r} already registered")
+            self._handlers[prefix] = (handler, help_text)
+
+    def unregister(self, prefix: str) -> None:
+        with self._lock:
+            self._handlers.pop(prefix, None)
+
+    def _help(self, _cmd: dict) -> dict:
+        with self._lock:
+            return {p: h for p, (_, h) in sorted(self._handlers.items())}
+
+    # --- serving -------------------------------------------------------------
+
+    def start(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.path)
+        srv.listen(8)
+        srv.settimeout(0.2)
+        self._srv = srv
+        self._thread = threading.Thread(
+            target=self._serve, name=f"admin-socket:{self.path}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if self._srv is not None:
+            self._srv.close()
+        if os.path.exists(self.path):
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self._handle_conn(conn)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(5)
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+        try:
+            cmd = json.loads(buf.split(b"\n", 1)[0])
+            prefix = cmd.get("prefix", "")
+            with self._lock:
+                entry = self._handlers.get(prefix)
+            if entry is None:
+                reply = {"error": f"unknown command {prefix!r}"}
+            else:
+                reply = {"ok": True, "result": entry[0](cmd)}
+        except Exception as e:  # a broken handler must not kill the daemon
+            reply = {"error": f"{type(e).__name__}: {e}"}
+        conn.sendall(json.dumps(reply).encode() + b"\n")
+
+
+def admin_command(path: str, prefix: str, timeout: float = 5.0,
+                  **args) -> object:
+    """Client side: one-shot command (the 'ceph daemon <sock> <cmd>' analog)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout)
+        s.connect(path)
+        req = dict(args)
+        req["prefix"] = prefix
+        s.sendall(json.dumps(req).encode() + b"\n")
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    reply = json.loads(buf.split(b"\n", 1)[0])
+    if "error" in reply:
+        raise AdminSocketError(reply["error"])
+    return reply["result"]

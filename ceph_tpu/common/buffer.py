@@ -1,0 +1,217 @@
+"""BufferList — refcounted scatter-gather buffers with cached crc32c.
+
+Rebuild of the reference bufferlist (src/include/buffer.h, 1285 LoC;
+src/common/buffer.cc, 2184 LoC).  The essentials kept:
+
+- a list of segments over shared backing stores (here: numpy uint8 arrays /
+  memoryviews — Python objects are refcounted, playing buffer::raw's role),
+- zero-copy append/substr/slicing where possible,
+- ``rebuild_aligned`` to coalesce into one aligned contiguous buffer
+  (reference rebuild_aligned_size_and_memory),
+- **cached crc32c per backing buffer**: the reference memoizes (offset,
+  length) -> (seed, crc) pairs on each buffer::raw
+  (src/include/buffer_raw.h:96-105) so repeated crcs of the same bytes and
+  crcs of concatenations are cheap; reproduced here including the
+  crc-combine path for multi-segment lists.
+
+TPU note: the device-native chunk representation is packed uint32 (see
+ops/gf_jax); BufferList is the *host* side — the IO/messenger currency.
+``to_u32()`` hands a buffer to the device path without copies when the
+length is 4-byte aligned and contiguous.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..ops import crc32c as crcmod
+
+
+class _Raw:
+    """One backing store + its crc cache (the buffer::raw analog)."""
+
+    __slots__ = ("data", "crc_cache")
+
+    def __init__(self, data: np.ndarray) -> None:
+        self.data = data                       # 1-D uint8, immutable by convention
+        self.crc_cache: "dict[tuple[int, int], tuple[int, int]]" = {}
+        # maps (off, len) -> (seed, crc)
+
+    def crc(self, off: int, length: int, seed: int) -> int:
+        key = (off, length)
+        hit = self.crc_cache.get(key)
+        if hit is not None and hit[0] == seed:
+            return hit[1]
+        if hit is not None:
+            # Cached under a different seed: the crc register update is
+            # linear over GF(2), so crc(data, s2) = crc(data, s1) ^
+            # A(len)·(s1^s2) with A the zero-shift operator — the same
+            # adjust-the-seed dance the reference does in
+            # buffer::list::crc32c over buffer_raw's cache.
+            s1, c1 = hit
+            out = c1 ^ crcmod.crc32c_combine(s1 ^ seed, 0, length)
+        else:
+            out = crcmod.crc32c(self.data[off:off + length], seed)
+        self.crc_cache[key] = (seed, out)
+        return out
+
+
+class _Segment:
+    __slots__ = ("raw", "off", "len")
+
+    def __init__(self, raw: _Raw, off: int, length: int) -> None:
+        self.raw = raw
+        self.off = off
+        self.len = length
+
+    def view(self) -> np.ndarray:
+        return self.raw.data[self.off:self.off + self.len]
+
+
+class BufferList:
+    """Scatter-gather byte container (the bufferlist analog)."""
+
+    def __init__(self, data: "bytes | bytearray | np.ndarray | None" = None):
+        self._segs: "list[_Segment]" = []
+        self._len = 0
+        if data is not None:
+            self.append(data)
+
+    # --- construction -------------------------------------------------------
+
+    @staticmethod
+    def _as_array(data) -> np.ndarray:
+        if isinstance(data, np.ndarray):
+            arr = data.reshape(-1).view(np.uint8) if data.dtype != np.uint8 \
+                else data.reshape(-1)
+            return arr
+        return np.frombuffer(bytes(data), dtype=np.uint8)
+
+    def append(self, data) -> "BufferList":
+        if isinstance(data, BufferList):
+            self._segs.extend(data._segs)
+            self._len += data._len
+            return self
+        arr = self._as_array(data)
+        if arr.size:
+            self._segs.append(_Segment(_Raw(arr), 0, arr.size))
+            self._len += arr.size
+        return self
+
+    def append_zero(self, length: int) -> "BufferList":
+        if length > 0:
+            self.append(np.zeros(length, dtype=np.uint8))
+        return self
+
+    # --- inspection ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._len
+
+    def length(self) -> int:
+        return self._len
+
+    def get_num_buffers(self) -> int:
+        return len(self._segs)
+
+    def is_contiguous(self) -> bool:
+        return len(self._segs) <= 1
+
+    def is_aligned(self, align: int) -> bool:
+        return all(s.view().ctypes.data % align == 0 for s in self._segs)
+
+    # --- access -------------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return b"".join(s.view().tobytes() for s in self._segs)
+
+    def to_array(self) -> np.ndarray:
+        """Contiguous uint8 copy-free when single-segment."""
+        if not self._segs:
+            return np.zeros(0, dtype=np.uint8)
+        if len(self._segs) == 1:
+            return self._segs[0].view()
+        return np.concatenate([s.view() for s in self._segs])
+
+    def to_u32(self) -> np.ndarray:
+        """Packed uint32 view for the device path; requires 4-byte length."""
+        arr = self.to_array()
+        if arr.size % 4:
+            raise ValueError(f"length {arr.size} not 4-byte aligned")
+        return np.ascontiguousarray(arr).view(np.uint32)
+
+    def substr(self, off: int, length: int) -> "BufferList":
+        """Zero-copy sub-range (shares backing stores and crc caches)."""
+        if off < 0 or length < 0 or off + length > self._len:
+            raise IndexError(f"substr({off}, {length}) of {self._len}")
+        out = BufferList()
+        pos = 0
+        for s in self._segs:
+            if length == 0:
+                break
+            seg_end = pos + s.len
+            if seg_end <= off:
+                pos = seg_end
+                continue
+            start_in_seg = max(0, off - pos)
+            take = min(s.len - start_in_seg, length)
+            out._segs.append(_Segment(s.raw, s.off + start_in_seg, take))
+            out._len += take
+            off += take
+            length -= take
+            pos = seg_end
+        return out
+
+    # --- rebuild ------------------------------------------------------------
+
+    def rebuild(self) -> "BufferList":
+        """Coalesce into a single contiguous buffer, in place."""
+        if len(self._segs) > 1:
+            arr = np.concatenate([s.view() for s in self._segs])
+            self._segs = [_Segment(_Raw(arr), 0, arr.size)]
+        return self
+
+    def rebuild_aligned(self, align: int) -> "BufferList":
+        """Single contiguous buffer whose base address is ``align``-aligned
+        (reference rebuild_aligned; SIMD_ALIGN=32 there, 512 for TPU tiles
+        here — callers choose)."""
+        arr = np.concatenate([s.view() for s in self._segs]) if self._segs \
+            else np.zeros(0, dtype=np.uint8)
+        if arr.size and arr.ctypes.data % align:
+            backing = np.zeros(arr.size + align, dtype=np.uint8)
+            shift = (-backing.ctypes.data) % align
+            aligned = backing[shift:shift + arr.size]
+            aligned[:] = arr
+            arr = aligned
+        self._segs = [_Segment(_Raw(arr), 0, arr.size)] if arr.size else []
+        self._len = arr.size
+        return self
+
+    # --- crc ----------------------------------------------------------------
+
+    def crc32c(self, seed: int = 0) -> int:
+        """crc of the whole list; per-raw cached, segments combined via the
+        GF(2) shift identity (reference buffer::list::crc32c +
+        buffer_raw cached crc, src/include/buffer_raw.h:96-105)."""
+        crc = seed & 0xFFFFFFFF
+        for s in self._segs:
+            crc = s.raw.crc(s.off, s.len, crc)
+        return crc
+
+    def invalidate_crc(self) -> None:
+        for s in self._segs:
+            s.raw.crc_cache.clear()
+
+    # --- comparison / repr ---------------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, (bytes, bytearray)):
+            return self.to_bytes() == bytes(other)
+        if isinstance(other, BufferList):
+            return len(self) == len(other) and self.to_bytes() == other.to_bytes()
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"BufferList(len={self._len}, buffers={len(self._segs)})"

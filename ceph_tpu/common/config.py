@@ -1,0 +1,173 @@
+"""Layered config with runtime observers — rebuild of md_config_t.
+
+Reference: src/common/config.cc + ConfigMonitor.  Value resolution layers,
+lowest to highest precedence (reference order kept):
+
+    compiled defaults < conf file < mon central config < env
+    (CEPH_TPU_<NAME>) < cli overrides < runtime overrides
+
+Runtime ``set`` on a FLAG_RUNTIME option notifies registered observers
+(the md_config_obs_t pattern — e.g. the op scheduler re-tunes on
+mClock-style option changes, reference src/osd/scheduler/mClockScheduler.h
+:61).  Startup-only options reject runtime mutation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from .options import FLAG_STARTUP, OPTIONS, Option, OptionError
+
+# Layer names, lowest precedence first.
+LAYERS = ("default", "file", "mon", "env", "cli", "runtime")
+
+ENV_PREFIX = "CEPH_TPU_"
+
+
+class ConfigObserver:
+    """Subclass (or duck-type) and register to hear runtime changes."""
+
+    def get_tracked_keys(self) -> "Iterable[str]":
+        return ()
+
+    def handle_conf_change(self, config: "Config",
+                           changed: "set[str]") -> None:
+        raise NotImplementedError
+
+
+class Config:
+    def __init__(self, schema: "dict[str, Option] | None" = None,
+                 read_env: bool = True) -> None:
+        self.schema = dict(schema) if schema is not None else dict(OPTIONS)
+        self._values: "dict[str, dict[str, Any]]" = {l: {} for l in LAYERS}
+        self._observers: "list[ConfigObserver]" = []
+        self._lock = threading.RLock()
+        self._started = False
+        if read_env:
+            for name, opt in self.schema.items():
+                env = os.environ.get(ENV_PREFIX + name.upper())
+                if env is not None:
+                    self._values["env"][name] = opt.validate(env)
+
+    # --- reads --------------------------------------------------------------
+
+    def _opt(self, name: str) -> Option:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise OptionError(f"unknown option {name!r}")
+        return opt
+
+    def get(self, name: str) -> Any:
+        opt = self._opt(name)
+        with self._lock:
+            for layer in reversed(LAYERS):
+                if name in self._values[layer]:
+                    return self._values[layer][name]
+        return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def origin(self, name: str) -> str:
+        """Which layer supplies the effective value (diff support —
+        the 'ceph config diff' analog)."""
+        self._opt(name)
+        with self._lock:
+            for layer in reversed(LAYERS):
+                if name in self._values[layer]:
+                    return layer
+        return "default"
+
+    def dump(self, include_defaults: bool = True) -> "dict[str, Any]":
+        out = {}
+        for name in sorted(self.schema):
+            if include_defaults or self.origin(name) != "default":
+                out[name] = self.get(name)
+        return out
+
+    # --- writes -------------------------------------------------------------
+
+    def set(self, name: str, value: Any, layer: str = "runtime") -> None:
+        opt = self._opt(name)
+        if layer not in LAYERS:
+            raise OptionError(f"unknown config layer {layer!r}")
+        validated = opt.validate(value)
+        with self._lock:
+            if (layer in ("runtime", "mon") and self._started
+                    and FLAG_STARTUP in opt.flags):
+                raise OptionError(
+                    f"option {name} can only be set at startup")
+            old = self.get(name)
+            self._values[layer][name] = validated
+            changed = self.get(name) != old
+        if changed:
+            self._notify({name})
+
+    def rm(self, name: str, layer: str = "runtime") -> None:
+        self._opt(name)
+        with self._lock:
+            old = self.get(name)
+            self._values[layer].pop(name, None)
+            changed = self.get(name) != old
+        if changed:
+            self._notify({name})
+
+    def apply_cli(self, overrides: "dict[str, Any]") -> None:
+        for k, v in overrides.items():
+            self.set(k, v, layer="cli")
+
+    def load_file(self, path: str) -> None:
+        """Conf file: JSON object or 'name = value' lines."""
+        with open(path) as f:
+            text = f.read()
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError:
+            data = {}
+            for line in text.splitlines():
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                data[k.strip()] = v.strip()
+        for k, v in data.items():
+            self.set(k, v, layer="file")
+
+    def apply_mon_config(self, kv: "dict[str, Any]") -> None:
+        """Central config pushed from the mon (ConfigMonitor analog):
+        replaces the whole mon layer."""
+        with self._lock:
+            before = {k: self.get(k) for k in set(self._values["mon"]) | set(kv)}
+            self._values["mon"] = {
+                k: self._opt(k).validate(v) for k, v in kv.items()
+                if k in self.schema}
+            changed = {k for k, v in before.items()
+                       if k in self.schema and self.get(k) != v}
+        if changed:
+            self._notify(changed)
+
+    def mark_started(self) -> None:
+        """After this, FLAG_STARTUP options are frozen."""
+        self._started = True
+
+    # --- observers ----------------------------------------------------------
+
+    def add_observer(self, obs: ConfigObserver) -> None:
+        with self._lock:
+            self._observers.append(obs)
+
+    def remove_observer(self, obs: ConfigObserver) -> None:
+        with self._lock:
+            self._observers = [o for o in self._observers if o is not obs]
+
+    def _notify(self, changed: "set[str]") -> None:
+        with self._lock:
+            observers = list(self._observers)
+        for obs in observers:
+            tracked = set(obs.get_tracked_keys())
+            hits = changed & tracked if tracked else set()
+            if hits:
+                obs.handle_conf_change(self, hits)

@@ -1,0 +1,117 @@
+"""dout-style logging: per-subsystem levels, async sink, crash ring dump.
+
+Reference: src/log/Log.cc (async Log thread, in-memory ring of recent
+entries dumped on crash), src/log/SubsystemMap.h (per-subsystem gather
+level vs file level), the ``dout(n)`` macros.
+
+Here: a process-wide ``Log`` with per-subsystem levels; every entry below
+the *gather* level is appended to a bounded ring regardless of whether it
+is written out, so ``dump_recent()`` reconstructs the run after a failure
+(the reference's most operationally loved feature).  Writing is
+synchronous-by-default to a file object; daemons run it as-is (Python's
+GIL makes a separate flush thread pointless at our volumes).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+DEFAULT_SUBSYS = {
+    # subsystem: (gather_level, output_level) — reference SubsystemMap dual
+    # levels: everything <= gather lands in the ring, <= output is written.
+    "ms": (5, 1),
+    "osd": (5, 1),
+    "mon": (5, 1),
+    "ec": (5, 1),
+    "pg": (5, 1),
+    "objectstore": (5, 1),
+    "client": (5, 1),
+    "bench": (5, 1),
+    "none": (5, 1),
+}
+
+
+class Log:
+    def __init__(self, name: str = "", max_recent: int = 10000,
+                 stream: "Optional[io.TextIOBase]" = None) -> None:
+        self.name = name
+        self._subsys = {k: list(v) for k, v in DEFAULT_SUBSYS.items()}
+        self._ring: "collections.deque[str]" = collections.deque(
+            maxlen=max_recent)
+        self._stream = stream
+        self._lock = threading.Lock()
+
+    # --- levels --------------------------------------------------------------
+
+    def set_level(self, subsys: str, gather: int,
+                  output: "Optional[int]" = None) -> None:
+        with self._lock:
+            cur = self._subsys.setdefault(subsys, [5, 1])
+            cur[0] = gather
+            if output is not None:
+                cur[1] = output
+
+    def get_level(self, subsys: str) -> "tuple[int, int]":
+        g, o = self._subsys.get(subsys, self._subsys["none"])
+        return g, o
+
+    def should_gather(self, subsys: str, level: int) -> bool:
+        return level <= self._subsys.get(subsys, self._subsys["none"])[0]
+
+    # --- emit ----------------------------------------------------------------
+
+    def dout(self, subsys: str, level: int, msg: str) -> None:
+        gather, output = self._subsys.get(subsys, self._subsys["none"])
+        if level > gather:
+            return
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
+        line = f"{ts} {self.name} {level} {subsys}: {msg}"
+        with self._lock:
+            self._ring.append(line)
+            if level <= output and self._stream is not None:
+                try:
+                    self._stream.write(line + "\n")
+                    self._stream.flush()
+                except (OSError, ValueError):
+                    pass
+
+    def derr(self, subsys: str, msg: str) -> None:
+        self.dout(subsys, -1, msg)
+
+    # --- crash support --------------------------------------------------------
+
+    def dump_recent(self, stream: "Optional[io.TextIOBase]" = None) -> "list[str]":
+        """Flush the in-memory ring (reference: dumped on assert/crash)."""
+        out = stream or self._stream or sys.stderr
+        with self._lock:
+            lines = list(self._ring)
+        try:
+            out.write(f"--- begin dump of recent events ({len(lines)}) ---\n")
+            for line in lines:
+                out.write(line + "\n")
+            out.write("--- end dump of recent events ---\n")
+            out.flush()
+        except (OSError, ValueError):
+            pass
+        return lines
+
+    def dump_on_exc(self) -> None:
+        traceback.print_exc()
+        self.dump_recent()
+
+
+_global = Log("global")
+
+
+def get_log() -> Log:
+    return _global
+
+
+def dout(subsys: str, level: int, msg: str) -> None:
+    _global.dout(subsys, level, msg)

@@ -1,0 +1,180 @@
+"""Typed option schema — rebuild of the reference Option table.
+
+Reference: src/common/options.cc (8474 LoC, ~1600 Options).  Each option
+has a type, default, optional min/max or enum constraint, a level
+(basic/advanced/dev), flags (startup vs runtime-mutable), description,
+see_also links and service tags.  This table carries the subset the
+rebuilt daemons actually consume; the *schema machinery* is complete so
+new options are one-liners.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+FLAG_STARTUP = "startup"        # only settable before daemon start
+FLAG_RUNTIME = "runtime"        # observable at runtime
+
+
+class OptionError(ValueError):
+    pass
+
+
+@dataclass
+class Option:
+    name: str
+    type: type                   # int, float, str, bool
+    default: Any
+    level: str = LEVEL_ADVANCED
+    flags: "tuple[str, ...]" = (FLAG_RUNTIME,)
+    desc: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+    enum_values: "tuple[str, ...]" = ()
+    see_also: "tuple[str, ...]" = ()
+    services: "tuple[str, ...]" = ()
+
+    def validate(self, value: Any) -> Any:
+        """Coerce + bounds-check ``value``; raises OptionError."""
+        try:
+            if self.type is bool and isinstance(value, str):
+                low = value.strip().lower()
+                if low in ("true", "1", "yes", "on"):
+                    out: Any = True
+                elif low in ("false", "0", "no", "off"):
+                    out = False
+                else:
+                    raise ValueError(value)
+            else:
+                out = self.type(value)
+        except (TypeError, ValueError):
+            raise OptionError(
+                f"option {self.name}: {value!r} is not a {self.type.__name__}")
+        if self.min is not None and out < self.min:
+            raise OptionError(
+                f"option {self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise OptionError(
+                f"option {self.name}: {out} > max {self.max}")
+        if self.enum_values and out not in self.enum_values:
+            raise OptionError(
+                f"option {self.name}: {out!r} not in {self.enum_values}")
+        return out
+
+    def is_runtime(self) -> bool:
+        return FLAG_RUNTIME in self.flags
+
+
+def _opts(*options: Option) -> "dict[str, Option]":
+    out: "dict[str, Option]" = {}
+    for o in options:
+        if o.name in out:
+            raise OptionError(f"duplicate option {o.name}")
+        out[o.name] = o
+    return out
+
+
+# The live schema.  Names follow the reference where the concept carries
+# over (grep-ability for operators coming from Ceph).
+OPTIONS: "dict[str, Option]" = _opts(
+    # --- erasure code -------------------------------------------------------
+    Option("erasure_code_dir", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           "directory for out-of-tree EC plugin modules",
+           services=("mon", "osd")),
+    Option("osd_erasure_code_plugins", str, "jax_rs xor lrc isa jerasure shec clay",
+           LEVEL_ADVANCED, (FLAG_STARTUP,),
+           "EC plugins to preload at daemon start", services=("mon", "osd")),
+    Option("osd_pool_default_erasure_code_profile", str,
+           "plugin=jax_rs technique=reed_sol_van k=4 m=2",
+           LEVEL_ADVANCED, desc="default EC profile for new pools",
+           services=("mon",)),
+    # --- osd ----------------------------------------------------------------
+    Option("osd_heartbeat_interval", float, 1.0, LEVEL_ADVANCED,
+           min=0.05, max=60, desc="seconds between peer pings",
+           services=("osd",)),
+    Option("osd_heartbeat_grace", float, 6.0, LEVEL_ADVANCED,
+           min=0.1, desc="seconds without reply before reporting a peer down",
+           see_also=("osd_heartbeat_interval",), services=("osd", "mon")),
+    Option("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
+           min=4096, desc="max recovery payload per push (bytes)",
+           services=("osd",)),
+    Option("osd_recovery_max_active", int, 3, LEVEL_ADVANCED, min=1,
+           desc="concurrent recovery ops per OSD", services=("osd",)),
+    Option("osd_max_write_size", int, 90 << 20, LEVEL_ADVANCED, min=4096,
+           desc="max single write accepted from clients", services=("osd",)),
+    Option("osd_client_message_cap", int, 256, LEVEL_ADVANCED, min=1,
+           desc="max in-flight client messages before backpressure",
+           services=("osd",)),
+    Option("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
+           enum_values=("wpq", "mclock"), desc="op scheduler implementation",
+           services=("osd",)),
+    Option("osd_ec_batch_stripes", int, 64, LEVEL_ADVANCED, min=1,
+           desc="stripes batched per device encode launch across PGs "
+                "(TPU amortization knob)", services=("osd",)),
+    Option("osd_fast_read", bool, False, LEVEL_ADVANCED,
+           desc="issue redundant shard reads, decode from first k",
+           services=("osd",)),
+    Option("osd_pool_default_size", int, 3, LEVEL_BASIC, min=1,
+           desc="default replica count for replicated pools",
+           services=("mon",)),
+    Option("osd_pool_default_pg_num", int, 32, LEVEL_BASIC, min=1,
+           desc="default PG count for new pools", services=("mon",)),
+    # --- messenger ----------------------------------------------------------
+    Option("ms_type", str, "async+tcp", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           enum_values=("async+tcp", "async+local"),
+           desc="messenger transport"),
+    Option("ms_crc_data", bool, True, LEVEL_ADVANCED,
+           desc="crc32c-protect message payloads on the wire"),
+    Option("ms_secure_mode", bool, False, LEVEL_ADVANCED,
+           desc="AEAD-encrypt frames instead of crc (protocol v2 'secure')"),
+    Option("ms_tcp_nodelay", bool, True, LEVEL_ADVANCED,
+           desc="disable Nagle on connections"),
+    Option("ms_initial_backoff", float, 0.2, LEVEL_ADVANCED, min=0.001,
+           desc="reconnect backoff start (seconds)"),
+    Option("ms_max_backoff", float, 15.0, LEVEL_ADVANCED, min=0.01,
+           desc="reconnect backoff cap (seconds)"),
+    Option("ms_dispatch_throttle_bytes", int, 100 << 20, LEVEL_ADVANCED,
+           min=0, desc="max bytes queued for dispatch before backpressure"),
+    Option("ms_inject_socket_failures", int, 0, LEVEL_DEV, min=0,
+           desc="one-in-N chance to kill a socket on send/recv (QA)"),
+    Option("ms_inject_delay_max", float, 0.0, LEVEL_DEV, min=0,
+           desc="max random injected delivery delay (seconds, QA)"),
+    Option("ms_inject_drop_ratio", float, 0.0, LEVEL_DEV, min=0, max=1,
+           desc="probability of dropping an outgoing message (QA)"),
+    # --- mon ----------------------------------------------------------------
+    Option("mon_lease", float, 5.0, LEVEL_ADVANCED, min=0.1,
+           desc="leader lease duration (seconds)", services=("mon",)),
+    Option("mon_tick_interval", float, 1.0, LEVEL_ADVANCED, min=0.05,
+           desc="mon periodic tick (seconds)", services=("mon",)),
+    Option("mon_osd_down_out_interval", float, 600.0, LEVEL_ADVANCED, min=0,
+           desc="seconds down before an OSD is marked out", services=("mon",)),
+    Option("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED, min=1,
+           desc="failure reports required to mark an OSD down",
+           services=("mon",)),
+    Option("mon_max_pg_per_osd", int, 250, LEVEL_ADVANCED, min=1,
+           desc="PG-per-OSD cap enforced at pool create", services=("mon",)),
+    # --- log / observability ------------------------------------------------
+    Option("log_to_file", bool, False, LEVEL_BASIC,
+           desc="write the daemon log to log_file"),
+    Option("log_file", str, "", LEVEL_BASIC, desc="log file path"),
+    Option("log_max_recent", int, 10000, LEVEL_ADVANCED, min=1,
+           desc="in-memory ring of recent entries dumped on crash"),
+    Option("admin_socket", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           desc="unix socket path for runtime admin commands"),
+    Option("debug_default", int, 1, LEVEL_BASIC, min=0, max=20,
+           desc="default per-subsystem debug level"),
+    # --- objectstore --------------------------------------------------------
+    Option("objectstore_type", str, "mem", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           enum_values=("mem", "file"), desc="object store backend",
+           services=("osd",)),
+    Option("objectstore_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           desc="data directory for the file objectstore", services=("osd",)),
+    Option("objectstore_fsync", bool, False, LEVEL_ADVANCED,
+           desc="fsync file-store transactions (durable but slow in QA)",
+           services=("osd",)),
+)

@@ -1,0 +1,197 @@
+"""PerfCounters — rebuild of the reference perf counter framework.
+
+Reference: src/common/perf_counters.h:34 (builder pattern; u64 gauges,
+u64 counters, time counters, long-run averages, histograms), consumed by
+``perf dump`` over the admin socket and aggregated by the mgr/prometheus
+exporter.  The OSD's counter set lives in src/osd/osd_perf_counters.cc.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# Counter kinds.
+U64 = "u64"                  # settable gauge
+U64_COUNTER = "u64_counter"  # monotonically increasing
+TIME = "time"                # accumulated seconds
+LONGRUNAVG = "longrunavg"    # (sum, count) pair -> average
+HISTOGRAM = "histogram"      # log2-bucketed value histogram
+
+
+class _Counter:
+    __slots__ = ("name", "kind", "desc", "unit", "value", "sum", "count",
+                 "buckets")
+
+    def __init__(self, name: str, kind: str, desc: str, unit: str) -> None:
+        self.name = name
+        self.kind = kind
+        self.desc = desc
+        self.unit = unit
+        self.value = 0
+        self.sum = 0.0
+        self.count = 0
+        self.buckets = [0] * 64 if kind == HISTOGRAM else None
+
+
+class PerfCounters:
+    """One named group of counters (per daemon subsystem)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._counters: "dict[str, _Counter]" = {}
+        self._lock = threading.Lock()
+
+    # --- mutation ------------------------------------------------------------
+
+    def _c(self, name: str, kind: "Optional[str]" = None) -> _Counter:
+        c = self._counters[name]
+        if kind is not None and c.kind != kind:
+            raise TypeError(f"counter {name} is {c.kind}, not {kind}")
+        return c
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._c(name, U64).value = int(value)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            c = self._c(name)
+            if c.kind not in (U64, U64_COUNTER):
+                raise TypeError(f"counter {name} is {c.kind}")
+            c.value += int(by)
+
+    def dec(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c(name, U64).value -= int(by)
+
+    def tinc(self, name: str, seconds: float) -> None:
+        """Accumulate elapsed time (reference tinc)."""
+        with self._lock:
+            c = self._c(name)
+            if c.kind == TIME:
+                c.sum += float(seconds)
+                c.count += 1
+            elif c.kind == LONGRUNAVG:
+                c.sum += float(seconds)
+                c.count += 1
+            else:
+                raise TypeError(f"counter {name} is {c.kind}")
+
+    def hinc(self, name: str, value: float) -> None:
+        """Histogram insert (log2 buckets)."""
+        with self._lock:
+            c = self._c(name, HISTOGRAM)
+            v = max(0, int(value))
+            c.buckets[min(63, v.bit_length())] += 1
+            c.sum += value
+            c.count += 1
+
+    class _Timer:
+        def __init__(self, pc: "PerfCounters", name: str) -> None:
+            self._pc = pc
+            self._name = name
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._pc.tinc(self._name, time.perf_counter() - self._t0)
+            return False
+
+    def timer(self, name: str) -> "_Timer":
+        return self._Timer(self, name)
+
+    # --- dump ----------------------------------------------------------------
+
+    def dump(self) -> dict:
+        """'perf dump' shape: {counter: value-or-struct}."""
+        out: dict = {}
+        with self._lock:
+            for name, c in self._counters.items():
+                if c.kind in (U64, U64_COUNTER):
+                    out[name] = c.value
+                elif c.kind == TIME:
+                    out[name] = {"avgcount": c.count, "sum": c.sum}
+                elif c.kind == LONGRUNAVG:
+                    avg = c.sum / c.count if c.count else 0.0
+                    out[name] = {"avgcount": c.count, "sum": c.sum,
+                                 "avg": avg}
+                elif c.kind == HISTOGRAM:
+                    out[name] = {"count": c.count, "sum": c.sum,
+                                 "buckets": {
+                                     str(1 << (i - 1) if i else 0): n
+                                     for i, n in enumerate(c.buckets) if n}}
+        return out
+
+    def schema(self) -> dict:
+        with self._lock:
+            return {name: {"type": c.kind, "description": c.desc,
+                           "unit": c.unit}
+                    for name, c in self._counters.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            for c in self._counters.values():
+                c.value = 0
+                c.sum = 0.0
+                c.count = 0
+                if c.buckets is not None:
+                    c.buckets = [0] * 64
+
+
+class PerfCountersBuilder:
+    """Reference builder pattern: declare, then create_perf_counters()."""
+
+    def __init__(self, name: str) -> None:
+        self._pc = PerfCounters(name)
+
+    def _add(self, name: str, kind: str, desc: str, unit: str):
+        if name in self._pc._counters:
+            raise ValueError(f"duplicate counter {name}")
+        self._pc._counters[name] = _Counter(name, kind, desc, unit)
+        return self
+
+    def add_u64(self, name: str, desc: str = "", unit: str = ""):
+        return self._add(name, U64, desc, unit)
+
+    def add_u64_counter(self, name: str, desc: str = "", unit: str = ""):
+        return self._add(name, U64_COUNTER, desc, unit)
+
+    def add_time_avg(self, name: str, desc: str = ""):
+        return self._add(name, TIME, desc, "s")
+
+    def add_longrunavg(self, name: str, desc: str = "", unit: str = ""):
+        return self._add(name, LONGRUNAVG, desc, unit)
+
+    def add_histogram(self, name: str, desc: str = "", unit: str = ""):
+        return self._add(name, HISTOGRAM, desc, unit)
+
+    def create_perf_counters(self) -> PerfCounters:
+        return self._pc
+
+
+class PerfCountersCollection:
+    """All of a daemon's counter groups (admin socket 'perf dump' target)."""
+
+    def __init__(self) -> None:
+        self._groups: "dict[str, PerfCounters]" = {}
+        self._lock = threading.Lock()
+
+    def add(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._groups[pc.name] = pc
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {name: pc.dump() for name, pc in self._groups.items()}
+
+    def schema(self) -> dict:
+        with self._lock:
+            return {name: pc.schema() for name, pc in self._groups.items()}

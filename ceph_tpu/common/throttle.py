@@ -1,0 +1,79 @@
+"""Byte/count throttles — rebuild of src/common/Throttle.{h,cc}.
+
+Both a threaded (blocking) and an asyncio acquire path, because the
+messenger is asyncio while store/compute paths are threaded.  Used for
+messenger dispatch backpressure (ms_dispatch_throttle_bytes) and
+client-op admission, mirroring the reference Policy throttles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+
+class Throttle:
+    def __init__(self, name: str, max_value: int) -> None:
+        self.name = name
+        self._max = max_value
+        self._cur = 0
+        self._cond = threading.Condition()
+
+    # --- inspection ----------------------------------------------------------
+
+    @property
+    def max(self) -> int:
+        return self._max
+
+    @property
+    def current(self) -> int:
+        return self._cur
+
+    def past_midpoint(self) -> bool:
+        return self._cur >= self._max / 2
+
+    # --- threaded API --------------------------------------------------------
+
+    def reset_max(self, m: int) -> None:
+        with self._cond:
+            self._max = m
+            self._cond.notify_all()
+
+    def get(self, count: int, timeout: "Optional[float]" = None) -> bool:
+        """Block until ``count`` can be taken; False on timeout.  A request
+        larger than max is admitted alone (reference behavior)."""
+        if self._max <= 0:
+            return True
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._cur == 0 or self._cur + count <= self._max,
+                timeout)
+            if not ok:
+                return False
+            self._cur += count
+            return True
+
+    def get_or_fail(self, count: int) -> bool:
+        if self._max <= 0:
+            return True
+        with self._cond:
+            if self._cur and self._cur + count > self._max:
+                return False
+            self._cur += count
+            return True
+
+    def put(self, count: int) -> None:
+        if self._max <= 0:
+            return
+        with self._cond:
+            self._cur = max(0, self._cur - count)
+            self._cond.notify_all()
+
+    # --- asyncio API ---------------------------------------------------------
+
+    async def aget(self, count: int) -> None:
+        if self._max <= 0:
+            return
+        while not self.get_or_fail(count):
+            await asyncio.sleep(0.001)
