@@ -1,0 +1,8 @@
+"""OSD-side data path: stripe math, hash info, write planning, extent
+cache, EC backend state machines, PG log.
+
+Rebuild of reference src/osd (SURVEY.md §2.2) — the consumer of the EC
+codec layer.
+"""
+
+from .ecutil import HashInfo, StripeInfo  # noqa: F401

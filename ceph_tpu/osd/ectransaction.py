@@ -1,0 +1,94 @@
+"""EC write planning — rebuild of src/osd/ECTransaction.{h,cc} front half.
+
+``get_write_plan`` (reference ECTransaction.h:40): an EC overwrite must be
+stripe-aligned on disk, so a logical write decomposes into
+- ``to_read``: the head/tail stripes that are only partially covered by
+  the write but hold existing data — fetched (from the extent cache or
+  remote shards), merged, re-encoded (the RMW path),
+- ``will_write``: the stripe-aligned extents that will be encoded and
+  written per shard.
+
+The per-shard transaction generation half (generate_transactions /
+encode_and_write, ECTransaction.cc:25-97) lives with the EC backend, where
+the object store's Transaction type is in scope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Tuple
+
+from .ecutil import StripeInfo
+
+Extent = Tuple[int, int]  # (offset, length), logical bytes
+
+
+def _merge_extents(extents: "Iterable[Extent]") -> "list[Extent]":
+    out: "list[Extent]" = []
+    for off, length in sorted(e for e in extents if e[1] > 0):
+        if out and off <= out[-1][0] + out[-1][1]:
+            last_off, last_len = out[-1]
+            out[-1] = (last_off, max(last_len, off + length - last_off))
+        else:
+            out.append((off, length))
+    return out
+
+
+@dataclass
+class WritePlan:
+    """reference ECTransaction.h:26-33 (WritePlan)."""
+    to_read: "list[Extent]" = field(default_factory=list)     # stripe-aligned
+    will_write: "list[Extent]" = field(default_factory=list)  # stripe-aligned
+    orig_size: int = 0
+    projected_size: int = 0
+    invalidates_cache: bool = False
+
+
+def get_write_plan(sinfo: StripeInfo, writes: "Iterable[Extent]",
+                   orig_size: int, truncate_to: "int | None" = None
+                   ) -> WritePlan:
+    """Plan RMW for a set of logical write extents on an object of
+    ``orig_size`` bytes.
+
+    A stripe needs reading iff the union of writes covers it only
+    partially AND it intersects existing data ([0, orig_size) rounded out
+    to stripes).  Head/tail-only in practice, but computed per overlapped
+    stripe so multi-extent ops plan correctly.
+    """
+    sw = sinfo.stripe_width
+    writes = _merge_extents(writes)
+    plan = WritePlan(orig_size=orig_size)
+    size = orig_size
+    for off, length in writes:
+        size = max(size, off + length)
+    plan.projected_size = size if truncate_to is None else truncate_to
+    if truncate_to is not None and truncate_to < orig_size:
+        plan.invalidates_cache = True
+
+    aligned_orig = sinfo.logical_to_next_stripe_offset(orig_size)
+    to_read: "list[Extent]" = []
+    will_write: "list[Extent]" = []
+    for off, length in writes:
+        start, span = sinfo.offset_len_to_stripe_bounds(off, length)
+        will_write.append((start, span))
+        for stripe_off in range(start, start + span, sw):
+            covered = _covered_in(writes, stripe_off, sw)
+            if covered >= sw:
+                continue  # full-stripe write: pure encode, no read
+            if stripe_off < aligned_orig:
+                # partial stripe with existing data: read it (clamped to
+                # existing stripes; bytes past orig_size decode as zeros)
+                to_read.append((stripe_off, sw))
+    plan.to_read = _merge_extents(to_read)
+    plan.will_write = _merge_extents(will_write)
+    return plan
+
+
+def _covered_in(writes: "list[Extent]", off: int, length: int) -> int:
+    """Bytes of [off, off+length) covered by the (merged) write extents."""
+    covered = 0
+    for woff, wlen in writes:
+        lo = max(off, woff)
+        hi = min(off + length, woff + wlen)
+        covered += max(0, hi - lo)
+    return covered

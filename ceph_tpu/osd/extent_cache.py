@@ -1,0 +1,119 @@
+"""ExtentCache — pins in-flight RMW stripes so pipelined overlapping
+writes read locally instead of re-fetching from shards.
+
+Rebuild of src/osd/ExtentCache.{h,cc} (design comment at
+ExtentCache.h:15-40): the primary, while a write is between "planned" and
+"committed", keeps the affected stripes' *logical* bytes cached and
+pinned.  A later overlapping write reads the pinned bytes directly; pins
+are released (and the LRU trimmed) when the write commits.
+
+Model: per-object sorted extent map of logical bytes + a pin count per
+write op.  Only whole planned extents are inserted (stripe-aligned by
+construction), so reads hit iff the range is fully present.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+Extent = Tuple[int, int]
+
+
+class _ObjectCache:
+    def __init__(self) -> None:
+        # disjoint, sorted extents: start -> (data, pin_count)
+        self.extents: "dict[int, list]" = {}
+
+    def _overlapping(self, off: int, length: int) -> "list[int]":
+        return [s for s, (d, _) in self.extents.items()
+                if s < off + length and off < s + len(d)]
+
+    def insert(self, off: int, data: np.ndarray, pin: bool) -> None:
+        """Insert/overwrite [off, off+len(data)); newer bytes win
+        (the pinned write is the authoritative in-flight content)."""
+        data = np.asarray(data, dtype=np.uint8).reshape(-1)
+        length = data.size
+        if not length:
+            return
+        for s in self._overlapping(off, length):
+            d, pins = self.extents.pop(s)
+            # keep non-overlapped prefix/suffix of the old extent
+            if s < off:
+                self.extents[s] = [d[: off - s], pins]
+            if s + len(d) > off + length:
+                tail_start = off + length
+                self.extents[tail_start] = [d[tail_start - s:], pins]
+        self.extents[off] = [data, 1 if pin else 0]
+
+    def read(self, off: int, length: int) -> "Optional[np.ndarray]":
+        """The bytes iff fully present, else None."""
+        out = np.empty(length, dtype=np.uint8)
+        pos = off
+        remaining = length
+        while remaining > 0:
+            seg = None
+            for s, (d, _) in self.extents.items():
+                if s <= pos < s + len(d):
+                    seg = (s, d)
+                    break
+            if seg is None:
+                return None
+            s, d = seg
+            take = min(remaining, s + len(d) - pos)
+            out[length - remaining: length - remaining + take] = \
+                d[pos - s: pos - s + take]
+            pos += take
+            remaining -= take
+        return out
+
+    def unpin(self, off: int, length: int) -> None:
+        for s in self._overlapping(off, length):
+            self.extents[s][1] = max(0, self.extents[s][1] - 1)
+
+    def trim_unpinned(self) -> None:
+        self.extents = {s: v for s, v in self.extents.items() if v[1] > 0}
+
+    def empty(self) -> bool:
+        return not self.extents
+
+
+class ExtentCache:
+    def __init__(self) -> None:
+        self._objects: "Dict[object, _ObjectCache]" = {}
+
+    def _obj(self, oid) -> _ObjectCache:
+        return self._objects.setdefault(oid, _ObjectCache())
+
+    # --- write pipeline hooks (names track the reference) ---------------------
+
+    def present_rmw_update(self, oid, off: int, data: np.ndarray) -> None:
+        """A planned write's post-image bytes become visible to later
+        overlapping ops (pinned until release)."""
+        self._obj(oid).insert(off, data, pin=True)
+
+    def maybe_read(self, oid, off: int, length: int) -> "Optional[np.ndarray]":
+        cache = self._objects.get(oid)
+        if cache is None:
+            return None
+        return cache.read(off, length)
+
+    def release_write(self, oid, extents: "List[Extent]") -> None:
+        """Write committed: unpin its extents, trim what nothing pins."""
+        cache = self._objects.get(oid)
+        if cache is None:
+            return
+        for off, length in extents:
+            cache.unpin(off, length)
+        cache.trim_unpinned()
+        if cache.empty():
+            del self._objects[oid]
+
+    def invalidate(self, oid) -> None:
+        """Object truncated/removed mid-pipeline."""
+        self._objects.pop(oid, None)
+
+    def size_bytes(self) -> int:
+        return sum(len(d) for c in self._objects.values()
+                   for d, _ in c.extents.values())

@@ -1,0 +1,211 @@
+"""Stripe math / HashInfo / write plan / extent cache tests
+(reference: src/test/osd/TestECUtil-style coverage, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.osd import HashInfo, StripeInfo
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.ectransaction import get_write_plan
+from ceph_tpu.osd.extent_cache import ExtentCache
+from ceph_tpu.ops import crc32c as crcmod
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return ErasureCodePluginRegistry.instance().factory(
+        "jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+
+
+@pytest.fixture(scope="module")
+def sinfo(codec):
+    return StripeInfo.for_codec(codec, stripe_unit=512)
+
+
+class TestStripeInfo:
+    def test_geometry(self, sinfo):
+        assert sinfo.k == 4
+        assert sinfo.stripe_width == 4 * sinfo.chunk_size
+
+    def test_offset_algebra(self):
+        si = StripeInfo(4096, 1024)
+        assert si.logical_to_prev_stripe_offset(5000) == 4096
+        assert si.logical_to_next_stripe_offset(5000) == 8192
+        assert si.logical_to_next_stripe_offset(4096) == 4096
+        assert si.logical_to_prev_chunk_offset(5000) == 1024
+        assert si.logical_to_next_chunk_offset(5000) == 2048
+        assert si.aligned_logical_offset_to_chunk_offset(8192) == 2048
+        assert si.aligned_chunk_offset_to_logical_offset(2048) == 8192
+        assert si.offset_len_to_stripe_bounds(5000, 100) == (4096, 4096)
+        assert si.offset_len_to_stripe_bounds(4000, 200) == (0, 8192)
+        with pytest.raises(ValueError):
+            si.aligned_logical_offset_to_chunk_offset(5000)
+
+    def test_split_roundtrip(self):
+        si = StripeInfo(64, 16)
+        data = np.arange(192, dtype=np.uint8)
+        shards = si.split_to_shards(data)
+        assert shards.shape == (4, 48)
+        # stripe 0 chunk 1 = bytes 16..32, at shard 1's first chunk
+        assert np.array_equal(shards[1][:16], data[16:32])
+        # stripe 2 chunk 0 = bytes 128..144 at shard 0 chunk slot 2
+        assert np.array_equal(shards[0][32:], data[128:144])
+        assert np.array_equal(si.shards_to_logical(shards), data)
+
+
+class TestEncodeDecode:
+    def test_multi_stripe_batched_encode_decode(self, codec, sinfo):
+        S = 7
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, size=S * sinfo.stripe_width,
+                            dtype=np.uint8).astype(np.uint8)
+        shards = ecutil.encode(sinfo, codec, data)
+        assert len(shards) == 6
+        assert all(v.size == S * sinfo.chunk_size for v in shards.values())
+        # batched whole-extent encode == per-stripe encode
+        for s in range(S):
+            stripe = data[s * sinfo.stripe_width:(s + 1) * sinfo.stripe_width]
+            per = ecutil.encode(sinfo, codec, stripe)
+            for i in range(6):
+                got = shards[i][s * sinfo.chunk_size:(s + 1) * sinfo.chunk_size]
+                assert np.array_equal(got, per[i]), (s, i)
+        # reconstruct logical stream after losing 2 shards
+        have = {i: shards[i] for i in (0, 2, 4, 5)}
+        assert np.array_equal(
+            ecutil.decode_concat(sinfo, codec, have), data)
+        # reconstruct a lost shard exactly
+        out = ecutil.decode(sinfo, codec, have, [1, 3])
+        assert np.array_equal(out[1], shards[1])
+        assert np.array_equal(out[3], shards[3])
+
+    def test_encode_rejects_unaligned(self, codec, sinfo):
+        from ceph_tpu.ec.interface import ErasureCodeError
+        with pytest.raises(ErasureCodeError):
+            ecutil.encode(sinfo, codec, b"x" * 100)
+
+    def test_lrc_mapping_roundtrip(self):
+        reg = ErasureCodePluginRegistry.instance()
+        lrc = reg.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+        si = StripeInfo.for_codec(lrc, 512)
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, size=3 * si.stripe_width,
+                            dtype=np.uint8).astype(np.uint8)
+        shards = ecutil.encode(si, lrc, data)
+        assert len(shards) == lrc.get_chunk_count()
+        have = {i: shards[i] for i in range(len(shards)) if i not in (0, 5)}
+        assert np.array_equal(ecutil.decode_concat(si, lrc, have), data)
+        out = ecutil.decode(si, lrc, have, [0, 5])
+        assert np.array_equal(out[0], shards[0])
+        assert np.array_equal(out[5], shards[5])
+
+
+class TestHashInfo:
+    def test_append_and_verify(self, codec, sinfo):
+        hi = HashInfo(6)
+        rng = np.random.default_rng(2)
+        data1 = rng.integers(0, 256, size=sinfo.stripe_width,
+                             dtype=np.uint8).astype(np.uint8)
+        data2 = rng.integers(0, 256, size=2 * sinfo.stripe_width,
+                             dtype=np.uint8).astype(np.uint8)
+        s1 = ecutil.encode(sinfo, codec, data1)
+        s2 = ecutil.encode(sinfo, codec, data2)
+        hi.append(0, s1)
+        hi.append(sinfo.chunk_size, s2)
+        # cumulative crc == crc of the concatenated shard bytes
+        for i in range(6):
+            whole = np.concatenate([s1[i], s2[i]])
+            assert hi.get_chunk_hash(i) == crcmod.crc32c(whole, 0xFFFFFFFF)
+        assert hi.total_chunk_size == 3 * sinfo.chunk_size
+
+    def test_append_gap_rejected(self):
+        hi = HashInfo(2)
+        with pytest.raises(ValueError):
+            hi.append(100, {0: np.zeros(10, np.uint8),
+                            1: np.zeros(10, np.uint8)})
+
+    def test_serialization(self):
+        hi = HashInfo(3)
+        hi.append(0, {i: np.full(64, i, np.uint8) for i in range(3)})
+        hi2 = HashInfo.decode(hi.encode())
+        assert hi2 == hi
+
+    def test_truncate_resets(self):
+        hi = HashInfo(2)
+        hi.append(0, {0: np.ones(8, np.uint8), 1: np.ones(8, np.uint8)})
+        hi.truncate(0)
+        assert hi.total_chunk_size == 0
+        assert hi.get_chunk_hash(0) == 0xFFFFFFFF
+
+
+class TestWritePlan:
+    SI = StripeInfo(4096, 1024)
+
+    def test_full_stripe_write_no_read(self):
+        plan = get_write_plan(self.SI, [(0, 8192)], orig_size=8192)
+        assert plan.to_read == []
+        assert plan.will_write == [(0, 8192)]
+        assert plan.projected_size == 8192
+
+    def test_append_no_read(self):
+        # Unaligned append beyond current data: nothing to read.
+        plan = get_write_plan(self.SI, [(8192, 100)], orig_size=8192)
+        assert plan.to_read == []
+        assert plan.will_write == [(8192, 4096)]
+        assert plan.projected_size == 8292
+
+    def test_partial_overwrite_reads_stripe(self):
+        plan = get_write_plan(self.SI, [(1000, 100)], orig_size=8192)
+        assert plan.to_read == [(0, 4096)]
+        assert plan.will_write == [(0, 4096)]
+
+    def test_head_tail_rmw(self):
+        # write spans stripes 0..2 partially at both ends
+        plan = get_write_plan(self.SI, [(2000, 8192)], orig_size=16384)
+        assert plan.to_read == [(0, 4096), (8192, 4096)]
+        assert plan.will_write == [(0, 12288)]
+
+    def test_partial_on_last_ragged_stripe(self):
+        # object ends mid-stripe at 5000; a partial write into that stripe
+        # must read it (the existing ragged tail is real data)
+        plan = get_write_plan(self.SI, [(6000, 10)], orig_size=5000)
+        assert plan.to_read == [(4096, 4096)]
+
+    def test_truncate_invalidates(self):
+        plan = get_write_plan(self.SI, [(0, 4096)], orig_size=8192,
+                              truncate_to=2000)
+        assert plan.invalidates_cache
+        assert plan.projected_size == 2000
+
+
+class TestExtentCache:
+    def test_rmw_pipeline(self):
+        ec = ExtentCache()
+        oid = "obj1"
+        ec.present_rmw_update(oid, 0, np.full(4096, 1, np.uint8))
+        got = ec.maybe_read(oid, 1024, 512)
+        assert got is not None and (got == 1).all()
+        assert ec.maybe_read(oid, 0, 8192) is None  # not fully present
+        ec.present_rmw_update(oid, 4096, np.full(4096, 2, np.uint8))
+        got = ec.maybe_read(oid, 4000, 200)
+        assert got is not None
+        assert (got[:96] == 1).all() and (got[96:] == 2).all()
+        # commit the first write: its extent unpins and is trimmed
+        ec.release_write(oid, [(0, 4096)])
+        assert ec.maybe_read(oid, 0, 100) is None
+        assert ec.maybe_read(oid, 4096, 4096) is not None
+        ec.release_write(oid, [(4096, 4096)])
+        assert ec.size_bytes() == 0
+
+    def test_overwrite_wins(self):
+        ec = ExtentCache()
+        ec.present_rmw_update("o", 0, np.full(100, 1, np.uint8))
+        ec.present_rmw_update("o", 50, np.full(100, 2, np.uint8))
+        got = ec.maybe_read("o", 0, 150)
+        assert (got[:50] == 1).all() and (got[50:] == 2).all()
+
+    def test_invalidate(self):
+        ec = ExtentCache()
+        ec.present_rmw_update("o", 0, np.ones(10, np.uint8))
+        ec.invalidate("o")
+        assert ec.maybe_read("o", 0, 10) is None
