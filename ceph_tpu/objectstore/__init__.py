@@ -1,0 +1,28 @@
+"""Local object storage — rebuild of reference src/os (SURVEY.md §2.5).
+
+``ObjectStore`` + ``Transaction`` mirror src/os/ObjectStore.h's contract:
+every mutation batch is atomic.  Two backends:
+
+- ``MemStore`` (reference src/os/memstore) — tests/ephemeral daemons.
+- ``FileStore`` (file-per-object data + sqlite metadata/omap WAL) — the
+  durable single-host backend; BlueStore's raw-blockdev design is out of
+  scope for the rebuild (SURVEY.md §7.6) but the transactional semantics
+  OSDs rely on are identical.
+"""
+
+from .types import Collection, ObjectId  # noqa: F401
+from .transaction import Transaction  # noqa: F401
+from .store import ObjectStore, StoreError  # noqa: F401
+from .memstore import MemStore  # noqa: F401
+from .filestore import FileStore  # noqa: F401
+
+
+def create_store(kind: str, path: str = "") -> ObjectStore:
+    """Factory keyed by the objectstore_type option."""
+    if kind == "mem":
+        return MemStore()
+    if kind == "file":
+        if not path:
+            raise StoreError("file store needs objectstore_path")
+        return FileStore(path)
+    raise StoreError(f"unknown objectstore type {kind!r}")

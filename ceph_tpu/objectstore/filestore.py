@@ -1,0 +1,328 @@
+"""FileStore — durable single-host ObjectStore on sqlite.
+
+Role of reference FileStore/BlueStore (src/os): a crash-consistent,
+transactional object store.  Data lives as fixed-size blocks in sqlite
+(WAL journaling), so a Transaction maps to ONE sqlite transaction —
+metadata and data commit atomically, and kill -9 mid-write leaves either
+the old or the new state (the property the reference buys with its own
+WAL/rocksdb machinery; thrasher QA relies on it).
+
+Block size 64 KiB: EC chunk writes (typically >= 4 KiB, chunk-aligned)
+touch few blocks; partial-block RMW reads one block.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .store import NotFound, ObjectStore, StoreError
+from .types import Collection, ObjectId
+
+BLOCK = 64 * 1024
+
+
+class FileStore(ObjectStore):
+    def __init__(self, path: str, fsync: bool = False) -> None:
+        super().__init__()
+        self.path = path
+        self._fsync = fsync
+        self._db: "Optional[sqlite3.Connection]" = None
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def _db_path(self) -> str:
+        return os.path.join(self.path, "store.db")
+
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        db = sqlite3.connect(self._db_path())
+        db.executescript("""
+            PRAGMA journal_mode=WAL;
+            CREATE TABLE IF NOT EXISTS colls (cid TEXT PRIMARY KEY);
+            CREATE TABLE IF NOT EXISTS objs (
+                cid TEXT, oid TEXT, size INTEGER NOT NULL DEFAULT 0,
+                PRIMARY KEY (cid, oid));
+            CREATE TABLE IF NOT EXISTS blocks (
+                cid TEXT, oid TEXT, blk INTEGER, data BLOB,
+                PRIMARY KEY (cid, oid, blk));
+            CREATE TABLE IF NOT EXISTS attrs (
+                cid TEXT, oid TEXT, name TEXT, value BLOB,
+                PRIMARY KEY (cid, oid, name));
+            CREATE TABLE IF NOT EXISTS omap (
+                cid TEXT, oid TEXT, key TEXT, value BLOB,
+                PRIMARY KEY (cid, oid, key));
+        """)
+        db.commit()
+        db.close()
+
+    def mount(self) -> None:
+        if not os.path.exists(self._db_path()):
+            raise StoreError(f"no store at {self.path}; run mkfs")
+        self._db = sqlite3.connect(self._db_path(), check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=%s"
+                         % ("FULL" if self._fsync else "NORMAL"))
+        self._db.isolation_level = None  # manual txns
+
+    def umount(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._db is None:
+            raise StoreError("store not mounted")
+        return self._db
+
+    # --- txn hooks ------------------------------------------------------------
+
+    def _txn_begin(self) -> None:
+        self._conn().execute("BEGIN IMMEDIATE")
+
+    def _txn_commit(self) -> None:
+        self._conn().execute("COMMIT")
+
+    def _txn_rollback(self) -> None:
+        try:
+            self._conn().execute("ROLLBACK")
+        except sqlite3.OperationalError:
+            pass
+
+    # --- helpers --------------------------------------------------------------
+
+    def _obj_size(self, cid: str, oid: str,
+                  required: bool = True) -> "Optional[int]":
+        row = self._conn().execute(
+            "SELECT size FROM objs WHERE cid=? AND oid=?",
+            (cid, oid)).fetchone()
+        if row is None:
+            if required:
+                raise NotFound(f"{cid}/{oid} does not exist")
+            return None
+        return row[0]
+
+    def _require_coll(self, cid: str) -> None:
+        if self._conn().execute("SELECT 1 FROM colls WHERE cid=?",
+                                (cid,)).fetchone() is None:
+            raise NotFound(f"collection {cid} does not exist")
+
+    def _ensure_obj(self, cid: str, oid: str) -> int:
+        self._require_coll(cid)
+        size = self._obj_size(cid, oid, required=False)
+        if size is None:
+            self._conn().execute(
+                "INSERT INTO objs (cid, oid, size) VALUES (?, ?, 0)",
+                (cid, oid))
+            return 0
+        return size
+
+    def _set_size(self, cid: str, oid: str, size: int) -> None:
+        self._conn().execute(
+            "UPDATE objs SET size=? WHERE cid=? AND oid=?", (size, cid, oid))
+
+    def _read_block(self, cid: str, oid: str, blk: int) -> bytearray:
+        row = self._conn().execute(
+            "SELECT data FROM blocks WHERE cid=? AND oid=? AND blk=?",
+            (cid, oid, blk)).fetchone()
+        return bytearray(row[0]) if row else bytearray(BLOCK)
+
+    def _put_block(self, cid: str, oid: str, blk: int, data: bytes) -> None:
+        self._conn().execute(
+            "INSERT INTO blocks (cid, oid, blk, data) VALUES (?, ?, ?, ?) "
+            "ON CONFLICT (cid, oid, blk) DO UPDATE SET data=excluded.data",
+            (cid, oid, blk, sqlite3.Binary(bytes(data))))
+
+    # --- primitives -----------------------------------------------------------
+
+    def _mkcoll(self, cid: Collection) -> None:
+        try:
+            self._conn().execute("INSERT INTO colls (cid) VALUES (?)",
+                                 (cid.key(),))
+        except sqlite3.IntegrityError:
+            raise StoreError(f"collection {cid} already exists")
+
+    def _rmcoll(self, cid: Collection) -> None:
+        self._require_coll(cid.key())
+        n = self._conn().execute("SELECT COUNT(*) FROM objs WHERE cid=?",
+                                 (cid.key(),)).fetchone()[0]
+        if n:
+            raise StoreError(f"collection {cid} not empty")
+        self._conn().execute("DELETE FROM colls WHERE cid=?", (cid.key(),))
+
+    def _touch(self, cid, oid) -> None:
+        self._ensure_obj(cid.key(), oid.key())
+
+    def _write(self, cid, oid, off: int, data: bytes) -> None:
+        c, o = cid.key(), oid.key()
+        size = self._ensure_obj(c, o)
+        pos = off
+        remaining = memoryview(data)
+        while len(remaining):
+            blk, in_blk = divmod(pos, BLOCK)
+            take = min(BLOCK - in_blk, len(remaining))
+            if in_blk == 0 and take == BLOCK:
+                self._put_block(c, o, blk, remaining[:take])
+            else:
+                buf = self._read_block(c, o, blk)
+                buf[in_blk:in_blk + take] = remaining[:take]
+                self._put_block(c, o, blk, buf)
+            pos += take
+            remaining = remaining[take:]
+        if pos > size:
+            self._set_size(c, o, pos)
+
+    def _zero(self, cid, oid, off: int, length: int) -> None:
+        self._write(cid, oid, off, b"\x00" * length)
+
+    def _truncate(self, cid, oid, size: int) -> None:
+        c, o = cid.key(), oid.key()
+        self._ensure_obj(c, o)
+        last_blk = (size + BLOCK - 1) // BLOCK
+        self._conn().execute(
+            "DELETE FROM blocks WHERE cid=? AND oid=? AND blk>=?",
+            (c, o, last_blk))
+        if size % BLOCK:
+            blk = size // BLOCK
+            buf = self._read_block(c, o, blk)
+            buf[size % BLOCK:] = b"\x00" * (BLOCK - size % BLOCK)
+            self._put_block(c, o, blk, buf)
+        self._set_size(c, o, size)
+
+    def _remove(self, cid, oid) -> None:
+        c, o = cid.key(), oid.key()
+        self._obj_size(c, o)
+        for table in ("objs", "blocks", "attrs", "omap"):
+            self._conn().execute(
+                f"DELETE FROM {table} WHERE cid=? AND oid=?", (c, o))
+
+    def _clone(self, cid, src, dst) -> None:
+        c, s, d = cid.key(), src.key(), dst.key()
+        size = self._obj_size(c, s)
+        self._apply_remove_if_exists(c, d)
+        self._conn().execute(
+            "INSERT INTO objs (cid, oid, size) VALUES (?, ?, ?)",
+            (c, d, size))
+        for table, cols in (("blocks", "blk, data"), ("attrs", "name, value"),
+                            ("omap", "key, value")):
+            self._conn().execute(
+                f"INSERT INTO {table} (cid, oid, {cols}) "
+                f"SELECT cid, ?, {cols} FROM {table} WHERE cid=? AND oid=?",
+                (d, c, s))
+
+    def _apply_remove_if_exists(self, c: str, o: str) -> None:
+        for table in ("objs", "blocks", "attrs", "omap"):
+            self._conn().execute(
+                f"DELETE FROM {table} WHERE cid=? AND oid=?", (c, o))
+
+    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
+        self._ensure_obj(cid.key(), oid.key())
+        self._conn().execute(
+            "INSERT INTO attrs (cid, oid, name, value) VALUES (?, ?, ?, ?) "
+            "ON CONFLICT (cid, oid, name) DO UPDATE SET value=excluded.value",
+            (cid.key(), oid.key(), name, sqlite3.Binary(value)))
+
+    def _rmattr(self, cid, oid, name: str) -> None:
+        self._obj_size(cid.key(), oid.key())
+        self._conn().execute(
+            "DELETE FROM attrs WHERE cid=? AND oid=? AND name=?",
+            (cid.key(), oid.key(), name))
+
+    def _omap_set(self, cid, oid, kv) -> None:
+        self._ensure_obj(cid.key(), oid.key())
+        for k, v in kv.items():
+            self._conn().execute(
+                "INSERT INTO omap (cid, oid, key, value) VALUES (?, ?, ?, ?) "
+                "ON CONFLICT (cid, oid, key) DO UPDATE SET value=excluded.value",
+                (cid.key(), oid.key(), k, sqlite3.Binary(v)))
+
+    def _omap_rm(self, cid, oid, keys) -> None:
+        self._obj_size(cid.key(), oid.key())
+        for k in keys:
+            self._conn().execute(
+                "DELETE FROM omap WHERE cid=? AND oid=? AND key=?",
+                (cid.key(), oid.key(), k))
+
+    def _omap_clear(self, cid, oid) -> None:
+        self._obj_size(cid.key(), oid.key())
+        self._conn().execute("DELETE FROM omap WHERE cid=? AND oid=?",
+                             (cid.key(), oid.key()))
+
+    # --- reads ---------------------------------------------------------------
+
+    def exists(self, cid: Collection, oid: ObjectId) -> bool:
+        with self._lock:
+            return self._obj_size(cid.key(), oid.key(),
+                                  required=False) is not None
+
+    def read(self, cid, oid, off: int = 0,
+             length: "Optional[int]" = None) -> np.ndarray:
+        with self._lock:
+            c, o = cid.key(), oid.key()
+            size = self._obj_size(c, o)
+            end = size if length is None else min(size, off + length)
+            if end <= off:
+                return np.zeros(0, dtype=np.uint8)
+            out = np.zeros(end - off, dtype=np.uint8)
+            for blk in range(off // BLOCK, (end + BLOCK - 1) // BLOCK):
+                row = self._conn().execute(
+                    "SELECT data FROM blocks WHERE cid=? AND oid=? AND blk=?",
+                    (c, o, blk)).fetchone()
+                if row is None:
+                    continue
+                bstart = blk * BLOCK
+                lo = max(off, bstart)
+                hi = min(end, bstart + BLOCK)
+                out[lo - off:hi - off] = np.frombuffer(
+                    row[0], dtype=np.uint8, count=hi - lo, offset=lo - bstart)
+            return out
+
+    def stat(self, cid, oid) -> dict:
+        with self._lock:
+            return {"size": self._obj_size(cid.key(), oid.key())}
+
+    def get_attr(self, cid, oid, name: str) -> bytes:
+        with self._lock:
+            row = self._conn().execute(
+                "SELECT value FROM attrs WHERE cid=? AND oid=? AND name=?",
+                (cid.key(), oid.key(), name)).fetchone()
+            if row is None:
+                raise NotFound(f"attr {name} on {oid.key()}")
+            return bytes(row[0])
+
+    def get_attrs(self, cid, oid) -> "dict[str, bytes]":
+        with self._lock:
+            self._obj_size(cid.key(), oid.key())
+            rows = self._conn().execute(
+                "SELECT name, value FROM attrs WHERE cid=? AND oid=?",
+                (cid.key(), oid.key())).fetchall()
+            return {name: bytes(v) for name, v in rows}
+
+    def omap_get(self, cid, oid) -> "dict[str, bytes]":
+        with self._lock:
+            self._obj_size(cid.key(), oid.key())
+            rows = self._conn().execute(
+                "SELECT key, value FROM omap WHERE cid=? AND oid=?",
+                (cid.key(), oid.key())).fetchall()
+            return {k: bytes(v) for k, v in rows}
+
+    def list_collections(self) -> "List[Collection]":
+        with self._lock:
+            rows = self._conn().execute("SELECT cid FROM colls").fetchall()
+            return sorted(Collection.from_key(r[0]) for r in rows)
+
+    def collection_exists(self, cid: Collection) -> bool:
+        with self._lock:
+            return self._conn().execute(
+                "SELECT 1 FROM colls WHERE cid=?",
+                (cid.key(),)).fetchone() is not None
+
+    def list_objects(self, cid: Collection) -> "List[ObjectId]":
+        with self._lock:
+            self._require_coll(cid.key())
+            rows = self._conn().execute(
+                "SELECT oid FROM objs WHERE cid=?", (cid.key(),)).fetchall()
+            return sorted(ObjectId.from_key(r[0]) for r in rows)

@@ -1,0 +1,222 @@
+"""MemStore — in-memory ObjectStore (reference src/os/memstore).
+
+Atomicity via per-transaction undo log: the first mutation of each
+object/collection snapshots its prior state; rollback restores.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .store import NotFound, ObjectStore, StoreError
+from .types import Collection, ObjectId
+
+
+class _Obj:
+    __slots__ = ("data", "attrs", "omap")
+
+    def __init__(self) -> None:
+        self.data = bytearray()
+        self.attrs: "dict[str, bytes]" = {}
+        self.omap: "dict[str, bytes]" = {}
+
+    def copy(self) -> "_Obj":
+        o = _Obj()
+        o.data = bytearray(self.data)
+        o.attrs = dict(self.attrs)
+        o.omap = dict(self.omap)
+        return o
+
+
+class MemStore(ObjectStore):
+    def __init__(self) -> None:
+        super().__init__()
+        self._colls: "Dict[Collection, Dict[ObjectId, _Obj]]" = {}
+        self._mounted = False
+        self._undo: "Optional[list]" = None
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def mkfs(self) -> None:
+        self._colls.clear()
+
+    def mount(self) -> None:
+        self._mounted = True
+
+    def umount(self) -> None:
+        self._mounted = False
+
+    # --- txn engine hooks -----------------------------------------------------
+
+    def _txn_begin(self) -> None:
+        self._undo = []
+
+    def _txn_commit(self) -> None:
+        self._undo = None
+
+    def _txn_rollback(self) -> None:
+        assert self._undo is not None
+        for action in reversed(self._undo):
+            action()
+        self._undo = None
+
+    def _save_obj(self, cid: Collection, oid: ObjectId) -> None:
+        coll = self._colls.get(cid)
+        if coll is None:
+            return
+        prev = coll.get(oid)
+        snapshot = prev.copy() if prev is not None else None
+
+        def restore(coll=coll, oid=oid, snapshot=snapshot):
+            if snapshot is None:
+                coll.pop(oid, None)
+            else:
+                coll[oid] = snapshot
+
+        self._undo.append(restore)
+
+    # --- primitives -----------------------------------------------------------
+
+    def _coll(self, cid: Collection) -> "Dict[ObjectId, _Obj]":
+        coll = self._colls.get(cid)
+        if coll is None:
+            raise NotFound(f"collection {cid} does not exist")
+        return coll
+
+    def _get(self, cid: Collection, oid: ObjectId,
+             create: bool = False) -> _Obj:
+        coll = self._coll(cid)
+        obj = coll.get(oid)
+        if obj is None:
+            if not create:
+                raise NotFound(f"{cid}/{oid.key()} does not exist")
+            self._save_obj(cid, oid)
+            obj = coll[oid] = _Obj()
+        elif create is False:
+            pass
+        return obj
+
+    def _mutate(self, cid: Collection, oid: ObjectId,
+                create: bool = False) -> _Obj:
+        coll = self._coll(cid)
+        if oid in coll:
+            self._save_obj(cid, oid)
+            return coll[oid]
+        if not create:
+            raise NotFound(f"{cid}/{oid.key()} does not exist")
+        self._save_obj(cid, oid)
+        obj = coll[oid] = _Obj()
+        return obj
+
+    def _mkcoll(self, cid: Collection) -> None:
+        if cid in self._colls:
+            raise StoreError(f"collection {cid} already exists")
+        self._colls[cid] = {}
+        self._undo.append(lambda: self._colls.pop(cid, None))
+
+    def _rmcoll(self, cid: Collection) -> None:
+        coll = self._coll(cid)
+        if coll:
+            raise StoreError(f"collection {cid} not empty")
+        prev = self._colls.pop(cid)
+        self._undo.append(lambda: self._colls.__setitem__(cid, prev))
+
+    def _touch(self, cid, oid) -> None:
+        self._mutate(cid, oid, create=True)
+
+    def _write(self, cid, oid, off: int, data: bytes) -> None:
+        obj = self._mutate(cid, oid, create=True)
+        end = off + len(data)
+        if len(obj.data) < end:
+            obj.data.extend(b"\x00" * (end - len(obj.data)))
+        obj.data[off:end] = data
+
+    def _zero(self, cid, oid, off: int, length: int) -> None:
+        self._write(cid, oid, off, b"\x00" * length)
+
+    def _truncate(self, cid, oid, size: int) -> None:
+        obj = self._mutate(cid, oid, create=True)
+        if len(obj.data) > size:
+            del obj.data[size:]
+        else:
+            obj.data.extend(b"\x00" * (size - len(obj.data)))
+
+    def _remove(self, cid, oid) -> None:
+        coll = self._coll(cid)
+        if oid not in coll:
+            raise NotFound(f"{cid}/{oid.key()} does not exist")
+        self._save_obj(cid, oid)
+        del coll[oid]
+
+    def _clone(self, cid, src, dst) -> None:
+        coll = self._coll(cid)
+        if src not in coll:
+            raise NotFound(f"{cid}/{src.key()} does not exist")
+        self._save_obj(cid, dst)
+        coll[dst] = coll[src].copy()
+
+    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
+        self._mutate(cid, oid, create=True).attrs[name] = value
+
+    def _rmattr(self, cid, oid, name: str) -> None:
+        obj = self._mutate(cid, oid)
+        obj.attrs.pop(name, None)
+
+    def _omap_set(self, cid, oid, kv) -> None:
+        self._mutate(cid, oid, create=True).omap.update(kv)
+
+    def _omap_rm(self, cid, oid, keys) -> None:
+        obj = self._mutate(cid, oid)
+        for k in keys:
+            obj.omap.pop(k, None)
+
+    def _omap_clear(self, cid, oid) -> None:
+        self._mutate(cid, oid).omap.clear()
+
+    # --- reads ---------------------------------------------------------------
+
+    def exists(self, cid: Collection, oid: ObjectId) -> bool:
+        with self._lock:
+            return oid in self._colls.get(cid, {})
+
+    def read(self, cid, oid, off: int = 0,
+             length: "Optional[int]" = None) -> np.ndarray:
+        with self._lock:
+            obj = self._get(cid, oid)
+            end = len(obj.data) if length is None else min(
+                len(obj.data), off + length)
+            return np.frombuffer(bytes(obj.data[off:end]), dtype=np.uint8)
+
+    def stat(self, cid, oid) -> dict:
+        with self._lock:
+            obj = self._get(cid, oid)
+            return {"size": len(obj.data)}
+
+    def get_attr(self, cid, oid, name: str) -> bytes:
+        with self._lock:
+            obj = self._get(cid, oid)
+            if name not in obj.attrs:
+                raise NotFound(f"attr {name} on {oid.key()}")
+            return obj.attrs[name]
+
+    def get_attrs(self, cid, oid) -> "dict[str, bytes]":
+        with self._lock:
+            return dict(self._get(cid, oid).attrs)
+
+    def omap_get(self, cid, oid) -> "dict[str, bytes]":
+        with self._lock:
+            return dict(self._get(cid, oid).omap)
+
+    def list_collections(self) -> "List[Collection]":
+        with self._lock:
+            return sorted(self._colls)
+
+    def collection_exists(self, cid: Collection) -> bool:
+        with self._lock:
+            return cid in self._colls
+
+    def list_objects(self, cid: Collection) -> "List[ObjectId]":
+        with self._lock:
+            return sorted(self._coll(cid))

@@ -1,0 +1,165 @@
+"""ObjectStore abstract interface (src/os/ObjectStore.h contract subset the
+OSD uses) plus the shared transaction-application engine.
+
+Both backends implement primitive hooks (_write/_truncate/...); the
+``apply_transaction`` loop, validation, and atomicity policy live here:
+a transaction either fully applies or raises with no partial effect
+(backends provide begin/commit/rollback)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from .transaction import (OP_CLONE, OP_MKCOLL, OP_OMAP_CLEAR,
+                          OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
+                          OP_RMATTR, OP_RMCOLL, OP_SETATTR, OP_TOUCH,
+                          OP_TRUNCATE, OP_WRITE, OP_ZERO, Transaction)
+from .types import Collection, ObjectId
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class ObjectStore:
+    """Abstract store.  Thread-safe: one big lock around transactions and
+    reads (the reference shards by PG; a single lock is enough at our
+    daemons' concurrency — PGs already serialize their own ops)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def mkfs(self) -> None:
+        raise NotImplementedError
+
+    def mount(self) -> None:
+        raise NotImplementedError
+
+    def umount(self) -> None:
+        raise NotImplementedError
+
+    # --- reads ---------------------------------------------------------------
+
+    def exists(self, cid: Collection, oid: ObjectId) -> bool:
+        raise NotImplementedError
+
+    def read(self, cid: Collection, oid: ObjectId, off: int = 0,
+             length: "Optional[int]" = None) -> np.ndarray:
+        """Bytes [off, off+length); short reads past EOF (reference
+        semantics); NotFound if the object is absent."""
+        raise NotImplementedError
+
+    def stat(self, cid: Collection, oid: ObjectId) -> dict:
+        raise NotImplementedError
+
+    def get_attr(self, cid: Collection, oid: ObjectId, name: str) -> bytes:
+        raise NotImplementedError
+
+    def get_attrs(self, cid: Collection, oid: ObjectId) -> "dict[str, bytes]":
+        raise NotImplementedError
+
+    def omap_get(self, cid: Collection, oid: ObjectId) -> "dict[str, bytes]":
+        raise NotImplementedError
+
+    def list_collections(self) -> "List[Collection]":
+        raise NotImplementedError
+
+    def collection_exists(self, cid: Collection) -> bool:
+        raise NotImplementedError
+
+    def list_objects(self, cid: Collection) -> "List[ObjectId]":
+        raise NotImplementedError
+
+    # --- transaction engine ---------------------------------------------------
+
+    def _txn_begin(self) -> None: ...
+    def _txn_commit(self) -> None: ...
+    def _txn_rollback(self) -> None: ...
+
+    # backend primitive hooks (called under lock, inside a txn)
+    def _mkcoll(self, cid: Collection) -> None: raise NotImplementedError
+    def _rmcoll(self, cid: Collection) -> None: raise NotImplementedError
+    def _touch(self, cid, oid) -> None: raise NotImplementedError
+    def _write(self, cid, oid, off: int, data: bytes) -> None:
+        raise NotImplementedError
+    def _zero(self, cid, oid, off: int, length: int) -> None:
+        raise NotImplementedError
+    def _truncate(self, cid, oid, size: int) -> None:
+        raise NotImplementedError
+    def _remove(self, cid, oid) -> None: raise NotImplementedError
+    def _clone(self, cid, src, dst) -> None: raise NotImplementedError
+    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
+        raise NotImplementedError
+    def _rmattr(self, cid, oid, name: str) -> None: raise NotImplementedError
+    def _omap_set(self, cid, oid, kv: "dict[str, bytes]") -> None:
+        raise NotImplementedError
+    def _omap_rm(self, cid, oid, keys: "list[str]") -> None:
+        raise NotImplementedError
+    def _omap_clear(self, cid, oid) -> None: raise NotImplementedError
+
+    def apply_transaction(self, txn: Transaction,
+                          on_commit: "Optional[Callable[[], None]]" = None
+                          ) -> None:
+        """Atomically apply; raises StoreError with no effect on failure.
+        ``on_commit`` fires after durability (the queue_transaction callback
+        analog, synchronous here — OSD wraps it in its event loop)."""
+        with self._lock:
+            self._txn_begin()
+            try:
+                for op in txn.ops:
+                    self._apply_op(op)
+            except Exception:
+                self._txn_rollback()
+                raise
+            self._txn_commit()
+        if on_commit is not None:
+            on_commit()
+
+    def apply_transactions(self, txns: "Iterable[Transaction]") -> None:
+        merged = Transaction()
+        for t in txns:
+            merged.append(t)
+        self.apply_transaction(merged)
+
+    def _apply_op(self, op: dict) -> None:
+        kind = op["op"]
+        cid = Collection.from_key(op["cid"])
+        if kind == OP_MKCOLL:
+            return self._mkcoll(cid)
+        if kind == OP_RMCOLL:
+            return self._rmcoll(cid)
+        oid = ObjectId.from_key(op["oid"])
+        if kind == OP_TOUCH:
+            return self._touch(cid, oid)
+        if kind == OP_WRITE:
+            return self._write(cid, oid, op["off"], Transaction.op_bytes(op))
+        if kind == OP_ZERO:
+            return self._zero(cid, oid, op["off"], op["len"])
+        if kind == OP_TRUNCATE:
+            return self._truncate(cid, oid, op["size"])
+        if kind == OP_REMOVE:
+            return self._remove(cid, oid)
+        if kind == OP_CLONE:
+            return self._clone(cid, oid, ObjectId.from_key(op["dst"]))
+        if kind == OP_SETATTR:
+            return self._setattr(cid, oid, op["name"],
+                                 Transaction.op_bytes(op))
+        if kind == OP_RMATTR:
+            return self._rmattr(cid, oid, op["name"])
+        if kind == OP_OMAP_SETKEYS:
+            return self._omap_set(cid, oid, {
+                k: bytes.fromhex(v) for k, v in op["kv"].items()})
+        if kind == OP_OMAP_RMKEYS:
+            return self._omap_rm(cid, oid, op["keys"])
+        if kind == OP_OMAP_CLEAR:
+            return self._omap_clear(cid, oid)
+        raise StoreError(f"unknown transaction op {kind!r}")

@@ -1,0 +1,147 @@
+"""Transaction — ordered atomic mutation batch (src/os/ObjectStore.h:768's
+Transaction, the ops the OSD data path actually uses).
+
+Serializable: ECSubWrite ships a per-shard transaction over the wire
+(reference ECMsgTypes.h:23-38), so every op encodes to plain JSON-able
+structures (buffers as bytes, hex-packed).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .types import Collection, ObjectId
+
+# Op codes (names after the reference's Transaction::Op enum).
+OP_TOUCH = "touch"
+OP_WRITE = "write"
+OP_ZERO = "zero"
+OP_TRUNCATE = "truncate"
+OP_REMOVE = "remove"
+OP_SETATTR = "setattr"
+OP_RMATTR = "rmattr"
+OP_CLONE = "clone"
+OP_OMAP_SETKEYS = "omap_setkeys"
+OP_OMAP_RMKEYS = "omap_rmkeys"
+OP_OMAP_CLEAR = "omap_clear"
+OP_MKCOLL = "mkcoll"
+OP_RMCOLL = "rmcoll"
+
+
+def _b2h(data) -> str:
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
+    return bytes(data).hex()
+
+
+def _h2b(h: str) -> bytes:
+    return bytes.fromhex(h)
+
+
+class Transaction:
+    def __init__(self) -> None:
+        self.ops: "List[dict]" = []
+
+    def empty(self) -> bool:
+        return not self.ops
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    # --- collection ops -------------------------------------------------------
+
+    def create_collection(self, cid: Collection) -> "Transaction":
+        self.ops.append({"op": OP_MKCOLL, "cid": cid.key()})
+        return self
+
+    def remove_collection(self, cid: Collection) -> "Transaction":
+        self.ops.append({"op": OP_RMCOLL, "cid": cid.key()})
+        return self
+
+    # --- object data ops ------------------------------------------------------
+
+    def touch(self, cid: Collection, oid: ObjectId) -> "Transaction":
+        self.ops.append({"op": OP_TOUCH, "cid": cid.key(), "oid": oid.key()})
+        return self
+
+    def write(self, cid: Collection, oid: ObjectId, off: int,
+              data) -> "Transaction":
+        self.ops.append({"op": OP_WRITE, "cid": cid.key(), "oid": oid.key(),
+                         "off": int(off), "data": _b2h(data)})
+        return self
+
+    def zero(self, cid: Collection, oid: ObjectId, off: int,
+             length: int) -> "Transaction":
+        self.ops.append({"op": OP_ZERO, "cid": cid.key(), "oid": oid.key(),
+                         "off": int(off), "len": int(length)})
+        return self
+
+    def truncate(self, cid: Collection, oid: ObjectId,
+                 size: int) -> "Transaction":
+        self.ops.append({"op": OP_TRUNCATE, "cid": cid.key(),
+                         "oid": oid.key(), "size": int(size)})
+        return self
+
+    def remove(self, cid: Collection, oid: ObjectId) -> "Transaction":
+        self.ops.append({"op": OP_REMOVE, "cid": cid.key(), "oid": oid.key()})
+        return self
+
+    def clone(self, cid: Collection, src: ObjectId,
+              dst: ObjectId) -> "Transaction":
+        self.ops.append({"op": OP_CLONE, "cid": cid.key(),
+                         "oid": src.key(), "dst": dst.key()})
+        return self
+
+    # --- attrs / omap ---------------------------------------------------------
+
+    def setattr(self, cid: Collection, oid: ObjectId, name: str,
+                value) -> "Transaction":
+        self.ops.append({"op": OP_SETATTR, "cid": cid.key(),
+                         "oid": oid.key(), "name": name, "value": _b2h(value)})
+        return self
+
+    def rmattr(self, cid: Collection, oid: ObjectId,
+               name: str) -> "Transaction":
+        self.ops.append({"op": OP_RMATTR, "cid": cid.key(),
+                         "oid": oid.key(), "name": name})
+        return self
+
+    def omap_setkeys(self, cid: Collection, oid: ObjectId,
+                     kv: "dict[str, bytes]") -> "Transaction":
+        self.ops.append({"op": OP_OMAP_SETKEYS, "cid": cid.key(),
+                         "oid": oid.key(),
+                         "kv": {k: _b2h(v) for k, v in kv.items()}})
+        return self
+
+    def omap_rmkeys(self, cid: Collection, oid: ObjectId,
+                    keys: "list[str]") -> "Transaction":
+        self.ops.append({"op": OP_OMAP_RMKEYS, "cid": cid.key(),
+                         "oid": oid.key(), "keys": list(keys)})
+        return self
+
+    def omap_clear(self, cid: Collection, oid: ObjectId) -> "Transaction":
+        self.ops.append({"op": OP_OMAP_CLEAR, "cid": cid.key(),
+                         "oid": oid.key()})
+        return self
+
+    # --- composition / wire ---------------------------------------------------
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops)
+        return self
+
+    def encode(self) -> bytes:
+        return json.dumps(self.ops).encode()
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "Transaction":
+        t = cls()
+        t.ops = json.loads(payload.decode())
+        return t
+
+    @staticmethod
+    def op_bytes(op: dict) -> bytes:
+        return _h2b(op.get("data") or op.get("value") or "")

@@ -1,0 +1,62 @@
+"""Object / collection identity — the ghobject_t / coll_t analogs.
+
+Reference: src/osd/osd_types.{h,cc}.  ``ObjectId`` carries (name, shard,
+generation):
+
+- ``shard``: which EC shard this replica holds (NO_SHARD for replicated
+  pools) — the reference's shard_id_t baked into ghobject_t.
+- ``generation``: EC rollback support — a new write may land at a new
+  generation while the old object survives until roll_forward
+  (SURVEY.md §5 checkpoint/resume; reference ECMsgTypes.h:31-32).
+
+``Collection`` is the PG's container (coll_t): one per (pool, pg, shard).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+NO_SHARD = -1
+NO_GEN = -1
+
+
+@dataclass(frozen=True, order=True)
+class ObjectId:
+    name: str
+    shard: int = NO_SHARD
+    generation: int = NO_GEN
+
+    def with_gen(self, gen: int) -> "ObjectId":
+        return ObjectId(self.name, self.shard, gen)
+
+    def base(self) -> "ObjectId":
+        """The head object (no generation)."""
+        return ObjectId(self.name, self.shard, NO_GEN)
+
+    def key(self) -> str:
+        return f"{self.name}.{self.shard}.{self.generation}"
+
+    @classmethod
+    def from_key(cls, key: str) -> "ObjectId":
+        name, shard, gen = key.rsplit(".", 2)
+        return cls(name, int(shard), int(gen))
+
+
+@dataclass(frozen=True, order=True)
+class Collection:
+    pool: int
+    pg: int
+    shard: int = NO_SHARD
+
+    def key(self) -> str:
+        return f"{self.pool}.{self.pg}.{self.shard}"
+
+    @classmethod
+    def from_key(cls, key: str) -> "Collection":
+        pool, pg, shard = key.split(".")
+        return cls(int(pool), int(pg), int(shard))
+
+    def __str__(self) -> str:
+        s = f"{self.pool}.{self.pg:x}"
+        return s if self.shard == NO_SHARD else f"{s}s{self.shard}"

@@ -1,0 +1,155 @@
+"""ObjectStore tests (both backends) — atomicity, remount durability,
+clone, attrs/omap; reference src/test/objectstore coverage shape."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.objectstore import (Collection, FileStore, MemStore, ObjectId,
+                                  StoreError, Transaction, create_store)
+from ceph_tpu.objectstore.store import NotFound
+
+CID = Collection(1, 0, 2)
+OID = ObjectId("rbd_data.1", shard=2)
+
+
+@pytest.fixture(params=["mem", "file"])
+def store(request, tmp_path):
+    s = create_store(request.param, str(tmp_path / "store"))
+    s.mkfs()
+    s.mount()
+    t = Transaction().create_collection(CID)
+    s.apply_transaction(t)
+    yield s
+    s.umount()
+
+
+def test_write_read_roundtrip(store):
+    data = np.arange(200000 % 256, dtype=np.uint8)
+    data = np.tile(np.arange(256, dtype=np.uint8), 700)  # 179200 B, >2 blocks
+    t = Transaction().write(CID, OID, 0, data)
+    store.apply_transaction(t)
+    assert np.array_equal(store.read(CID, OID), data)
+    assert store.stat(CID, OID)["size"] == data.size
+    # partial read + short read past EOF
+    assert np.array_equal(store.read(CID, OID, 100, 50), data[100:150])
+    assert store.read(CID, OID, data.size - 10, 100).size == 10
+
+
+def test_sparse_write_and_overwrite(store):
+    store.apply_transaction(Transaction().write(CID, OID, 70000, b"abc"))
+    assert store.stat(CID, OID)["size"] == 70003
+    out = store.read(CID, OID)
+    assert bytes(out[:10]) == b"\x00" * 10
+    assert bytes(out[70000:]) == b"abc"
+    store.apply_transaction(Transaction().write(CID, OID, 1, b"ZZ"))
+    assert bytes(store.read(CID, OID, 0, 4)) == b"\x00ZZ\x00"
+    assert store.stat(CID, OID)["size"] == 70003
+
+
+def test_zero_truncate(store):
+    store.apply_transaction(Transaction().write(CID, OID, 0, b"x" * 1000))
+    store.apply_transaction(Transaction().zero(CID, OID, 10, 100))
+    out = store.read(CID, OID)
+    assert bytes(out[10:110]) == b"\x00" * 100
+    assert bytes(out[110:120]) == b"x" * 10
+    store.apply_transaction(Transaction().truncate(CID, OID, 5))
+    assert store.stat(CID, OID)["size"] == 5
+    store.apply_transaction(Transaction().truncate(CID, OID, 20))
+    out = store.read(CID, OID)
+    assert out.size == 20 and bytes(out[5:]) == b"\x00" * 15
+
+
+def test_attrs_and_omap(store):
+    t = (Transaction()
+         .touch(CID, OID)
+         .setattr(CID, OID, "hinfo_key", b"\x01\x02")
+         .omap_setkeys(CID, OID, {"k1": b"v1", "k2": b"v2"}))
+    store.apply_transaction(t)
+    assert store.get_attr(CID, OID, "hinfo_key") == b"\x01\x02"
+    assert store.get_attrs(CID, OID) == {"hinfo_key": b"\x01\x02"}
+    assert store.omap_get(CID, OID) == {"k1": b"v1", "k2": b"v2"}
+    store.apply_transaction(
+        Transaction().omap_rmkeys(CID, OID, ["k1"]).rmattr(CID, OID,
+                                                           "hinfo_key"))
+    assert store.omap_get(CID, OID) == {"k2": b"v2"}
+    with pytest.raises(NotFound):
+        store.get_attr(CID, OID, "hinfo_key")
+
+
+def test_clone_and_generations(store):
+    """EC rollback layout: head object cloned to a generation object."""
+    gen_oid = OID.with_gen(41)
+    store.apply_transaction(
+        Transaction().write(CID, OID, 0, b"version1")
+        .setattr(CID, OID, "a", b"1"))
+    store.apply_transaction(Transaction().clone(CID, OID, gen_oid))
+    store.apply_transaction(Transaction().write(CID, OID, 0, b"version2"))
+    assert bytes(store.read(CID, gen_oid)) == b"version1"
+    assert bytes(store.read(CID, OID)) == b"version2"
+    assert store.get_attr(CID, gen_oid, "a") == b"1"
+    objs = store.list_objects(CID)
+    assert gen_oid in objs and OID in objs
+
+
+def test_remove_and_collections(store):
+    store.apply_transaction(Transaction().write(CID, OID, 0, b"x"))
+    store.apply_transaction(Transaction().remove(CID, OID))
+    assert not store.exists(CID, OID)
+    with pytest.raises(NotFound):
+        store.read(CID, OID)
+    c2 = Collection(1, 1, 0)
+    store.apply_transaction(Transaction().create_collection(c2))
+    assert set(store.list_collections()) == {CID, c2}
+    with pytest.raises(StoreError):
+        store.apply_transaction(Transaction().create_collection(c2))
+    store.apply_transaction(Transaction().remove_collection(c2))
+    assert store.list_collections() == [CID]
+
+
+def test_transaction_atomic_rollback(store):
+    """A failing op mid-transaction must leave no partial effects."""
+    store.apply_transaction(Transaction().write(CID, OID, 0, b"before"))
+    bad = (Transaction()
+           .write(CID, OID, 0, b"after!")
+           .setattr(CID, OID, "a", b"x")
+           .remove(CID, ObjectId("missing")))  # raises NotFound
+    with pytest.raises(NotFound):
+        store.apply_transaction(bad)
+    assert bytes(store.read(CID, OID)) == b"before"
+    with pytest.raises(NotFound):
+        store.get_attr(CID, OID, "a")
+
+
+def test_transaction_wire_roundtrip(store):
+    t = (Transaction().write(CID, OID, 4, b"wire")
+         .omap_setkeys(CID, OID, {"log": b"entry"}))
+    t2 = Transaction.decode(t.encode())
+    store.apply_transaction(t2)
+    assert bytes(store.read(CID, OID, 4, 4)) == b"wire"
+
+
+def test_filestore_remount_durability(tmp_path):
+    path = str(tmp_path / "fs")
+    s = FileStore(path)
+    s.mkfs()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection(CID))
+    s.apply_transaction(
+        Transaction().write(CID, OID, 0, b"durable")
+        .setattr(CID, OID, "a", b"v")
+        .omap_setkeys(CID, OID, {"k": b"v"}))
+    s.umount()
+    s2 = FileStore(path)
+    s2.mount()
+    assert bytes(s2.read(CID, OID)) == b"durable"
+    assert s2.get_attr(CID, OID, "a") == b"v"
+    assert s2.omap_get(CID, OID) == {"k": b"v"}
+    assert s2.list_collections() == [CID]
+    s2.umount()
+
+
+def test_on_commit_callback(store):
+    fired = []
+    store.apply_transaction(Transaction().touch(CID, OID),
+                            on_commit=lambda: fired.append(1))
+    assert fired == [1]
