@@ -1,0 +1,11 @@
+"""Pseudo-random placement — rebuild of reference src/crush (SURVEY.md §2.4).
+
+Deterministic, hierarchical, weighted device selection with failure
+domains and device classes, straw2-style: every mapping decision is a pure
+function of (map, input id, trial), so any party with the map computes the
+same placement — the property the whole architecture leans on (clients
+place ops without asking the mon; reference crush_do_rule,
+src/crush/mapper.h:75).
+"""
+
+from .crush import Bucket, CrushError, CrushMap, Rule  # noqa: F401

@@ -1,0 +1,302 @@
+"""CRUSH-style placement map.
+
+Not a byte-compatible port of reference src/crush (its rjenkins hash and
+bucket encodings are irrelevant off-cluster); the *semantics* are kept:
+
+- hierarchy of typed buckets (root > rack > host > osd ...) with weights
+  and device classes (reference CrushWrapper),
+- straw2 weighted selection (reference bucket_straw2_choose,
+  src/crush/mapper.c): each candidate draws ln(u)/w from a per-
+  (input, item, trial) hash — statistically weight-proportional and
+  movement-minimal under weight changes,
+- rules: take <root> / chooseleaf firstn <n> type <domain> / emit, with
+  retries and rejection of down/out/reweighted-out devices
+  (crush_do_rule, mapper.h:75),
+- device classes filter candidate subtrees (reference device-class
+  shadow hierarchies).
+
+Hash: blake2b-64 keyed on (map seed, x, item id, trial) — stable across
+processes/versions, which is all determinism needs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import struct
+from typing import Dict, List, Optional, Sequence
+
+
+class CrushError(Exception):
+    pass
+
+
+def _hash64(*parts: int) -> int:
+    h = hashlib.blake2b(struct.pack(f"<{len(parts)}q", *parts),
+                        digest_size=8)
+    return struct.unpack("<Q", h.digest())[0]
+
+
+def _straw2(x: int, item: int, trial: int, weight: float) -> float:
+    """Max-draw wins.  u in (0,1]; draw = ln(u)/w (negative; closer to 0 is
+    better for heavier items, matching straw2's ln(u)*0x10000/w)."""
+    if weight <= 0:
+        return -math.inf
+    u = (_hash64(x, item, trial) + 1) / 2.0 ** 64
+    return math.log(u) / weight
+
+
+class Bucket:
+    """Internal node (or device leaf) of the hierarchy."""
+
+    def __init__(self, bid: int, name: str, type_name: str,
+                 weight: float = 0.0,
+                 device_class: "Optional[str]" = None) -> None:
+        self.id = bid
+        self.name = name
+        self.type_name = type_name          # "osd" leaves, else bucket type
+        self.weight = weight                # leaves: capacity weight
+        self.device_class = device_class    # leaves only (e.g. tpu/ssd/hdd)
+        self.children: "List[int]" = []
+
+    def is_device(self) -> bool:
+        return self.id >= 0
+
+
+class Rule:
+    """take <root> -> chooseleaf firstn <n> type <domain> -> emit."""
+
+    def __init__(self, name: str, root: str = "default",
+                 failure_domain: str = "host",
+                 device_class: "Optional[str]" = None) -> None:
+        self.name = name
+        self.root = root
+        self.failure_domain = failure_domain
+        self.device_class = device_class
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "root": self.root,
+                "failure_domain": self.failure_domain,
+                "device_class": self.device_class}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Rule":
+        return cls(d["name"], d.get("root", "default"),
+                   d.get("failure_domain", "host"), d.get("device_class"))
+
+
+class CrushMap:
+    """Devices have ids >= 0 ("osd.N"); buckets get negative ids."""
+
+    def __init__(self) -> None:
+        self._buckets: "Dict[int, Bucket]" = {}
+        self._by_name: "Dict[str, int]" = {}
+        self.rules: "Dict[str, Rule]" = {
+            "replicated_rule": Rule("replicated_rule")}
+        self._next_bucket_id = -1
+
+    # --- construction --------------------------------------------------------
+
+    def add_bucket(self, name: str, type_name: str,
+                   parent: "Optional[str]" = None) -> Bucket:
+        if name in self._by_name:
+            raise CrushError(f"bucket {name!r} exists")
+        b = Bucket(self._next_bucket_id, name, type_name)
+        self._next_bucket_id -= 1
+        self._register(b, parent)
+        return b
+
+    def add_device(self, osd_id: int, weight: float,
+                   parent: str, device_class: "Optional[str]" = None
+                   ) -> Bucket:
+        name = f"osd.{osd_id}"
+        if name in self._by_name:
+            raise CrushError(f"device {name} exists")
+        if osd_id < 0:
+            raise CrushError("device ids must be >= 0")
+        b = Bucket(osd_id, name, "osd", weight, device_class)
+        self._register(b, parent)
+        return b
+
+    def _register(self, b: Bucket, parent: "Optional[str]") -> None:
+        self._buckets[b.id] = b
+        self._by_name[b.name] = b.id
+        if parent is not None:
+            p = self.get(parent)
+            p.children.append(b.id)
+
+    def remove(self, name: str) -> None:
+        bid = self._by_name.pop(name, None)
+        if bid is None:
+            raise CrushError(f"no bucket {name!r}")
+        self._buckets.pop(bid)
+        for b in self._buckets.values():
+            b.children = [c for c in b.children if c != bid]
+
+    def get(self, name: str) -> Bucket:
+        bid = self._by_name.get(name)
+        if bid is None:
+            raise CrushError(f"no bucket {name!r}")
+        return self._buckets[bid]
+
+    def get_by_id(self, bid: int) -> Bucket:
+        if bid not in self._buckets:
+            raise CrushError(f"no bucket id {bid}")
+        return self._buckets[bid]
+
+    def reweight_device(self, osd_id: int, weight: float) -> None:
+        self.get_by_id(osd_id).weight = weight
+
+    def devices(self) -> "List[int]":
+        return sorted(b.id for b in self._buckets.values() if b.is_device())
+
+    # --- weights -------------------------------------------------------------
+
+    def subtree_weight(self, bid: int,
+                       device_class: "Optional[str]" = None,
+                       overrides: "Optional[Dict[int, float]]" = None
+                       ) -> float:
+        b = self._buckets[bid]
+        if b.is_device():
+            if device_class is not None and b.device_class != device_class:
+                return 0.0
+            w = b.weight
+            if overrides and b.id in overrides:
+                w *= overrides[b.id]
+            return max(0.0, w)
+        return sum(self.subtree_weight(c, device_class, overrides)
+                   for c in b.children)
+
+    # --- selection -----------------------------------------------------------
+
+    def _choose(self, x: int, candidates: "Sequence[int]", trial: int,
+                device_class: "Optional[str]",
+                overrides: "Optional[Dict[int, float]]") -> "Optional[int]":
+        best, best_draw = None, -math.inf
+        for c in candidates:
+            w = self.subtree_weight(c, device_class, overrides)
+            draw = _straw2(x, c, trial, w)
+            if draw > best_draw:
+                best, best_draw = c, draw
+        return best
+
+    def _descend_to_device(self, x: int, bid: int, trial: int,
+                           device_class: "Optional[str]",
+                           overrides) -> "Optional[int]":
+        b = self._buckets[bid]
+        while not b.is_device():
+            nxt = self._choose(x, b.children, trial, device_class, overrides)
+            if nxt is None:
+                return None
+            b = self._buckets[nxt]
+        if device_class is not None and b.device_class != device_class:
+            return None
+        if self.subtree_weight(b.id, device_class, overrides) <= 0:
+            return None
+        return b.id
+
+    def do_rule(self, rule_name: str, x: int, num: int,
+                weights: "Optional[Dict[int, float]]" = None
+                ) -> "List[int]":
+        """Map input ``x`` (a pg seed) to ``num`` distinct devices in
+        distinct failure domains (the crush_do_rule analog).
+
+        ``weights``: per-device multiplier in [0,1] — the OSDMap's in/out +
+        reweight vector (reference passes the same).  Fewer than ``num``
+        results means the hierarchy can't satisfy the rule (degraded
+        placement; callers handle short acting sets).
+        """
+        rule = self.rules.get(rule_name)
+        if rule is None:
+            raise CrushError(f"no rule {rule_name!r}")
+        root = self.get(rule.root)
+        # Collect failure-domain buckets under the root.
+        domains = self._collect_type(root.id, rule.failure_domain)
+        if not domains:
+            # Degenerate flat hierarchy: treat devices as their own domains.
+            domains = [b for b in self._collect_type(root.id, "osd")]
+        out: "List[int]" = []
+        used_domains: "set[int]" = set()
+        for r in range(num):
+            picked = None
+            for trial in range(50):  # choose_total_tries analog
+                cand = [d for d in domains if d not in used_domains]
+                if not cand:
+                    break
+                dom = self._choose(x, cand, r * 50 + trial,
+                                   rule.device_class, weights)
+                if dom is None:
+                    break
+                dev = self._descend_to_device(
+                    x, dom, r * 50 + trial, rule.device_class, weights)
+                if dev is not None and dev not in out:
+                    picked = (dom, dev)
+                    break
+            if picked is None:
+                continue
+            used_domains.add(picked[0])
+            out.append(picked[1])
+        return out
+
+    def _collect_type(self, bid: int, type_name: str) -> "List[int]":
+        b = self._buckets[bid]
+        if b.type_name == type_name:
+            return [bid]
+        if b.is_device():
+            return []
+        out: "List[int]" = []
+        for c in b.children:
+            out.extend(self._collect_type(c, type_name))
+        return out
+
+    # --- serialization --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "buckets": [{
+                "id": b.id, "name": b.name, "type": b.type_name,
+                "weight": b.weight, "device_class": b.device_class,
+                "children": b.children,
+            } for b in self._buckets.values()],
+            "rules": {n: r.to_dict() for n, r in self.rules.items()},
+            "next_bucket_id": self._next_bucket_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CrushMap":
+        m = cls()
+        m.rules = {n: Rule.from_dict(r) for n, r in d["rules"].items()}
+        m._next_bucket_id = d["next_bucket_id"]
+        for bd in d["buckets"]:
+            b = Bucket(bd["id"], bd["name"], bd["type"], bd["weight"],
+                       bd.get("device_class"))
+            b.children = list(bd["children"])
+            m._buckets[b.id] = b
+            m._by_name[b.name] = b.id
+        return m
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_dict()).encode()
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "CrushMap":
+        return cls.from_dict(json.loads(payload.decode()))
+
+    # --- convenience ----------------------------------------------------------
+
+    @classmethod
+    def flat(cls, osd_ids: "Sequence[int]", weight: float = 1.0,
+             host_per_osd: bool = True) -> "CrushMap":
+        """Dev/test topology: one root, one host per osd (so the default
+        host failure domain yields distinct-osd placements — the vstart.sh
+        analog)."""
+        m = cls()
+        m.add_bucket("default", "root")
+        for i in osd_ids:
+            if host_per_osd:
+                host = m.add_bucket(f"host{i}", "host", parent="default")
+                m.add_device(i, weight, host.name)
+            else:
+                m.add_device(i, weight, "default")
+        return m

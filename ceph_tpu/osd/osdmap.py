@@ -1,0 +1,230 @@
+"""OSDMap — the versioned cluster map (rebuild of src/osd/OSDMap.{h,cc}).
+
+Carries: osd states (up/down, in/out, reweight, addresses), pools
+(replicated or erasure, pg_num, rule, ec profile), EC profiles, the crush
+map, and pg_temp overrides.  Everyone (mon, osds, clients) computes
+``pg_to_up_acting_osds`` locally from the same epoch — placement is never
+a network question (reference OSDMap::pg_to_up_acting_osds).
+
+Maps are distributed as full JSON-encoded epochs (the reference uses
+incrementals as an optimization; full maps keep identical semantics at
+this scale).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..crush import CrushMap, Rule
+from ..ops import crc32c as crcmod
+
+POOL_REPLICATED = "replicated"
+POOL_ERASURE = "erasure"
+
+# Acting-set hole: position exists but no osd holds it (CRUSH_ITEM_NONE).
+NONE_OSD = -1
+
+
+@dataclass
+class Pool:
+    pool_id: int
+    name: str
+    type: str = POOL_REPLICATED
+    size: int = 3                 # replicas, or k+m for EC
+    min_size: int = 2
+    pg_num: int = 32
+    crush_rule: str = "replicated_rule"
+    ec_profile: str = ""          # name into OSDMap.ec_profiles
+    stripe_unit: int = 4096       # EC chunk granularity
+    fast_read: bool = False
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_ERASURE
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Pool":
+        return cls(**d)
+
+
+@dataclass
+class OsdInfo:
+    osd_id: int
+    up: bool = False
+    in_cluster: bool = True
+    weight: float = 1.0           # reweight multiplier [0, 1]
+    addr: str = ""                # host:port of the public messenger
+    up_from: int = 0              # epoch marked up
+    down_at: int = 0              # epoch marked down
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OsdInfo":
+        return cls(**d)
+
+
+class OSDMap:
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.fsid = ""
+        self.osds: "Dict[int, OsdInfo]" = {}
+        self.pools: "Dict[int, Pool]" = {}
+        self.ec_profiles: "Dict[str, dict]" = {}
+        self.crush = CrushMap()
+        self.pg_temp: "Dict[str, List[int]]" = {}  # "pool.pg" -> acting
+        self.next_pool_id = 1
+
+    # --- lookup ---------------------------------------------------------------
+
+    def get_pool(self, pool_id: int) -> Pool:
+        if pool_id not in self.pools:
+            raise KeyError(f"no pool {pool_id}")
+        return self.pools[pool_id]
+
+    def pool_by_name(self, name: str) -> "Optional[Pool]":
+        for p in self.pools.values():
+            if p.name == name:
+                return p
+        return None
+
+    def is_up(self, osd_id: int) -> bool:
+        info = self.osds.get(osd_id)
+        return bool(info and info.up)
+
+    def get_addr(self, osd_id: int) -> str:
+        info = self.osds.get(osd_id)
+        return info.addr if info else ""
+
+    # --- placement ------------------------------------------------------------
+
+    def object_to_pg(self, pool_id: int, name: str) -> int:
+        pool = self.get_pool(pool_id)
+        return crcmod.crc32c(name.encode()) % pool.pg_num
+
+    def _pg_seed(self, pool_id: int, pg: int) -> int:
+        return (pool_id << 32) ^ pg
+
+    def _weights(self) -> "Dict[int, float]":
+        out: "Dict[int, float]" = {}
+        for i, info in self.osds.items():
+            w = info.weight if info.in_cluster else 0.0
+            out[i] = w
+        return out
+
+    def pg_to_raw_up(self, pool_id: int, pg: int) -> "List[int]":
+        pool = self.get_pool(pool_id)
+        raw = self.crush.do_rule(pool.crush_rule,
+                                 self._pg_seed(pool_id, pg),
+                                 pool.size, self._weights())
+        # Up set: raw placement restricted to up osds, holes preserved for
+        # EC (positions are shard ids); replicated pools compact instead.
+        if pool.is_erasure():
+            up = [o if self.is_up(o) else NONE_OSD for o in raw]
+            up += [NONE_OSD] * (pool.size - len(up))
+        else:
+            up = [o for o in raw if self.is_up(o)]
+        return up
+
+    def pg_to_up_acting_osds(self, pool_id: int,
+                             pg: int) -> "Tuple[List[int], List[int]]":
+        """(up, acting): acting = pg_temp override if present, else up
+        (reference OSDMap::pg_to_up_acting_osds)."""
+        up = self.pg_to_raw_up(pool_id, pg)
+        temp = self.pg_temp.get(f"{pool_id}.{pg}")
+        acting = list(temp) if temp else list(up)
+        return up, acting
+
+    def primary_of(self, acting: "Sequence[int]") -> int:
+        for o in acting:
+            if o != NONE_OSD:
+                return o
+        return NONE_OSD
+
+    def all_pgs(self) -> "List[Tuple[int, int]]":
+        return [(pid, pg) for pid, pool in sorted(self.pools.items())
+                for pg in range(pool.pg_num)]
+
+    # --- mutation (mon side) --------------------------------------------------
+
+    def bump(self) -> None:
+        self.epoch += 1
+
+    def add_osd(self, osd_id: int, weight: float = 1.0,
+                host: "Optional[str]" = None,
+                device_class: "Optional[str]" = None) -> None:
+        if osd_id in self.osds:
+            raise KeyError(f"osd.{osd_id} exists")
+        self.osds[osd_id] = OsdInfo(osd_id)
+        hostname = host or f"host{osd_id}"
+        try:
+            self.crush.get(hostname)
+        except Exception:
+            self.crush.add_bucket(hostname, "host", parent="default")
+        self.crush.add_device(osd_id, weight, hostname, device_class)
+
+    def mark_up(self, osd_id: int, addr: str) -> None:
+        info = self.osds[osd_id]
+        info.up = True
+        info.addr = addr
+        info.up_from = self.epoch + 1
+
+    def mark_down(self, osd_id: int) -> None:
+        info = self.osds[osd_id]
+        info.up = False
+        info.down_at = self.epoch + 1
+
+    def mark_out(self, osd_id: int) -> None:
+        self.osds[osd_id].in_cluster = False
+
+    def mark_in(self, osd_id: int) -> None:
+        self.osds[osd_id].in_cluster = True
+
+    def create_pool(self, name: str, **kwargs) -> Pool:
+        if self.pool_by_name(name) is not None:
+            raise KeyError(f"pool {name!r} exists")
+        pool = Pool(self.next_pool_id, name, **kwargs)
+        self.pools[pool.pool_id] = pool
+        self.next_pool_id += 1
+        return pool
+
+    # --- serialization --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "fsid": self.fsid,
+            "osds": {str(i): o.to_dict() for i, o in self.osds.items()},
+            "pools": {str(i): p.to_dict() for i, p in self.pools.items()},
+            "ec_profiles": self.ec_profiles,
+            "crush": self.crush.to_dict(),
+            "pg_temp": self.pg_temp,
+            "next_pool_id": self.next_pool_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        m = cls()
+        m.epoch = d["epoch"]
+        m.fsid = d.get("fsid", "")
+        m.osds = {int(i): OsdInfo.from_dict(o)
+                  for i, o in d["osds"].items()}
+        m.pools = {int(i): Pool.from_dict(p)
+                   for i, p in d["pools"].items()}
+        m.ec_profiles = dict(d["ec_profiles"])
+        m.crush = CrushMap.from_dict(d["crush"])
+        m.pg_temp = {k: list(v) for k, v in d["pg_temp"].items()}
+        m.next_pool_id = d["next_pool_id"]
+        return m
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_dict()).encode()
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "OSDMap":
+        return cls.from_dict(json.loads(payload.decode()))

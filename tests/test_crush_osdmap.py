@@ -1,0 +1,189 @@
+"""Placement tests: straw2 statistics, failure domains, device classes,
+stability under change; OSDMap pg mapping (reference src/test/crush +
+OSDMap suites, SURVEY.md §4)."""
+
+import collections
+
+import pytest
+
+from ceph_tpu.crush import CrushError, CrushMap, Rule
+from ceph_tpu.osd.osdmap import NONE_OSD, OSDMap, POOL_ERASURE
+
+
+def build_map(hosts=4, osds_per_host=3) -> CrushMap:
+    m = CrushMap()
+    m.add_bucket("default", "root")
+    osd = 0
+    for h in range(hosts):
+        m.add_bucket(f"host{h}", "host", parent="default")
+        for _ in range(osds_per_host):
+            m.add_device(osd, 1.0, f"host{h}")
+            osd += 1
+    return m
+
+
+class TestCrush:
+    def test_deterministic(self):
+        m = build_map()
+        a = m.do_rule("replicated_rule", 1234, 3)
+        b = m.do_rule("replicated_rule", 1234, 3)
+        assert a == b
+        m2 = CrushMap.decode(m.encode())
+        assert m2.do_rule("replicated_rule", 1234, 3) == a
+
+    def test_distinct_failure_domains(self):
+        m = build_map()
+        for x in range(200):
+            out = m.do_rule("replicated_rule", x, 3)
+            assert len(out) == 3
+            hosts = {o // 3 for o in out}
+            assert len(hosts) == 3, f"x={x}: {out} not host-distinct"
+
+    def test_weight_proportionality(self):
+        m = CrushMap()
+        m.add_bucket("default", "root")
+        m.add_bucket("h0", "host", parent="default")
+        m.add_device(0, 1.0, "h0")
+        m.add_bucket("h1", "host", parent="default")
+        m.add_device(1, 3.0, "h1")
+        counts = collections.Counter(
+            m.do_rule("replicated_rule", x, 1)[0] for x in range(4000))
+        ratio = counts[1] / counts[0]
+        assert 2.4 < ratio < 3.6, counts
+
+    def test_zero_weight_excluded(self):
+        m = build_map()
+        weights = {i: 1.0 for i in range(12)}
+        weights[5] = 0.0
+        for x in range(300):
+            assert 5 not in m.do_rule("replicated_rule", x, 3, weights)
+
+    def test_stability_on_device_loss(self):
+        """CRUSH's minimal-movement property: zeroing osd.7 (in host2) must
+        not reshuffle placements that never touched host2's subtree, and
+        total movement stays bounded."""
+        m = build_map()
+        host2 = {6, 7, 8}
+        weights = {i: 1.0 for i in range(12)}
+        before = {x: m.do_rule("replicated_rule", x, 3, weights)
+                  for x in range(500)}
+        weights[7] = 0.0
+        moved = unrelated_moved = 0
+        for x, prev in before.items():
+            after = m.do_rule("replicated_rule", x, 3, weights)
+            if after != prev:
+                moved += 1
+                if not host2 & set(prev):
+                    unrelated_moved += 1
+        assert moved > 0
+        assert unrelated_moved == 0, \
+            "placements outside host2's subtree reshuffled"
+        assert moved < 500 * 0.55, f"excessive movement: {moved}/500"
+
+    def test_device_class_rule(self):
+        m = CrushMap()
+        m.add_bucket("default", "root")
+        for h in range(3):
+            m.add_bucket(f"h{h}", "host", parent="default")
+            m.add_device(h * 2, 1.0, f"h{h}", device_class="tpu")
+            m.add_device(h * 2 + 1, 1.0, f"h{h}", device_class="hdd")
+        m.rules["tpu_only"] = Rule("tpu_only", device_class="tpu")
+        for x in range(100):
+            out = m.do_rule("tpu_only", x, 2)
+            assert all(o % 2 == 0 for o in out), out
+
+    def test_short_result_when_unsatisfiable(self):
+        m = build_map(hosts=2)
+        out = m.do_rule("replicated_rule", 7, 3)
+        assert len(out) == 2  # only 2 host domains exist
+
+    def test_unknown_rule(self):
+        with pytest.raises(CrushError):
+            build_map().do_rule("nope", 1, 1)
+
+
+class TestOSDMap:
+    def build(self, n=6) -> OSDMap:
+        m = OSDMap()
+        m.crush.add_bucket("default", "root")
+        for i in range(n):
+            m.add_osd(i)
+            m.mark_up(i, f"127.0.0.1:{6800 + i}")
+        m.ec_profiles["ecprof"] = {
+            "plugin": "jax_rs", "k": "4", "m": "2",
+            "technique": "reed_sol_van"}
+        m.create_pool("ecpool", type=POOL_ERASURE, size=6, min_size=4,
+                      pg_num=8, ec_profile="ecprof")
+        m.bump()
+        return m
+
+    def test_pg_mapping_complete(self):
+        m = self.build()
+        pool = m.pool_by_name("ecpool")
+        for pg in range(pool.pg_num):
+            up, acting = m.pg_to_up_acting_osds(pool.pool_id, pg)
+            assert len(acting) == 6
+            assert len(set(acting)) == 6  # all shards on distinct osds
+            assert m.primary_of(acting) == acting[0]
+
+    def test_object_to_pg_stable(self):
+        m = self.build()
+        pool = m.pool_by_name("ecpool")
+        pg1 = m.object_to_pg(pool.pool_id, "myobject")
+        assert pg1 == m.object_to_pg(pool.pool_id, "myobject")
+        assert 0 <= pg1 < pool.pg_num
+
+    def test_down_osd_leaves_hole_in_ec_up_set(self):
+        m = self.build()
+        pool = m.pool_by_name("ecpool")
+        up0, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        victim = up0[2]
+        m.mark_down(victim)
+        m.bump()
+        up1, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        assert up1[2] == NONE_OSD
+        assert [o for i, o in enumerate(up1) if i != 2] == \
+            [o for i, o in enumerate(up0) if i != 2]
+
+    def test_out_osd_remapped(self):
+        m = self.build(8)  # spare osds exist to remap onto
+        pool = m.pool_by_name("ecpool")
+        up0, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        victim = up0[0]
+        m.mark_out(victim)
+        m.bump()
+        up1, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        assert victim not in up1
+        assert all(o != NONE_OSD for o in up1)  # remapped, not degraded
+
+    def test_pg_temp_override(self):
+        m = self.build()
+        pool = m.pool_by_name("ecpool")
+        up, acting = m.pg_to_up_acting_osds(pool.pool_id, 3)
+        m.pg_temp[f"{pool.pool_id}.3"] = [5, 4, 3, 2, 1, 0]
+        up2, acting2 = m.pg_to_up_acting_osds(pool.pool_id, 3)
+        assert up2 == up
+        assert acting2 == [5, 4, 3, 2, 1, 0]
+
+    def test_serialization_roundtrip(self):
+        m = self.build()
+        m2 = OSDMap.decode(m.encode())
+        assert m2.epoch == m.epoch
+        assert m2.ec_profiles == m.ec_profiles
+        pool = m2.pool_by_name("ecpool")
+        for pg in range(8):
+            assert m2.pg_to_up_acting_osds(pool.pool_id, pg) == \
+                m.pg_to_up_acting_osds(pool.pool_id, pg)
+
+    def test_replicated_pool_compacts(self):
+        m = self.build()
+        m.create_pool("rpool", size=3, pg_num=4)
+        m.bump()
+        pool = m.pool_by_name("rpool")
+        up, acting = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        victim = up[1]
+        m.mark_down(victim)
+        m.bump()
+        up2, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
+        assert victim not in up2 and NONE_OSD not in up2
+        assert len(up2) == 2
