@@ -1,0 +1,18 @@
+"""Communication layer — rebuild of reference src/msg + src/messages
+(SURVEY.md §2.3).
+
+- ``message``: typed, versioned message envelopes (163 reference headers
+  collapse to one envelope + a type registry; payload buffers ride as raw
+  binary, never JSON).
+- ``messenger``: asyncio transport with per-peer-class policies
+  (lossy/lossless), seq/ack replay for lossless peers, crc32c or AES-GCM
+  frame protection (protocol v2's two modes), dispatch throttling, and
+  ms_inject_* fault injection for QA.
+
+Bulk shard movement between chips rides JAX collectives over ICI
+(ceph_tpu.parallel); this messenger is the host control/data plane across
+processes and hosts — the AsyncMessenger role.
+"""
+
+from .message import Message, MessageError, decode_message, register_message  # noqa: F401
+from .messenger import Connection, Dispatcher, Messenger, entity_addr  # noqa: F401

@@ -1,0 +1,110 @@
+"""Typed message envelopes.
+
+Reference: src/messages/ (163 typed headers) + Message.h's
+header/payload/data split.  Kept:
+
+- a type registry (wire type string -> class) with HEAD_VERSION /
+  COMPAT_VERSION checks: a receiver rejects messages whose compat version
+  exceeds what it speaks (the feature-gating analog),
+- the payload split: ``fields`` (small JSON-able header values) vs
+  ``data`` (bulk bytes — shard chunks, transactions — shipped raw).
+
+Concrete subclasses live beside their subsystems (osd/mon/client modules)
+and are one-liner declarations.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+
+class MessageError(Exception):
+    pass
+
+
+_REGISTRY: "Dict[str, Type[Message]]" = {}
+
+
+def register_message(cls: "Type[Message]") -> "Type[Message]":
+    """Class decorator: adds the type to the wire registry."""
+    if not cls.TYPE:
+        raise MessageError(f"{cls.__name__} has no TYPE")
+    if cls.TYPE in _REGISTRY:
+        raise MessageError(f"message type {cls.TYPE!r} already registered")
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+class Message:
+    TYPE = ""
+    HEAD_VERSION = 1     # current encoding version
+    COMPAT_VERSION = 1   # oldest decoder this encoding supports
+
+    def __init__(self, fields: "Optional[dict]" = None,
+                 data: "bytes | np.ndarray" = b"") -> None:
+        self.fields: "Dict[str, Any]" = dict(fields or {})
+        if isinstance(data, np.ndarray):
+            data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
+        self.data: bytes = bytes(data)
+        self.priority = 127
+        # filled by the messenger on receive:
+        self.from_name: str = ""
+
+    def __getitem__(self, key: str) -> Any:
+        return self.fields[key]
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.fields.get(key, default)
+
+    def data_array(self) -> np.ndarray:
+        return np.frombuffer(self.data, dtype=np.uint8)
+
+    # --- wire ----------------------------------------------------------------
+
+    def encode(self) -> "tuple[bytes, bytes]":
+        header = json.dumps({
+            "type": self.TYPE,
+            "v": self.HEAD_VERSION,
+            "compat": self.COMPAT_VERSION,
+            "prio": self.priority,
+            "fields": self.fields,
+        }).encode()
+        return header, self.data
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}({self.fields}, "
+                f"data={len(self.data)}B)")
+
+
+def decode_message(header: bytes, data: bytes,
+                   from_name: str = "") -> Message:
+    try:
+        h = json.loads(header.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MessageError(f"bad message header: {e}")
+    cls = _REGISTRY.get(h.get("type", ""))
+    if cls is None:
+        raise MessageError(f"unknown message type {h.get('type')!r}")
+    if h.get("compat", 1) > cls.HEAD_VERSION:
+        raise MessageError(
+            f"{h['type']}: peer compat v{h['compat']} > our v{cls.HEAD_VERSION}")
+    msg = cls(h.get("fields", {}), data)
+    msg.priority = h.get("prio", 127)
+    msg.from_name = from_name
+    return msg
+
+
+# --- generic types used by the transport itself ------------------------------
+
+
+@register_message
+class MPing(Message):
+    TYPE = "ping"
+
+
+@register_message
+class MPong(Message):
+    TYPE = "pong"

@@ -1,0 +1,519 @@
+"""Async messenger — the AsyncMessenger/ProtocolV2 rebuild.
+
+Reference: src/msg/async (epoll event loops, connection state machines),
+ProtocolV2.cc (banner/handshake, crc vs secure AES-GCM frame modes),
+Policy.h (lossy client vs lossless cluster peers), plus the QA fault
+injection options ms_inject_socket_failures / ms_inject_delay_max /
+ms_inject_drop_ratio (src/common/options.cc:1065-1086).
+
+Shape here: one asyncio loop per daemon.  Outgoing connections are cached
+per peer address and owned by the sender; lossless peers get seq/ack
+tracking with replay-on-reconnect (exponential backoff), lossy peers drop
+state on failure (reference Policy::lossy semantics).  Frames carry
+either a crc32c trailer or an AES-GCM seal keyed off the cluster secret
+(the cephx shared-key analog; nonce = per-connection salt + direction +
+seq, so replay across connections is rejected by the seal).
+
+Transports: ``async+tcp`` (real sockets) and ``async+local`` (in-process
+loopback registry — the unit-test/multi-daemon-in-one-process path).
+Fault injection applies to both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from ..common.throttle import Throttle
+from ..common.log import dout
+from ..ops import crc32c as crcmod
+from .message import Message, MessageError, decode_message
+
+MAGIC = 0x43545032  # "CTP2"
+_FRAME_HDR = struct.Struct("<IBQQII")  # magic, flags, seq, ack, hlen, dlen
+FLAG_SECURE = 1
+
+
+def entity_addr(addr: str) -> "Tuple[str, int]":
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class Policy:
+    def __init__(self, lossy: bool) -> None:
+        self.lossy = lossy
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False)
+
+
+class Dispatcher:
+    """Interface (reference Dispatcher.h)."""
+
+    async def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if consumed."""
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """Peer session dropped (lossy) or replaced."""
+
+
+class _Injector:
+    """QA fault injection shared by both transports."""
+
+    def __init__(self, messenger: "Messenger") -> None:
+        self.m = messenger
+        self.rng = random.Random(hash(messenger.name) & 0xFFFFFFFF)
+
+    def kill_socket(self) -> bool:
+        n = int(self.m.conf("ms_inject_socket_failures"))
+        return n > 0 and self.rng.randrange(n) == 0
+
+    def drop(self) -> bool:
+        r = float(self.m.conf("ms_inject_drop_ratio"))
+        return r > 0 and self.rng.random() < r
+
+    async def maybe_delay(self) -> None:
+        d = float(self.m.conf("ms_inject_delay_max"))
+        if d > 0:
+            await asyncio.sleep(self.rng.random() * d)
+
+
+class Connection:
+    """One peer session.  Owned by the messenger that created it."""
+
+    def __init__(self, messenger: "Messenger", peer_addr: str,
+                 policy: Policy, outgoing: bool) -> None:
+        self.messenger = messenger
+        self.peer_addr = peer_addr        # listen addr ("" for pure clients)
+        self.peer_name = ""               # filled at handshake
+        self.policy = policy
+        self.outgoing = outgoing
+        self.out_seq = 0
+        self.unacked: "List[Tuple[int, bytes]]" = []  # (seq, frame)
+        self.in_seq = 0
+        self._writer: "Optional[asyncio.StreamWriter]" = None
+        self._send_lock = asyncio.Lock()
+        self._connected = asyncio.Event()
+        self.closed = False
+        self._salt = os.urandom(4)
+        self._peer_salt = b"\x00" * 4
+        self._task: "Optional[asyncio.Task]" = None
+
+    # --- crypto/frame helpers -------------------------------------------------
+
+    def _seal_key(self) -> bytes:
+        return hashlib.sha256(
+            b"ceph-tpu-onwire:" + self.messenger.secret).digest()
+
+    def _nonce(self, seq: int, outbound: bool) -> bytes:
+        salt = self._salt if outbound else self._peer_salt
+        direction = 1 if (outbound == self.outgoing) else 0
+        return salt + struct.pack("<BQxxx", direction, seq)[:8]
+
+    def _frame(self, header: bytes, data: bytes, seq: int, ack: int,
+               force_plain: bool = False) -> bytes:
+        # Banners ride in crc mode even under ms_secure_mode: they CARRY
+        # the nonce salt (reference does its handshake pre-auth too).  The
+        # secure-mode flag in the banner is cross-checked, so a stripped
+        # or tampered banner fails the session, and every post-banner
+        # frame is sealed.
+        secure = self.messenger.secure and not force_plain
+        flags = FLAG_SECURE if secure else 0
+        body = header + data
+        if secure:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+            hdr = _FRAME_HDR.pack(MAGIC, flags, seq, ack, len(header),
+                                  len(data))
+            sealed = AESGCM(self._seal_key()).encrypt(
+                self._nonce(seq, outbound=True), body, hdr)
+            return hdr + sealed
+        hdr = _FRAME_HDR.pack(MAGIC, flags, seq, ack, len(header), len(data))
+        crc = crcmod.crc32c(hdr + body)
+        return hdr + body + struct.pack("<I", crc)
+
+    async def _read_frame(self, reader: asyncio.StreamReader
+                          ) -> "Tuple[bytes, bytes, int, int]":
+        hdr = await reader.readexactly(_FRAME_HDR.size)
+        magic, flags, seq, ack, hlen, dlen = _FRAME_HDR.unpack(hdr)
+        if magic != MAGIC:
+            raise MessageError("bad frame magic")
+        if flags & FLAG_SECURE:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+            sealed = await reader.readexactly(hlen + dlen + 16)
+            body = AESGCM(self._seal_key()).decrypt(
+                self._nonce(seq, outbound=False), sealed, hdr)
+        else:
+            body = await reader.readexactly(hlen + dlen)
+            crc, = struct.unpack("<I",
+                                 await reader.readexactly(4))
+            if crc != crcmod.crc32c(hdr + body):
+                raise MessageError("frame crc mismatch")
+        return body[:hlen], body[hlen:], seq, ack
+
+    # --- sending ---------------------------------------------------------------
+
+    async def send_message(self, msg: Message) -> None:
+        """Queue + transmit.  Lossless: tracked until acked, replayed on
+        reconnect.  Lossy: best effort."""
+        if self.closed:
+            if self.policy.lossy:
+                raise ConnectionError(f"connection to {self.peer_addr} closed")
+            return
+        header, data = msg.encode()
+        self.out_seq += 1
+        seq = self.out_seq
+        frame = self._frame(header, data, seq, self.in_seq)
+        if not self.policy.lossy:
+            self.unacked.append((seq, frame))
+        await self._transmit(frame)
+
+    async def _transmit(self, frame: bytes) -> None:
+        inj = self.messenger.injector
+        if inj.drop():
+            dout("ms", 5, f"{self.messenger.name}: injected drop to "
+                 f"{self.peer_addr}")
+            return
+        await inj.maybe_delay()
+        if inj.kill_socket():
+            dout("ms", 5, f"{self.messenger.name}: injected socket kill to "
+                 f"{self.peer_addr}")
+            self._abort()
+            return
+        if not self.policy.lossy:
+            # wait for an (re)established session
+            try:
+                await asyncio.wait_for(self._connected.wait(), timeout=30)
+            except asyncio.TimeoutError:
+                return
+        elif not self._connected.is_set():
+            raise ConnectionError(f"no session to {self.peer_addr}")
+        writer = self._writer
+        if writer is None:
+            return
+        async with self._send_lock:
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except (ConnectionError, OSError):
+                self._abort()
+
+    async def _send_ctrl(self, fields: dict) -> None:
+        # Control frames consume real seq numbers too: every frame on a
+        # (connection, direction) needs a unique AES-GCM nonce.  Receivers
+        # skip in_seq advancement for them, so acks/dedup track data only.
+        self.out_seq += 1
+        frame = self._frame(json.dumps(fields).encode(), b"",
+                            self.out_seq, self.in_seq)
+        writer = self._writer
+        if writer is None:
+            return
+        async with self._send_lock:
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except (ConnectionError, OSError):
+                self._abort()
+
+    def _abort(self) -> None:
+        self._connected.clear()
+        w, self._writer = self._writer, None
+        if w is not None:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    def mark_down(self) -> None:
+        """Administrative close (reference Connection::mark_down)."""
+        self.closed = True
+        self._abort()
+        if self._task is not None:
+            self._task.cancel()
+
+    # --- session (outgoing side) -----------------------------------------------
+
+    def start_outgoing(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run_outgoing())
+
+    async def _run_outgoing(self) -> None:
+        backoff = float(self.messenger.conf("ms_initial_backoff"))
+        while not self.closed:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    *entity_addr(self.peer_addr))
+            except OSError:
+                if self.policy.lossy:
+                    self.closed = True
+                    self.messenger._drop_connection(self)
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2,
+                              float(self.messenger.conf("ms_max_backoff")))
+                continue
+            backoff = float(self.messenger.conf("ms_initial_backoff"))
+            try:
+                await self._session(reader, writer, client_side=True)
+            except (OSError, MessageError, asyncio.IncompleteReadError):
+                pass
+            self._abort()
+            if self.policy.lossy:
+                self.closed = True
+                self.messenger._drop_connection(self)
+                for d in self.messenger.dispatchers:
+                    d.ms_handle_reset(self)
+                return
+
+    def _banner(self) -> bytes:
+        self.out_seq += 1
+        banner = {"type": "__banner", "name": self.messenger.name,
+                  "addr": self.messenger.listen_addr,
+                  "salt": self._salt.hex(),
+                  "in_seq": self.in_seq, "secure": self.messenger.secure}
+        return self._frame(json.dumps(banner).encode(), b"",
+                           self.out_seq, self.in_seq, force_plain=True)
+
+    async def _read_banner(self, reader: asyncio.StreamReader) -> dict:
+        pheader, _, _, _ = await self._read_frame(reader)
+        ph = json.loads(pheader.decode())
+        if ph.get("type") != "__banner":
+            raise MessageError("expected banner")
+        if bool(ph.get("secure")) != self.messenger.secure:
+            raise MessageError("secure-mode mismatch")
+        self.peer_name = ph.get("name", "")
+        self._peer_salt = bytes.fromhex(ph.get("salt", "00000000"))
+        if ph.get("addr") and not self.peer_addr:
+            self.peer_addr = ph["addr"]
+        return ph
+
+    async def _session(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter,
+                       client_side: bool) -> None:
+        self._writer = writer
+        if client_side:
+            # client speaks first; server replies with how far it had
+            # received from us, so replay resends exactly the lost tail
+            writer.write(self._banner())
+            await writer.drain()
+            ph = await self._read_banner(reader)
+            peer_in_seq = int(ph.get("in_seq", 0))
+            if not self.policy.lossy:
+                self.unacked = [(s, f) for s, f in self.unacked
+                                if s > peer_in_seq]
+                self._connected.set()
+                for _, fr in list(self.unacked):
+                    writer.write(fr)
+                await writer.drain()
+            else:
+                self._connected.set()
+        else:
+            await self._read_banner(reader)
+            # restore receive progress for this peer (survives reconnects)
+            key = self.peer_addr or self.peer_name
+            self.in_seq = self.messenger._peer_in_seq.get(key, 0)
+            writer.write(self._banner())
+            await writer.drain()
+            self._connected.set()
+        await self._read_loop(reader)
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        while not self.closed:
+            header, data, seq, ack = await self._read_frame(reader)
+            inj = self.messenger.injector
+            if inj.kill_socket():
+                dout("ms", 5, f"{self.messenger.name}: injected recv kill")
+                self._abort()
+                return
+            if ack:
+                self.unacked = [(s, f) for s, f in self.unacked if s > ack]
+            h = json.loads(header.decode())
+            if h.get("type") == "__ack":
+                continue
+            if h.get("type") == "__banner":
+                continue
+            if seq:
+                if seq <= self.in_seq:
+                    continue  # replayed duplicate
+                self.in_seq = seq
+                self.messenger._peer_in_seq[self.peer_addr or
+                                            self.peer_name] = seq
+            msg = decode_message(header, data, from_name=self.peer_name)
+            await self.messenger._deliver(self, msg)
+            await self._send_ctrl({"type": "__ack"})
+
+
+class _LocalConnection:
+    """In-process transport: delivers straight into the peer messenger's
+    dispatch path (async+local)."""
+
+    def __init__(self, messenger: "Messenger", peer: "Messenger",
+                 policy: Policy) -> None:
+        self.messenger = messenger
+        self.peer = peer
+        self.peer_addr = peer.listen_addr
+        self.peer_name = peer.name
+        self.policy = policy
+        self.closed = False
+        self._reverse: "Optional[_LocalConnection]" = None
+
+    def _get_reverse(self) -> "_LocalConnection":
+        if self._reverse is None:
+            self._reverse = _LocalConnection(self.peer, self.messenger,
+                                             Policy.lossless_peer())
+            self._reverse._reverse = self
+        return self._reverse
+
+    async def send_message(self, msg: Message) -> None:
+        if self.closed or self.peer.stopped:
+            if self.policy.lossy:
+                raise ConnectionError(f"connection to {self.peer_addr} closed")
+            return
+        inj = self.messenger.injector
+        if inj.drop() or inj.kill_socket():
+            dout("ms", 5, f"{self.messenger.name}: injected local drop")
+            return
+        await inj.maybe_delay()
+        # re-encode/decode: no shared mutable state between daemons
+        header, data = msg.encode()
+        peer_msg = decode_message(header, data,
+                                  from_name=self.messenger.name)
+        await self.peer._deliver(self._get_reverse(), peer_msg)
+
+    def mark_down(self) -> None:
+        self.closed = True
+
+
+class Messenger:
+    """create() -> bind() -> add_dispatcher() -> start()."""
+
+    _local_registry: "Dict[str, Messenger]" = {}
+
+    def __init__(self, name: str, config=None,
+                 secret: bytes = b"shared-cluster-secret") -> None:
+        self.name = name
+        self._config = config
+        self.secret = secret
+        self.listen_addr = ""
+        self.dispatchers: "List[Dispatcher]" = []
+        self.connections: "Dict[str, Connection]" = {}
+        self._server: "Optional[asyncio.AbstractServer]" = None
+        self._accepted: "List[Connection]" = []
+        self._peer_in_seq: "Dict[str, int]" = {}
+        self.stopped = False
+        self.injector = _Injector(self)
+        self.dispatch_throttle = Throttle(
+            f"{name}-dispatch", int(self.conf("ms_dispatch_throttle_bytes")))
+        self.local = self.conf("ms_type") == "async+local"
+
+    @classmethod
+    def create(cls, name: str, config=None, **kw) -> "Messenger":
+        return cls(name, config, **kw)
+
+    def conf(self, key: str):
+        if self._config is not None:
+            return self._config.get(key)
+        from ..common.options import OPTIONS
+        return OPTIONS[key].default
+
+    @property
+    def secure(self) -> bool:
+        return bool(self.conf("ms_secure_mode"))
+
+    # --- lifecycle -------------------------------------------------------------
+
+    async def bind(self, addr: str) -> None:
+        self.listen_addr = addr
+        if self.local:
+            Messenger._local_registry[addr] = self
+            return
+        host, port = entity_addr(addr)
+        self._server = await asyncio.start_server(
+            self._on_accept, host, port)
+        if port == 0:
+            port = self._server.sockets[0].getsockname()[1]
+            self.listen_addr = f"{host}:{port}"
+            # rebind the advertised addr
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    async def shutdown(self) -> None:
+        self.stopped = True
+        if self.local:
+            Messenger._local_registry.pop(self.listen_addr, None)
+        for conn in list(self.connections.values()):
+            conn.mark_down()
+        for conn in self._accepted:
+            conn.mark_down()
+        self.connections.clear()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+
+    # --- connections -----------------------------------------------------------
+
+    def get_connection(self, addr: str,
+                       policy: "Optional[Policy]" = None):
+        """Cached outgoing connection to a peer's listen address."""
+        policy = policy or Policy.lossless_peer()
+        conn = self.connections.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        if self.local:
+            peer = Messenger._local_registry.get(addr)
+            if peer is None or peer.stopped:
+                raise ConnectionError(f"no local peer at {addr}")
+            lconn = _LocalConnection(self, peer, policy)
+            self.connections[addr] = lconn  # type: ignore[assignment]
+            return lconn
+        conn = Connection(self, addr, policy, outgoing=True)
+        conn.in_seq = 0
+        conn.start_outgoing()
+        self.connections[addr] = conn
+        return conn
+
+    def _drop_connection(self, conn: Connection) -> None:
+        cur = self.connections.get(conn.peer_addr)
+        if cur is conn:
+            del self.connections[conn.peer_addr]
+
+    async def _on_accept(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        conn = Connection(self, "", Policy.lossless_peer(), outgoing=False)
+        self._accepted.append(conn)
+        try:
+            await conn._session(reader, writer, client_side=False)
+        except (OSError, MessageError, asyncio.IncompleteReadError,
+                json.JSONDecodeError):
+            pass
+        finally:
+            conn._abort()
+            if conn in self._accepted:
+                self._accepted.remove(conn)
+
+    # --- dispatch ----------------------------------------------------------------
+
+    async def _deliver(self, conn, msg: Message) -> None:
+        cost = len(msg.data)
+        await self.dispatch_throttle.aget(cost)
+        try:
+            for d in self.dispatchers:
+                if await d.ms_dispatch(conn, msg):
+                    return
+            dout("ms", 1, f"{self.name}: unhandled message {msg!r}")
+        finally:
+            self.dispatch_throttle.put(cost)
