@@ -1,0 +1,4 @@
+from .objecter import Objecter
+from .rados import IoCtx, RadosClient
+
+__all__ = ["Objecter", "IoCtx", "RadosClient"]

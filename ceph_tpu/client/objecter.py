@@ -1,0 +1,100 @@
+"""Objecter — client-side placement, dispatch, and retry.
+
+Reference: src/osdc/Objecter.cc (5.3k LoC): ``op_submit`` (:2256) computes
+the target via CRUSH client-side (``_calc_target`` :882 — pool -> pg ->
+acting primary), sends over the messenger (``_send_op`` :716), and
+resends on map changes or connection resets.  The client never asks a
+server where data lives — placement is pure computation on the OSDMap,
+the defining RADOS trait.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.log import dout
+from ..msg.messenger import Dispatcher, Messenger, Policy
+from ..osd.messages import MOSDOp, MOSDOpReply, unpack_buffers
+from ..osd.osdmap import NONE_OSD, OSDMap
+
+
+class ObjecterError(Exception):
+    pass
+
+
+class Objecter(Dispatcher):
+    def __init__(self, ms: Messenger, osdmap: OSDMap,
+                 max_retries: int = 6, backoff: float = 0.05) -> None:
+        self.ms = ms
+        self.osdmap = osdmap
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.ms.add_dispatcher(self)
+        self._next_tid = 0
+        self._inflight: "Dict[int, asyncio.Future]" = {}
+
+    def new_tid(self) -> int:
+        self._next_tid += 1
+        return self._next_tid
+
+    # --- placement (reference _calc_target Objecter.cc:882) ------------------
+
+    def calc_target(self, pool_id: int, oid: str) -> "Tuple[int, int]":
+        """(pg, primary osd) for an object."""
+        pg = self.osdmap.object_to_pg(pool_id, oid)
+        _up, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        primary = next((o for o in acting if o != NONE_OSD), NONE_OSD)
+        return pg, primary
+
+    # --- submit (reference op_submit Objecter.cc:2256) -----------------------
+
+    async def op_submit(self, pool_id: int, oid: str, ops: "List[dict]",
+                        data: bytes = b"") -> "Tuple[List[dict], bytes]":
+        """Send ops to the object's primary; retry on resets/down primary
+        (the reference requeues on every new map epoch)."""
+        last_err: "Optional[Exception]" = None
+        # one tid per *logical* op: retries reuse it, and the server-side
+        # reqid dedup (reference osd_reqid_t in the PG log) keeps a
+        # mutation whose ack was lost from applying twice
+        tid = self.new_tid()
+        reqid = f"{self.ms.name}:{tid}"
+        for attempt in range(self.max_retries):
+            pg, primary = self.calc_target(pool_id, oid)
+            if primary == NONE_OSD:
+                last_err = ObjecterError(f"pg {pool_id}.{pg} has no primary")
+                await asyncio.sleep(self.backoff * (attempt + 1))
+                continue
+            fut = asyncio.get_event_loop().create_future()
+            self._inflight[tid] = fut
+            msg = MOSDOp({"tid": tid, "pool": pool_id, "pg": pg,
+                          "oid": oid, "ops": ops, "reqid": reqid,
+                          "map_epoch": self.osdmap.epoch}, data)
+            try:
+                conn = self.ms.get_connection(
+                    self.osdmap.get_addr(primary), Policy.lossy_client())
+                await conn.send_message(msg)
+                reply = await asyncio.wait_for(fut, timeout=10.0)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last_err = e
+                self._inflight.pop(tid, None)
+                await asyncio.sleep(self.backoff * (attempt + 1))
+                continue
+            finally:
+                self._inflight.pop(tid, None)
+            outs = list(reply.get("outs", []))
+            if int(reply.get("result", 0)) != 0:
+                errs = [o.get("error") for o in outs if "error" in o]
+                raise ObjecterError(
+                    f"op on {oid} failed: {errs or reply['result']}")
+            return outs, reply.data
+        raise ObjecterError(
+            f"op on {oid} failed after {self.max_retries} tries: {last_err}")
+
+    async def ms_dispatch(self, conn, msg) -> bool:
+        if msg.TYPE != "osd_op_reply":
+            return False
+        fut = self._inflight.get(int(msg["tid"]))
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+        return True

@@ -1,0 +1,92 @@
+"""librados-style client API.
+
+Reference: src/librados IoCtx (IoCtxImpl.cc:595 write, :645 operate).
+``RadosClient`` owns the messenger + Objecter; ``IoCtx`` scopes ops to a
+pool.  All I/O methods are coroutines (the reference offers aio_*
+variants; an async-first API is the idiomatic rebuild).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..common.config import Config
+from ..msg.messenger import Messenger
+from ..osd.messages import unpack_buffers
+from ..osd.osdmap import OSDMap
+from .objecter import Objecter, ObjecterError
+
+
+class RadosClient:
+    def __init__(self, osdmap: OSDMap, name: str = "client",
+                 config: "Optional[Config]" = None) -> None:
+        self.osdmap = osdmap
+        self.ms = Messenger.create(name, config or Config())
+        self.objecter = Objecter(self.ms, osdmap)
+
+    async def connect(self, addr: str = "") -> None:
+        await self.ms.bind(addr or f"client:{id(self) & 0xFFFF}")
+
+    async def shutdown(self) -> None:
+        await self.ms.shutdown()
+
+    def io_ctx(self, pool_name: str) -> "IoCtx":
+        pool = self.osdmap.pool_by_name(pool_name)
+        if pool is None:
+            raise ObjecterError(f"no pool {pool_name!r}")
+        return IoCtx(self, pool.pool_id)
+
+
+class IoCtx:
+    """Per-pool I/O context (reference librados::IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_id: int) -> None:
+        self.client = client
+        self.pool_id = pool_id
+
+    async def _submit(self, oid: str, ops: "List[dict]",
+                      data: bytes = b"") -> "Tuple[List[dict], bytes]":
+        return await self.client.objecter.op_submit(
+            self.pool_id, oid, ops, data)
+
+    # --- writes ---------------------------------------------------------------
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        await self._submit(oid, [{"op": "write_full", "dlen": len(data)}],
+                           bytes(data))
+
+    async def write(self, oid: str, data: bytes, off: int) -> None:
+        await self._submit(oid, [{"op": "write", "off": off,
+                                  "dlen": len(data)}], bytes(data))
+
+    async def append(self, oid: str, data: bytes) -> None:
+        await self._submit(oid, [{"op": "append", "dlen": len(data)}],
+                           bytes(data))
+
+    async def truncate(self, oid: str, size: int) -> None:
+        await self._submit(oid, [{"op": "truncate", "off": size}])
+
+    async def remove(self, oid: str) -> None:
+        await self._submit(oid, [{"op": "delete"}])
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        await self._submit(oid, [{"op": "setxattr", "name": name,
+                                  "dlen": len(value)}], bytes(value))
+
+    # --- reads ----------------------------------------------------------------
+
+    async def read(self, oid: str, length: int = 0, off: int = 0) -> bytes:
+        outs, blob = await self._submit(
+            oid, [{"op": "read", "off": off, "len": length}])
+        lens = [o["dlen"] for o in outs if o.get("op") == "read"]
+        return b"".join(unpack_buffers(lens, blob))
+
+    async def stat(self, oid: str) -> dict:
+        outs, _ = await self._submit(oid, [{"op": "stat"}])
+        return next(o for o in outs if o.get("op") == "stat")
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        outs, blob = await self._submit(
+            oid, [{"op": "getxattr", "name": name}])
+        lens = [o["dlen"] for o in outs if o.get("op") == "getxattr"]
+        return unpack_buffers(lens, blob)[0]

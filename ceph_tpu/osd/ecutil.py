@@ -220,6 +220,16 @@ class HashInfo:
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
+    def invalidate(self) -> None:
+        """Overwrites break the cumulative chain (the reference keeps no
+        hinfo on ec_overwrites pools and relies on store checksums);
+        an invalid hinfo skips read-side verification until a scrub or
+        recovery rebuilds it."""
+        self.total_chunk_size = -1
+
+    def valid(self) -> bool:
+        return self.total_chunk_size >= 0
+
     def truncate(self, new_size: int) -> None:
         """Hashes cannot be rolled back: truncation resets them (the
         reference keeps projected sizes and re-hashes; a reset forces a

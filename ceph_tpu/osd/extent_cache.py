@@ -32,20 +32,25 @@ class _ObjectCache:
 
     def insert(self, off: int, data: np.ndarray, pin: bool) -> None:
         """Insert/overwrite [off, off+len(data)); newer bytes win
-        (the pinned write is the authoritative in-flight content)."""
+        (the pinned write is the authoritative in-flight content).
+        Pins of replaced extents carry over: each in-flight op holds one
+        pin, and the extent must survive until every such op releases
+        (the reference pins per-op via pin_state)."""
         data = np.asarray(data, dtype=np.uint8).reshape(-1)
         length = data.size
         if not length:
             return
+        carried = 0
         for s in self._overlapping(off, length):
             d, pins = self.extents.pop(s)
+            carried = max(carried, pins)
             # keep non-overlapped prefix/suffix of the old extent
             if s < off:
                 self.extents[s] = [d[: off - s], pins]
             if s + len(d) > off + length:
                 tail_start = off + length
                 self.extents[tail_start] = [d[tail_start - s:], pins]
-        self.extents[off] = [data, 1 if pin else 0]
+        self.extents[off] = [data, carried + (1 if pin else 0)]
 
     def read(self, off: int, length: int) -> "Optional[np.ndarray]":
         """The bytes iff fully present, else None."""

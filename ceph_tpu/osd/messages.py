@@ -1,0 +1,133 @@
+"""OSD wire messages — the src/messages/ analogs for the EC data path.
+
+Reference: MOSDECSubOpWrite/Read{,Reply}.h wrap ECSubWrite/ECSubRead
+(src/osd/ECMsgTypes.h:23-127); client I/O rides MOSDOp/MOSDOpReply;
+recovery pushes ride MOSDPGPush/MOSDPGPushReply.  Every struct is a
+versioned encodable (SURVEY.md §2.3) — here a typed Message subclass
+whose ``fields`` dict is the encode/decode payload and whose bulk bytes
+ride the zero-copy ``data`` segment.
+
+Bulk-buffer convention: a message carries at most a flat byte blob in
+``data``; multi-buffer payloads (per-shard reads) are packed by
+(offset, length) tables in the fields so buffers never round-trip
+through JSON.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..msg.message import Message, register_message
+
+
+def pack_buffers(bufs: "List[bytes]") -> "Tuple[List[int], bytes]":
+    """Pack buffers into one data segment; returns (lengths, blob)."""
+    return [len(b) for b in bufs], b"".join(bytes(b) for b in bufs)
+
+
+def unpack_buffers(lengths: "List[int]", blob: bytes) -> "List[bytes]":
+    out, off = [], 0
+    for n in lengths:
+        out.append(blob[off:off + n])
+        off += n
+    return out
+
+
+# --- client <-> primary -------------------------------------------------------
+
+
+@register_message
+class MOSDOp(Message):
+    """Client op (reference src/messages/MOSDOp.h).
+
+    fields: tid, pool, pg, oid, ops=[{op, off, len, name?, dlen?}...],
+    map_epoch.  Bulk write payloads concatenated in ``data`` in op order
+    (each write op's dlen says how much it consumes).
+    """
+    TYPE = "osd_op"
+
+
+@register_message
+class MOSDOpReply(Message):
+    """fields: tid, result (errno-style, 0=ok), outs=[{...}] per-op output
+    metadata; read payloads concatenated in ``data``."""
+    TYPE = "osd_op_reply"
+
+
+# --- EC sub ops (primary <-> shard) ------------------------------------------
+
+
+@register_message
+class MECSubOpWrite(Message):
+    """Reference MOSDECSubOpWrite.h + ECSubWrite (ECMsgTypes.h:23-38).
+
+    fields: pgid, shard (target), from_osd, tid, at_version=[epoch,v],
+    trim_to, roll_forward_to, log_entries=[...], txn (encoded shard
+    transaction dict with write payloads hex-free: offsets into data).
+    """
+    TYPE = "ec_sub_write"
+
+
+@register_message
+class MECSubOpWriteReply(Message):
+    """fields: pgid, shard, from_osd, tid, committed, applied."""
+    TYPE = "ec_sub_write_reply"
+
+
+@register_message
+class MECSubOpRead(Message):
+    """Reference MOSDECSubOpRead.h + ECSubRead (ECMsgTypes.h:105-116).
+
+    fields: pgid, shard, from_osd, tid,
+    to_read = [{oid, extents: [[off,len]...], subchunks: [[sub_off,sub_ct]]}],
+    attrs_to_read = [oid...].
+    """
+    TYPE = "ec_sub_read"
+
+
+@register_message
+class MECSubOpReadReply(Message):
+    """fields: pgid, shard, from_osd, tid,
+    buffers_read = [{oid, extents: [[off, dlen]...]}]  (dlen indexes data),
+    attrs_read = {oid: {name: hex}}, errors = {oid: errno}."""
+    TYPE = "ec_sub_read_reply"
+
+
+# --- recovery (primary -> peer shard) ----------------------------------------
+
+
+@register_message
+class MOSDPGPush(Message):
+    """Reference MOSDPGPush.h: push reconstructed shard content to a peer.
+
+    fields: pgid, shard, from_osd, tid, oid, version, whole (bool),
+    off, attrs={name: hex}; shard bytes in ``data``."""
+    TYPE = "pg_push"
+
+
+@register_message
+class MOSDPGPushReply(Message):
+    """fields: pgid, shard, from_osd, tid, oid, result."""
+    TYPE = "pg_push_reply"
+
+
+# --- maps / control ----------------------------------------------------------
+
+
+@register_message
+class MOSDMapMsg(Message):
+    """Map epoch broadcast (reference MOSDMap.h); full map json in data."""
+    TYPE = "osd_map"
+
+
+@register_message
+class MOSDPing(Message):
+    """Heartbeat (reference MOSDPing.h). fields: from_osd, epoch, stamp."""
+    TYPE = "osd_ping"
+
+
+@register_message
+class MOSDPingReply(Message):
+    TYPE = "osd_ping_reply"

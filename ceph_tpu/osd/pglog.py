@@ -1,0 +1,152 @@
+"""PG log — bounded per-PG op journal with EC rollback support.
+
+Reference: src/osd/PGLog.{h,cc} (1725 LoC) and the EC rollback design in
+doc/dev/osd_internals/erasure_coding/ecbackend.rst:1-26 — EC log entries
+carry enough local undo info (old size for appends, old attr values) that
+a shard can locally revert a write that never became globally durable.
+Objects written by an as-yet-unrolled-forward entry live at a bumped
+generation; ``roll_forward_to`` advances the point of no return and
+``can_rollback_to`` bounds divergence repair (plumbed through every
+ECSubWrite — reference ECMsgTypes.h:31-32).
+
+Versions are eversion_t analogs: (epoch, v) tuples ordered
+lexicographically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+Version = Tuple[int, int]          # (epoch, v)
+ZERO: Version = (0, 0)
+
+
+def ver(x) -> Version:
+    return (int(x[0]), int(x[1]))
+
+
+@dataclass
+class LogEntry:
+    """One mutation (reference pg_log_entry_t)."""
+    version: Version
+    oid: str
+    op: str                         # "modify" | "delete" | "error"
+    prior_version: Version = ZERO
+    # EC local-undo payload (reference ECTransaction rollback info):
+    #  - "append_from": size before an append -> rollback = truncate
+    #  - "old_attrs": {name: bytes|None} before attr writes -> restore
+    #  - "removed": object content snapshot is at generation `gen`
+    rollback: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        rb = dict(self.rollback)
+        if "old_attrs" in rb:
+            rb = dict(rb)
+            rb["old_attrs"] = {
+                k: (v.hex() if isinstance(v, (bytes, bytearray)) else v)
+                for k, v in rb["old_attrs"].items()}
+        return {"version": list(self.version), "oid": self.oid,
+                "op": self.op, "prior": list(self.prior_version),
+                "rollback": rb}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        rb = dict(d.get("rollback", {}))
+        if "old_attrs" in rb:
+            rb["old_attrs"] = {
+                k: (bytes.fromhex(v) if isinstance(v, str) else v)
+                for k, v in rb["old_attrs"].items()}
+        return cls(ver(d["version"]), d["oid"], d["op"],
+                   ver(d.get("prior", ZERO)), rb)
+
+
+class PGLog:
+    """Bounded journal enabling delta resync + rollback.
+
+    Invariants (reference PGLog.h): entries sorted by version;
+    ``tail < entries <= head``; ``can_rollback_to`` >= tail marks the
+    newest version every shard is known to have durably applied — entries
+    above it may still be rolled back during peering.
+    """
+
+    def __init__(self) -> None:
+        self.entries: "List[LogEntry]" = []
+        self.tail: Version = ZERO
+        self.head: Version = ZERO
+        self.can_rollback_to: Version = ZERO
+        self.rollback_info_trimmed_to: Version = ZERO
+
+    # --- append / trim -------------------------------------------------------
+
+    def add(self, entry: LogEntry) -> None:
+        if entry.version <= self.head:
+            raise ValueError(
+                f"log add: {entry.version} <= head {self.head}")
+        self.entries.append(entry)
+        self.head = entry.version
+
+    def roll_forward_to(self, v: Version) -> "List[LogEntry]":
+        """Advance the no-rollback point; returns entries whose rollback
+        state (old-generation objects) can now be reaped."""
+        reaped = [e for e in self.entries
+                  if self.can_rollback_to < e.version <= v]
+        if v > self.can_rollback_to:
+            self.can_rollback_to = v
+        return reaped
+
+    def trim_to(self, v: Version) -> "List[LogEntry]":
+        """Drop entries <= v (reference PGLog::trim); v must not pass
+        can_rollback_to."""
+        v = min(v, self.can_rollback_to)
+        dropped = [e for e in self.entries if e.version <= v]
+        self.entries = [e for e in self.entries if e.version > v]
+        if v > self.tail:
+            self.tail = v
+        return dropped
+
+    # --- divergence (peering) ------------------------------------------------
+
+    def entries_after(self, v: Version) -> "List[LogEntry]":
+        return [e for e in self.entries if e.version > v]
+
+    def rewind_divergent(self, to: Version) -> "List[LogEntry]":
+        """Drop entries newer than ``to`` (authoritative head); returns the
+        divergent entries (newest first) for the caller to roll back
+        against the store.  Fails if divergence passes can_rollback_to —
+        that demands backfill instead (reference PGLog::rewind_divergent_log).
+        """
+        if to < self.can_rollback_to:
+            raise ValueError(
+                f"cannot rewind to {to}: rollback bound "
+                f"{self.can_rollback_to}")
+        div = [e for e in self.entries if e.version > to]
+        self.entries = [e for e in self.entries if e.version <= to]
+        self.head = to
+        return list(reversed(div))
+
+    # --- missing-set computation ---------------------------------------------
+
+    def missing_from(self, other_head: Version) -> "Dict[str, Version]":
+        """Objects this log mutated after ``other_head`` — what a peer at
+        that head is missing (reference PGLog::merge_log missing calc)."""
+        out: "Dict[str, Version]" = {}
+        for e in self.entries_after(other_head):
+            out[e.oid] = e.version
+        return out
+
+    # --- encode --------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"tail": list(self.tail), "head": list(self.head),
+                "crt": list(self.can_rollback_to),
+                "entries": [e.to_dict() for e in self.entries]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGLog":
+        log = cls()
+        log.tail = ver(d.get("tail", ZERO))
+        log.head = ver(d.get("head", ZERO))
+        log.can_rollback_to = ver(d.get("crt", ZERO))
+        log.entries = [LogEntry.from_dict(e) for e in d.get("entries", [])]
+        return log

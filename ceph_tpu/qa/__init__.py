@@ -1,0 +1,3 @@
+from .cluster import MiniCluster
+
+__all__ = ["MiniCluster"]

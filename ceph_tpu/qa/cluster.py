@@ -1,0 +1,94 @@
+"""MiniCluster — a whole cluster in one process.
+
+Reference: src/vstart.sh (dev cluster on localhost) and
+qa/standalone/ceph-helpers.sh (throwaway mon+osd clusters for bash
+integration tests).  Uses the ``async+local`` messenger transport so N
+OSDs + clients share one asyncio loop; swap ms_type to ``async+tcp`` in
+the config for real-socket runs (the helpers' multi-process analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ..common.config import Config
+from ..client.rados import RadosClient
+from ..osd.daemon import OSDDaemon
+from ..osd.osdmap import OSDMap, POOL_ERASURE
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 6,
+                 config: "Optional[Config]" = None) -> None:
+        self.config = config or Config()
+        if config is None or self.config.origin("ms_type") == "default":
+            # default to the in-process transport; an explicit ms_type in
+            # the caller's config (e.g. async+tcp for real sockets) wins
+            self.config.set("ms_type", "async+local")
+        self.osdmap = OSDMap()
+        self.osdmap.crush.add_bucket("default", "root")
+        self.osds: "Dict[int, OSDDaemon]" = {}
+        self.clients: "List[RadosClient]" = []
+        for i in range(n_osds):
+            self.osdmap.add_osd(i)
+            self.osdmap.mark_up(i, f"local:osd.{i}")
+        self.osdmap.bump()
+        for i in range(n_osds):
+            self.osds[i] = OSDDaemon(i, self.osdmap, config=self.config)
+
+    # --- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        for osd in self.osds.values():
+            await osd.init()
+
+    async def stop(self) -> None:
+        for client in self.clients:
+            await client.shutdown()
+        for osd in self.osds.values():
+            await osd.shutdown()
+
+    async def __aenter__(self) -> "MiniCluster":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # --- pools / clients ------------------------------------------------------
+
+    def create_ec_pool(self, name: str, profile: "Optional[dict]" = None,
+                       pg_num: int = 8, stripe_unit: int = 4096):
+        profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
+        prof_name = f"{name}-profile"
+        self.osdmap.ec_profiles[prof_name] = profile
+        k, m = int(profile.get("k", 4)), int(profile.get("m", 2))
+        pool = self.osdmap.create_pool(
+            name, type=POOL_ERASURE, size=k + m, min_size=k,
+            pg_num=pg_num, ec_profile=prof_name, stripe_unit=stripe_unit)
+        self.osdmap.bump()
+        return pool
+
+    async def client(self) -> RadosClient:
+        c = RadosClient(self.osdmap, name=f"client.{len(self.clients)}",
+                        config=self.config)
+        await c.connect(f"local:client.{len(self.clients)}")
+        self.clients.append(c)
+        return c
+
+    # --- failure injection (reference qa thrasher primitives) ----------------
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """qa/tasks/ceph_manager.py Thrasher.kill_osd analog."""
+        await self.osds[osd_id].shutdown()
+        self.osdmap.mark_down(osd_id)
+        self.osdmap.bump()
+
+    async def revive_osd(self, osd_id: int) -> None:
+        osd = self.osds[osd_id] = OSDDaemon(
+            osd_id, self.osdmap, store=self.osds[osd_id].store,
+            config=self.config)
+        self.osdmap.mark_up(osd_id, f"local:osd.{osd_id}")
+        self.osdmap.bump()
+        await osd.init()
