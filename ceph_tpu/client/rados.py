@@ -18,14 +18,25 @@ from .objecter import Objecter, ObjecterError
 
 
 class RadosClient:
-    def __init__(self, osdmap: OSDMap, name: str = "client",
-                 config: "Optional[Config]" = None) -> None:
-        self.osdmap = osdmap
+    def __init__(self, osdmap: "Optional[OSDMap]" = None,
+                 name: str = "client",
+                 config: "Optional[Config]" = None,
+                 mon_addrs: "Optional[Dict[int, str]]" = None) -> None:
         self.ms = Messenger.create(name, config or Config())
-        self.objecter = Objecter(self.ms, osdmap)
+        from ..mon.client import attach_monc
+        self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
+        self.objecter = Objecter(self.ms, self.osdmap)
 
     async def connect(self, addr: str = "") -> None:
         await self.ms.bind(addr or f"client:{id(self) & 0xFFFF}")
+        if self.monc is not None:
+            await self.monc.subscribe_osdmap()
+            await self.monc.wait_for_map()
+
+    async def mon_command(self, cmd: dict) -> dict:
+        if self.monc is None:
+            raise ObjecterError("no mon connection")
+        return await self.monc.command(cmd)
 
     async def shutdown(self) -> None:
         await self.ms.shutdown()

@@ -1,0 +1,7 @@
+from .client import MonClient, MonClientError
+from .elector import Elector
+from .monitor import MonDaemon
+from .paxos import Paxos, PaxosError
+
+__all__ = ["MonClient", "MonClientError", "Elector", "MonDaemon",
+           "Paxos", "PaxosError"]

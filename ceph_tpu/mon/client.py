@@ -1,0 +1,184 @@
+"""MonClient — how daemons and clients talk to the mon quorum.
+
+Reference: src/mon/MonClient.{h,cc}: picks a mon, authenticates,
+forwards commands (following leader redirects), subscribes to map
+streams, and sends periodic beacons for its daemon.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Dict, List, Optional
+
+from ..common.config import Config
+from ..common.log import dout
+from ..msg.message import Message
+from ..msg.messenger import Dispatcher, Messenger
+from ..osd.osdmap import OSDMap
+from .messages import (MMonCommand, MMonCommandReply, MMonSubscribe,
+                       MOSDBeacon, MOSDBoot, MOSDFailure)
+
+EAGAIN = 11
+
+
+class MonClientError(Exception):
+    pass
+
+
+def attach_monc(ms: Messenger, mon_addrs: "Optional[Dict[int, str]]",
+                osdmap: "Optional[OSDMap]"):
+    """Shared daemon/client bootstrap: returns (monc_or_None, osdmap).
+    With mons, the MonClient owns the (subscription-updated) map;
+    without, the caller's map (or a fresh one) is used directly."""
+    if mon_addrs:
+        monc = MonClient(ms, mon_addrs, osdmap=osdmap)
+        return monc, monc.osdmap
+    return None, osdmap if osdmap is not None else OSDMap()
+
+
+class MonClient(Dispatcher):
+    """Shares the owner's messenger (the reference hunts a mon over the
+    daemon's client messenger the same way)."""
+
+    def __init__(self, ms: Messenger, mon_addrs: "Dict[int, str]",
+                 osdmap: "Optional[OSDMap]" = None) -> None:
+        self.ms = ms
+        self.mon_addrs = dict(mon_addrs)
+        self.osdmap = osdmap if osdmap is not None else OSDMap()
+        self.ms.add_dispatcher(self)
+        self.leader_guess = min(self.mon_addrs) if self.mon_addrs else 0
+        self._next_tid = 0
+        self._inflight: "Dict[int, asyncio.Future]" = {}
+        self.map_callbacks: "List[Callable[[OSDMap], None]]" = []
+        self._map_event = asyncio.Event()
+
+    # --- commands -------------------------------------------------------------
+
+    async def command(self, cmd: dict, timeout: float = 5.0,
+                      attempts: int = 8) -> dict:
+        """Send a command, following leader redirects and retrying
+        through elections (reference MonClient::start_mon_command +
+        forwarding; -EAGAIN means 'not leader / election in progress',
+        which is transient by construction)."""
+        last_err: "Optional[str]" = None
+        for attempt in range(attempts):
+            # leader guess first, then the rest — rebuilt every attempt
+            # so a dead leader doesn't pin us (hunt like the reference)
+            ranks = [self.leader_guess] + [
+                r for r in sorted(self.mon_addrs)
+                if r != self.leader_guess]
+            redirected = False
+            for rank in ranks:
+                self._next_tid += 1
+                tid = self._next_tid
+                fut = asyncio.get_event_loop().create_future()
+                self._inflight[tid] = fut
+                try:
+                    conn = self.ms.get_connection(self.mon_addrs[rank])
+                    await conn.send_message(MMonCommand(
+                        {"tid": tid, "cmd": cmd}))
+                    reply = await asyncio.wait_for(fut, timeout)
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError) as e:
+                    last_err = f"mon.{rank}: {e}"
+                    continue
+                finally:
+                    self._inflight.pop(tid, None)
+                result = int(reply["result"])
+                out = dict(reply.get("out", {}))
+                if result == -EAGAIN:
+                    # not leader or mid-election: follow the hint if any,
+                    # else keep hunting/retrying
+                    last_err = f"mon.{rank}: EAGAIN"
+                    if "leader" in out:
+                        self.leader_guess = int(out["leader"])
+                        redirected = True
+                        break
+                    continue
+                if result < 0:
+                    raise MonClientError(
+                        f"{cmd.get('prefix')}: {out.get('error', result)}")
+                self.leader_guess = rank
+                return out
+            if not redirected:
+                await asyncio.sleep(0.05 * (attempt + 1))
+        raise MonClientError(f"command failed: {last_err}")
+
+    # --- subscriptions --------------------------------------------------------
+
+    async def subscribe_osdmap(self) -> None:
+        sent = False
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MMonSubscribe(
+                    {"what": ["osdmap"], "addr": self.ms.listen_addr}))
+                sent = True
+            except (ConnectionError, OSError):
+                continue
+        if not sent:
+            raise MonClientError("no mon reachable for subscribe")
+
+    async def wait_for_map(self, min_epoch: int = 1,
+                           timeout: float = 5.0) -> OSDMap:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.osdmap.epoch < min_epoch:
+            remain = deadline - asyncio.get_event_loop().time()
+            if remain <= 0:
+                raise MonClientError(
+                    f"no osdmap epoch >= {min_epoch} "
+                    f"(have {self.osdmap.epoch})")
+            self._map_event.clear()
+            try:
+                await asyncio.wait_for(self._map_event.wait(), remain)
+            except asyncio.TimeoutError:
+                pass
+        return self.osdmap
+
+    # --- daemon duties --------------------------------------------------------
+
+    async def send_boot(self, osd_id: int, addr: str) -> None:
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MOSDBoot(
+                    {"osd_id": osd_id, "addr": addr}))
+            except (ConnectionError, OSError):
+                continue
+
+    async def send_beacon(self, osd_id: int) -> None:
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MOSDBeacon(
+                    {"osd_id": osd_id, "epoch": self.osdmap.epoch}))
+            except (ConnectionError, OSError):
+                continue
+
+    async def report_failure(self, reporter: int, failed: int) -> None:
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MOSDFailure(
+                    {"reporter": reporter, "failed_osd": failed}))
+            except (ConnectionError, OSError):
+                continue
+
+    # --- dispatch -------------------------------------------------------------
+
+    async def ms_dispatch(self, conn, msg: Message) -> bool:
+        if msg.TYPE == "mon_command_reply":
+            fut = self._inflight.get(int(msg["tid"]))
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return True
+        if msg.TYPE == "osd_map":
+            incoming = json.loads(msg.data.decode())
+            if int(incoming.get("epoch", 0)) > self.osdmap.epoch:
+                self.osdmap.load_dict(incoming)
+                self._map_event.set()
+                for cb in self.map_callbacks:
+                    cb(self.osdmap)
+            return True
+        return False

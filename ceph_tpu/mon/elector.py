@@ -1,0 +1,112 @@
+"""Elector — mon leader election.
+
+Reference: src/mon/Elector.{h,cc}: rank-based; the lowest rank that can
+reach a majority wins.  A mon proposes itself (bumping the election
+epoch); peers ack proposals from ranks lower than any they've acked this
+epoch, or counter-propose if they outrank the proposer.  After
+``election_timeout`` the proposer declares victory if it holds a
+majority of acks and broadcasts the quorum.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, List, Optional, Set
+
+
+class Elector:
+    def __init__(self, rank: int, ranks: "List[int]",
+                 send: "Callable[[int, str, dict], Awaitable[None]]",
+                 on_win: "Callable[[List[int]], Awaitable[None]]",
+                 on_lose: "Callable[[int, List[int]], None]",
+                 timeout: float = 0.3) -> None:
+        self.rank = rank
+        self.ranks = sorted(ranks)
+        self.send = send
+        self.on_win = on_win
+        self.on_lose = on_lose
+        self.timeout = timeout
+        self.epoch = 0
+        self.electing = False
+        self.acked: "Optional[int]" = None     # rank we acked this epoch
+        self.acks: "Set[int]" = set()
+        self.leader: "Optional[int]" = None
+        self.quorum: "List[int]" = []
+        self._task: "Optional[asyncio.Task]" = None
+
+    async def start_election(self) -> None:
+        """reference Elector::start."""
+        self.epoch += 1
+        self.electing = True
+        self.leader = None
+        self.acked = self.rank
+        self.acks = {self.rank}
+        for peer in self.ranks:
+            if peer != self.rank:
+                await self.send(peer, "propose", {"epoch": self.epoch})
+        if len(self.ranks) == 1:
+            await self._declare_victory()
+            return
+        if self._task:
+            self._task.cancel()
+        self._task = asyncio.ensure_future(self._expire())
+
+    async def _expire(self) -> None:
+        # rank-staggered timeout: the lowest live rank expires (and
+        # declares victory) first, so higher ranks usually see the
+        # victory before their own timer fires
+        await asyncio.sleep(self.timeout * (1 + 0.5 * self.rank))
+        if not self.electing:
+            return
+        if len(self.acks) > len(self.ranks) // 2 and \
+                self.acked == self.rank:
+            await self._declare_victory()
+        else:
+            # lost or no quorum: either a victory message will arrive,
+            # or we retry (peers may have been down)
+            await self.start_election()
+
+    async def _declare_victory(self) -> None:
+        self.electing = False
+        self.leader = self.rank
+        self.quorum = sorted(self.acks)
+        for peer in self.quorum:
+            if peer != self.rank:
+                await self.send(peer, "victory", {
+                    "epoch": self.epoch, "quorum": self.quorum})
+        await self.on_win(self.quorum)
+
+    async def handle(self, frm: int, op: str, fields: dict) -> None:
+        epoch = int(fields.get("epoch", 0))
+        if op == "propose":
+            if epoch < self.epoch:
+                return
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self.acked = None
+                self.electing = True
+            if frm < self.rank and (self.acked is None
+                                    or frm <= self.acked):
+                # defer to the lower rank (reference Elector::handle_propose)
+                self.acked = frm
+                await self.send(frm, "ack", {"epoch": self.epoch})
+            elif self.rank < frm and not self.electing:
+                # we outrank the proposer: counter-propose
+                await self.start_election()
+        elif op == "ack":
+            if epoch == self.epoch and self.electing:
+                self.acks.add(frm)
+                if len(self.acks) > len(self.ranks) // 2 and \
+                        self.acked == self.rank and \
+                        self.acks >= set(self.ranks):
+                    # everyone answered: no need to wait out the timer
+                    await self._declare_victory()
+        elif op == "victory":
+            if epoch >= self.epoch:
+                self.epoch = epoch
+                self.electing = False
+                self.leader = frm
+                self.quorum = [int(x) for x in fields["quorum"]]
+                if self._task:
+                    self._task.cancel()
+                self.on_lose(frm, self.quorum)

@@ -1,0 +1,53 @@
+"""Monitor wire messages (reference src/messages/MMon*.h)."""
+
+from __future__ import annotations
+
+from ..msg.message import Message, register_message
+
+
+@register_message
+class MMonElection(Message):
+    """fields: op (propose|ack|victory), rank, epoch, quorum?"""
+    TYPE = "mon_election"
+
+
+@register_message
+class MMonPaxosMsg(Message):
+    """fields: op (collect|last|begin|accept|commit), rank, + phase fields"""
+    TYPE = "mon_paxos"
+
+
+@register_message
+class MMonCommand(Message):
+    """fields: tid, cmd (dict) — the 'ceph ...' JSON command RPC."""
+    TYPE = "mon_command"
+
+
+@register_message
+class MMonCommandReply(Message):
+    """fields: tid, result, out (dict)."""
+    TYPE = "mon_command_reply"
+
+
+@register_message
+class MMonSubscribe(Message):
+    """fields: what (['osdmap', ...]), addr (subscriber's listen addr)."""
+    TYPE = "mon_subscribe"
+
+
+@register_message
+class MOSDBoot(Message):
+    """fields: osd_id, addr (reference MOSDBoot.h)."""
+    TYPE = "osd_boot"
+
+
+@register_message
+class MOSDBeacon(Message):
+    """fields: osd_id, epoch (reference MOSDBeacon.h)."""
+    TYPE = "osd_beacon"
+
+
+@register_message
+class MOSDFailure(Message):
+    """fields: reporter, failed_osd, since (reference MOSDFailure.h)."""
+    TYPE = "osd_failure"

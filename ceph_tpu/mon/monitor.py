@@ -1,0 +1,396 @@
+"""Monitor daemon — the cluster control plane.
+
+Reference: src/mon (54.6k LoC).  A mon quorum runs leader-based Paxos
+(paxos.py); *PaxosServices* (OSDMonitor, ConfigMonitor — reference
+src/mon/OSDMonitor.cc, ConfigMonitor.cc) turn validated commands into
+transactions committed through the log; every commit produces a new map
+epoch broadcast to subscribers (reference Monitor::handle_subscribe /
+OSDMonitor::send_incremental).
+
+Implemented commands (reference OSDMonitor.cc:10713 erasure-code-profile
+handlers, :6610 pool ops; ConfigMonitor command surface):
+
+    osd erasure-code-profile set|get|ls|rm
+    osd pool create | osd pool ls
+    osd down | osd out | osd in
+    osd dump | status
+    config set | config get
+
+Failure detection (reference OSDMonitor::handle_osd_failure + beacons):
+OSDs send periodic beacons; the leader marks an OSD down when beacons
+stop past the grace, or when enough peers report it failed
+(mon_osd_min_down_reporters).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..common.config import Config
+from ..common.log import dout
+from ..ec.registry import factory_from_profile
+from ..msg.message import Message
+from ..msg.messenger import Dispatcher, Messenger
+from ..osd.messages import MOSDMapMsg
+from ..osd.osdmap import OSDMap, POOL_ERASURE, POOL_REPLICATED
+from .elector import Elector
+from .messages import (MMonCommand, MMonCommandReply, MMonElection,
+                       MMonPaxosMsg, MMonSubscribe, MOSDBeacon, MOSDBoot,
+                       MOSDFailure)
+from .paxos import Paxos, PaxosError, PaxosTransport
+
+EAGAIN = 11
+
+
+class _MonTransport(PaxosTransport):
+    def __init__(self, mon: "MonDaemon") -> None:
+        self.mon = mon
+
+    async def send(self, rank: int, op: str, fields: dict) -> None:
+        msg = MMonPaxosMsg(dict(fields, op=op, rank=self.mon.rank))
+        await self.mon._send_mon(rank, msg)
+
+
+class MonDaemon(Dispatcher):
+    def __init__(self, rank: int, mon_addrs: "Dict[int, str]",
+                 config: "Optional[Config]" = None) -> None:
+        self.rank = rank
+        self.mon_addrs = dict(mon_addrs)
+        self.config = config or Config()
+        self.ms = Messenger.create(f"mon.{rank}", self.config)
+        self.ms.add_dispatcher(self)
+        self.store: "Dict[str, bytes]" = {}
+        self.paxos = Paxos(rank, _MonTransport(self), self.store,
+                           self._on_commit)
+        self.elector = Elector(
+            rank, sorted(mon_addrs), self._send_election,
+            self._on_win, self._on_lose,
+            timeout=float(self.config.get("mon_lease")) / 5)
+        # service state (rebuilt deterministically from the paxos log)
+        self.osdmap = OSDMap()
+        self.osdmap.crush.add_bucket("default", "root")
+        self.central_config: "Dict[str, str]" = {}
+        # volatile control state
+        self.subs: "Set[str]" = set()            # subscriber addresses
+        self.last_beacon: "Dict[int, float]" = {}
+        self.failure_reports: "Dict[int, Set[int]]" = {}
+        self._tick_task: "Optional[asyncio.Task]" = None
+        self._cmd_lock = asyncio.Lock()
+        self._last_lease = time.monotonic()
+        self.running = False
+
+    # --- lifecycle ------------------------------------------------------------
+
+    async def init(self) -> None:
+        await self.ms.bind(self.mon_addrs[self.rank])
+        self.running = True
+        self._tick_task = asyncio.ensure_future(self._tick_loop())
+        await self.elector.start_election()
+
+    async def shutdown(self) -> None:
+        self.running = False
+        if self._tick_task:
+            self._tick_task.cancel()
+        await self.ms.shutdown()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.leader == self.rank and not self.elector.electing
+
+    # --- wire -----------------------------------------------------------------
+
+    async def _send_mon(self, rank: int, msg: Message) -> None:
+        if rank == self.rank:
+            await self.ms._deliver(None, msg)
+            return
+        try:
+            conn = self.ms.get_connection(self.mon_addrs[rank])
+            await conn.send_message(msg)
+        except (ConnectionError, OSError) as e:
+            dout("mon", 5, f"mon.{self.rank} -> mon.{rank} failed: {e}")
+
+    async def _send_election(self, rank: int, op: str,
+                             fields: dict) -> None:
+        await self._send_mon(rank, MMonElection(
+            dict(fields, op=op, rank=self.rank)))
+
+    # --- election callbacks ---------------------------------------------------
+
+    async def _on_win(self, quorum: "List[int]") -> None:
+        dout("mon", 1, f"mon.{self.rank} leader of {quorum} "
+                       f"(epoch {self.elector.epoch})")
+        try:
+            await self.paxos.leader_init(quorum)
+        except PaxosError as e:
+            dout("mon", 1, f"collect failed: {e}; re-electing")
+            await self.elector.start_election()
+
+    def _on_lose(self, leader: int, quorum: "List[int]") -> None:
+        dout("mon", 1, f"mon.{self.rank} peon; leader mon.{leader}")
+        self.paxos.peon_init(quorum, leader)
+
+    # --- committed-state machine ---------------------------------------------
+
+    def _on_commit(self, v: int, value: bytes) -> None:
+        """Apply one committed transaction (deterministic on every mon)."""
+        txn = json.loads(value.decode())
+        if txn.get("service") == "osdmap":
+            for op in txn["ops"]:
+                self._apply_osd_op(op)
+            self.osdmap.epoch = v
+            if self.is_leader:
+                # only the leader publishes (subscribers register with
+                # every mon, so a new leader already knows them)
+                asyncio.ensure_future(self._broadcast_map())
+        elif txn.get("service") == "config":
+            for op in txn["ops"]:
+                if op["op"] == "set":
+                    self.central_config[op["name"]] = op["value"]
+                elif op["op"] == "rm":
+                    self.central_config.pop(op["name"], None)
+
+    def _apply_osd_op(self, op: dict) -> None:
+        m = self.osdmap
+        kind = op["op"]
+        if kind == "add_osd":
+            if int(op["osd"]) not in m.osds:
+                m.add_osd(int(op["osd"]), weight=float(op.get("weight", 1.0)))
+        elif kind == "mark_up":
+            m.mark_up(int(op["osd"]), op["addr"])
+        elif kind == "mark_down":
+            if m.is_up(int(op["osd"])):
+                m.mark_down(int(op["osd"]))
+        elif kind == "mark_out":
+            m.mark_out(int(op["osd"]))
+        elif kind == "mark_in":
+            m.mark_in(int(op["osd"]))
+        elif kind == "set_ec_profile":
+            m.ec_profiles[op["name"]] = dict(op["profile"])
+        elif kind == "rm_ec_profile":
+            m.ec_profiles.pop(op["name"], None)
+        elif kind == "create_pool":
+            m.create_pool(op["name"], **op.get("kwargs", {}))
+
+    async def _broadcast_map(self) -> None:
+        payload = json.dumps(self.osdmap.to_dict()).encode()
+        for addr in list(self.subs):
+            try:
+                conn = self.ms.get_connection(addr)
+                await conn.send_message(MOSDMapMsg(
+                    {"epoch": self.osdmap.epoch}, payload))
+            except (ConnectionError, OSError):
+                self.subs.discard(addr)
+
+    # --- proposals ------------------------------------------------------------
+
+    async def _propose_osd_ops(self, ops: "List[dict]") -> int:
+        value = json.dumps({"service": "osdmap", "ops": ops}).encode()
+        v = await self.paxos.propose(value)
+        # publish before returning so a command reply (e.g. pool create)
+        # never races its own map broadcast to the OSDs
+        await self._broadcast_map()
+        return v
+
+    # --- dispatch -------------------------------------------------------------
+
+    async def ms_dispatch(self, conn, msg: Message) -> bool:
+        t = msg.TYPE
+        if t == "mon_election":
+            if msg["op"] == "lease":
+                # leader liveness (reference Paxos::lease_start/ack)
+                if int(msg["rank"]) == self.elector.leader:
+                    self._last_lease = time.monotonic()
+            else:
+                await self.elector.handle(int(msg["rank"]), msg["op"],
+                                          msg.fields)
+        elif t == "mon_paxos":
+            await self.paxos.handle(int(msg["rank"]), msg["op"],
+                                    msg.fields)
+        elif t == "mon_command":
+            await self._handle_command(conn, msg)
+        elif t == "mon_subscribe":
+            self.subs.add(msg["addr"])
+            payload = json.dumps(self.osdmap.to_dict()).encode()
+            await conn.send_message(MOSDMapMsg(
+                {"epoch": self.osdmap.epoch}, payload))
+        elif t == "osd_boot":
+            if self.is_leader:
+                ops = []
+                osd = int(msg["osd_id"])
+                if osd not in self.osdmap.osds:
+                    ops.append({"op": "add_osd", "osd": osd})
+                ops.append({"op": "mark_up", "osd": osd,
+                            "addr": msg["addr"]})
+                self.last_beacon[osd] = time.monotonic()
+                await self._propose_osd_ops(ops)
+            elif self.elector.leader is not None and \
+                    not self.elector.electing:
+                # peon: forward to the leader (reference forward_request)
+                await self._send_mon(self.elector.leader, msg)
+        elif t == "osd_beacon":
+            self.last_beacon[int(msg["osd_id"])] = time.monotonic()
+        elif t == "osd_failure":
+            await self._handle_failure(msg)
+        else:
+            return False
+        return True
+
+    async def _handle_failure(self, msg: MOSDFailure) -> None:
+        """reference OSDMonitor::handle_osd_failure + check_failure."""
+        if not self.is_leader:
+            return
+        failed = int(msg["failed_osd"])
+        if not self.osdmap.is_up(failed):
+            return
+        reporters = self.failure_reports.setdefault(failed, set())
+        reporters.add(int(msg["reporter"]))
+        need = int(self.config.get("mon_osd_min_down_reporters"))
+        if len(reporters) >= need:
+            self.failure_reports.pop(failed, None)
+            await self._propose_osd_ops(
+                [{"op": "mark_down", "osd": failed}])
+
+    # --- ticks: beacon grace / down-out --------------------------------------
+
+    async def _tick_loop(self) -> None:
+        interval = float(self.config.get("mon_tick_interval"))
+        grace = float(self.config.get("osd_heartbeat_grace"))
+        down_out = float(self.config.get("mon_osd_down_out_interval"))
+        lease = float(self.config.get("mon_lease"))
+        while self.running:
+            await asyncio.sleep(interval)
+            if not self.is_leader:
+                # peon: detect a dead leader by lease silence
+                if self.elector.leader is not None and \
+                        not self.elector.electing and \
+                        time.monotonic() - self._last_lease > lease:
+                    dout("mon", 1, f"mon.{self.rank}: leader lease "
+                                   f"expired; calling election")
+                    self._last_lease = time.monotonic()
+                    await self.elector.start_election()
+                continue
+            # leader: extend the lease on the peons
+            for peer in self.elector.quorum:
+                if peer != self.rank:
+                    await self._send_election(peer, "lease", {})
+            now = time.monotonic()
+            ops = []
+            for osd, info in self.osdmap.osds.items():
+                seen = self.last_beacon.get(osd)
+                if info.up and seen is not None and now - seen > grace:
+                    ops.append({"op": "mark_down", "osd": osd})
+                if not info.up and info.in_cluster and seen is not None \
+                        and now - seen > down_out:
+                    ops.append({"op": "mark_out", "osd": osd})
+            if ops:
+                try:
+                    await self._propose_osd_ops(ops)
+                except PaxosError as e:
+                    dout("mon", 1, f"tick propose failed: {e}")
+
+    # --- commands (the 'ceph' CLI surface) ------------------------------------
+
+    async def _handle_command(self, conn, msg: MMonCommand) -> None:
+        cmd = dict(msg["cmd"])
+        tid = msg["tid"]
+        if not self.is_leader:
+            out = {}
+            if self.elector.leader is not None and not self.elector.electing:
+                out["leader"] = self.elector.leader
+            await conn.send_message(MMonCommandReply({
+                "tid": tid, "result": -EAGAIN, "out": out}))
+            return
+        async with self._cmd_lock:
+            try:
+                result, out = await self._do_command(cmd)
+            except PaxosError as e:
+                result, out = -EAGAIN, {"error": str(e)}
+            except Exception as e:  # noqa: BLE001 — command errors -> reply
+                result, out = -22, {"error": f"{type(e).__name__}: {e}"}
+        await conn.send_message(MMonCommandReply({
+            "tid": tid, "result": result, "out": out}))
+
+    async def _do_command(self, cmd: dict) -> "Tuple[int, dict]":
+        prefix = cmd.get("prefix", "")
+        if prefix == "osd erasure-code-profile set":
+            name = cmd["name"]
+            profile = dict(cmd.get("profile", {}))
+            # validate exactly like the reference: instantiate the plugin
+            # (OSDMonitor delegates to the registry before storing)
+            factory_from_profile(profile)
+            if name in self.osdmap.ec_profiles and \
+                    self.osdmap.ec_profiles[name] != profile and \
+                    not cmd.get("force"):
+                return -17, {"error": f"profile {name} exists"}  # EEXIST
+            await self._propose_osd_ops([{
+                "op": "set_ec_profile", "name": name, "profile": profile}])
+            return 0, {}
+        if prefix == "osd erasure-code-profile get":
+            name = cmd["name"]
+            if name not in self.osdmap.ec_profiles:
+                return -2, {"error": f"no profile {name}"}
+            return 0, {"profile": self.osdmap.ec_profiles[name]}
+        if prefix == "osd erasure-code-profile ls":
+            return 0, {"profiles": sorted(self.osdmap.ec_profiles)}
+        if prefix == "osd erasure-code-profile rm":
+            name = cmd["name"]
+            for pool in self.osdmap.pools.values():
+                if pool.ec_profile == name:
+                    return -16, {"error": f"profile {name} in use"}  # EBUSY
+            await self._propose_osd_ops([{"op": "rm_ec_profile",
+                                          "name": name}])
+            return 0, {}
+        if prefix == "osd pool create":
+            name = cmd["name"]
+            if self.osdmap.pool_by_name(name) is not None:
+                return -17, {"error": f"pool {name} exists"}
+            kwargs = dict(cmd.get("kwargs", {}))
+            profile_name = kwargs.get("ec_profile", "")
+            if kwargs.get("type") == POOL_ERASURE:
+                prof = self.osdmap.ec_profiles.get(profile_name)
+                if prof is None:
+                    return -2, {"error": f"no profile {profile_name}"}
+                k, m = int(prof.get("k", 2)), int(prof.get("m", 1))
+                kwargs.setdefault("size", k + m)
+                kwargs.setdefault("min_size", k)
+            v = await self._propose_osd_ops([{
+                "op": "create_pool", "name": name, "kwargs": kwargs}])
+            pool = self.osdmap.pool_by_name(name)
+            return 0, {"pool_id": pool.pool_id, "epoch": v}
+        if prefix == "osd pool ls":
+            return 0, {"pools": [p.name for p in
+                                 self.osdmap.pools.values()]}
+        if prefix in ("osd down", "osd out", "osd in"):
+            op = {"osd down": "mark_down", "osd out": "mark_out",
+                  "osd in": "mark_in"}[prefix]
+            await self._propose_osd_ops([{"op": op,
+                                          "osd": int(cmd["id"])}])
+            return 0, {}
+        if prefix == "osd dump":
+            return 0, {"map": self.osdmap.to_dict()}
+        if prefix == "status":
+            up = sum(1 for o in self.osdmap.osds.values() if o.up)
+            return 0, {
+                "mon": {"rank": self.rank, "quorum": self.elector.quorum,
+                        "leader": self.elector.leader},
+                "osdmap": {"epoch": self.osdmap.epoch,
+                           "num_osds": len(self.osdmap.osds),
+                           "num_up_osds": up},
+                "pools": len(self.osdmap.pools),
+                "health": "HEALTH_OK" if up == len(self.osdmap.osds)
+                          else "HEALTH_WARN"}
+        if prefix == "config set":
+            value = json.dumps({"service": "config", "ops": [
+                {"op": "set", "name": cmd["name"],
+                 "value": str(cmd["value"])}]}).encode()
+            await self.paxos.propose(value)
+            return 0, {}
+        if prefix == "config get":
+            name = cmd["name"]
+            if name in self.central_config:
+                return 0, {"value": self.central_config[name]}
+            return -2, {"error": f"no config {name}"}
+        return -22, {"error": f"unknown command {prefix!r}"}  # EINVAL

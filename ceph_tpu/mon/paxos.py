@@ -1,0 +1,285 @@
+"""Paxos — the monitor's replicated transaction log.
+
+Reference: src/mon/Paxos.{h,cc} (1585 LoC).  Ceph runs leader-based
+Paxos over the mon quorum: after every election the leader runs a
+*collect* phase (phase 1: learn the highest accepted proposal and any
+uncommitted value — Paxos.cc handle_collect/handle_last), then commits
+values through *begin/accept/commit* rounds (phase 2 — handle_begin,
+handle_accept, commit_start).  Exactly one value is in flight at a time;
+each committed value gets consecutive version numbers.  Peons lease
+readable state from the leader (Paxos::lease_start).
+
+Shape here: same protocol over async callbacks.  ``PaxosTransport``
+abstracts the wire (the MonDaemon supplies messenger sends); values are
+opaque bytes; committed versions land in ``store`` (a dict-like the
+daemon persists) and fire ``on_commit`` in version order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+
+class PaxosError(Exception):
+    pass
+
+
+class PaxosTransport:
+    """Supplied by the daemon: fire-and-forget send to a peer rank."""
+
+    async def send(self, rank: int, op: str, fields: dict) -> None:
+        raise NotImplementedError
+
+
+class Paxos:
+    """One replicated log instance (Ceph multiplexes all services over a
+    single Paxos instance the same way)."""
+
+    def __init__(self, rank: int, transport: PaxosTransport,
+                 store: "Dict[str, bytes]",
+                 on_commit: "Callable[[int, bytes], None]") -> None:
+        self.rank = rank
+        self.transport = transport
+        self.store = store
+        self.on_commit = on_commit
+        # membership (set by the elector on every election)
+        self.quorum: "List[int]" = [rank]
+        self.leader: int = rank
+        # proposal-number state (reference accepted_pn; pn = n*100 + rank)
+        self.accepted_pn = 0
+        self.last_committed = int(store.get("last_committed", 0))
+        # in-flight phase-2 state (leader)
+        self._pending_value: "Optional[bytes]" = None
+        self._pending_v: int = 0
+        self._accepts: "set[int]" = set()
+        self._commit_fut: "Optional[asyncio.Future]" = None
+        # collect state (leader, after election)
+        self._collected: "Dict[int, dict]" = {}
+        self._collect_fut: "Optional[asyncio.Future]" = None
+        # uncommitted value carried from a dead leader
+        self.uncommitted_v = 0
+        self.uncommitted_pn = 0
+        self.uncommitted_value: "Optional[bytes]" = None
+        self._propose_lock = asyncio.Lock()
+
+    # --- helpers --------------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader == self.rank
+
+    def _majority(self) -> int:
+        return len(self.quorum) // 2 + 1
+
+    def _new_pn(self) -> int:
+        n = self.accepted_pn // 100 + 1
+        self.accepted_pn = n * 100 + self.rank
+        return self.accepted_pn
+
+    def _get(self, v: int) -> "Optional[bytes]":
+        raw = self.store.get(f"v{v}")
+        return raw if raw is None else bytes(raw)
+
+    def _put_value(self, v: int, value: bytes) -> None:
+        self.store[f"v{v}"] = bytes(value)
+
+    def _commit(self, v: int, value: bytes) -> None:
+        """Apply commits strictly in order."""
+        if v <= self.last_committed:
+            return
+        if v != self.last_committed + 1:
+            raise PaxosError(
+                f"commit gap: {v} after {self.last_committed}")
+        self._put_value(v, value)
+        self.last_committed = v
+        self.store["last_committed"] = str(v).encode()
+        self.on_commit(v, value)
+
+    # --- election hook --------------------------------------------------------
+
+    async def leader_init(self, quorum: "List[int]") -> None:
+        """Called on this node when it wins an election (reference
+        Paxos::leader_init -> collect())."""
+        self.quorum = sorted(quorum)
+        self.leader = self.rank
+        self._collected = {}
+        self.uncommitted_v = 0
+        self.uncommitted_value = None
+        pn = self._new_pn()
+        self._collect_fut = asyncio.get_event_loop().create_future()
+        self._collected[self.rank] = {
+            "last_committed": self.last_committed,
+            "uncommitted_v": 0, "uncommitted_pn": 0, "value": None}
+        for peer in self.quorum:
+            if peer != self.rank:
+                await self.transport.send(peer, "collect", {
+                    "pn": pn, "last_committed": self.last_committed})
+        await self._wait_collect()
+
+    def peon_init(self, quorum: "List[int]", leader: int) -> None:
+        self.quorum = sorted(quorum)
+        self.leader = leader
+
+    async def _wait_collect(self) -> None:
+        if len(self._collected) >= self._majority():
+            await self._finish_collect()
+            return
+        try:
+            await asyncio.wait_for(asyncio.shield(self._collect_fut), 5.0)
+        except asyncio.TimeoutError:
+            raise PaxosError("collect phase timed out (no quorum)")
+
+    async def _finish_collect(self) -> None:
+        """Catch up peers, re-propose any uncommitted value (reference
+        handle_last: the new leader must finish a dead leader's round)."""
+        if self._collect_fut and not self._collect_fut.done():
+            self._collect_fut.set_result(None)
+        # share commits with lagging peers
+        for peer, info in self._collected.items():
+            if peer == self.rank:
+                continue
+            for v in range(info["last_committed"] + 1,
+                           self.last_committed + 1):
+                value = self._get(v)
+                if value is not None:
+                    await self.transport.send(peer, "commit", {
+                        "v": v, "value": value.hex()})
+        if self.uncommitted_value is not None \
+                and self.uncommitted_v == self.last_committed + 1:
+            value = self.uncommitted_value
+            self.uncommitted_value = None
+            await self.propose(value)
+
+    # --- phase 2: propose -----------------------------------------------------
+
+    async def propose(self, value: bytes) -> int:
+        """Leader-only: commit one value; returns its version.  Serialized
+        — one in-flight round at a time (reference Paxos allows a single
+        pending proposal)."""
+        if not self.is_leader:
+            raise PaxosError("propose on a peon")
+        async with self._propose_lock:
+            v = self.last_committed + 1
+            self._pending_v = v
+            self._pending_value = bytes(value)
+            self._accepts = {self.rank}
+            self._commit_fut = asyncio.get_event_loop().create_future()
+            # leader accepts its own proposal durably first
+            self.store[f"pending_v"] = str(v).encode()
+            self.store[f"pending_value"] = bytes(value)
+            for peer in self.quorum:
+                if peer != self.rank:
+                    await self.transport.send(peer, "begin", {
+                        "v": v, "pn": self.accepted_pn,
+                        "value": value.hex()})
+            if len(self._accepts) >= self._majority():
+                self._do_commit()
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._commit_fut), 5.0)
+            except asyncio.TimeoutError:
+                raise PaxosError(f"no quorum for v{v}")
+            return v
+
+    def _do_commit(self) -> None:
+        if self._pending_value is None:
+            return  # already committed (accepts can race the send loop)
+        v, value = self._pending_v, self._pending_value
+        self._pending_value = None
+        self.store.pop("pending_v", None)
+        self.store.pop("pending_value", None)
+        self._commit(v, value)
+        fut = self._commit_fut
+        if fut and not fut.done():
+            fut.set_result(v)
+        # async commit notification to peons
+        for peer in self.quorum:
+            if peer != self.rank:
+                asyncio.ensure_future(self.transport.send(
+                    peer, "commit", {"v": v, "value": value.hex()}))
+
+    # --- message handlers -----------------------------------------------------
+
+    async def handle(self, frm: int, op: str, fields: dict) -> None:
+        if op == "collect":
+            await self._handle_collect(frm, fields)
+        elif op == "last":
+            await self._handle_last(frm, fields)
+        elif op == "begin":
+            await self._handle_begin(frm, fields)
+        elif op == "accept":
+            self._handle_accept(frm, fields)
+        elif op == "commit":
+            self._handle_commit(frm, fields)
+
+    async def _handle_collect(self, frm: int, fields: dict) -> None:
+        """Peon: promise the higher pn, report our state + any
+        uncommitted accepted value (reference Paxos::handle_collect)."""
+        pn = int(fields["pn"])
+        if pn <= self.accepted_pn:
+            return  # stale collector; ignore (it will time out)
+        self.accepted_pn = pn
+        reply = {"pn": pn, "last_committed": self.last_committed,
+                 "uncommitted_v": 0, "uncommitted_pn": 0, "value": None}
+        pv = self.store.get("pending_v")
+        pval = self.store.get("pending_value")
+        if pv is not None and pval is not None:
+            v = int(pv.decode())
+            if v > self.last_committed:
+                reply.update({"uncommitted_v": v,
+                              "uncommitted_pn": self.accepted_pn,
+                              "value": bytes(pval).hex()})
+        # share commits the collector is missing
+        for v in range(int(fields["last_committed"]) + 1,
+                       self.last_committed + 1):
+            value = self._get(v)
+            if value is not None:
+                await self.transport.send(frm, "commit", {
+                    "v": v, "value": value.hex()})
+        await self.transport.send(frm, "last", reply)
+
+    async def _handle_last(self, frm: int, fields: dict) -> None:
+        """Leader: gather collect replies."""
+        if int(fields["pn"]) != self.accepted_pn:
+            return
+        self._collected[frm] = fields
+        if fields.get("value") and \
+                int(fields["uncommitted_v"]) > self.last_committed and \
+                int(fields["uncommitted_pn"]) >= self.uncommitted_pn:
+            self.uncommitted_v = int(fields["uncommitted_v"])
+            self.uncommitted_pn = int(fields["uncommitted_pn"])
+            self.uncommitted_value = bytes.fromhex(fields["value"])
+        if len(self._collected) >= self._majority() and \
+                self._collect_fut and not self._collect_fut.done():
+            await self._finish_collect()
+
+    async def _handle_begin(self, frm: int, fields: dict) -> None:
+        """Peon: accept iff pn matches our promise (reference
+        Paxos::handle_begin)."""
+        pn = int(fields["pn"])
+        if pn < self.accepted_pn:
+            return
+        self.accepted_pn = pn
+        v = int(fields["v"])
+        value = bytes.fromhex(fields["value"])
+        # durable accept (survives peon crash-restart)
+        self.store["pending_v"] = str(v).encode()
+        self.store["pending_value"] = value
+        await self.transport.send(frm, "accept", {"v": v, "pn": pn})
+
+    def _handle_accept(self, frm: int, fields: dict) -> None:
+        if int(fields.get("v", -1)) != self._pending_v or \
+                self._pending_value is None:
+            return
+        self._accepts.add(frm)
+        if len(self._accepts) >= self._majority():
+            self._do_commit()
+
+    def _handle_commit(self, frm: int, fields: dict) -> None:
+        v = int(fields["v"])
+        value = bytes.fromhex(fields["value"])
+        if v == self.last_committed + 1:
+            self.store.pop("pending_v", None)
+            self.store.pop("pending_value", None)
+            self._commit(v, value)

@@ -52,37 +52,74 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
 
 
 class OSDDaemon(Dispatcher):
-    """One shard server / primary (reference OSD + ceph_osd.cc)."""
+    """One shard server / primary (reference OSD + ceph_osd.cc).
 
-    def __init__(self, osd_id: int, osdmap: OSDMap,
+    Two boot modes, as in the reference:
+    - static map: ``osdmap`` is shared/maintained externally (unit tests)
+    - mon-managed: ``mon_addrs`` given -> subscribe for maps, announce
+      boot, send beacons (reference OSD::start_boot -> monc)
+    """
+
+    def __init__(self, osd_id: int, osdmap: "Optional[OSDMap]" = None,
                  store: "Optional[ObjectStore]" = None,
-                 config: "Optional[Config]" = None) -> None:
+                 config: "Optional[Config]" = None,
+                 mon_addrs: "Optional[Dict[int, str]]" = None,
+                 addr: str = "") -> None:
         self.whoami = osd_id
-        self.osdmap = osdmap
         self.store = store or MemStore()
         self.config = config or Config()
         self.ms = Messenger.create(f"osd.{osd_id}", self.config)
         self.ms.add_dispatcher(self)
+        from ..mon.client import attach_monc
+        self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
+        self.addr = addr or f"local:osd.{osd_id}"
         self.backends: "Dict[Tuple[int, int], ECBackend]" = {}
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
+        self._beacon_task = None
 
-    # --- boot (reference OSD::init OSD.cc:3257) ------------------------------
+    # --- boot (reference OSD::init OSD.cc:3257 -> start_boot) ----------------
 
     async def init(self) -> None:
         self.store.mount()
-        addr = self.osdmap.get_addr(self.whoami)
-        await self.ms.bind(addr)
+        addr = self.osdmap.get_addr(self.whoami) if self.monc is None \
+            else self.addr
+        await self.ms.bind(addr or self.addr)
+        if self.monc is not None:
+            await self.monc.subscribe_osdmap()
+            # announce boot until the map shows us up — boots sent during
+            # an election are dropped, so resend (reference start_boot
+            # re-queues until the map reflects the osd)
+            for attempt in range(50):
+                await self.monc.send_boot(self.whoami, self.ms.listen_addr)
+                for _ in range(10):
+                    if self.osdmap.is_up(self.whoami):
+                        break
+                    await asyncio.sleep(0.02)
+                if self.osdmap.is_up(self.whoami):
+                    break
+            else:
+                dout("osd", 0, f"osd.{self.whoami}: boot not acknowledged "
+                               f"by any mon; serving anyway")
+            self._beacon_task = asyncio.ensure_future(self._beacon_loop())
         # load_pgs: re-instantiate backends for collections on disk
         for c in self.store.list_collections():
             if c.pool in self.osdmap.pools:
                 self._get_backend((c.pool, c.pg))
         self.up = True
-        dout("osd", 1, f"osd.{self.whoami} up at {addr}")
+        dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
+
+    async def _beacon_loop(self) -> None:
+        interval = float(self.config.get("osd_heartbeat_interval"))
+        while True:
+            await self.monc.send_beacon(self.whoami)
+            await asyncio.sleep(interval)
 
     async def shutdown(self) -> None:
         self.up = False
+        if self._beacon_task:
+            self._beacon_task.cancel()
         await self.ms.shutdown()
         self.store.umount()
 
@@ -111,8 +148,16 @@ class OSDDaemon(Dispatcher):
         addr = self.osdmap.get_addr(osd)
         if not addr or not self.osdmap.is_up(osd):
             raise ECError(f"osd.{osd} is down")
-        conn = self.ms.get_connection(addr)
-        await conn.send_message(msg)
+        try:
+            conn = self.ms.get_connection(addr)
+            await conn.send_message(msg)
+        except (ConnectionError, OSError):
+            # peer unreachable: tell the mon (reference send_failures
+            # OSD.cc:6667); the mon marks it down after enough reports
+            if self.monc is not None:
+                asyncio.ensure_future(
+                    self.monc.report_failure(self.whoami, osd))
+            raise
 
     # --- dispatch (reference ms_fast_dispatch OSD.cc:6990) -------------------
 

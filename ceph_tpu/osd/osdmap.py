@@ -222,6 +222,20 @@ class OSDMap:
         m.next_pool_id = d["next_pool_id"]
         return m
 
+    def load_dict(self, d: dict) -> None:
+        """In-place replacement from an incoming map broadcast, so every
+        holder of this OSDMap instance (Objecter, OSD backends) sees the
+        new epoch (the reference swaps a shared OSDMapRef similarly)."""
+        m = OSDMap.from_dict(d)
+        self.epoch = m.epoch
+        self.fsid = m.fsid
+        self.osds = m.osds
+        self.pools = m.pools
+        self.ec_profiles = m.ec_profiles
+        self.crush = m.crush
+        self.pg_temp = m.pg_temp
+        self.next_pool_id = m.next_pool_id
+
     def encode(self) -> bytes:
         return json.dumps(self.to_dict()).encode()
 

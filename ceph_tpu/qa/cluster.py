@@ -2,9 +2,16 @@
 
 Reference: src/vstart.sh (dev cluster on localhost) and
 qa/standalone/ceph-helpers.sh (throwaway mon+osd clusters for bash
-integration tests).  Uses the ``async+local`` messenger transport so N
-OSDs + clients share one asyncio loop; swap ms_type to ``async+tcp`` in
+integration tests).  Uses the ``async+local`` messenger transport so
+mons, OSDs, and clients share one asyncio loop; set ms_type=async+tcp in
 the config for real-socket runs (the helpers' multi-process analog).
+
+Two modes:
+- static (n_mons=0): one OSDMap object shared by every daemon, mutated
+  directly — the fastest harness for data-path tests.
+- mon-managed (n_mons>0): a real mon quorum (election + Paxos); OSDs
+  boot/beacon via MonClient, maps flow by subscription, pools are
+  created through ``ceph``-style commands.
 """
 
 from __future__ import annotations
@@ -19,35 +26,69 @@ from ..osd.osdmap import OSDMap, POOL_ERASURE
 
 
 class MiniCluster:
-    def __init__(self, n_osds: int = 6,
+    def __init__(self, n_osds: int = 6, n_mons: int = 0,
                  config: "Optional[Config]" = None) -> None:
         self.config = config or Config()
         if config is None or self.config.origin("ms_type") == "default":
             # default to the in-process transport; an explicit ms_type in
             # the caller's config (e.g. async+tcp for real sockets) wins
             self.config.set("ms_type", "async+local")
-        self.osdmap = OSDMap()
-        self.osdmap.crush.add_bucket("default", "root")
+        self.n_osds = n_osds
+        self.mon_addrs: "Dict[int, str]" = {
+            r: f"local:mon.{r}" for r in range(n_mons)}
+        self.mons: "Dict[int, object]" = {}
         self.osds: "Dict[int, OSDDaemon]" = {}
         self.clients: "List[RadosClient]" = []
-        for i in range(n_osds):
-            self.osdmap.add_osd(i)
-            self.osdmap.mark_up(i, f"local:osd.{i}")
-        self.osdmap.bump()
-        for i in range(n_osds):
-            self.osds[i] = OSDDaemon(i, self.osdmap, config=self.config)
+        self._admin: "Optional[RadosClient]" = None
+        if not self.mon_addrs:
+            # static mode: one shared map, pre-populated
+            self.osdmap = OSDMap()
+            self.osdmap.crush.add_bucket("default", "root")
+            for i in range(n_osds):
+                self.osdmap.add_osd(i)
+                self.osdmap.mark_up(i, f"local:osd.{i}")
+            self.osdmap.bump()
+            for i in range(n_osds):
+                self.osds[i] = OSDDaemon(i, self.osdmap,
+                                         config=self.config)
+        else:
+            self.osdmap = None  # authoritative map lives on the mons
 
     # --- lifecycle ------------------------------------------------------------
 
     async def start(self) -> None:
-        for osd in self.osds.values():
-            await osd.init()
+        if self.mon_addrs:
+            from ..mon.monitor import MonDaemon
+            for r in self.mon_addrs:
+                self.mons[r] = MonDaemon(r, self.mon_addrs, self.config)
+            for mon in self.mons.values():
+                await mon.init()
+            await self.wait_for_leader()
+            for i in range(self.n_osds):
+                self.osds[i] = OSDDaemon(
+                    i, config=self.config, mon_addrs=self.mon_addrs)
+            for osd in self.osds.values():
+                await osd.init()
+        else:
+            for osd in self.osds.values():
+                await osd.init()
+
+    async def wait_for_leader(self, timeout: float = 5.0) -> int:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for mon in self.mons.values():
+                if mon.is_leader:
+                    return mon.rank
+            await asyncio.sleep(0.02)
+        raise TimeoutError("no mon leader elected")
 
     async def stop(self) -> None:
         for client in self.clients:
             await client.shutdown()
         for osd in self.osds.values():
             await osd.shutdown()
+        for mon in self.mons.values():
+            await mon.shutdown()
 
     async def __aenter__(self) -> "MiniCluster":
         await self.start()
@@ -60,6 +101,8 @@ class MiniCluster:
 
     def create_ec_pool(self, name: str, profile: "Optional[dict]" = None,
                        pg_num: int = 8, stripe_unit: int = 4096):
+        """Static-mode pool creation (direct map mutation)."""
+        assert not self.mon_addrs, "mon mode: use create_ec_pool_cmd"
         profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
         prof_name = f"{name}-profile"
         self.osdmap.ec_profiles[prof_name] = profile
@@ -70,10 +113,34 @@ class MiniCluster:
         self.osdmap.bump()
         return pool
 
+    async def create_ec_pool_cmd(self, name: str,
+                                 profile: "Optional[dict]" = None,
+                                 pg_num: int = 8,
+                                 stripe_unit: int = 4096) -> dict:
+        """Mon-mode pool creation via 'ceph'-style commands."""
+        admin = await self._admin_client()
+        profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
+        prof_name = f"{name}-profile"
+        await admin.mon_command({
+            "prefix": "osd erasure-code-profile set",
+            "name": prof_name, "profile": profile})
+        return await admin.mon_command({
+            "prefix": "osd pool create", "name": name,
+            "kwargs": {"type": POOL_ERASURE, "pg_num": pg_num,
+                       "ec_profile": prof_name,
+                       "stripe_unit": stripe_unit}})
+
+    async def _admin_client(self) -> RadosClient:
+        if self._admin is None:
+            self._admin = await self.client()
+        return self._admin
+
     async def client(self) -> RadosClient:
-        c = RadosClient(self.osdmap, name=f"client.{len(self.clients)}",
-                        config=self.config)
-        await c.connect(f"local:client.{len(self.clients)}")
+        idx = len(self.clients)
+        c = RadosClient(self.osdmap if not self.mon_addrs else None,
+                        name=f"client.{idx}", config=self.config,
+                        mon_addrs=self.mon_addrs or None)
+        await c.connect(f"local:client.{idx}")
         self.clients.append(c)
         return c
 
@@ -82,13 +149,28 @@ class MiniCluster:
     async def kill_osd(self, osd_id: int) -> None:
         """qa/tasks/ceph_manager.py Thrasher.kill_osd analog."""
         await self.osds[osd_id].shutdown()
-        self.osdmap.mark_down(osd_id)
-        self.osdmap.bump()
+        if not self.mon_addrs:
+            self.osdmap.mark_down(osd_id)
+            self.osdmap.bump()
 
     async def revive_osd(self, osd_id: int) -> None:
-        osd = self.osds[osd_id] = OSDDaemon(
-            osd_id, self.osdmap, store=self.osds[osd_id].store,
-            config=self.config)
-        self.osdmap.mark_up(osd_id, f"local:osd.{osd_id}")
-        self.osdmap.bump()
+        old = self.osds[osd_id]
+        if self.mon_addrs:
+            osd = OSDDaemon(osd_id, store=old.store, config=self.config,
+                            mon_addrs=self.mon_addrs)
+        else:
+            osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
+                            config=self.config)
+            self.osdmap.mark_up(osd_id, f"local:osd.{osd_id}")
+            self.osdmap.bump()
+        self.osds[osd_id] = osd
         await osd.init()
+
+    async def kill_mon(self, rank: int) -> None:
+        await self.mons[rank].shutdown()
+
+    def leader_mon(self):
+        for mon in self.mons.values():
+            if mon.running and mon.is_leader:
+                return mon
+        return None

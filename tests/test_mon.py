@@ -1,0 +1,279 @@
+"""Monitor tests — election, Paxos, commands, failure detection.
+
+Reference test strategy: src/test/mon/* unit tests plus
+qa/standalone/mon/*.sh (command surface) and the thrasher's mon-kill
+behavior.  Mon quorum runs on the async+local transport in one loop.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.mon.client import MonClient, MonClientError
+from ceph_tpu.mon.monitor import MonDaemon
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def fast_config() -> Config:
+    cfg = Config()
+    cfg.set("ms_type", "async+local")
+    cfg.set("mon_lease", 0.5)             # election timeout = lease/5
+    cfg.set("mon_tick_interval", 0.05)
+    cfg.set("osd_heartbeat_interval", 0.05)
+    cfg.set("osd_heartbeat_grace", 0.5)
+    cfg.set("mon_osd_down_out_interval", 30.0)
+    return cfg
+
+
+async def start_mons(n=3, cfg=None):
+    cfg = cfg or fast_config()
+    addrs = {r: f"local:mon.{r}" for r in range(n)}
+    mons = {r: MonDaemon(r, addrs, cfg) for r in range(n)}
+    for m in mons.values():
+        await m.init()
+    for _ in range(200):
+        if any(m.is_leader for m in mons.values()):
+            break
+        await asyncio.sleep(0.02)
+    return mons, addrs, cfg
+
+
+class TestElectionPaxos:
+    def test_lowest_rank_wins(self, loop):
+        async def go():
+            mons, _addrs, _cfg = await start_mons(3)
+            try:
+                await asyncio.sleep(0.2)
+                leaders = [m.rank for m in mons.values() if m.is_leader]
+                assert leaders == [0]
+                assert mons[1].elector.leader == 0
+                assert mons[2].elector.leader == 0
+            finally:
+                for m in mons.values():
+                    await m.shutdown()
+        loop.run_until_complete(go())
+
+    def test_commit_replicates(self, loop):
+        async def go():
+            mons, _addrs, _cfg = await start_mons(3)
+            try:
+                leader = next(m for m in mons.values() if m.is_leader)
+                v = await leader.paxos.propose(b'{"service":"config",'
+                                               b'"ops":[{"op":"set",'
+                                               b'"name":"x","value":"1"}]}')
+                await asyncio.sleep(0.1)
+                for m in mons.values():
+                    assert m.paxos.last_committed >= v
+                    assert m.central_config.get("x") == "1"
+            finally:
+                for m in mons.values():
+                    await m.shutdown()
+        loop.run_until_complete(go())
+
+    def test_leader_failover(self, loop):
+        """Kill the leader: a new leader must emerge and keep committing,
+        and previously committed state must survive."""
+        async def go():
+            mons, _addrs, _cfg = await start_mons(3)
+            try:
+                leader = next(m for m in mons.values() if m.is_leader)
+                await leader.paxos.propose(b'{"service":"config",'
+                                           b'"ops":[{"op":"set",'
+                                           b'"name":"k","value":"v"}]}')
+                await asyncio.sleep(0.05)
+                await leader.shutdown()
+                # survivors detect the dead leader via lease expiry and
+                # re-elect on their own (no manual kick)
+                survivors = [m for m in mons.values() if m is not leader]
+                for _ in range(300):
+                    if any(m.is_leader for m in survivors):
+                        break
+                    await asyncio.sleep(0.02)
+                new_leader = next(m for m in survivors if m.is_leader)
+                assert new_leader.central_config.get("k") == "v"
+                v = await new_leader.paxos.propose(
+                    b'{"service":"config","ops":[{"op":"set",'
+                    b'"name":"k2","value":"v2"}]}')
+                assert v > 0
+                await asyncio.sleep(0.1)
+                for m in survivors:
+                    assert m.central_config.get("k2") == "v2"
+            finally:
+                for m in mons.values():
+                    if m.running:
+                        await m.shutdown()
+        loop.run_until_complete(go())
+
+
+class TestCommands:
+    def test_ec_profile_lifecycle(self, loop):
+        async def go():
+            mons, addrs, cfg = await start_mons(3)
+            from ceph_tpu.msg.messenger import Messenger
+            ms = Messenger.create("client.t", cfg)
+            await ms.bind("local:client.t")
+            monc = MonClient(ms, addrs)
+            try:
+                await monc.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "p1",
+                    "profile": {"plugin": "jax_rs", "k": "4", "m": "2"}})
+                out = await monc.command({
+                    "prefix": "osd erasure-code-profile get", "name": "p1"})
+                assert out["profile"]["k"] == "4"
+                out = await monc.command({
+                    "prefix": "osd erasure-code-profile ls"})
+                assert "p1" in out["profiles"]
+                # invalid profile rejected by plugin instantiation
+                with pytest.raises(MonClientError):
+                    await monc.command({
+                        "prefix": "osd erasure-code-profile set",
+                        "name": "bad",
+                        "profile": {"plugin": "nope_plugin"}})
+                # profile replicated to peons via paxos
+                await asyncio.sleep(0.1)
+                for m in mons.values():
+                    assert "p1" in m.osdmap.ec_profiles
+                await monc.command({
+                    "prefix": "osd erasure-code-profile rm", "name": "p1"})
+                out = await monc.command({
+                    "prefix": "osd erasure-code-profile ls"})
+                assert "p1" not in out["profiles"]
+            finally:
+                await ms.shutdown()
+                for m in mons.values():
+                    await m.shutdown()
+        loop.run_until_complete(go())
+
+    def test_command_redirect_from_peon(self, loop):
+        async def go():
+            mons, addrs, cfg = await start_mons(3)
+            from ceph_tpu.msg.messenger import Messenger
+            ms = Messenger.create("client.r", cfg)
+            await ms.bind("local:client.r")
+            monc = MonClient(ms, addrs)
+            monc.leader_guess = 2  # deliberately aim at a peon
+            try:
+                out = await monc.command({"prefix": "status"})
+                assert out["mon"]["leader"] == 0
+                assert monc.leader_guess == 0  # learned via redirect
+            finally:
+                await ms.shutdown()
+                for m in mons.values():
+                    await m.shutdown()
+        loop.run_until_complete(go())
+
+
+class TestLeaderKill:
+    def test_commands_survive_leader_kill(self, loop):
+        """Kill the leader mon: commands stall through the election and
+        then succeed against the new leader (lease-based detection +
+        client retry/redirect)."""
+        async def go():
+            mons, addrs, cfg = await start_mons(3)
+            from ceph_tpu.msg.messenger import Messenger
+            ms = Messenger.create("client.lk", cfg)
+            await ms.bind("local:client.lk")
+            monc = MonClient(ms, addrs)
+            try:
+                await monc.command({
+                    "prefix": "config set", "name": "a", "value": "1"})
+                await mons[0].shutdown()
+                out = await monc.command({"prefix": "status"},
+                                         timeout=2.0)
+                assert out["mon"]["leader"] in (1, 2)
+                got = await monc.command({
+                    "prefix": "config get", "name": "a"})
+                assert got["value"] == "1"
+            finally:
+                await ms.shutdown()
+                for m in mons.values():
+                    if m.running:
+                        await m.shutdown()
+        loop.run_until_complete(go())
+
+
+class TestMonManagedCluster:
+    def test_boot_pool_io(self, loop):
+        """Full control-plane flow: mons elect, OSDs boot + get marked
+        up, pool created by command, client I/O round-trips."""
+        async def go():
+            cluster = MiniCluster(5, n_mons=3, config=fast_config())
+            async with cluster:
+                out = await cluster.create_ec_pool_cmd(
+                    "ecpool", {"plugin": "jax_rs", "k": "3", "m": "2"},
+                    pg_num=4, stripe_unit=64)
+                assert out["pool_id"] >= 1
+                client = await cluster.client()
+                io = client.io_ctx("ecpool")
+                data = bytes(np.random.default_rng(0).integers(
+                    0, 256, 4000, dtype=np.uint8))
+                await io.write_full("obj", data)
+                assert await io.read("obj") == data
+                # every OSD learned the map through subscription
+                for osd in cluster.osds.values():
+                    assert osd.osdmap.epoch >= 1
+                    assert osd.osdmap.pool_by_name("ecpool") is not None
+        loop.run_until_complete(go())
+
+    def test_beacon_timeout_marks_down(self, loop):
+        """Kill an OSD silently: the mon's beacon grace marks it down and
+        the new map reaches the other daemons."""
+        async def go():
+            cluster = MiniCluster(4, n_mons=1, config=fast_config())
+            async with cluster:
+                await cluster.create_ec_pool_cmd(
+                    "ecpool", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=4, stripe_unit=64)
+                mon = cluster.mons[0]
+                assert all(i.up for i in mon.osdmap.osds.values())
+                await cluster.osds[3].shutdown()   # silent death
+                for _ in range(300):
+                    if not mon.osdmap.is_up(3):
+                        break
+                    await asyncio.sleep(0.02)
+                assert not mon.osdmap.is_up(3)
+                # surviving OSDs see the new epoch
+                await asyncio.sleep(0.2)
+                for i in (0, 1, 2):
+                    assert not cluster.osds[i].osdmap.is_up(3)
+        loop.run_until_complete(go())
+
+    def test_io_survives_osd_death_mon_managed(self, loop):
+        async def go():
+            cluster = MiniCluster(5, n_mons=1, config=fast_config())
+            async with cluster:
+                await cluster.create_ec_pool_cmd(
+                    "ecpool", {"plugin": "jax_rs", "k": "3", "m": "2"},
+                    pg_num=4, stripe_unit=64)
+                client = await cluster.client()
+                io = client.io_ctx("ecpool")
+                data = bytes(np.random.default_rng(1).integers(
+                    0, 256, 6000, dtype=np.uint8))
+                await io.write_full("obj", data)
+                pool = client.osdmap.pool_by_name("ecpool")
+                pg = client.osdmap.object_to_pg(pool.pool_id, "obj")
+                _up, acting = client.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                victim = acting[1]
+                await cluster.osds[victim].shutdown()
+                mon = cluster.mons[0]
+                for _ in range(300):
+                    if not mon.osdmap.is_up(victim):
+                        break
+                    await asyncio.sleep(0.02)
+                # degraded read once the map has propagated
+                await asyncio.sleep(0.2)
+                assert await io.read("obj") == data
+        loop.run_until_complete(go())
