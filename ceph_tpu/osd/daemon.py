@@ -78,6 +78,9 @@ class OSDDaemon(Dispatcher):
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
         self._beacon_task = None
+        self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
+        if self.monc is not None:
+            self.monc.map_callbacks.append(self._on_map_change)
 
     # --- boot (reference OSD::init OSD.cc:3257 -> start_boot) ----------------
 
@@ -109,6 +112,49 @@ class OSDDaemon(Dispatcher):
                 self._get_backend((c.pool, c.pg))
         self.up = True
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
+
+    # --- peering on map change (reference: new interval -> PG peers) ---------
+
+    def _on_map_change(self, osdmap: OSDMap) -> None:
+        """New epoch: every PG whose primary we now are re-peers
+        (reference OSD::consume_map -> PG advance_map -> peering)."""
+        if not self.up:
+            return
+        for pool_id, pool in osdmap.pools.items():
+            if not pool.is_erasure():
+                continue
+            for pg in range(pool.pg_num):
+                _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
+                if osdmap.primary_of(acting) != self.whoami:
+                    continue
+                pgid = (pool_id, pg)
+                prev = self._peer_tasks.get(pgid)
+                if prev is not None and not prev.done():
+                    continue
+                self._peer_tasks[pgid] = asyncio.ensure_future(
+                    self._peer_pg(pgid))
+
+    async def _peer_pg(self, pgid: "Tuple[int, int]") -> None:
+        try:
+            be = self._get_backend(pgid)
+            res = await be.peer()
+            if res.get("recovered") or res.get("failed"):
+                dout("osd", 1, f"osd.{self.whoami} pg {pgid} peered: {res}")
+        except Exception as e:  # noqa: BLE001 — peering must not kill the loop
+            dout("osd", 0, f"peering {pgid} failed: {type(e).__name__}: {e}")
+
+    async def peer_all_pgs(self) -> "Dict[Tuple[int, int], dict]":
+        """Explicit peering sweep (static-map harness + admin use)."""
+        out = {}
+        for pool_id, pool in self.osdmap.pools.items():
+            if not pool.is_erasure():
+                continue
+            for pg in range(pool.pg_num):
+                _u, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+                if self.osdmap.primary_of(acting) == self.whoami:
+                    be = self._get_backend((pool_id, pg))
+                    out[(pool_id, pg)] = await be.peer()
+        return out
 
     async def _beacon_loop(self) -> None:
         interval = float(self.config.get("osd_heartbeat_interval"))
@@ -188,6 +234,18 @@ class OSDDaemon(Dispatcher):
         elif t == "pg_push_reply":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_push_reply(msg)
+        elif t == "pg_query":
+            be = self._get_backend(tuple(msg["pgid"]))
+            await conn.send_message(be.handle_pg_query(msg))
+        elif t == "pg_info":
+            be = self._get_backend(tuple(msg["pgid"]))
+            be.handle_pg_info(msg)
+        elif t == "pg_rewind":
+            be = self._get_backend(tuple(msg["pgid"]))
+            await conn.send_message(be.handle_pg_rewind(msg))
+        elif t == "pg_rewind_ack":
+            be = self._get_backend(tuple(msg["pgid"]))
+            be.handle_pg_info(msg)
         elif t == "osd_ping":
             await conn.send_message(MOSDPingReply({
                 "from_osd": self.whoami, "epoch": self.osdmap.epoch,

@@ -44,6 +44,7 @@ from .ectransaction import Extent, WritePlan, get_write_plan
 from .extent_cache import ExtentCache
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDPGPush, MOSDPGPushReply,
+                       MPGInfo, MPGQuery, MPGRewind, MPGRewindAck,
                        pack_buffers, unpack_buffers)
 from .pglog import LogEntry, PGLog, Version, ZERO, ver
 
@@ -184,6 +185,9 @@ class ECBackend:
         # reqid -> committed version: client-retry dedup (the reference
         # stores osd_reqid_t in pg log entries for the same purpose)
         self.completed_reqids: "Dict[str, Version]" = {}
+        # peering request/reply correlation (MPGInfo / MPGRewindAck)
+        self.pending_queries: "Dict[int, asyncio.Future]" = {}
+        self.peering = False
         self._next_tid = 0
         self._lock = asyncio.Lock()
         # shard-local state
@@ -730,11 +734,15 @@ class ECBackend:
 
     async def _start_read(self, reads: "Dict[str, List[Extent]]",
                           for_recovery: bool, want_attrs: bool = False,
-                          want_to_read: "Optional[List[int]]" = None
+                          want_to_read: "Optional[List[int]]" = None,
+                          exclude: "Optional[Set[int]]" = None
                           ) -> ReadOp:
         """Build + launch a ReadOp (reference start_read_op
-        ECBackend.cc:1679 -> do_read_op :1707)."""
+        ECBackend.cc:1679 -> do_read_op :1707).  ``exclude`` drops shards
+        known stale/missing for these objects from the source set."""
         avail = self._avail_shards()
+        for s in (exclude or ()):
+            avail.pop(s, None)
         want = (want_to_read if want_to_read is not None
                 else list(range(self.k)))
         try:
@@ -908,22 +916,34 @@ class ECBackend:
 
     # ============================================================== RECOVERY
 
-    async def recover_object(self, oid: str,
-                             missing_on: "Set[int]") -> None:
+    def _recovery_size(self, oid: str, exclude: "Set[int]") -> int:
+        """Upper bound on the object's logical size for the recovery
+        read.  When our own shard is healthy the local object_info is
+        authoritative; when we're the stale one, over-request — shards
+        clamp reads to their actual extent and decode pads."""
+        if self.my_shard not in exclude:
+            return self.object_size(oid)
+        return 1 << 32
+
+    async def recover_object(self, oid: str, missing_on: "Set[int]",
+                             exclude: "Optional[Set[int]]" = None) -> None:
         """Rebuild ``oid``'s shards on ``missing_on`` (reference
         recover_object ECBackend.cc:738 + continue_recovery_op :570:
-        IDLE -> READING -> WRITING -> COMPLETE)."""
+        IDLE -> READING -> WRITING -> COMPLETE).  ``exclude`` keeps
+        stale shards out of the source reads (recovery may read
+        non-acting shards but never ones missing this object)."""
         rop = RecoveryOp(oid=oid, missing_on=set(missing_on))
         rop.done = asyncio.get_event_loop().create_future()
         self.recovery_ops[oid] = rop
         # READING: fetch enough surviving shards to rebuild the missing
         rop.state = RecoveryOp.READING
-        size = self.object_size(oid)
+        size = self._recovery_size(oid, exclude or set(missing_on))
         aligned = max(self.sinfo.logical_to_next_stripe_offset(size),
                       self.sinfo.stripe_width)
         read = await self._start_read({oid: [(0, aligned)]},
                                       for_recovery=True, want_attrs=True,
-                                      want_to_read=sorted(rop.missing_on))
+                                      want_to_read=sorted(rop.missing_on),
+                                      exclude=exclude or set(missing_on))
         await read.done
         if oid in read.errors:
             self.recovery_ops.pop(oid, None)
@@ -981,19 +1001,24 @@ class ECBackend:
             rop.done.set_result(None)
 
     def handle_push(self, msg: MOSDPGPush) -> MOSDPGPushReply:
-        """Peer side: persist the pushed shard content + attrs."""
+        """Peer side: persist the pushed shard content + attrs (or apply
+        a propagated deletion)."""
         shard = int(msg["shard"])
         cid = self.coll(shard)
         sid = ObjectId(msg["oid"], shard)
         t = Transaction()
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
-        if msg.get("whole") and self.store.exists(cid, sid):
-            t.remove(cid, sid)
-        t.touch(cid, sid)
-        t.write(cid, sid, int(msg.get("off", 0)), msg.data)
-        for name, hexval in msg.get("attrs", {}).items():
-            t.setattr(cid, sid, name, bytes.fromhex(hexval))
+        if msg.get("remove"):
+            if self.store.exists(cid, sid):
+                t.remove(cid, sid)
+        else:
+            if msg.get("whole") and self.store.exists(cid, sid):
+                t.remove(cid, sid)
+            t.touch(cid, sid)
+            t.write(cid, sid, int(msg.get("off", 0)), msg.data)
+            for name, hexval in msg.get("attrs", {}).items():
+                t.setattr(cid, sid, name, bytes.fromhex(hexval))
         self._pg_meta_txn(t, cid)
         self.store.apply_transaction(t)
         return MOSDPGPushReply({
@@ -1010,6 +1035,250 @@ class ECBackend:
             rop.state = RecoveryOp.COMPLETE
             self.recovery_ops.pop(msg["oid"], None)
             rop.done.set_result(None)
+
+    # =============================================================== PEERING
+
+    def _list_objects(self, shard: int) -> "List[str]":
+        cid = self.coll(shard)
+        if not self.store.collection_exists(cid):
+            return []
+        return sorted({o.name for o in self.store.list_objects(cid)
+                       if o.name != PGMETA_OID and o.generation == NO_GEN})
+
+    def handle_pg_query(self, msg: MPGQuery) -> MPGInfo:
+        """Shard side: report our log + object list (reference
+        MOSDPGQuery -> MOSDPGNotify/MOSDPGLog exchange)."""
+        shard = int(msg["shard"])
+        return MPGInfo({
+            "pgid": list(self.pgid), "shard": shard,
+            "from_osd": self.whoami, "tid": int(msg["tid"]),
+            "log": self.pg_log.to_dict(),
+            "objects": self._list_objects(shard)})
+
+    def handle_pg_info(self, msg) -> None:
+        fut = self.pending_queries.get(int(msg["tid"]))
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+
+    def handle_pg_rewind(self, msg: MPGRewind) -> MPGRewindAck:
+        """Shard side: drop + roll back entries newer than ``to``."""
+        shard = int(msg["shard"])
+        self._rewind_local(shard, ver(msg["to"]))
+        return MPGRewindAck({
+            "pgid": list(self.pgid), "shard": shard,
+            "from_osd": self.whoami, "tid": int(msg["tid"]),
+            "head": list(self.pg_log.head)})
+
+    def _rewind_local(self, shard: int, to: Version) -> None:
+        try:
+            div = self.pg_log.rewind_divergent(to)
+        except ValueError:
+            # divergence beyond can_rollback_to: nuke to backfill state
+            # (reference falls back to backfill the same way)
+            self.pg_log = PGLog()
+            div = []
+        if not div and not self.store.collection_exists(self.coll(shard)):
+            return
+        cid = self.coll(shard)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        for e in div:
+            self._rollback_entry(t, cid, shard, e)
+        self._pg_meta_txn(t, cid)
+        self.store.apply_transaction(t)
+
+    def _rollback_entry(self, t: Transaction, cid: Collection, shard: int,
+                        e: LogEntry) -> None:
+        """Undo one divergent entry using its local rollback payload
+        (reference ecbackend.rst:1-26 — append old size, attr old
+        values, generation clones)."""
+        sid = ObjectId(e.oid, shard)
+        rb = e.rollback
+        if "clone_gen" in rb:
+            gid = sid.with_gen(int(rb["clone_gen"]))
+            if self.store.exists(cid, gid):
+                t.remove(cid, sid)
+                t.clone(cid, gid, sid)
+                t.remove(cid, gid)
+            else:
+                # entry created the object: undo = remove
+                t.remove(cid, sid)
+        elif "append_from" in rb:
+            old_size = int(rb["append_from"])
+            ct = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                self.sinfo.logical_to_next_stripe_offset(old_size))
+            t.truncate(cid, sid, ct)
+            t.setattr(cid, sid, OI_KEY,
+                      ObjectInfo(old_size, e.prior_version).encode())
+            hinfo = ecutil.HashInfo(self.k + self.m)
+            hinfo.invalidate()  # crc chain broken; scrub/recovery rebuilds
+            t.setattr(cid, sid, HINFO_KEY, hinfo.encode())
+        for name, val in rb.get("old_attrs", {}).items():
+            if val is None:
+                t.rmattr(cid, sid, name)
+            else:
+                t.setattr(cid, sid, name, val)
+
+    async def _query_shard(self, shard: int, osd: int,
+                           timeout: float = 2.0):
+        tid = self.new_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self.pending_queries[tid] = fut
+        try:
+            await self.send(osd, MPGQuery({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": tid}))
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
+            return None
+        finally:
+            self.pending_queries.pop(tid, None)
+
+    async def _rewind_shard(self, shard: int, osd: int, to: Version,
+                            timeout: float = 2.0) -> None:
+        if osd == self.whoami:
+            self._rewind_local(shard, to)
+            return
+        tid = self.new_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self.pending_queries[tid] = fut
+        try:
+            await self.send(osd, MPGRewind({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": tid, "to": list(to)}))
+            await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
+            pass
+        finally:
+            self.pending_queries.pop(tid, None)
+
+    async def peer(self) -> dict:
+        """Primary: bring every up shard to a consistent, recovered state
+        (the GetInfo -> GetLog -> GetMissing -> Recovering arc of the
+        reference PeeringState machine, PeeringState.h:654-1240,
+        compressed into one async routine).
+
+        1. gather log infos from all up shards
+        2. pick the authoritative head: the newest version durable on
+           enough shards to decode (>= k) — anything newer is a partial
+           write that must roll back (EC can't serve it)
+        3. rewind divergent shards (local undo via rollback payloads)
+        4. compute per-shard missing sets from the auth log (or schedule
+           full backfill when a shard's log is too far behind)
+        5. reconstruct + push every missing object
+        """
+        if self.peering:
+            return {"status": "already"}
+        self.peering = True
+        try:
+            return await self._do_peer()
+        finally:
+            self.peering = False
+
+    async def _do_peer(self) -> dict:
+        up = self._avail_shards()
+        infos: "Dict[int, dict]" = {}
+        for s, osd in up.items():
+            if osd == self.whoami:
+                infos[s] = {"log": self.pg_log.to_dict(),
+                            "objects": self._list_objects(s)}
+            else:
+                reply = await self._query_shard(s, osd)
+                if reply is not None:
+                    infos[s] = {"log": dict(reply["log"]),
+                                "objects": list(reply["objects"])}
+        if not infos:
+            return {"status": "no_infos"}
+        heads = {s: ver(infos[s]["log"].get("head", [0, 0]))
+                 for s in infos}
+        need = min(self.k, len(infos))
+        candidates = sorted(set(heads.values()), reverse=True)
+        auth_head = ZERO
+        for v in candidates:
+            if sum(1 for h in heads.values() if h >= v) >= need:
+                auth_head = v
+                break
+        auth_shard = max((s for s in infos if heads[s] >= auth_head),
+                         key=lambda s: (heads[s], -s))
+        auth_log = PGLog.from_dict(infos[auth_shard]["log"])
+        auth_entries = [e for e in auth_log.entries
+                        if e.version <= auth_head]
+
+        # rewind anything newer than the decodable head
+        for s in sorted(infos):
+            if heads[s] > auth_head:
+                await self._rewind_shard(s, up[s], auth_head)
+                heads[s] = auth_head
+        # adopt the authoritative log locally if we're behind
+        if self.pg_log.head < auth_head:
+            adopted = PGLog()
+            adopted.tail = auth_log.tail
+            adopted.head = auth_head
+            adopted.can_rollback_to = auth_head
+            adopted.entries = list(auth_entries)
+            self.pg_log = adopted
+
+        # missing objects per shard
+        all_objects: "Set[str]" = set()
+        for s in infos:
+            if heads[s] >= auth_head:
+                all_objects.update(infos[s]["objects"])
+        deleted = {e.oid for e in auth_entries if e.op == "delete"
+                   and not any(e2.version > e.version
+                               and e2.oid == e.oid
+                               for e2 in auth_entries)}
+        missing: "Dict[str, Set[int]]" = {}
+        backfill_shards = []
+        for s in sorted(infos):
+            h = heads[s]
+            if h >= auth_head:
+                continue
+            if h < auth_log.tail:
+                backfill_shards.append(s)
+                for oid in all_objects:
+                    missing.setdefault(oid, set()).add(s)
+            else:
+                for e in auth_entries:
+                    if e.version > h:
+                        missing.setdefault(e.oid, set()).add(s)
+        recovered = failed = 0
+        for oid in sorted(missing):
+            shards = missing[oid]
+            if oid in deleted or oid not in all_objects:
+                await self._push_delete(oid, shards, up)
+                continue
+            try:
+                await self.recover_object(oid, shards,
+                                          exclude=set(shards))
+                recovered += 1
+            except ECError as e:
+                dout("osd", 1, f"peer: recover {oid} failed: {e}")
+                failed += 1
+        return {"status": "ok", "auth_head": list(auth_head),
+                "auth_shard": auth_shard, "recovered": recovered,
+                "failed": failed, "backfilled_shards": backfill_shards,
+                "missing": {o: sorted(s) for o, s in missing.items()}}
+
+    async def _push_delete(self, oid: str, shards: "Set[int]",
+                           up: "Dict[int, int]") -> None:
+        """Propagate a deletion to stale shards (push with remove flag)."""
+        for shard in sorted(shards):
+            osd = up.get(shard)
+            if osd is None:
+                continue
+            msg = MOSDPGPush({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": self.new_tid(),
+                "oid": oid, "version": list(self.pg_log.head),
+                "remove": True, "whole": True, "off": 0, "attrs": {}})
+            if osd == self.whoami:
+                self.handle_push(msg)
+            else:
+                try:
+                    await self.send(osd, msg)
+                except (ConnectionError, OSError, ECError):
+                    pass
 
     # ============================================================ PREDICATES
 

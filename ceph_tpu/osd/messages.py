@@ -113,6 +113,38 @@ class MOSDPGPushReply(Message):
     TYPE = "pg_push_reply"
 
 
+# --- peering (reference MOSDPGQuery / MOSDPGNotify / MOSDPGLog) --------------
+
+
+@register_message
+class MPGQuery(Message):
+    """Primary asks a shard for its pg info + log.
+    fields: pgid, shard, from_osd, tid."""
+    TYPE = "pg_query"
+
+
+@register_message
+class MPGInfo(Message):
+    """Shard's reply: fields: pgid, shard, from_osd, tid,
+    log (PGLog.to_dict), objects ([oid...] for backfill planning)."""
+    TYPE = "pg_info"
+
+
+@register_message
+class MPGRewind(Message):
+    """Primary tells a divergent shard to rewind its log to ``to`` and
+    roll back newer entries locally (reference: the peon-side divergent
+    entry handling in PGLog::rewind_divergent_log + rollback).
+    fields: pgid, shard, from_osd, tid, to=[epoch,v]."""
+    TYPE = "pg_rewind"
+
+
+@register_message
+class MPGRewindAck(Message):
+    """fields: pgid, shard, from_osd, tid, head=[epoch,v]."""
+    TYPE = "pg_rewind_ack"
+
+
 # --- maps / control ----------------------------------------------------------
 
 

@@ -166,6 +166,15 @@ class MiniCluster:
         self.osds[osd_id] = osd
         await osd.init()
 
+    async def peer_all(self) -> dict:
+        """Run a peering sweep on every up OSD (static-mode recovery
+        trigger; mon mode re-peers automatically on map changes)."""
+        out = {}
+        for osd in self.osds.values():
+            if osd.up:
+                out.update(await osd.peer_all_pgs())
+        return out
+
     async def kill_mon(self, rank: int) -> None:
         await self.mons[rank].shutdown()
 
