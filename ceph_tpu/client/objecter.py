@@ -11,6 +11,7 @@ the defining RADOS trait.
 from __future__ import annotations
 
 import asyncio
+import errno
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common.log import dout
@@ -83,7 +84,13 @@ class Objecter(Dispatcher):
             finally:
                 self._inflight.pop(tid, None)
             outs = list(reply.get("outs", []))
-            if int(reply.get("result", 0)) != 0:
+            result = int(reply.get("result", 0))
+            if result == -errno.ESTALE:  # wrong primary / PG peering
+                last_err = ObjecterError(
+                    f"stale target for {oid}: {outs}")
+                await asyncio.sleep(self.backoff * (attempt + 1))
+                continue
+            if result != 0:
                 errs = [o.get("error") for o in outs if "error" in o]
                 raise ObjecterError(
                     f"op on {oid} failed: {errs or reply['result']}")

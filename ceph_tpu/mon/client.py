@@ -91,7 +91,7 @@ class MonClient(Dispatcher):
                     # not leader or mid-election: follow the hint if any,
                     # else keep hunting/retrying
                     last_err = f"mon.{rank}: EAGAIN"
-                    if "leader" in out:
+                    if "leader" in out and int(out["leader"]) != rank:
                         self.leader_guess = int(out["leader"])
                         redirected = True
                         break
@@ -101,8 +101,12 @@ class MonClient(Dispatcher):
                         f"{cmd.get('prefix')}: {out.get('error', result)}")
                 self.leader_guess = rank
                 return out
-            if not redirected:
-                await asyncio.sleep(0.05 * (attempt + 1))
+            # always pace retries: a dead leader makes every hunt step
+            # fail instantly (fast ConnectionError), and the surviving
+            # mons need lease-expiry + election time before one of them
+            # can serve — spinning through attempts in microseconds
+            # exhausts the budget before that happens
+            await asyncio.sleep(0.05 * (attempt + 1))
         raise MonClientError(f"command failed: {last_err}")
 
     # --- subscriptions --------------------------------------------------------

@@ -245,6 +245,10 @@ class MonDaemon(Dispatcher):
         failed = int(msg["failed_osd"])
         if not self.osdmap.is_up(failed):
             return
+        # only up OSDs are credible reporters (reference: failure reports
+        # carry the reporter's up_from epoch and stale ones are dropped)
+        if not self.osdmap.is_up(int(msg["reporter"])):
+            return
         reporters = self.failure_reports.setdefault(failed, set())
         reporters.add(int(msg["reporter"]))
         need = int(self.config.get("mon_osd_min_down_reporters"))

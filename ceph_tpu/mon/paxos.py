@@ -122,7 +122,13 @@ class Paxos:
         self.leader = leader
 
     async def _wait_collect(self) -> None:
-        if len(self._collected) >= self._majority():
+        # the reference waits for EVERY quorum member, not a majority
+        # (Paxos.cc:560 num_last == quorum.size()): the quorum was just
+        # established by the election, so all members are presumed alive.
+        # A majority of equally-stale peons could otherwise let a behind
+        # leader finish collect before an up-to-date peon's catch-up
+        # commits arrive and re-propose over a committed version.
+        if len(self._collected) >= len(self.quorum):
             await self._finish_collect()
             return
         try:
@@ -135,6 +141,20 @@ class Paxos:
         handle_last: the new leader must finish a dead leader's round)."""
         if self._collect_fut and not self._collect_fut.done():
             self._collect_fut.set_result(None)
+        # if a peon is ahead of us, its _handle_collect sent the missing
+        # commits — they MUST be applied before proposing anything new:
+        # proposing a fresh value at a version an up-to-date peon already
+        # committed would diverge the replicated state
+        newest = max((int(i.get("last_committed", 0))
+                      for i in self._collected.values()), default=0)
+        for _ in range(200):
+            if self.last_committed >= newest:
+                break
+            await asyncio.sleep(0.01)
+        if self.last_committed < newest:
+            raise PaxosError(
+                f"collect: stuck at {self.last_committed} < quorum "
+                f"newest {newest}; refusing leadership")
         # share commits with lagging peers
         for peer, info in self._collected.items():
             if peer == self.rank:
@@ -250,7 +270,7 @@ class Paxos:
             self.uncommitted_v = int(fields["uncommitted_v"])
             self.uncommitted_pn = int(fields["uncommitted_pn"])
             self.uncommitted_value = bytes.fromhex(fields["value"])
-        if len(self._collected) >= self._majority() and \
+        if len(self._collected) >= len(self.quorum) and \
                 self._collect_fut and not self._collect_fut.done():
             await self._finish_collect()
 

@@ -375,10 +375,20 @@ class _LocalConnection:
         return self._reverse
 
     async def send_message(self, msg: Message) -> None:
-        if self.closed or self.peer.stopped:
-            if self.policy.lossy:
-                raise ConnectionError(f"connection to {self.peer_addr} closed")
-            return
+        if self.closed:
+            raise ConnectionError(f"connection to {self.peer_addr} closed")
+        if self.peer.stopped:
+            # lossless reconnect: the peer may have restarted and
+            # re-registered at the same address (daemon revive) — swap to
+            # the live messenger.  A genuinely-down peer is an error the
+            # caller must see: silently dropping turned unreachable
+            # shards into phantom acks.
+            new = Messenger._local_registry.get(self.peer_addr)
+            if new is None or new.stopped:
+                raise ConnectionError(f"peer at {self.peer_addr} is down")
+            self.peer = new
+            self.peer_name = new.name
+            self._reverse = None
         inj = self.messenger.injector
         if inj.drop() or inj.kill_socket():
             dout("ms", 5, f"{self.messenger.name}: injected local drop")

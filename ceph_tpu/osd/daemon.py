@@ -28,7 +28,8 @@ from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..objectstore.memstore import MemStore
 from ..objectstore.store import ObjectStore
-from .ecbackend import EIO, ClientOp, ECBackend, ECError, NONE_OSD
+from .ecbackend import (EIO, ESTALE, ClientOp, ECBackend, ECError, NONE_OSD,
+                        NotActive)
 from .ecutil import StripeInfo
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
@@ -182,7 +183,9 @@ class OSDDaemon(Dispatcher):
         codec = factory_from_profile(profile)
         sinfo = StripeInfo.for_codec(codec, pool.stripe_unit)
         be = ECBackend(pgid, self.whoami, codec, sinfo, self.store,
-                       self._send_to_osd, lambda p=pgid: self._acting(p))
+                       self._send_to_osd, lambda p=pgid: self._acting(p),
+                       min_size=pool.min_size)
+        be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be
 
@@ -199,8 +202,10 @@ class OSDDaemon(Dispatcher):
             await conn.send_message(msg)
         except (ConnectionError, OSError):
             # peer unreachable: tell the mon (reference send_failures
-            # OSD.cc:6667); the mon marks it down after enough reports
-            if self.monc is not None:
+            # OSD.cc:6667); the mon marks it down after enough reports.
+            # Never report while WE are shutting down — a dying daemon's
+            # sends all fail locally and would frame every live peer.
+            if self.monc is not None and self.up:
                 asyncio.ensure_future(
                     self.monc.report_failure(self.whoami, osd))
             raise
@@ -246,6 +251,12 @@ class OSDDaemon(Dispatcher):
         elif t == "pg_rewind_ack":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)
+        elif t == "pg_log":
+            be = self._get_backend(tuple(msg["pgid"]))
+            await conn.send_message(be.handle_pg_log(msg))
+        elif t == "pg_log_ack":
+            be = self._get_backend(tuple(msg["pgid"]))
+            be.handle_pg_info(msg)
         elif t == "osd_ping":
             await conn.send_message(MOSDPingReply({
                 "from_osd": self.whoami, "epoch": self.osdmap.epoch,
@@ -261,10 +272,14 @@ class OSDDaemon(Dispatcher):
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
         be = self._get_backend(pgid)
+        be.last_epoch = self.osdmap.epoch
         outs: "List[dict]" = []
         out_bufs: "List[bytes]" = []
         result = 0
         try:
+            # serve only once the PG is peered for the current acting set
+            # (reference: ops wait for PeeringState Active)
+            await be.ensure_active()
             mutations: "List[ClientOp]" = []
             doff = 0
             for op in msg["ops"]:
@@ -308,6 +323,11 @@ class OSDDaemon(Dispatcher):
                     oid, mutations, reqid=str(msg.get("reqid", "")))
                 outs.append({"op": "commit", "version": list(version),
                              "dlen": 0})
+        except NotActive as e:
+            # wrong primary / mid-peering: the client should wait for a
+            # newer map and resend (reference: requeue on map change)
+            result = -ESTALE
+            outs.append({"error": str(e)})
         except Exception as e:  # noqa: BLE001 — op errors become EIO replies
             if not isinstance(e, (ECError, KeyError)):
                 dout("osd", 0, f"op error: {type(e).__name__}: {e}")

@@ -44,19 +44,25 @@ from .ectransaction import Extent, WritePlan, get_write_plan
 from .extent_cache import ExtentCache
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDPGPush, MOSDPGPushReply,
-                       MPGInfo, MPGQuery, MPGRewind, MPGRewindAck,
-                       pack_buffers, unpack_buffers)
+                       MPGInfo, MPGLog, MPGLogAck, MPGQuery, MPGRewind,
+                       MPGRewindAck, pack_buffers, unpack_buffers)
 from .pglog import LogEntry, PGLog, Version, ZERO, ver
 
 NONE_OSD = -1
 HINFO_KEY = "hinfo_key"      # reference ECUtil.h (xattr carrying HashInfo)
 OI_KEY = "_"                 # reference OI_ATTR (object_info_t xattr)
 PGMETA_OID = "_pgmeta_"      # per-collection pg metadata object
-EIO, ENOENT = 5, 2
+EIO, ENOENT, ESTALE = 5, 2, 116
 
 
 class ECError(Exception):
     pass
+
+
+class NotActive(ECError):
+    """The PG cannot serve I/O right now: wrong primary or unable to
+    peer.  Clients should wait for a newer map and retry (reference: ops
+    sent to a non-primary are dropped and resent on the next epoch)."""
 
 
 @dataclass
@@ -104,6 +110,8 @@ class Op:
     read_data: "Dict[int, np.ndarray]" = field(default_factory=dict)
     reads_pending: bool = False
     pending_commits: "Set[int]" = field(default_factory=set)
+    failed_shards: "Set[int]" = field(default_factory=set)
+    acting: "List[int]" = field(default_factory=list)   # at issue time
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
 
 
@@ -160,7 +168,8 @@ class ECBackend:
                  codec: ErasureCodeInterface, sinfo: ecutil.StripeInfo,
                  store: ObjectStore,
                  send: "Callable[[int, Any], Any]",
-                 get_acting: "Callable[[], List[int]]") -> None:
+                 get_acting: "Callable[[], List[int]]",
+                 min_size: "Optional[int]" = None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -170,6 +179,7 @@ class ECBackend:
         self.get_acting = get_acting
         self.k = codec.get_data_chunk_count()
         self.m = codec.get_coding_chunk_count()
+        self.min_size = min_size if min_size is not None else self.k
         self.extent_cache = ExtentCache()
         # primary pipeline state
         self.waiting_state: "List[Op]" = []
@@ -185,13 +195,31 @@ class ECBackend:
         # reqid -> committed version: client-retry dedup (the reference
         # stores osd_reqid_t in pg log entries for the same purpose)
         self.completed_reqids: "Dict[str, Version]" = {}
-        # peering request/reply correlation (MPGInfo / MPGRewindAck)
+        # peering request/reply correlation (MPGInfo / MPGRewindAck / ...)
         self.pending_queries: "Dict[int, asyncio.Future]" = {}
         self.peering = False
+        self._peer_lock = asyncio.Lock()
+        # the acting set this PG last successfully peered+activated for;
+        # client ops are gated on it matching the current acting set
+        # (reference: a PG serves I/O only in Active, and every interval
+        # change sends it back through Peering — PeeringState.h:654-1240)
+        self.active_acting: "Optional[List[int]]" = None
+        # primary's view of which objects each shard is missing
+        # (reference peer_missing / pg_missing_t): shard -> oid -> version
+        self.peer_missing: "Dict[int, Dict[str, Version]]" = {}
         self._next_tid = 0
         self._lock = asyncio.Lock()
+        self._not_peering = asyncio.Event()
+        self._not_peering.set()
         # shard-local state
         self.pg_log = PGLog()
+        # objects THIS shard is missing (persisted; cleared by pushes)
+        self.local_missing: "Dict[str, Version]" = {}
+        # head before the first gap in our log: set when handle_sub_write
+        # sees a non-contiguous entry (we missed ops while the primary
+        # couldn't reach us); peering treats everything after it as
+        # suspect.  None = log is contiguous.
+        self.log_gap_from: "Optional[Version]" = None
         self.last_epoch = 1
         self._load_pg_meta()
 
@@ -224,12 +252,61 @@ class ECBackend:
                 if "pglog" in kv:
                     self.pg_log = PGLog.from_dict(
                         json.loads(kv["pglog"].decode()))
+                if "missing" in kv:
+                    self.local_missing = {
+                        o: ver(v) for o, v in
+                        json.loads(kv["missing"].decode()).items()}
+                if "gap_from" in kv:
+                    raw = json.loads(kv["gap_from"].decode())
+                    self.log_gap_from = ver(raw) if raw else None
                 return
 
     def _pg_meta_txn(self, t: Transaction, cid: Collection) -> None:
         t.touch(cid, ObjectId(PGMETA_OID))
         t.omap_setkeys(cid, ObjectId(PGMETA_OID), {
-            "pglog": json.dumps(self.pg_log.to_dict()).encode()})
+            "pglog": json.dumps(self.pg_log.to_dict()).encode(),
+            "missing": json.dumps({o: list(v) for o, v in
+                                   self.local_missing.items()}).encode(),
+            "gap_from": json.dumps(
+                list(self.log_gap_from) if self.log_gap_from
+                else None).encode()})
+
+    def _persist_pg_meta(self, shard: int) -> None:
+        cid = self.coll(shard)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        self._pg_meta_txn(t, cid)
+        self.store.apply_transaction(t)
+
+    def _complete_to(self) -> Version:
+        """Newest version our log is known contiguous through — the head,
+        unless we detected a gap (missed sub-writes)."""
+        return (self.log_gap_from if self.log_gap_from is not None
+                else self.pg_log.head)
+
+    # ------------------------------------------------------------- activation
+
+    def is_primary(self) -> bool:
+        acting = self.get_acting()
+        for o in acting:
+            if o != NONE_OSD:
+                return o == self.whoami
+        return False
+
+    async def ensure_active(self) -> None:
+        """Gate client I/O on the PG being peered for the CURRENT acting
+        set (reference: ops wait for PeeringState Active; any interval
+        change re-peers before I/O resumes)."""
+        acting = self.get_acting()
+        if acting == self.active_acting:
+            return
+        if not self.is_primary():
+            raise NotActive(f"osd.{self.whoami} is not primary for "
+                            f"pg {self.pgid}")
+        res = await self.peer(force=False)
+        if res.get("status") not in ("ok", "already"):
+            raise NotActive(f"pg {self.pgid} cannot peer: {res}")
 
     # ------------------------------------------------------- local shard meta
 
@@ -274,11 +351,21 @@ class ECBackend:
             return self.completed_reqids[reqid]
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops))
         op.on_commit = asyncio.get_event_loop().create_future()
-        async with self._lock:
-            self._prepare_plan(op)
-            self.waiting_state.append(op)
-            self.tid_to_op[op.tid] = op
-            await self._check_ops()
+        # peering drains + blocks the pipeline (reference: client ops are
+        # requeued until the PG is Active again).  The peering check must
+        # be re-taken UNDER the lock: a peer() starting between the event
+        # wait and lock acquisition would otherwise miss this op in its
+        # drain and let it fan out mid-rewind.
+        while True:
+            await self._not_peering.wait()
+            async with self._lock:
+                if self.peering:
+                    continue
+                self._prepare_plan(op)
+                self.waiting_state.append(op)
+                self.tid_to_op[op.tid] = op
+                await self._check_ops()
+                break
         version = await op.on_commit
         if reqid:
             self.completed_reqids[reqid] = version
@@ -462,6 +549,7 @@ class ECBackend:
         ECBackend.cc:1939 -> ECTransaction::generate_transactions
         ECTransaction.cc:97 -> encode_and_write :25)."""
         acting = self.get_acting()
+        op.acting = list(acting)
         op.version = (self.last_epoch, self.pg_log.head[1] + 1)
         if op.delete or op.plan.invalidates_cache:
             # barrier op (pipeline drained, see _state_head_ready): drop
@@ -478,18 +566,23 @@ class ECBackend:
             new_oi = ObjectInfo(op.plan.projected_size, op.version)
             hinfo = (ecutil.HashInfo(self.k + self.m) if op.rewrite
                      else self._get_hinfo(op.oid))
-            # a full rewrite starts a fresh crc chain; a pure
+            # crc chain: a full rewrite starts fresh; a pure
             # stripe-aligned append extends it (ECUtil.cc:172); anything
             # else (RMW overwrite, bare truncate) invalidates it
-            is_append = (op.rewrite
-                         or (not op.plan.to_read
-                             and op.truncate_to is None
-                             and hinfo.valid() and len(stripes) == 1
-                             and all(self.sinfo
-                                     .aligned_logical_offset_to_chunk_offset(o)
-                                     == hinfo.total_chunk_size
-                                     for o in stripes)))
-            rollback = ({"append_from": op.oi.size} if is_append
+            extends = (not op.rewrite
+                       and not op.plan.to_read
+                       and op.truncate_to is None
+                       and hinfo.valid() and len(stripes) == 1
+                       and all(self.sinfo
+                               .aligned_logical_offset_to_chunk_offset(o)
+                               == hinfo.total_chunk_size
+                               for o in stripes))
+            is_append = op.rewrite or extends
+            # rollback: truncating back to the old size only undoes a
+            # pure extension; any write that REPLACES existing bytes
+            # (write_full included) needs a generation clone — and for a
+            # create, the absent clone makes the undo a remove
+            rollback = ({"append_from": op.oi.size} if extends
                         else {"clone_gen": op.version[1]})
             for shard in range(self.k + self.m):
                 shard_txns[shard] = {"writes": [],
@@ -554,26 +647,54 @@ class ECBackend:
                 try:
                     await self.send(acting[shard], msg)
                 except (ConnectionError, OSError, ECError) as e:
-                    # shard unreachable: proceed without it — the shard
-                    # is now missing and recovery will repair it (the
-                    # reference lets peering/backfill catch it up)
+                    # shard unreachable: the write is NOT durable there.
+                    # Never count it committed (that would let decode mix
+                    # in a stale chunk later) — record the object missing
+                    # on that shard so reads avoid it and peering repairs
+                    # it (reference: unacked shards are resolved by map
+                    # change + re-peering, PeeringState.h:654-1240).
                     dout("osd", 1, f"sub_write to shard {shard} "
                                    f"(osd.{acting[shard]}) failed: {e}")
-                    self._sub_write_committed(op, shard)
+                    op.failed_shards.add(shard)
+                    op.pending_commits.discard(shard)
+                    self.peer_missing.setdefault(shard, {})[op.oid] = \
+                        op.version
         for shard, msg in local_msgs:
             self.handle_sub_write(msg)
             self._sub_write_committed(op, shard)
+        self._check_commit_queue()
 
     # --- pipeline stage 3: commit --------------------------------------------
 
     def _sub_write_committed(self, op: Op, shard: int) -> None:
         op.pending_commits.discard(shard)
-        if not op.pending_commits:
+        self._check_commit_queue()
+
+    def _check_commit_queue(self) -> None:
+        """Complete ops strictly from the FRONT of waiting_commit
+        (reference try_finish_rmw completes only waiting_commit.front(),
+        ECBackend.cc:2103): an op whose acks arrive early must not
+        advance roll_forward past a still-uncommitted predecessor."""
+        while self.waiting_commit and \
+                not self.waiting_commit[0].pending_commits:
+            op = self.waiting_commit[0]
+            # non-durable = shards whose send failed UNION holes in the
+            # acting set the op was issued under (a shard can be both;
+            # counting twice would spuriously fail a durable write)
+            non_durable = set(op.failed_shards)
+            non_durable |= {s for s, o in enumerate(op.acting)
+                            if s < self.k + self.m and o == NONE_OSD}
+            durable = self.k + self.m - len(non_durable)
+            if durable < self.min_size:
+                self._fail_op(op, ECError(
+                    f"write {op.oid} v{op.version}: only {durable} "
+                    f"shards durable < min_size {self.min_size}"))
+                continue
             self._try_finish_rmw(op)
 
     def _try_finish_rmw(self, op: Op) -> None:
-        """All shards durable (reference try_finish_rmw ECBackend.cc:2103):
-        advance the roll-forward point and complete."""
+        """Head op fully durable (reference try_finish_rmw
+        ECBackend.cc:2103): advance the roll-forward point and complete."""
         self.pg_log.roll_forward_to(op.version)
         if op in self.waiting_commit:
             self.waiting_commit.remove(op)
@@ -631,6 +752,16 @@ class ECBackend:
 
         for e in entries:
             if e.version > self.pg_log.head:
+                if e.version[1] > self.pg_log.head[1] + 1 and \
+                        self.log_gap_from is None:
+                    # non-contiguous: we missed sub-writes (primary
+                    # couldn't reach us).  Everything after this point is
+                    # suspect until peering recovers it; a head-based
+                    # missing computation would silently skip the hole.
+                    self.log_gap_from = self.pg_log.head
+                    dout("osd", 1,
+                         f"shard {shard} log gap after "
+                         f"{self.pg_log.head} (got {e.version})")
                 self.pg_log.add(e)
         reaped = self.pg_log.roll_forward_to(
             ver(msg.get("roll_forward_to", [0, 0])))
@@ -664,8 +795,11 @@ class ECBackend:
             try:
                 st = self.store.stat(cid, sid)
                 for off, length in req["extents"]:
-                    data = bytes(self.store.read(cid, sid, int(off),
-                                                 int(length)))
+                    # length -1 = whole shard (recovery reads don't know
+                    # the object size up front; the store clamps)
+                    data = bytes(self.store.read(
+                        cid, sid, int(off),
+                        None if int(length) < 0 else int(length)))
                     extents_out.append([int(off), len(out_bufs)])
                     out_bufs.append(data)
                 self._verify_shard_crc(cid, sid, shard, st,
@@ -743,6 +877,15 @@ class ECBackend:
         avail = self._avail_shards()
         for s in (exclude or ()):
             avail.pop(s, None)
+        # never read a shard known to be missing/stale for these objects
+        # (reference: missing_loc excludes peers whose pg_missing_t lists
+        # the object)
+        for oid in reads:
+            for s, mset in self.peer_missing.items():
+                if oid in mset:
+                    avail.pop(s, None)
+            if oid in self.local_missing:
+                avail.pop(self.my_shard, None)
         want = (want_to_read if want_to_read is not None
                 else list(range(self.k)))
         try:
@@ -755,6 +898,11 @@ class ECBackend:
         for oid, extents in reads.items():
             chunk_extents: "List[Extent]" = []
             for off, length in extents:
+                if length < 0:
+                    # whole-shard read (recovery): shards clamp to their
+                    # actual extent
+                    chunk_extents.append((0, -1))
+                    continue
                 start, span = self.sinfo.offset_len_to_stripe_bounds(
                     off, length)
                 chunk_extents.append((
@@ -916,31 +1064,21 @@ class ECBackend:
 
     # ============================================================== RECOVERY
 
-    def _recovery_size(self, oid: str, exclude: "Set[int]") -> int:
-        """Upper bound on the object's logical size for the recovery
-        read.  When our own shard is healthy the local object_info is
-        authoritative; when we're the stale one, over-request — shards
-        clamp reads to their actual extent and decode pads."""
-        if self.my_shard not in exclude:
-            return self.object_size(oid)
-        return 1 << 32
-
     async def recover_object(self, oid: str, missing_on: "Set[int]",
                              exclude: "Optional[Set[int]]" = None) -> None:
         """Rebuild ``oid``'s shards on ``missing_on`` (reference
         recover_object ECBackend.cc:738 + continue_recovery_op :570:
         IDLE -> READING -> WRITING -> COMPLETE).  ``exclude`` keeps
         stale shards out of the source reads (recovery may read
-        non-acting shards but never ones missing this object)."""
+        non-acting shards but never ones missing this object).  Reads are
+        whole-shard: sources clamp to their extent, so recovery never
+        trusts the (possibly stale) local object_info for sizing."""
         rop = RecoveryOp(oid=oid, missing_on=set(missing_on))
         rop.done = asyncio.get_event_loop().create_future()
         self.recovery_ops[oid] = rop
         # READING: fetch enough surviving shards to rebuild the missing
         rop.state = RecoveryOp.READING
-        size = self._recovery_size(oid, exclude or set(missing_on))
-        aligned = max(self.sinfo.logical_to_next_stripe_offset(size),
-                      self.sinfo.stripe_width)
-        read = await self._start_read({oid: [(0, aligned)]},
+        read = await self._start_read({oid: [(0, -1)]},
                                       for_recovery=True, want_attrs=True,
                                       want_to_read=sorted(rop.missing_on),
                                       exclude=exclude or set(missing_on))
@@ -1019,6 +1157,8 @@ class ECBackend:
             t.write(cid, sid, int(msg.get("off", 0)), msg.data)
             for name, hexval in msg.get("attrs", {}).items():
                 t.setattr(cid, sid, name, bytes.fromhex(hexval))
+        # the push satisfies our missing record for this object
+        self.local_missing.pop(msg["oid"], None)
         self._pg_meta_txn(t, cid)
         self.store.apply_transaction(t)
         return MOSDPGPushReply({
@@ -1027,10 +1167,13 @@ class ECBackend:
             "oid": msg["oid"], "result": 0})
 
     def handle_push_reply(self, msg: MOSDPGPushReply) -> None:
+        shard = int(msg["shard"])
+        # shard is no longer missing this object
+        self.peer_missing.get(shard, {}).pop(msg["oid"], None)
         rop = self.recovery_ops.get(msg["oid"])
         if rop is None:
             return
-        rop.waiting_on_pushes.discard(int(msg["shard"]))
+        rop.waiting_on_pushes.discard(shard)
         if not rop.waiting_on_pushes and not rop.done.done():
             rop.state = RecoveryOp.COMPLETE
             self.recovery_ops.pop(msg["oid"], None)
@@ -1046,14 +1189,57 @@ class ECBackend:
                        if o.name != PGMETA_OID and o.generation == NO_GEN})
 
     def handle_pg_query(self, msg: MPGQuery) -> MPGInfo:
-        """Shard side: report our log + object list (reference
-        MOSDPGQuery -> MOSDPGNotify/MOSDPGLog exchange)."""
+        """Shard side: report our log, how far it is contiguous, our
+        missing set, and our object list (reference MOSDPGQuery ->
+        MOSDPGNotify/MOSDPGLog exchange)."""
         shard = int(msg["shard"])
         return MPGInfo({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
             "log": self.pg_log.to_dict(),
+            "complete_to": list(self._complete_to()),
+            "missing": {o: list(v)
+                        for o, v in self.local_missing.items()},
             "objects": self._list_objects(shard)})
+
+    def handle_pg_log(self, msg: MPGLog) -> MPGLogAck:
+        """Shard side: adopt the authoritative log and derive our missing
+        set from the delta (reference PGLog::merge_log + pg_missing_t via
+        the GetMissing exchange).  A shard whose contiguous point predates
+        the auth tail backfills: everything in the live object set is
+        missing, and local objects absent from it are stale extras."""
+        shard = int(msg["shard"])
+        auth = PGLog.from_dict(msg["log"])
+        complete = self._complete_to()
+        missing: "Dict[str, Version]" = {}
+        t = Transaction()
+        cid = self.coll(shard)
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        if complete < auth.tail:
+            # backfill: log delta unavailable
+            live = set(msg.get("objects", []))
+            for oid in live:
+                missing[oid] = auth.head
+            for oid in self._list_objects(shard):
+                if oid not in live:
+                    t.remove(cid, ObjectId(oid, shard))
+        else:
+            latest: "Dict[str, LogEntry]" = {}
+            for e in auth.entries:
+                if e.version > complete:
+                    latest[e.oid] = e
+            for oid, e in latest.items():
+                missing[oid] = e.version
+        self.pg_log = auth
+        self.local_missing = missing
+        self.log_gap_from = None
+        self._pg_meta_txn(t, cid)
+        self.store.apply_transaction(t)
+        return MPGLogAck({
+            "pgid": list(self.pgid), "shard": shard,
+            "from_osd": self.whoami, "tid": int(msg["tid"]),
+            "missing": {o: list(v) for o, v in missing.items()}})
 
     def handle_pg_info(self, msg) -> None:
         fut = self.pending_queries.get(int(msg["tid"]))
@@ -1077,6 +1263,10 @@ class ECBackend:
             # (reference falls back to backfill the same way)
             self.pg_log = PGLog()
             div = []
+        if self.log_gap_from is not None \
+                and self.pg_log.head <= self.log_gap_from:
+            # the rewind dropped everything past the gap: contiguous again
+            self.log_gap_from = None
         if not div and not self.store.collection_exists(self.coll(shard)):
             return
         cid = self.coll(shard)
@@ -1153,98 +1343,185 @@ class ECBackend:
         finally:
             self.pending_queries.pop(tid, None)
 
-    async def peer(self) -> dict:
+    async def _send_pg_log(self, shard: int, osd: int, auth_log: PGLog,
+                           objects: "List[str]",
+                           timeout: float = 2.0) -> "Optional[dict]":
+        """Send the auth log to a stale shard; returns its missing set
+        (None if unreachable)."""
+        tid = self.new_tid()
+        payload = {"pgid": list(self.pgid), "shard": shard,
+                   "from_osd": self.whoami, "tid": tid,
+                   "log": auth_log.to_dict(), "objects": list(objects)}
+        if osd == self.whoami:
+            ack = self.handle_pg_log(MPGLog(payload))
+            return {o: ver(v) for o, v in ack["missing"].items()}
+        fut = asyncio.get_event_loop().create_future()
+        self.pending_queries[tid] = fut
+        try:
+            await self.send(osd, MPGLog(payload))
+            ack = await asyncio.wait_for(fut, timeout)
+            return {o: ver(v) for o, v in ack["missing"].items()}
+        except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
+            return None
+        finally:
+            self.pending_queries.pop(tid, None)
+
+    def _drain_in_flight(self, err: "Optional[Exception]" = None) -> None:
+        """Fail every op still in the pipeline (reference: on interval
+        change in-flight ops are requeued; here the client sees EIO and
+        retries against the re-peered PG)."""
+        err = err or NotActive(f"pg {self.pgid}: interval change, "
+                               f"op aborted by peering")
+        for op in (list(self.waiting_state) + list(self.waiting_reads)
+                   + list(self.waiting_commit)):
+            self._fail_op(op, err)
+
+    async def peer(self, force: bool = True) -> dict:
         """Primary: bring every up shard to a consistent, recovered state
-        (the GetInfo -> GetLog -> GetMissing -> Recovering arc of the
-        reference PeeringState machine, PeeringState.h:654-1240,
+        (the GetInfo -> GetLog -> GetMissing -> Recovering -> Active arc
+        of the reference PeeringState machine, PeeringState.h:654-1240,
         compressed into one async routine).
 
-        1. gather log infos from all up shards
-        2. pick the authoritative head: the newest version durable on
-           enough shards to decode (>= k) — anything newer is a partial
-           write that must roll back (EC can't serve it)
-        3. rewind divergent shards (local undo via rollback payloads)
-        4. compute per-shard missing sets from the auth log (or schedule
-           full backfill when a shard's log is too far behind)
-        5. reconstruct + push every missing object
+        1. drain in-flight client ops (interval change)
+        2. gather infos (log + contiguity + missing) from all up shards;
+           refuse to peer with fewer than k respondents — a lower bar
+           could elect an undecodable head and roll back durable writes
+        3. auth head = newest version contiguously durable on >= k
+           shards; anything newer is a partial write that must roll back
+        4. rewind divergent shards (local undo via rollback payloads)
+        5. send the auth log to every stale shard; each adopts it and
+           reports its missing set (backfill when too far behind)
+        6. reconstruct + push every missing object; pushes clear the
+           missing records on both ends
+        7. activate for the current acting set
+
+        ``force=False`` (the ensure_active path) short-circuits when the
+        PG is already active for the current acting set; explicit sweeps
+        (peer_all, map-change handlers) always re-run.
         """
-        if self.peering:
-            return {"status": "already"}
-        self.peering = True
-        try:
-            return await self._do_peer()
-        finally:
-            self.peering = False
+        async with self._peer_lock:
+            if not force and self.get_acting() == self.active_acting:
+                return {"status": "already"}
+            self.peering = True
+            self._not_peering.clear()
+            try:
+                # a map change mid-peer invalidates the run: the new
+                # acting set never got the auth log/pushes.  Re-run
+                # against the fresh set (bounded; give up -> inactive).
+                res: dict = {"status": "interval_changed"}
+                for _ in range(3):
+                    acting = list(self.get_acting())
+                    res = await self._do_peer()
+                    if self.get_acting() != acting:
+                        res = {"status": "interval_changed"}
+                        continue
+                    if res.get("status") == "ok":
+                        self.active_acting = acting
+                    else:
+                        self.active_acting = None
+                    return res
+                self.active_acting = None
+                return res
+            finally:
+                self.peering = False
+                self._not_peering.set()
 
     async def _do_peer(self) -> dict:
+        async with self._lock:
+            self._drain_in_flight()
         up = self._avail_shards()
         infos: "Dict[int, dict]" = {}
         for s, osd in up.items():
             if osd == self.whoami:
                 infos[s] = {"log": self.pg_log.to_dict(),
+                            "complete_to": list(self._complete_to()),
+                            "missing": {o: list(v) for o, v in
+                                        self.local_missing.items()},
                             "objects": self._list_objects(s)}
             else:
                 reply = await self._query_shard(s, osd)
                 if reply is not None:
                     infos[s] = {"log": dict(reply["log"]),
+                                "complete_to": list(
+                                    reply.get("complete_to",
+                                              reply["log"]["head"])),
+                                "missing": dict(reply.get("missing", {})),
                                 "objects": list(reply["objects"])}
-        if not infos:
-            return {"status": "no_infos"}
+        if len(infos) < self.k:
+            # not enough shards to even decide what the data is: stay
+            # inactive (reference marks the PG incomplete/down and
+            # blocks I/O rather than guessing)
+            return {"status": "incomplete", "have": sorted(infos),
+                    "need": self.k}
         heads = {s: ver(infos[s]["log"].get("head", [0, 0]))
                  for s in infos}
-        need = min(self.k, len(infos))
-        candidates = sorted(set(heads.values()), reverse=True)
+        complete = {s: ver(infos[s]["complete_to"]) for s in infos}
+        # auth head = newest version whose log entry >= k shards have
+        # APPLIED (log-contiguity, like the reference's auth-log
+        # selection).  Per-object gaps (missing sets) don't regress it:
+        # rolling back writes that k shards durably applied would lose
+        # acked data; an object k shards can't supply becomes unfound ->
+        # clean EIO instead (reference missing_loc / incomplete).
         auth_head = ZERO
-        for v in candidates:
-            if sum(1 for h in heads.values() if h >= v) >= need:
+        for v in sorted(set(complete.values()), reverse=True):
+            if sum(1 for c in complete.values() if c >= v) >= self.k:
                 auth_head = v
                 break
-        auth_shard = max((s for s in infos if heads[s] >= auth_head),
-                         key=lambda s: (heads[s], -s))
+        auth_shard = max(
+            (s for s in infos if complete[s] >= auth_head),
+            key=lambda s: (complete[s], len(infos[s]["log"]["entries"]),
+                           -s))
         auth_log = PGLog.from_dict(infos[auth_shard]["log"])
-        auth_entries = [e for e in auth_log.entries
-                        if e.version <= auth_head]
+        # truncate the auth log to the decodable head
+        if auth_log.head > auth_head:
+            auth_log.entries = [e for e in auth_log.entries
+                                if e.version <= auth_head]
+            auth_log.head = auth_head
+        auth_log.can_rollback_to = min(auth_log.can_rollback_to,
+                                       auth_head)
+        auth_entries = list(auth_log.entries)
 
-        # rewind anything newer than the decodable head
+        # rewind anything newer than the decodable head (incl. ourselves)
         for s in sorted(infos):
             if heads[s] > auth_head:
                 await self._rewind_shard(s, up[s], auth_head)
-                heads[s] = auth_head
-        # adopt the authoritative log locally if we're behind
-        if self.pg_log.head < auth_head:
-            adopted = PGLog()
-            adopted.tail = auth_log.tail
-            adopted.head = auth_head
-            adopted.can_rollback_to = auth_head
-            adopted.entries = list(auth_entries)
-            self.pg_log = adopted
+                heads[s] = min(heads[s], auth_head)
 
-        # missing objects per shard
+        # live object set + deletions within the auth log window
+        latest: "Dict[str, LogEntry]" = {}
+        for e in auth_entries:
+            latest[e.oid] = e
+        deleted = {oid for oid, e in latest.items() if e.op == "delete"}
         all_objects: "Set[str]" = set()
         for s in infos:
-            if heads[s] >= auth_head:
+            if complete[s] >= auth_head:
                 all_objects.update(infos[s]["objects"])
-        deleted = {e.oid for e in auth_entries if e.op == "delete"
-                   and not any(e2.version > e.version
-                               and e2.oid == e.oid
-                               for e2 in auth_entries)}
-        missing: "Dict[str, Set[int]]" = {}
-        backfill_shards = []
+        all_objects -= deleted
+
+        # stale shards adopt the auth log and report their missing sets
+        self.peer_missing = {}
+        backfill_shards: "List[int]" = []
         for s in sorted(infos):
-            h = heads[s]
-            if h >= auth_head:
-                continue
-            if h < auth_log.tail:
-                backfill_shards.append(s)
-                for oid in all_objects:
-                    missing.setdefault(oid, set()).add(s)
-            else:
-                for e in auth_entries:
-                    if e.version > h:
-                        missing.setdefault(e.oid, set()).add(s)
+            prior = {o: ver(v) for o, v in infos[s]["missing"].items()}
+            if complete[s] < auth_head:
+                if complete[s] < auth_log.tail:
+                    backfill_shards.append(s)
+                got = await self._send_pg_log(s, up[s], auth_log,
+                                              sorted(all_objects))
+                if got is None:
+                    got = prior or {o: auth_head for o in all_objects}
+                self.peer_missing[s] = got
+            elif prior:
+                self.peer_missing[s] = prior
+
+        # recovery: reconstruct + push every missing object
         recovered = failed = 0
-        for oid in sorted(missing):
-            shards = missing[oid]
+        missing_union: "Dict[str, Set[int]]" = {}
+        for s, mset in self.peer_missing.items():
+            for oid in mset:
+                missing_union.setdefault(oid, set()).add(s)
+        for oid in sorted(missing_union):
+            shards = missing_union[oid]
             if oid in deleted or oid not in all_objects:
                 await self._push_delete(oid, shards, up)
                 continue
@@ -1258,7 +1535,8 @@ class ECBackend:
         return {"status": "ok", "auth_head": list(auth_head),
                 "auth_shard": auth_shard, "recovered": recovered,
                 "failed": failed, "backfilled_shards": backfill_shards,
-                "missing": {o: sorted(s) for o, s in missing.items()}}
+                "missing": {o: sorted(s)
+                            for o, s in missing_union.items()}}
 
     async def _push_delete(self, oid: str, shards: "Set[int]",
                            up: "Dict[int, int]") -> None:
@@ -1273,7 +1551,7 @@ class ECBackend:
                 "oid": oid, "version": list(self.pg_log.head),
                 "remove": True, "whole": True, "off": 0, "attrs": {}})
             if osd == self.whoami:
-                self.handle_push(msg)
+                self.handle_push_reply(self.handle_push(msg))
             else:
                 try:
                     await self.send(osd, msg)

@@ -145,6 +145,26 @@ class MPGRewindAck(Message):
     TYPE = "pg_rewind_ack"
 
 
+@register_message
+class MPGLog(Message):
+    """Primary sends the authoritative log to a stale shard, which adopts
+    it and derives its missing set (reference MOSDPGLog.h: the GetLog /
+    GetMissing exchange — peers merge the auth log via
+    PGLog::merge_log and record pg_missing_t).
+
+    fields: pgid, shard, from_osd, tid, log (auth PGLog.to_dict, already
+    truncated to the auth head), objects ([oid...] — the full live object
+    set, for shards so stale they need backfill)."""
+    TYPE = "pg_log"
+
+
+@register_message
+class MPGLogAck(Message):
+    """fields: pgid, shard, from_osd, tid, missing={oid: [epoch,v]} — the
+    shard's computed missing set (reference MOSDPGLog's missing reply)."""
+    TYPE = "pg_log_ack"
+
+
 # --- maps / control ----------------------------------------------------------
 
 

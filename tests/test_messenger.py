@@ -193,8 +193,11 @@ class TestLocalTransport:
             await wait_for(lambda: rcoll.replies)
             assert rcoll.replies[0]["n"] == 7
             await server.shutdown()
-            # sending to a stopped peer: silent for lossless policy
-            await conn.send_message(MTest({"n": 8}))
+            # sending to a stopped peer must surface, not silently drop:
+            # a phantom "sent" is how unreachable shards turned into
+            # acked-but-lost writes
+            with pytest.raises(ConnectionError):
+                await conn.send_message(MTest({"n": 8}))
             await client.shutdown()
 
         run(main())
