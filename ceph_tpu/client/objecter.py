@@ -11,12 +11,11 @@ the defining RADOS trait.
 from __future__ import annotations
 
 import asyncio
-import errno
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common.log import dout
 from ..msg.messenger import Dispatcher, Messenger, Policy
-from ..osd.messages import MOSDOp, MOSDOpReply, unpack_buffers
+from ..osd.messages import ESTALE, MOSDOp, MOSDOpReply, unpack_buffers
 from ..osd.osdmap import NONE_OSD, OSDMap
 
 
@@ -85,7 +84,7 @@ class Objecter(Dispatcher):
                 self._inflight.pop(tid, None)
             outs = list(reply.get("outs", []))
             result = int(reply.get("result", 0))
-            if result == -errno.ESTALE:  # wrong primary / PG peering
+            if result == -ESTALE:  # wrong primary / PG peering
                 last_err = ObjecterError(
                     f"stale target for {oid}: {outs}")
                 await asyncio.sleep(self.backoff * (attempt + 1))

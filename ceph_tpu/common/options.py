@@ -113,6 +113,13 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
            enum_values=("wpq", "mclock"), desc="op scheduler implementation",
            services=("osd",)),
+    Option("osd_ec_batch_max", int, 64, LEVEL_ADVANCED, min=1,
+           desc="max sub-write encodes stacked into one device launch by "
+                "the cross-PG EncodeService"),
+    Option("osd_ec_batch_min_device_bytes", int, 64 << 10, LEVEL_ADVANCED,
+           min=0,
+           desc="batches smaller than this fall back to host encode "
+                "(device dispatch overhead exceeds the kernel)"),
     Option("osd_ec_batch_stripes", int, 64, LEVEL_ADVANCED, min=1,
            desc="stripes batched per device encode launch across PGs "
                 "(TPU amortization knob)", services=("osd",)),

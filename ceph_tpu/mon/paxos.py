@@ -62,6 +62,9 @@ class Paxos:
         self.uncommitted_pn = 0
         self.uncommitted_value: "Optional[bytes]" = None
         self._propose_lock = asyncio.Lock()
+        # pulsed on every applied commit; _finish_collect waits on it
+        # instead of polling while catch-up commits stream in
+        self._commit_applied = asyncio.Event()
 
     # --- helpers --------------------------------------------------------------
 
@@ -94,6 +97,7 @@ class Paxos:
         self._put_value(v, value)
         self.last_committed = v
         self.store["last_committed"] = str(v).encode()
+        self._commit_applied.set()
         self.on_commit(v, value)
 
     # --- election hook --------------------------------------------------------
@@ -147,10 +151,17 @@ class Paxos:
         # committed would diverge the replicated state
         newest = max((int(i.get("last_committed", 0))
                       for i in self._collected.values()), default=0)
-        for _ in range(200):
-            if self.last_committed >= newest:
+        deadline = asyncio.get_event_loop().time() + 2.0
+        while self.last_committed < newest:
+            self._commit_applied.clear()
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
                 break
-            await asyncio.sleep(0.01)
+            try:
+                await asyncio.wait_for(self._commit_applied.wait(),
+                                       remaining)
+            except asyncio.TimeoutError:
+                break
         if self.last_committed < newest:
             raise PaxosError(
                 f"collect: stuck at {self.last_committed} < quorum "

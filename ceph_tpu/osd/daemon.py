@@ -31,6 +31,7 @@ from ..objectstore.store import ObjectStore
 from .ecbackend import (EIO, ESTALE, ClientOp, ECBackend, ECError, NONE_OSD,
                         NotActive)
 from .ecutil import StripeInfo
+from .encode_service import EncodeService
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
                        MOSDPGPushReply, MOSDPing, MOSDPingReply,
@@ -75,6 +76,10 @@ class OSDDaemon(Dispatcher):
         self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
         self.addr = addr or f"local:osd.{osd_id}"
         self.backends: "Dict[Tuple[int, int], ECBackend]" = {}
+        # one cross-PG batched device encode queue per daemon: every
+        # primary this OSD hosts funnels sub-write encodes through it
+        # (BASELINE.json north-star deviation; see osd/encode_service.py)
+        self.encode_service = EncodeService.from_config(self.config)
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
@@ -184,7 +189,8 @@ class OSDDaemon(Dispatcher):
         sinfo = StripeInfo.for_codec(codec, pool.stripe_unit)
         be = ECBackend(pgid, self.whoami, codec, sinfo, self.store,
                        self._send_to_osd, lambda p=pgid: self._acting(p),
-                       min_size=pool.min_size)
+                       min_size=pool.min_size,
+                       encode_service=self.encode_service)
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be

@@ -42,17 +42,16 @@ from ..ops import crc32c as crcmod
 from . import ecutil
 from .ectransaction import Extent, WritePlan, get_write_plan
 from .extent_cache import ExtentCache
-from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
-                       MECSubOpWriteReply, MOSDPGPush, MOSDPGPushReply,
-                       MPGInfo, MPGLog, MPGLogAck, MPGQuery, MPGRewind,
-                       MPGRewindAck, pack_buffers, unpack_buffers)
+from .messages import (EIO, ENOENT, ESTALE, MECSubOpRead, MECSubOpReadReply,
+                       MECSubOpWrite, MECSubOpWriteReply, MOSDPGPush,
+                       MOSDPGPushReply, MPGInfo, MPGLog, MPGLogAck, MPGQuery,
+                       MPGRewind, MPGRewindAck, pack_buffers, unpack_buffers)
 from .pglog import LogEntry, PGLog, Version, ZERO, ver
 
 NONE_OSD = -1
 HINFO_KEY = "hinfo_key"      # reference ECUtil.h (xattr carrying HashInfo)
 OI_KEY = "_"                 # reference OI_ATTR (object_info_t xattr)
 PGMETA_OID = "_pgmeta_"      # per-collection pg metadata object
-EIO, ENOENT, ESTALE = 5, 2, 116
 
 
 class ECError(Exception):
@@ -169,7 +168,8 @@ class ECBackend:
                  store: ObjectStore,
                  send: "Callable[[int, Any], Any]",
                  get_acting: "Callable[[], List[int]]",
-                 min_size: "Optional[int]" = None) -> None:
+                 min_size: "Optional[int]" = None,
+                 encode_service=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -180,6 +180,9 @@ class ECBackend:
         self.k = codec.get_data_chunk_count()
         self.m = codec.get_coding_chunk_count()
         self.min_size = min_size if min_size is not None else self.k
+        # daemon-shared cross-PG batched device encode queue (None =
+        # direct host/codec calls, the reference's per-op behavior)
+        self.encode_service = encode_service
         self.extent_cache = ExtentCache()
         # primary pipeline state
         self.waiting_state: "List[Op]" = []
@@ -520,7 +523,11 @@ class ECBackend:
 
     async def _try_reads_to_commit(self) -> None:
         op = self.waiting_reads.pop(0)
-        self.waiting_commit.append(op)
+        # op joins waiting_commit inside _issue_sub_writes only AFTER the
+        # (possibly awaited, batched-device) encode completes: an op
+        # sitting in waiting_commit with an empty pending_commits set
+        # would look fully-acked to a concurrently-running
+        # _check_commit_queue and be completed before any shard was sent
         await self._issue_sub_writes(op)
 
     def _materialize_stripes(self, op: Op) -> "Dict[int, np.ndarray]":
@@ -589,13 +596,26 @@ class ECBackend:
                                      "oi": new_oi.encode().hex(),
                                      "rollback": rollback}
             for off, buf in sorted(stripes.items()):
-                shards = ecutil.encode(self.sinfo, self.codec, buf)
+                crcs = None
+                if self.encode_service is not None:
+                    # daemon-wide batched device encode: this op's stripes
+                    # ride one (B, k, W) launch with every other PG's
+                    # pending sub-writes, crc32c fused on device
+                    allc, crcs = await self.encode_service.encode(
+                        self.sinfo, self.codec, buf, with_crc=is_append)
+                    shards = {s: allc[s] for s in range(self.k + self.m)}
+                else:
+                    shards = ecutil.encode(self.sinfo, self.codec, buf)
                 chunk_off = \
                     self.sinfo.aligned_logical_offset_to_chunk_offset(off)
                 if is_append:
-                    hinfo.append(chunk_off,
-                                 {s: np.asarray(c) for s, c in
-                                  shards.items()})
+                    if crcs is not None:
+                        hinfo.append_crcs(chunk_off, crcs,
+                                          allc.shape[1])
+                    else:
+                        hinfo.append(chunk_off,
+                                     {s: np.asarray(c) for s, c in
+                                      shards.items()})
                 else:
                     hinfo.invalidate()
                 for shard, chunk in shards.items():
@@ -621,8 +641,11 @@ class ECBackend:
                          "delete" if op.delete else "modify",
                          prior_version=op.oi.version, rollback=rollback)
 
+        # encode done — now (atomically w.r.t. the event loop) enter the
+        # commit stage with the full pending set before any send awaits
         op.pending_commits = {s for s in range(self.k + self.m)
                               if s < len(acting) and acting[s] != NONE_OSD}
+        self.waiting_commit.append(op)
         # fan out remotes first, then apply locally (reference sends
         # MOSDECSubOpWrite then calls handle_sub_write on itself)
         local_msgs = []

@@ -217,6 +217,30 @@ class HashInfo:
                 self.cumulative_shard_hashes[shard])
         self.total_chunk_size += sizes.pop()
 
+    def append_crcs(self, old_size: int, chunk_crcs: "Sequence[int]",
+                    chunk_len: int) -> None:
+        """Chain device-computed per-shard chunk crc32cs (seed-0,
+        finalized — what the fused encode+crc kernel returns) into the
+        cumulative hashes without re-reading the bytes.
+
+        By GF(2) linearity of the crc register update,
+        ``crc32c(chunk, seed=s) == crc32c_combine(s, crc32c(chunk, 0),
+        len(chunk))`` — the identity that makes the TPU-fused crc
+        chainable into the reference's cumulative HashInfo (ECUtil.cc:172)
+        with O(1) host work per shard.
+        """
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"append at {old_size} != current size {self.total_chunk_size}")
+        if len(chunk_crcs) != len(self.cumulative_shard_hashes):
+            raise ValueError(
+                f"append of {len(chunk_crcs)} shard crcs, expected "
+                f"{len(self.cumulative_shard_hashes)}")
+        for shard, c in enumerate(chunk_crcs):
+            self.cumulative_shard_hashes[shard] = crcmod.crc32c_combine(
+                self.cumulative_shard_hashes[shard], int(c), chunk_len)
+        self.total_chunk_size += chunk_len
+
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 

@@ -1,0 +1,191 @@
+"""Per-daemon batched device encode service — the cross-PG TPU pipeline.
+
+The reference encodes once per op on the host inside the write path
+(src/osd/ECUtil.cc:120 loops stripes; src/osd/ECTransaction.cc:25
+encode_and_write per extent).  On TPU a per-op dispatch wastes the MXU:
+launch latency (~20-30 us) dwarfs the kernel for small writes and every op
+pays its own host->HBM transfer.  This service is the BASELINE.json "north
+star" deviation: ALL primaries on one daemon funnel their sub-write
+encodes here, requests with the same coding matrix and chunk width are
+stacked into one (B, k, W) launch of the fused encode+crc32c step
+(JaxRS.encode_device -> models/pipeline semantics), and results fan back
+out to each PG's pipeline.
+
+Batching windows arise naturally from asyncio: requests that are runnable
+in the same event-loop pass coalesce, and while one batch is on the
+device, new arrivals queue for the next — an async double buffer.  The
+crc32c of each chunk comes back fused from the device (seed-0 finalized)
+and is chained into the cumulative per-shard HashInfo via the GF(2)
+combine identity (ecutil.HashInfo.append_crcs), so the host never touches
+the parity bytes for hashing.
+
+Codecs that lack a device path (lrc/shec/clay orchestration layers) and
+sub-threshold batches fall back to the host ``encode_chunks`` call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ec.interface import ErasureCodeInterface
+from .ecutil import StripeInfo
+
+# Pad batch depth to the next power of two (bounded by max_batch) so the
+# number of distinct compiled shapes stays small; zero-stripe padding is
+# free for a linear code and the pad rows are sliced away.
+def _bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max(cap, 1))
+
+
+class _Request:
+    __slots__ = ("data", "with_crc", "future")
+
+    def __init__(self, data: np.ndarray, with_crc: bool,
+                 future: "asyncio.Future") -> None:
+        self.data = data            # (k, W) uint8, W % 4 == 0
+        self.with_crc = with_crc
+        self.future = future
+
+
+class EncodeService:
+    """Gathers encode requests across PGs into batched device launches.
+
+    One instance per OSD daemon (shared by every ECBackend it hosts).
+    ``encode`` is the entry point; it returns ``(allchunks, crcs)`` where
+    ``allchunks`` is the (k+m, W) uint8 array of data+parity rows and
+    ``crcs`` is a (k+m,) uint32 vector of seed-0 chunk crc32cs (None on
+    the host fallback path, where the caller hashes as before).
+    """
+
+    def __init__(self, max_batch: int = 64,
+                 min_device_bytes: int = 64 * 1024) -> None:
+        self.max_batch = max(1, int(max_batch))
+        self.min_device_bytes = int(min_device_bytes)
+        self._pending: "Dict[Tuple, List[_Request]]" = {}
+        self._codecs: "Dict[Tuple, ErasureCodeInterface]" = {}
+        self._flusher: "Optional[asyncio.Task]" = None
+        self.stats = {
+            "requests": 0,          # total encode() calls
+            "device_batches": 0,    # device launches
+            "device_requests": 0,   # requests served by a device launch
+            "host_requests": 0,     # host-fallback requests
+            "max_batch": 0,         # largest batch depth observed
+        }
+
+    @classmethod
+    def from_config(cls, config) -> "EncodeService":
+        try:
+            return cls(max_batch=int(config.get("osd_ec_batch_max")),
+                       min_device_bytes=int(
+                           config.get("osd_ec_batch_min_device_bytes")))
+        except Exception:
+            return cls()
+
+    # --- public entry ---------------------------------------------------------
+
+    async def encode(self, sinfo: StripeInfo, codec: ErasureCodeInterface,
+                     data: "bytes | np.ndarray", with_crc: bool = True
+                     ) -> "Tuple[np.ndarray, Optional[np.ndarray]]":
+        """Encode a stripe-aligned buffer into all k+m shard rows.
+
+        Equivalent to ``ecutil.encode(sinfo, codec, data)`` (same row
+        convention: row s is what acting position s stores) but routed
+        through the shared batch queue when the codec has a device path.
+        """
+        self.stats["requests"] += 1
+        arr = (np.frombuffer(bytes(data), dtype=np.uint8)
+               if not isinstance(data, np.ndarray) else data.reshape(-1))
+        shards = sinfo.split_to_shards(arr)          # (k, W)
+        W = shards.shape[1]
+        enc_dev = getattr(codec, "encode_device", None)
+        if enc_dev is None or W % 4 != 0:
+            return self._host_encode(codec, shards), None
+        # requests batch by (coding matrix, chunk width): any codec
+        # instance with the same matrix shares the compiled device step
+        key = (codec._C.tobytes(), W)               # type: ignore[attr-defined]
+        fut: "asyncio.Future" = asyncio.get_event_loop().create_future()
+        self._pending.setdefault(key, []).append(
+            _Request(shards, with_crc, fut))
+        self._codecs[key] = codec
+        if self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.ensure_future(self._flush_loop())
+        return await fut
+
+    def _host_encode(self, codec: ErasureCodeInterface,
+                     shards: np.ndarray) -> np.ndarray:
+        self.stats["host_requests"] += 1
+        parity = np.asarray(codec.encode_chunks(shards))
+        return np.concatenate([shards, parity], axis=0)
+
+    # --- flusher --------------------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        # Two zero-sleeps: let every coroutine that is currently runnable
+        # (other PG pipelines mid-submit) reach its encode() call and
+        # join this window before the first batch is cut.
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        while self._pending:
+            key = max(self._pending, key=lambda k: len(self._pending[k]))
+            reqs = self._pending.pop(key)
+            codec = self._codecs[key]
+            while reqs:
+                chunk, reqs = reqs[:self.max_batch], reqs[self.max_batch:]
+                try:
+                    await self._run_batch(codec, key, chunk)
+                except Exception as e:  # noqa: BLE001 — fail the waiters
+                    for r in chunk:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+            # while the batch ran on device, new arrivals queued; loop
+            await asyncio.sleep(0)
+
+    async def _run_batch(self, codec: ErasureCodeInterface, key,
+                         reqs: "List[_Request]") -> None:
+        _c_bytes, W = key
+        B = len(reqs)
+        self.stats["max_batch"] = max(self.stats["max_batch"], B)
+        total = B * codec.get_data_chunk_count() * W
+        if total < self.min_device_bytes:
+            for r in reqs:
+                out = self._host_encode(codec, r.data)
+                if not r.future.done():
+                    r.future.set_result((out, None))
+            return
+
+        k = codec.get_data_chunk_count()
+        m = codec.get_coding_chunk_count()
+        Bb = _bucket(B, self.max_batch)
+        batch = np.zeros((Bb, k, W), dtype=np.uint8)
+        for i, r in enumerate(reqs):
+            batch[i] = r.data
+        with_crc = any(r.with_crc for r in reqs)
+        u32 = batch.view(np.uint32).reshape(Bb, k, W // 4)
+
+        parity_dev, crcs_dev = codec.encode_device(u32, with_crc=with_crc)
+        loop = asyncio.get_event_loop()
+        # np.asarray blocks on the device; do it off-loop so other PGs
+        # keep filling the next batch (async double buffer).
+        if with_crc:
+            parity, crcs = await loop.run_in_executor(
+                None, lambda: (np.asarray(parity_dev), np.asarray(crcs_dev)))
+        else:
+            parity = await loop.run_in_executor(
+                None, lambda: np.asarray(parity_dev))
+            crcs = None
+        self.stats["device_batches"] += 1
+        self.stats["device_requests"] += B
+
+        pu8 = parity.view(np.uint8).reshape(Bb, m, W)
+        for i, r in enumerate(reqs):
+            allc = np.concatenate([r.data, pu8[i]], axis=0)
+            c = (np.asarray(crcs[i], dtype=np.uint32)
+                 if (crcs is not None and r.with_crc) else None)
+            if not r.future.done():
+                r.future.set_result((allc, c))

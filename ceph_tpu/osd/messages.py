@@ -21,6 +21,12 @@ import numpy as np
 
 from ..msg.message import Message, register_message
 
+# Wire errno values carried in MOSDOpReply.result — fixed Linux numbers
+# (the reference wire protocol encodes Linux errnos regardless of the
+# host platform; comparing against the platform's ``errno`` module would
+# mis-route replies on BSD/Darwin where ESTALE is 70).
+EIO, ENOENT, ESTALE = 5, 2, 116
+
 
 def pack_buffers(bufs: "List[bytes]") -> "Tuple[List[int], bytes]":
     """Pack buffers into one data segment; returns (lengths, blob)."""

@@ -1,0 +1,113 @@
+"""Cross-PG batched device encode service (osd/encode_service.py).
+
+The north-star OSD integration (BASELINE.json): sub-write encodes from
+many PG pipelines stack into one fused encode+crc device launch.  Checks
+byte-equality against the direct host path (ecutil.encode), crc chain
+equivalence against the host HashInfo, batching evidence via service
+stats, and the end-to-end MiniCluster path actually exercising it.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import factory_from_profile
+from ceph_tpu.ops import crc32c as crcmod
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.encode_service import EncodeService
+from ceph_tpu.osd.ecutil import HashInfo, StripeInfo
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_codec(k=4, m=2):
+    return factory_from_profile({"plugin": "jax_rs", "k": str(k),
+                                 "m": str(m)})
+
+
+def test_append_crcs_matches_append():
+    """Device-crc chaining (combine identity) == host byte hashing."""
+    rng = np.random.default_rng(7)
+    hi_host, hi_dev = HashInfo(3), HashInfo(3)
+    off = 0
+    for _ in range(3):
+        chunks = {s: rng.integers(0, 256, 512, dtype=np.uint8)
+                  for s in range(3)}
+        hi_host.append(off, chunks)
+        crcs = [crcmod.crc32c(chunks[s], 0) for s in range(3)]
+        hi_dev.append_crcs(off, crcs, 512)
+        off += 512
+    assert hi_host == hi_dev
+
+
+def test_device_batch_matches_host_path(loop):
+    async def go():
+        codec = make_codec()
+        sinfo = StripeInfo.for_codec(codec, 256)
+        svc = EncodeService(max_batch=8, min_device_bytes=0)
+        rng = np.random.default_rng(1)
+        bufs = [rng.integers(0, 256, sinfo.stripe_width * 2, dtype=np.uint8)
+                for _ in range(5)]
+
+        outs = await asyncio.gather(
+            *(svc.encode(sinfo, codec, b, with_crc=True) for b in bufs))
+
+        for buf, (allc, crcs) in zip(bufs, outs):
+            want = ecutil.encode(sinfo, codec, buf)
+            for s in range(6):
+                assert bytes(allc[s]) == bytes(want[s].tobytes()), f"shard {s}"
+            assert crcs is not None
+            for s in range(6):
+                assert int(crcs[s]) == crcmod.crc32c(allc[s], 0), f"crc {s}"
+        assert svc.stats["device_batches"] >= 1
+        assert svc.stats["device_requests"] == 5
+        assert svc.stats["max_batch"] >= 2  # concurrent requests coalesced
+    loop.run_until_complete(go())
+
+
+def test_host_fallback_below_threshold(loop):
+    async def go():
+        codec = make_codec()
+        sinfo = StripeInfo.for_codec(codec, 256)
+        svc = EncodeService(max_batch=8, min_device_bytes=1 << 30)
+        buf = np.arange(sinfo.stripe_width, dtype=np.uint8)
+        allc, crcs = await svc.encode(sinfo, codec, buf)
+        assert crcs is None
+        want = ecutil.encode(sinfo, codec, buf)
+        for s in range(6):
+            assert bytes(allc[s]) == bytes(want[s].tobytes())
+        assert svc.stats["host_requests"] == 1
+        assert svc.stats["device_batches"] == 0
+    loop.run_until_complete(go())
+
+
+def test_cluster_writes_ride_the_batch_queue(loop):
+    """Concurrent client writes to many PGs batch on the primary's
+    daemon-wide service and round-trip byte-equal."""
+    async def go():
+        async with MiniCluster(n_osds=6) as c:
+            c.create_ec_pool("p", pg_num=8, stripe_unit=512)
+            # force the device path even for the tiny test payloads
+            for osd in c.osds.values():
+                osd.encode_service.min_device_bytes = 0
+            client = await c.client()
+            io = client.io_ctx("p")
+            payloads = {f"obj-{i}": bytes([i % 251]) * 4096
+                        for i in range(12)}
+            await asyncio.gather(*(io.write_full(oid, data)
+                                   for oid, data in payloads.items()))
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+            assert sum(o.encode_service.stats["device_requests"]
+                       for o in c.osds.values()) > 0
+            assert max(o.encode_service.stats["max_batch"]
+                       for o in c.osds.values()) >= 2
+    loop.run_until_complete(go())
