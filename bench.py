@@ -2,16 +2,27 @@
 """Headline benchmark: fused RS(k=8,m=3) encode + crc32c over 1 MiB stripes.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, ...}
 
-- value: data throughput (GiB/s of input data) of the flagship fused
-  encode+crc pipeline (ceph_tpu.models.make_encode_step) on the default
-  JAX backend, batch of 8 stripes resident on device.
-- baseline: the same work on the host via the native C++ library
-  (SWAR encode + slicing-by-8 crc32c, single thread) — the stand-in for
-  the reference's ISA-L/jerasure CPU path (BASELINE.md protocol:
-  k=8, m=3, 1 MiB stripe = 128 KiB chunks).
-- vs_baseline = value / baseline.
+- value: data throughput (GiB/s of input) of the flagship fused encode+crc
+  pipeline (ceph_tpu.models.make_encode_step) on the default JAX backend,
+  batch of 8 stripes resident on device — the same fused step the OSD's
+  cross-PG EncodeService launches (osd/encode_service.py).
+- baseline: a MODELED 96-core ISA-L-class host (BASELINE.md: ">=8x vs
+  ISA-L on a 96-core host").  We measure this host's per-core rate of the
+  native AVX2 split-nibble encode + SSE4.2 hw-crc32c (native/ec_native.cpp
+  ec_encode_mt — the same vpshufb technique ISA-L uses), then model the
+  96-core aggregate as min(percore x 96, DRAM ceiling).  The DRAM ceiling
+  assumes a dual-socket DDR4 host of the reference's era (~280 GB/s raw;
+  encode traffic = 1 read + m/k writes per input byte -> /1.375).  Both
+  terms are reported so the multiplier is auditable.  This replaces the
+  round-1 baseline (single-thread SWAR, ~0.2 GiB/s) which inflated
+  vs_baseline ~1600x.
+- vs_baseline = value / baseline_96core_model.
+
+The five-config BASELINE.md sweep (encode size sweep, decode w/ 1-2
+erasures, cauchy k=10 m=4, LRC k=8 m=4 l=4) lives in
+tools/baseline_sweep.py -> BENCH_SWEEP.json.
 
 Robustness: if the TPU backend cannot initialize within a timeout (tunnel
 down), falls back to the JAX CPU backend so a result line is always
@@ -19,7 +30,6 @@ produced (the JSON then reflects CPU-vs-native throughput).
 """
 
 from __future__ import annotations
-
 
 import ctypes
 import json
@@ -31,8 +41,13 @@ import numpy as np
 
 K, M = 8, 3
 CHUNK_BYTES = 128 * 1024       # 1 MiB stripe / k=8
-BATCH = 8
-TRIALS = 30
+BATCH = 64                     # EncodeService max_batch default: the
+                               # cross-PG operating point of the OSD
+
+BASELINE_CORES = 96            # BASELINE.md protocol host
+# Dual-socket DDR4-2933 x 12ch ~ 280 GB/s; encode+crc moves ~1.375 bytes
+# per input byte (read k, write m, crc in-cache) -> input-rate ceiling.
+BASELINE_DRAM_GIBS = 280e9 / 1.375 / 2**30
 
 
 def _init_jax_with_timeout(timeout_s: float = 90.0):
@@ -64,35 +79,37 @@ def _init_jax_with_timeout(timeout_s: float = 90.0):
 
 
 def bench_device() -> "tuple[float, str]":
+    """Fused encode+crc rate, measured with the dependency-chained
+    on-device loop (utils/devtime.py): per-dispatch block_until_ready
+    timing over the remote TPU tunnel returns on enqueue, not
+    completion, and reports physically impossible rates."""
     jax, platform = _init_jax_with_timeout()
     from ceph_tpu.models import example_batch, make_encode_step
+    from ceph_tpu.utils.devtime import chained_time
 
-    step = make_encode_step(K, M)
+    step = make_encode_step(K, M)   # THE step the EncodeService launches
+
+    def body(i, d):
+        parity, crcs = step(d)
+        d = d.at[:, :M, :].set(d[:, :M, :] ^ parity)
+        return d.at[:, 0, 0].set(d[:, 0, 0] ^ crcs[:, 0])
+
     data = jax.device_put(example_batch(BATCH, K, CHUNK_BYTES))
-    # Warm-up compile.
-    parity, crcs = step(data)
-    parity.block_until_ready()
-
-    best = []
-    for _ in range(TRIALS):
-        t0 = time.perf_counter()
-        parity, crcs = step(data)
-        parity.block_until_ready()
-        best.append(time.perf_counter() - t0)
-    dt = float(np.median(best))
+    jax.block_until_ready(data)
+    dt = chained_time(body, data)
     nbytes = BATCH * K * CHUNK_BYTES
     return nbytes / dt / 2 ** 30, platform
 
 
-def bench_native_baseline() -> float:
-    """Single-thread C++ SWAR encode + crc32c over the same work."""
+def bench_native_percore() -> float:
+    """Measured per-core host rate: AVX2 table encode + hw crc32c over
+    data+parity (ec_encode_mt with_crc=1), k=8 m=3, 1 MiB chunks."""
     from ceph_tpu.ops import gf8
     from ceph_tpu.utils import native
 
     lib = native.get_lib()
     rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, size=(K, CHUNK_BYTES), dtype=np.uint8) \
-        .astype(np.uint8)
+    data = rng.integers(0, 256, size=(K, CHUNK_BYTES), dtype=np.uint8)
     out = np.zeros((M, CHUNK_BYTES), dtype=np.uint8)
     C = np.ascontiguousarray(gf8.generator_matrix(K, M)[K:])
 
@@ -103,22 +120,19 @@ def bench_native_baseline() -> float:
             gf8.gf_mat_encode(C, data)
         return K * CHUNK_BYTES * 4 / (time.perf_counter() - t0) / 2 ** 30
 
-    dptrs = (ctypes.c_char_p * K)(*[data[j].ctypes.data for j in range(K)])
-    optrs = (ctypes.c_char_p * M)(*[out[i].ctypes.data for i in range(M)])
+    dptrs = (ctypes.c_char_p * K)(
+        *[ctypes.cast(data[j].ctypes.data, ctypes.c_char_p)
+          for j in range(K)])
+    optrs = (ctypes.c_char_p * M)(
+        *[ctypes.cast(out[i].ctypes.data, ctypes.c_char_p)
+          for i in range(M)])
     cbuf = C.tobytes()
 
-    crc_ptrs = [ctypes.cast(data[j].ctypes.data, ctypes.c_char_p)
-                for j in range(K)]
-    crc_ptrs += [ctypes.cast(out[i].ctypes.data, ctypes.c_char_p)
-                 for i in range(M)]
-
     def one_pass():
-        lib.ec_encode_swar(cbuf, M, K, dptrs, optrs, CHUNK_BYTES)
-        for p in crc_ptrs:
-            lib.ec_crc32c(0, p, CHUNK_BYTES)
+        lib.ec_encode_mt(cbuf, M, K, dptrs, optrs, CHUNK_BYTES, 1, 1)
 
     one_pass()  # warm
-    reps = 8  # ~1 MiB stripes x8 ~ same work per trial as the device batch
+    reps = 8
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
@@ -130,13 +144,20 @@ def bench_native_baseline() -> float:
 
 
 def main() -> int:
-    baseline = bench_native_baseline()
+    percore = bench_native_percore()
+    baseline = min(percore * BASELINE_CORES, BASELINE_DRAM_GIBS)
     value, platform = bench_device()
     print(json.dumps({
         "metric": f"ec_encode_crc32c_k{K}m{M}_1MiB_stripe_{platform}",
         "value": round(value, 3),
         "unit": "GiB/s",
         "vs_baseline": round(value / baseline, 2) if baseline > 0 else None,
+        "baseline_model": {
+            "percore_measured_gibs": round(percore, 3),
+            "cores": BASELINE_CORES,
+            "dram_ceiling_gibs": round(BASELINE_DRAM_GIBS, 1),
+            "baseline_96core_gibs": round(baseline, 1),
+        },
     }))
     return 0
 

@@ -84,15 +84,22 @@ def _device_encode_step(c_bytes: bytes, m: int, k: int, with_crc: bool):
     def run(d):
         if d.ndim == 2:
             parity = gf_jax.gf_mat_encode_u32(C, d)
-            cat = jnp.concatenate([d, parity], axis=0)
         else:
             parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(d)
-            cat = jnp.concatenate([d, parity], axis=1)
         if not with_crc:
             return parity, None
-        flat = cat.reshape(-1, cat.shape[-1])
-        crcs = crc_ops.crc32c_words_jax(flat)
-        return parity, crcs.reshape(cat.shape[:-1])
+        # crc data and parity separately (concatenating would
+        # materialize an extra full copy of the batch in HBM)
+        W = d.shape[-1]
+        dcrc = crc_ops.crc32c_words_jax(d.reshape(-1, W))
+        pcrc = crc_ops.crc32c_words_jax(parity.reshape(-1, W))
+        if d.ndim == 2:
+            crcs = jnp.concatenate([dcrc, pcrc])
+        else:
+            crcs = jnp.concatenate(
+                [dcrc.reshape(d.shape[0], k), pcrc.reshape(d.shape[0], m)],
+                axis=1)
+        return parity, crcs
 
     return run
 

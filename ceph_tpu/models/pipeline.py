@@ -32,11 +32,16 @@ def make_encode_step(k: int, m: int, technique: str = "reed_sol_van",
     def step(data_u32: jax.Array):
         """(B, k, W) uint32 -> ((B, m, W) parity, (B, k+m) crcs)."""
         parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(data_u32)
-        allc = jnp.concatenate([data_u32, parity], axis=1)
-        B, n, W = allc.shape
+        B, _, W = data_u32.shape
         seg = crc_seg_words if W % crc_seg_words == 0 else 1
-        crcs = crc_ops.crc32c_words_jax(allc.reshape(B * n, W), seg_words=seg)
-        return parity, crcs.reshape(B, n)
+        # crc data and parity separately: a concatenate would
+        # materialize an extra (k+m)/k copy of the batch in HBM
+        dcrc = crc_ops.crc32c_words_jax(
+            data_u32.reshape(B * k, W), seg_words=seg)
+        pcrc = crc_ops.crc32c_words_jax(
+            parity.reshape(B * m, W), seg_words=seg)
+        return parity, jnp.concatenate(
+            [dcrc.reshape(B, k), pcrc.reshape(B, m)], axis=1)
 
     return step
 

@@ -1,0 +1,73 @@
+"""Tunnel-safe device timing.
+
+Under remote-attached accelerators (the axon tunnel), ``block_until_ready``
+can return once the op is enqueued remotely, and per-dispatch wall times
+include a network RTT that dwarfs the kernel — naive timing reports
+physically impossible rates (we measured "17 PB/s").  The honest recipe:
+
+1. chain N iterations on-device in one ``lax.fori_loop`` dispatch (each
+   iteration's output feeds the next, so nothing reorders or overlaps),
+2. return a FULL reduction of the final carry (a sliced element lets XLA
+   dead-code-eliminate the work; a reduction keeps every element live),
+3. fetch that scalar to host (forces true completion, 4-byte transfer),
+4. time two iteration counts and divide the difference — constant costs
+   (dispatch, tunnel RTT, the reduction itself) cancel.
+
+Calibration on the attached chip with this recipe: uint32 x+1 over
+256 MiB -> ~600 GiB/s read+write; 4k bf16 matmul -> ~130 TFLOP/s — v5e-
+class numbers, vs "600 TiB/s" from naive block_until_ready timing.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable
+
+import numpy as np
+
+
+def chained_time(body: "Callable[[Any, Any], Any]", x0,
+                 iters_lo: int = 2, iters_hi: int = 22,
+                 reps: int = 3, min_signal_s: float = 1.0) -> float:
+    """Seconds per iteration of ``body`` (a fori_loop body taking
+    (i, carry) -> carry), measured dependency-chained on device.
+
+    Adaptive: if the (hi - lo) wall-time difference is below
+    ``min_signal_s`` (tunnel jitter would swamp it), iters_hi doubles and
+    the measurement repeats, so fast kernels get enough chained work.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames="n")
+    def run(x, n):
+        out = jax.lax.fori_loop(0, n, body, x)
+        # value is irrelevant; full-array sums keep every element live
+        return sum(jnp.sum(leaf).astype(jnp.float32)
+                   for leaf in jax.tree_util.tree_leaves(out))
+
+    def once(n):
+        return float(np.asarray(run(x0, n)))
+
+    once(iters_lo)
+    while True:
+        once(iters_hi)
+        los, his = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            once(iters_lo)
+            los.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            once(iters_hi)
+            his.append(time.perf_counter() - t0)
+        diff = min(his) - min(los)
+        if diff >= min_signal_s or iters_hi >= 4096:
+            break
+        iters_hi = iters_hi * 2
+    if diff <= 0:
+        # jitter swamped even the largest chain: report the full hi run
+        # per iteration — a conservative (slow-side) bound, never the
+        # impossible fast-side rates this module exists to prevent
+        return min(his) / iters_hi
+    return diff / (iters_hi - iters_lo)

@@ -64,6 +64,13 @@ def get_lib():
         lib.ec_region_xor.restype = None
         lib.ec_region_xor.argtypes = [PP, ctypes.c_int, ctypes.c_char_p,
                                       ctypes.c_size_t]
+        lib.ec_encode_tbl.restype = None
+        lib.ec_encode_tbl.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int, PP, PP, ctypes.c_size_t]
+        lib.ec_encode_mt.restype = None
+        lib.ec_encode_mt.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int, PP, PP, ctypes.c_size_t,
+                                     ctypes.c_int, ctypes.c_int]
         _lib = lib
     return _lib
 

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Full BASELINE.md benchmark sweep -> BENCH_SWEEP.json.
+
+Produces every configuration the baseline protocol names (BASELINE.md
+"Benchmark configurations to reproduce"):
+
+  1. reed_sol_van k=4 m=2, 1 MiB buffer          (canonical isa invocation)
+  2. reed_sol_van k=8 m=3 encode, stripe sweep 64 KiB - 4 MiB
+  3. reed_sol_van k=8 m=3 decode, 1 and 2 erasures
+  4. cauchy_good  k=10 m=4 encode/decode
+  5. LRC k=8 m=4 l=4 encode (layered code as one fused matrix)
+
+For each config two rates are reported:
+- device_gibs: the fused device-resident pipeline (models.make_encode_step
+  / make_decode_step semantics — what the OSD's EncodeService launches),
+  median of 20 timed steps, batch of 8 stripes.
+- host_percore_gibs: the native AVX2 split-nibble + hw-crc32c path
+  (native/ec_native.cpp ec_encode_mt, ISA-L's technique), one core.
+plus the modeled 96-core aggregate (same model as bench.py: min(percore x
+96, DRAM ceiling)) and vs_baseline against it.
+
+Decode configs verify byte-equality of the reconstruction before timing
+(the reference's exhaustive-erasure gate does the same check,
+ceph_erasure_code_benchmark.cc:202-249; the full exhaustive sweep runs in
+tests/test_ec_codec.py).
+
+LRC: every parity of a layered linear code is a GF-linear function of the
+k data chunks, so the whole layered encode collapses to one (m_total, k)
+matrix; we derive it by probing the lrc plugin with unit data chunks and
+bench that fused matrix — the TPU-first formulation of layered encode
+(one launch instead of one per layer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.ops import gf8  # noqa: E402
+
+BATCH = 64       # the OSD EncodeService's max_batch operating point
+TRIALS = 20
+BASELINE_CORES = 96
+BASELINE_DRAM_BYTES = 280e9      # dual-socket DDR4-2933 x 12ch host
+
+
+def _dram_ceiling_gibs(k: int, m: int) -> float:
+    """Input-rate ceiling of the modeled host: traffic per input byte is
+    1 read + m/k writes (encode: write m parities per k read; decode:
+    write the reconstructed chunks — same formula with m = matrix rows)."""
+    return BASELINE_DRAM_BYTES / (1 + m / k) / 2**30
+
+
+def _device_rate(matrix: np.ndarray, k: int, chunk_bytes: int,
+                 with_crc: bool, batch: int = BATCH) -> float:
+    """GiB/s (input) of the fused matmul(+crc) over a (batch, k, W)
+    device-resident stripe batch, measured with the tunnel-safe
+    dependency-chained recipe (utils/devtime.py) — naive per-dispatch
+    timing over the remote tunnel reports impossible rates."""
+    import jax
+    from ceph_tpu.ops import crc32c as crc_ops, gf_jax
+    from ceph_tpu.utils.devtime import chained_time
+
+    m = matrix.shape[0]
+    C = np.ascontiguousarray(matrix, dtype=np.uint8)
+    W = chunk_bytes // 4
+    fold = min(m, k)
+
+    def body(i, d):
+        out = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(d)
+        # feed outputs back into the carry so iterations serialize and
+        # no work is dead: xor the first min(m,k) parity rows into data
+        d = d.at[:, :fold, :].set(d[:, :fold, :] ^ out[:, :fold, :])
+        if with_crc:
+            # crc all k+m shards as the OSD pipeline does, but data and
+            # parity separately (no HBM-materialized concatenate)
+            dcrc = crc_ops.crc32c_words_jax(d.reshape(batch * k, W))
+            pcrc = crc_ops.crc32c_words_jax(out.reshape(batch * m, W))
+            d = d.at[:, 0, 0].set(
+                d[:, 0, 0] ^ dcrc.reshape(batch, k)[:, 0]
+                ^ pcrc.reshape(batch, m)[:, 0])
+        return d
+
+    rng = np.random.default_rng(0)
+    data = jax.device_put(rng.integers(
+        0, 2**32, size=(batch, k, W), dtype=np.uint32))
+    jax.block_until_ready(data)
+    dt = chained_time(body, data)
+    return batch * k * chunk_bytes / dt / 2**30
+
+
+def _host_rate(matrix: np.ndarray, k: int, chunk_bytes: int,
+               with_crc: bool) -> float:
+    """One-core native table-encode(+crc) GiB/s for the same matrix."""
+    from ceph_tpu.utils import native
+
+    lib = native.get_lib()
+    m = matrix.shape[0]
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, chunk_bytes), dtype=np.uint8)
+    out = np.zeros((m, chunk_bytes), dtype=np.uint8)
+    if lib is None or m > 16 or k > 32:
+        t0 = time.perf_counter()
+        gf8.gf_mat_encode(np.ascontiguousarray(matrix), data)
+        return k * chunk_bytes / (time.perf_counter() - t0) / 2**30
+    dptrs = (ctypes.c_char_p * k)(
+        *[ctypes.cast(data[j].ctypes.data, ctypes.c_char_p)
+          for j in range(k)])
+    optrs = (ctypes.c_char_p * m)(
+        *[ctypes.cast(out[i].ctypes.data, ctypes.c_char_p)
+          for i in range(m)])
+    cbuf = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+
+    def one():
+        lib.ec_encode_mt(cbuf, m, k, dptrs, optrs, chunk_bytes, 1,
+                         1 if with_crc else 0)
+
+    one()
+    reps = max(1, (8 << 20) // (k * chunk_bytes))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            one()
+        times.append(time.perf_counter() - t0)
+    return k * chunk_bytes * reps / min(times) / 2**30
+
+
+def _config(name: str, matrix: np.ndarray, k: int, chunk_bytes: int,
+            with_crc: bool, batch: int = BATCH) -> dict:
+    dev = _device_rate(matrix, k, chunk_bytes, with_crc, batch)
+    host = _host_rate(matrix, k, chunk_bytes, with_crc)
+    m = int(matrix.shape[0])
+    base = min(host * BASELINE_CORES, _dram_ceiling_gibs(k, m))
+    return {"config": name, "k": k, "m": int(matrix.shape[0]),
+            "chunk_bytes": chunk_bytes, "batch": batch,
+            "device_gibs": round(dev, 2),
+            "host_percore_gibs": round(host, 3),
+            "baseline_96core_gibs": round(base, 1),
+            "vs_baseline": round(dev / base, 2)}
+
+
+def _decode_config(name: str, k: int, m: int, technique: str,
+                   erased: "list[int]", chunk_bytes: int) -> dict:
+    """Decode = the same GF matmul with the inverted matrix for the
+    surviving rows (ErasureCodeIsa.cc decode-table path)."""
+    G = gf8.generator_matrix(k, m, technique)
+    rows = [i for i in range(k + m) if i not in erased][:k]
+    D = gf8.decode_matrix(G, k, rows)
+    # correctness gate: reconstruction must be byte-equal
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(k, 64), dtype=np.uint8)
+    allc = np.concatenate([data, gf8.gf_mat_encode(
+        np.ascontiguousarray(G[k:]), data)], axis=0)
+    rec = gf8.gf_mat_encode(D, allc[rows])
+    assert np.array_equal(rec, data), f"{name}: decode mismatch"
+    return _config(name, D, k, chunk_bytes, with_crc=False)
+
+
+def _lrc_matrix(k: int, m: int, l: int) -> np.ndarray:
+    """Collapse the layered LRC encode into one (m_total, k) matrix by
+    probing the plugin with unit data chunks (linearity)."""
+    from ceph_tpu.ec.registry import factory_from_profile
+
+    codec = factory_from_profile({"plugin": "lrc", "k": str(k),
+                                  "m": str(m), "l": str(l)})
+    probes = []
+    W = 4
+    for j in range(k):
+        data = np.zeros((k, W), dtype=np.uint8)
+        data[j, :] = 1
+        parity = np.asarray(codec.encode_chunks(data))
+        probes.append(parity[:, 0])
+    return np.stack(probes, axis=1)  # (m_total, k)
+
+
+def main() -> int:
+    import jax
+    platform = jax.devices()[0].platform
+    out = {"platform": platform, "batch": BATCH,
+           "baseline_model": {"cores": BASELINE_CORES,
+                              "dram_bytes_per_s": BASELINE_DRAM_BYTES},
+           "configs": []}
+
+    van = lambda k, m: np.ascontiguousarray(  # noqa: E731
+        gf8.generator_matrix(k, m, "reed_sol_van")[k:])
+
+    # 1. canonical k=4 m=2, 1 MiB buffer -> 256 KiB chunks
+    out["configs"].append(_config(
+        "encode_rs_k4m2_1MiB", van(4, 2), 4, 256 * 1024, with_crc=True))
+    # 2. k=8 m=3 stripe sweep 64 KiB - 4 MiB
+    for stripe in (64 << 10, 256 << 10, 1 << 20, 4 << 20):
+        out["configs"].append(_config(
+            f"encode_rs_k8m3_stripe{stripe >> 10}KiB",
+            van(8, 3), 8, stripe // 8, with_crc=True))
+    # single-op operating point (no cross-PG batching), for contrast
+    out["configs"].append(_config(
+        "encode_rs_k8m3_stripe64KiB_batch1",
+        van(8, 3), 8, (64 << 10) // 8, with_crc=True, batch=1))
+    # 3. decode w/ 1 and 2 erasures
+    out["configs"].append(_decode_config(
+        "decode_rs_k8m3_erase1", 8, 3, "reed_sol_van", [0], 128 * 1024))
+    out["configs"].append(_decode_config(
+        "decode_rs_k8m3_erase2", 8, 3, "reed_sol_van", [0, 9], 128 * 1024))
+    # 4. cauchy k=10 m=4
+    cau = np.ascontiguousarray(gf8.cauchy_matrix(10, 4))
+    out["configs"].append(_config(
+        "encode_cauchy_k10m4_1MiB", cau, 10, 128 * 1024, with_crc=True))
+    out["configs"].append(_decode_config(
+        "decode_cauchy_k10m4_erase2", 10, 4, "cauchy_good", [0, 11],
+        128 * 1024))
+    # 5. LRC k=8 m=4 l=4 as one fused layered matrix
+    lrc = _lrc_matrix(8, 4, 4)
+    out["configs"].append(_config(
+        f"encode_lrc_k8m4l4_fused_m{lrc.shape[0]}", lrc, 8, 128 * 1024,
+        with_crc=True))
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SWEEP.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
