@@ -32,6 +32,7 @@ from .ecbackend import (EIO, ESTALE, ClientOp, ECBackend, ECError, NONE_OSD,
                         NotActive)
 from .ecutil import StripeInfo
 from .encode_service import EncodeService
+from .replicated import ReplicateCodec
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
                        MOSDPGPushReply, MOSDPing, MOSDPingReply,
@@ -127,8 +128,6 @@ class OSDDaemon(Dispatcher):
         if not self.up:
             return
         for pool_id, pool in osdmap.pools.items():
-            if not pool.is_erasure():
-                continue
             for pg in range(pool.pg_num):
                 _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
                 if osdmap.primary_of(acting) != self.whoami:
@@ -153,8 +152,6 @@ class OSDDaemon(Dispatcher):
         """Explicit peering sweep (static-map harness + admin use)."""
         out = {}
         for pool_id, pool in self.osdmap.pools.items():
-            if not pool.is_erasure():
-                continue
             for pg in range(pool.pg_num):
                 _u, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
                 if self.osdmap.primary_of(acting) == self.whoami:
@@ -183,9 +180,15 @@ class OSDDaemon(Dispatcher):
         if be is not None:
             return be
         pool = self.osdmap.get_pool(pgid[0])
-        profile = dict(self.osdmap.ec_profiles.get(pool.ec_profile, {
-            "plugin": "jax_rs", "k": "2", "m": "1"}))
-        codec = factory_from_profile(profile)
+        # pool-type strategy dispatch (reference build_pg_backend,
+        # PGBackend.cc:532-569): EC pools build their codec from the
+        # profile; replicated pools use the k=1 degenerate code
+        if pool.is_erasure():
+            profile = dict(self.osdmap.ec_profiles.get(pool.ec_profile, {
+                "plugin": "jax_rs", "k": "2", "m": "1"}))
+            codec = factory_from_profile(profile)
+        else:
+            codec = ReplicateCodec(pool.size)
         sinfo = StripeInfo.for_codec(codec, pool.stripe_unit)
         be = ECBackend(pgid, self.whoami, codec, sinfo, self.store,
                        self._send_to_osd, lambda p=pgid: self._acting(p),
@@ -263,6 +266,12 @@ class OSDDaemon(Dispatcher):
         elif t == "pg_log_ack":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)
+        elif t == "scrub_shard":
+            be = self._get_backend(tuple(msg["pgid"]))
+            await conn.send_message(be.handle_scrub_shard(msg))
+        elif t == "scrub_shard_reply":
+            be = self._get_backend(tuple(msg["pgid"]))
+            be.handle_pg_info(msg)   # resolves the tid future
         elif t == "osd_ping":
             await conn.send_message(MOSDPingReply({
                 "from_osd": self.whoami, "epoch": self.osdmap.epoch,

@@ -600,9 +600,18 @@ class ECBackend:
                 if self.encode_service is not None:
                     # daemon-wide batched device encode: this op's stripes
                     # ride one (B, k, W) launch with every other PG's
-                    # pending sub-writes, crc32c fused on device
-                    allc, crcs = await self.encode_service.encode(
-                        self.sinfo, self.codec, buf, with_crc=is_append)
+                    # pending sub-writes, crc32c fused on device.  A
+                    # failed batch fails THIS op cleanly (client gets the
+                    # error, pipeline state unwound) instead of leaking a
+                    # hung on_commit future out of the queues.
+                    try:
+                        allc, crcs = await self.encode_service.encode(
+                            self.sinfo, self.codec, buf,
+                            with_crc=is_append)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_op(op, ECError(
+                            f"batched encode failed for {op.oid}: {e}"))
+                        return
                     shards = {s: allc[s] for s in range(self.k + self.m)}
                 else:
                     shards = ecutil.encode(self.sinfo, self.codec, buf)
@@ -1201,6 +1210,19 @@ class ECBackend:
             rop.state = RecoveryOp.COMPLETE
             self.recovery_ops.pop(msg["oid"], None)
             rop.done.set_result(None)
+
+    # ================================================================= SCRUB
+
+    async def scrub(self, deep: bool = False, repair: bool = True) -> dict:
+        """Primary-driven shallow/deep scrub (reference PrimaryLogPG
+        scrub driver + ECBackend::be_deep_scrub ECBackend.cc:2475);
+        see osd/scrub.py."""
+        from . import scrub as scrubmod
+        return await scrubmod.run_scrub(self, deep=deep, repair=repair)
+
+    def handle_scrub_shard(self, msg):
+        from . import scrub as scrubmod
+        return scrubmod.handle_scrub_shard(self, msg)
 
     # =============================================================== PEERING
 

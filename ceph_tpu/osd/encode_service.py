@@ -80,12 +80,9 @@ class EncodeService:
 
     @classmethod
     def from_config(cls, config) -> "EncodeService":
-        try:
-            return cls(max_batch=int(config.get("osd_ec_batch_max")),
-                       min_device_bytes=int(
-                           config.get("osd_ec_batch_min_device_bytes")))
-        except Exception:
-            return cls()
+        return cls(max_batch=int(config.get("osd_ec_batch_max")),
+                   min_device_bytes=int(
+                       config.get("osd_ec_batch_min_device_bytes")))
 
     # --- public entry ---------------------------------------------------------
 
@@ -104,11 +101,12 @@ class EncodeService:
         shards = sinfo.split_to_shards(arr)          # (k, W)
         W = shards.shape[1]
         enc_dev = getattr(codec, "encode_device", None)
-        if enc_dev is None or W % 4 != 0:
+        matrix = getattr(codec, "_C", None)
+        if enc_dev is None or matrix is None or W % 4 != 0:
             return self._host_encode(codec, shards), None
         # requests batch by (coding matrix, chunk width): any codec
         # instance with the same matrix shares the compiled device step
-        key = (codec._C.tobytes(), W)               # type: ignore[attr-defined]
+        key = (matrix.tobytes(), W)
         fut: "asyncio.Future" = asyncio.get_event_loop().create_future()
         self._pending.setdefault(key, []).append(
             _Request(shards, with_crc, fut))

@@ -175,6 +175,20 @@ class MPGLogAck(Message):
 
 
 @register_message
+class MScrubShard(Message):
+    """Primary asks a shard for its scrub map (reference MOSDRepScrub).
+    fields: pgid, shard, from_osd, tid, deep."""
+    TYPE = "scrub_shard"
+
+
+@register_message
+class MScrubShardReply(Message):
+    """Shard's scrub map: fields: pgid, shard, from_osd, tid,
+    objects ({oid: {size, oi, hinfo, crc?}})."""
+    TYPE = "scrub_shard_reply"
+
+
+@register_message
 class MOSDMapMsg(Message):
     """Map epoch broadcast (reference MOSDMap.h); full map json in data."""
     TYPE = "osd_map"

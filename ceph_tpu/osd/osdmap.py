@@ -122,13 +122,14 @@ class OSDMap:
         raw = self.crush.do_rule(pool.crush_rule,
                                  self._pg_seed(pool_id, pg),
                                  pool.size, self._weights())
-        # Up set: raw placement restricted to up osds, holes preserved for
-        # EC (positions are shard ids); replicated pools compact instead.
-        if pool.is_erasure():
-            up = [o if self.is_up(o) else NONE_OSD for o in raw]
-            up += [NONE_OSD] * (pool.size - len(up))
-        else:
-            up = [o for o in raw if self.is_up(o)]
+        # Up set: raw placement restricted to up osds, holes preserved
+        # for BOTH pool types.  The reference compacts replicated sets;
+        # here replicated pools run on the same positional-shard backend
+        # (replicated.py: k=1 degenerate code), and positional holes keep
+        # a replica's store collection stable across failures.  Primary
+        # selection (first non-hole) gives the same answer either way.
+        up = [o if self.is_up(o) else NONE_OSD for o in raw]
+        up += [NONE_OSD] * (pool.size - len(up))
         return up
 
     def pg_to_up_acting_osds(self, pool_id: int,

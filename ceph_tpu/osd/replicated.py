@@ -1,0 +1,65 @@
+"""Replicated pools — primary-copy replication as a degenerate code.
+
+Reference: src/osd/ReplicatedBackend.{h,cc} (437+2404) selected per pool
+type by PGBackend::build_pg_backend (src/osd/PGBackend.cc:532-569).
+
+TPU-first deviation: rather than a second 2400-line backend, replication
+is expressed as the k=1 degenerate "code": every shard holds the full
+chunk (parity row i = identity), so the entire ECBackend machinery —
+three-stage write pipeline, PG log + rollback, peering, missing sets,
+push/recovery, crc-verified reads — serves replicated pools unchanged.
+``minimum_to_decode`` returns any single live shard, so reads hit one
+replica and recovery copies from any survivor, exactly the replicated
+data path.  The acting set keeps positional holes (like EC) so a
+replica's store collection is stable across failures.
+
+What the reference's ReplicatedBackend does differently and where that
+lands here:
+- op-based replication (ships the logical transaction): here sub-writes
+  carry the materialized chunk extents — same bytes, simpler wire.
+- partial writes at byte offsets: here a partial write RMWs its
+  stripe_unit-sized stripe via the ExtentCache (bounded overhead, same
+  semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ec.base import ErasureCode
+from ..ec.interface import ChunkMap, ErasureCodeError
+
+
+class ReplicateCodec(ErasureCode):
+    """k=1, m=size-1: encode = copy to every replica, decode = any one."""
+
+    def __init__(self, size: int) -> None:
+        super().__init__()
+        if size < 1:
+            raise ErasureCodeError(f"replicated size={size} must be >= 1")
+        self.k = 1
+        self.m = size - 1
+        self._profile = {"plugin": "replicate", "size": str(size)}
+
+    def init(self, profile) -> None:  # pragma: no cover - built directly
+        pass
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != 1:
+            raise ErasureCodeError(
+                f"replicate: got {data_chunks.shape[0]} data chunks")
+        if self.m == 0:
+            return np.zeros((0, data_chunks.shape[1]), dtype=np.uint8)
+        return np.repeat(data_chunks, self.m, axis=0)
+
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: ChunkMap) -> ChunkMap:
+        if not chunks:
+            raise ErasureCodeError("replicate: no chunks available")
+        src = np.asarray(next(iter(chunks.values())), dtype=np.uint8)
+        return {i: src for i in want_to_read}
+    # minimum_to_decode: base-class default with k=1 already returns a
+    # single live shard (want-first, then lowest index) — one replica read

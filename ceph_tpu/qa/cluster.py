@@ -113,6 +113,19 @@ class MiniCluster:
         self.osdmap.bump()
         return pool
 
+    def create_replicated_pool(self, name: str, size: int = 3,
+                               min_size: "Optional[int]" = None,
+                               pg_num: int = 8, stripe_unit: int = 4096):
+        """Static-mode replicated pool (pool-type dispatch selects the
+        k=1 degenerate-code backend, osd/replicated.py)."""
+        assert not self.mon_addrs, "mon mode: use mon_command"
+        pool = self.osdmap.create_pool(
+            name, type="replicated", size=size,
+            min_size=min_size if min_size is not None else max(1, size // 2 + 1),
+            pg_num=pg_num, stripe_unit=stripe_unit)
+        self.osdmap.bump()
+        return pool
+
     async def create_ec_pool_cmd(self, name: str,
                                  profile: "Optional[dict]" = None,
                                  pg_num: int = 8,
@@ -173,6 +186,23 @@ class MiniCluster:
         for osd in self.osds.values():
             if osd.up:
                 out.update(await osd.peer_all_pgs())
+        return out
+
+    async def scrub_pool(self, name: str, deep: bool = False,
+                         repair: bool = True) -> "Dict[tuple, dict]":
+        """Run a scrub on every PG of a pool from its primary (the
+        'ceph pg scrub/deep-scrub' analog)."""
+        pool = self.osdmap.pool_by_name(name)
+        out = {}
+        for pg in range(pool.pg_num):
+            _u, acting = self.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+            primary = self.osdmap.primary_of(acting)
+            if primary < 0 or primary not in self.osds \
+                    or not self.osds[primary].up:
+                continue
+            be = self.osds[primary]._get_backend((pool.pool_id, pg))
+            out[(pool.pool_id, pg)] = await be.scrub(deep=deep,
+                                                     repair=repair)
         return out
 
     async def kill_mon(self, rank: int) -> None:

@@ -175,7 +175,10 @@ class TestOSDMap:
             assert m2.pg_to_up_acting_osds(pool.pool_id, pg) == \
                 m.pg_to_up_acting_osds(pool.pool_id, pg)
 
-    def test_replicated_pool_compacts(self):
+    def test_replicated_pool_keeps_positional_holes(self):
+        """Replicated sets keep NONE_OSD holes (positions are stable
+        shard/collection ids for the k=1 degenerate-code backend; the
+        reference compacts instead — see osdmap.pg_to_raw_up)."""
         m = self.build()
         m.create_pool("rpool", size=3, pg_num=4)
         m.bump()
@@ -185,5 +188,7 @@ class TestOSDMap:
         m.mark_down(victim)
         m.bump()
         up2, _ = m.pg_to_up_acting_osds(pool.pool_id, 0)
-        assert victim not in up2 and NONE_OSD not in up2
-        assert len(up2) == 2
+        assert len(up2) == 3
+        assert up2[1] == NONE_OSD
+        assert up2[0] == up[0] and up2[2] == up[2]  # positions stable
+        assert m.primary_of(up2) == up[0]

@@ -1,0 +1,173 @@
+"""Scrub tests (osd/scrub.py).
+
+VERDICT round-1 'done' criteria: flip a bit in one shard on disk and
+show scrub detects + repairs it; RMW-invalidated hinfo gets rebuilt.
+Reference: ECBackend::be_deep_scrub (ECBackend.cc:2475) and the
+PrimaryLogPG scrub/repair driver.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.objectstore.transaction import Transaction
+from ceph_tpu.objectstore.types import Collection, ObjectId
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def corrupt_shard(cluster, pool_name, oid, shard_pos, flip_byte=7):
+    """Flip one byte of a shard's on-disk data, bypassing the backend."""
+    pool = cluster.osdmap.pool_by_name(pool_name)
+    pg = cluster.osdmap.object_to_pg(pool.pool_id, oid)
+    _u, acting = cluster.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+    osd = cluster.osds[acting[shard_pos]]
+    cid = Collection(pool.pool_id, pg, shard_pos)
+    sid = ObjectId(oid, shard_pos)
+    data = bytearray(osd.store.read(cid, sid, 0, -1))
+    data[flip_byte] ^= 0xFF
+    t = Transaction()
+    t.write(cid, sid, 0, bytes(data))
+    osd.store.apply_transaction(t)
+    return acting[shard_pos]
+
+
+class TestScrub:
+    def test_clean_scrub_reports_no_errors(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                       "m": "2"}, pg_num=4, stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                for i in range(4):
+                    await io.write_full(f"o{i}", payload(777, i))
+                res = await c.scrub_pool("p", deep=True)
+                assert sum(r["objects"] for r in res.values()) == 4
+                for r in res.values():
+                    assert r["shallow_errors"] == []
+                    assert r["deep_errors"] == []
+        loop.run_until_complete(go())
+
+    def test_deep_scrub_detects_and_repairs_bit_flip(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                       "m": "2"}, pg_num=1, stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                data = payload(3000, 42)
+                await io.write_full("obj", data)
+                corrupt_shard(c, "p", "obj", shard_pos=1)
+                # shallow scrub does NOT read data: no crc check
+                res = await c.scrub_pool("p", deep=False, repair=False)
+                assert all(not r["deep_errors"] for r in res.values())
+                # deep scrub catches it and repairs via recovery
+                res = await c.scrub_pool("p", deep=True)
+                errs = [e for r in res.values() for e in r["deep_errors"]]
+                assert len(errs) == 1 and errs[0]["shard"] == 1
+                reps = [x for r in res.values() for x in r["repaired"]]
+                assert reps == [{"oid": "obj", "shards": [1]}]
+                # clean after repair
+                res = await c.scrub_pool("p", deep=True)
+                assert all(not r["deep_errors"] for r in res.values())
+                assert await io.read("obj") == data
+        loop.run_until_complete(go())
+
+    def test_deep_scrub_repairs_parity_shard(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                       "m": "2"}, pg_num=1, stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                data = payload(2000, 43)
+                await io.write_full("obj", data)
+                corrupt_shard(c, "p", "obj", shard_pos=4)  # parity shard
+                res = await c.scrub_pool("p", deep=True)
+                errs = [e for r in res.values() for e in r["deep_errors"]]
+                assert [e["shard"] for e in errs] == [4]
+                res = await c.scrub_pool("p", deep=True)
+                assert all(not r["deep_errors"] for r in res.values())
+        loop.run_until_complete(go())
+
+    def test_rmw_invalidated_hinfo_rebuilt(self, loop):
+        """An unaligned overwrite invalidates the crc chain; deep scrub
+        must rebuild it so later scrubs verify crcs again."""
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                       "m": "2"}, pg_num=1, stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                await io.write_full("obj", payload(2000, 44))
+                await io.write("obj", b"Y" * 10, 100)   # RMW overwrite
+                res = await c.scrub_pool("p", deep=True)
+                rebuilt = [o for r in res.values()
+                           for o in r["hinfo_rebuilt"]]
+                assert rebuilt == ["obj"]
+                # the rebuilt chain now catches fresh corruption
+                corrupt_shard(c, "p", "obj", shard_pos=0)
+                res = await c.scrub_pool("p", deep=True)
+                errs = [e for r in res.values() for e in r["deep_errors"]]
+                assert [e["shard"] for e in errs] == [0]
+                assert not any(r["hinfo_rebuilt"] for r in res.values())
+        loop.run_until_complete(go())
+
+    def test_hinfo_rebuild_does_not_certify_corruption(self, loop):
+        """A corrupt shard present DURING the hinfo rebuild must be
+        identified by hypothesis-testing (not adopted as authority) and
+        repaired; the rebuilt chain must describe the true bytes."""
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                       "m": "2"}, pg_num=1, stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                data = payload(2000, 46)
+                await io.write_full("obj", data)
+                await io.write("obj", b"Z" * 10, 50)   # invalidate hinfo
+                want = data[:50] + b"Z" * 10 + data[60:]
+                corrupt_shard(c, "p", "obj", shard_pos=1)
+                res = await c.scrub_pool("p", deep=True)
+                errs = [e for r in res.values() for e in r["deep_errors"]]
+                assert [e.get("shard") for e in errs] == [1]
+                assert errs[0]["error"] == "crc_recomputed"
+                assert [o for r in res.values()
+                        for o in r["hinfo_rebuilt"]] == ["obj"]
+                # repaired + certified chain describes the TRUE bytes
+                res = await c.scrub_pool("p", deep=True)
+                assert all(not r["deep_errors"] for r in res.values())
+                assert await io.read("obj") == want
+        loop.run_until_complete(go())
+
+    def test_scrub_replicated_pool(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=5) as c:
+                c.create_replicated_pool("rep", size=3, pg_num=1,
+                                         stripe_unit=256)
+                client = await c.client()
+                io = client.io_ctx("rep")
+                data = payload(1500, 45)
+                await io.write_full("obj", data)
+                corrupt_shard(c, "rep", "obj", shard_pos=2)
+                res = await c.scrub_pool("rep", deep=True)
+                errs = [e for r in res.values() for e in r["deep_errors"]]
+                assert [e["shard"] for e in errs] == [2]
+                res = await c.scrub_pool("rep", deep=True)
+                assert all(not r["deep_errors"] for r in res.values())
+                assert await io.read("obj") == data
+        loop.run_until_complete(go())
