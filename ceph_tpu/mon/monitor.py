@@ -359,7 +359,9 @@ class MonDaemon(Dispatcher):
                     return -2, {"error": f"no profile {profile_name}"}
                 k, m = int(prof.get("k", 2)), int(prof.get("m", 1))
                 kwargs.setdefault("size", k + m)
-                kwargs.setdefault("min_size", k)
+                # k+1 default (reference): acked-at-exactly-k writes
+                # become unreadable on the next single failure
+                kwargs.setdefault("min_size", min(k + 1, k + m))
             v = await self._propose_osd_ops([{
                 "op": "create_pool", "name": name, "kwargs": kwargs}])
             pool = self.osdmap.pool_by_name(name)

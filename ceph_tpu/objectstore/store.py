@@ -16,7 +16,8 @@ import numpy as np
 from .transaction import (OP_CLONE, OP_MKCOLL, OP_OMAP_CLEAR,
                           OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
                           OP_RMATTR, OP_RMCOLL, OP_SETATTR, OP_TOUCH,
-                          OP_TRUNCATE, OP_WRITE, OP_ZERO, Transaction)
+                          OP_TRUNCATE, OP_TRY_REMOVE, OP_WRITE, OP_ZERO,
+                          Transaction)
 from .types import Collection, ObjectId
 
 
@@ -148,6 +149,11 @@ class ObjectStore:
             return self._truncate(cid, oid, op["size"])
         if kind == OP_REMOVE:
             return self._remove(cid, oid)
+        if kind == OP_TRY_REMOVE:
+            try:
+                return self._remove(cid, oid)
+            except NotFound:
+                return None
         if kind == OP_CLONE:
             return self._clone(cid, oid, ObjectId.from_key(op["dst"]))
         if kind == OP_SETATTR:

@@ -21,6 +21,7 @@ OP_WRITE = "write"
 OP_ZERO = "zero"
 OP_TRUNCATE = "truncate"
 OP_REMOVE = "remove"
+OP_TRY_REMOVE = "try_remove"   # idempotent: absent object is a no-op
 OP_SETATTR = "setattr"
 OP_RMATTR = "rmattr"
 OP_CLONE = "clone"
@@ -87,6 +88,14 @@ class Transaction:
 
     def remove(self, cid: Collection, oid: ObjectId) -> "Transaction":
         self.ops.append({"op": OP_REMOVE, "cid": cid.key(), "oid": oid.key()})
+        return self
+
+    def try_remove(self, cid: Collection, oid: ObjectId) -> "Transaction":
+        """Remove if present; absent is a no-op.  Used for rollback-clone
+        reaping, where a revived shard may legitimately never have held
+        the clone (reference try_remove semantics)."""
+        self.ops.append({"op": OP_TRY_REMOVE, "cid": cid.key(),
+                         "oid": oid.key()})
         return self
 
     def clone(self, cid: Collection, src: ObjectId,

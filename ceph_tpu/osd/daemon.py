@@ -142,6 +142,7 @@ class OSDDaemon(Dispatcher):
     async def _peer_pg(self, pgid: "Tuple[int, int]") -> None:
         try:
             be = self._get_backend(pgid)
+            be.last_epoch = self.osdmap.epoch
             res = await be.peer()
             if res.get("recovered") or res.get("failed"):
                 dout("osd", 1, f"osd.{self.whoami} pg {pgid} peered: {res}")
@@ -156,6 +157,7 @@ class OSDDaemon(Dispatcher):
                 _u, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
                 if self.osdmap.primary_of(acting) == self.whoami:
                     be = self._get_backend((pool_id, pg))
+                    be.last_epoch = self.osdmap.epoch
                     out[(pool_id, pg)] = await be.peer()
         return out
 
@@ -228,7 +230,22 @@ class OSDDaemon(Dispatcher):
         elif t == "ec_sub_write":
             be = self._get_backend(tuple(msg["pgid"]))
             self.perf.inc("subop_w")
-            reply = be.handle_sub_write(msg)
+            try:
+                reply = be.handle_sub_write(msg)
+            except Exception as e:  # noqa: BLE001 — failed apply: this
+                # shard misses the write; a committed:False reply makes
+                # the primary fail the op promptly (a silent drop would
+                # wedge the strictly-ordered commit queue behind it)
+                dout("osd", 0, f"sub_write apply failed: "
+                               f"{type(e).__name__}: {e}")
+                for entry in msg.get("log_entries", []):
+                    be.local_missing[entry["oid"]] = tuple(
+                        entry["version"])
+                reply = MECSubOpWriteReply({
+                    "pgid": list(msg["pgid"]), "shard": msg["shard"],
+                    "from_osd": self.whoami, "tid": msg["tid"],
+                    "committed": False, "applied": False,
+                    "error": f"apply failed: {type(e).__name__}"})
             await conn.send_message(reply)
         elif t == "ec_sub_write_reply":
             be = self._get_backend(tuple(msg["pgid"]))

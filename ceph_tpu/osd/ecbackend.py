@@ -224,6 +224,12 @@ class ECBackend:
         # suspect.  None = log is contiguous.
         self.log_gap_from: "Optional[Version]" = None
         self.last_epoch = 1
+        # newest map epoch a primary has peered this shard at: sub-ops
+        # from primaries of OLDER epochs are rejected, so a deposed
+        # primary can never complete (and ack) a write behind the back
+        # of a successor that already peered — the reference's
+        # same-interval/last_epoch_started gate (PeeringState).
+        self.peered_epoch = 0
         self._load_pg_meta()
 
     # ------------------------------------------------------------------ utils
@@ -262,6 +268,9 @@ class ECBackend:
                 if "gap_from" in kv:
                     raw = json.loads(kv["gap_from"].decode())
                     self.log_gap_from = ver(raw) if raw else None
+                if "peered_epoch" in kv:
+                    self.peered_epoch = int(
+                        json.loads(kv["peered_epoch"].decode()))
                 return
 
     def _pg_meta_txn(self, t: Transaction, cid: Collection) -> None:
@@ -272,7 +281,8 @@ class ECBackend:
                                    self.local_missing.items()}).encode(),
             "gap_from": json.dumps(
                 list(self.log_gap_from) if self.log_gap_from
-                else None).encode()})
+                else None).encode(),
+            "peered_epoch": json.dumps(self.peered_epoch).encode()})
 
     def _persist_pg_meta(self, shard: int) -> None:
         cid = self.coll(shard)
@@ -518,6 +528,10 @@ class ECBackend:
         self._unproject(op)
         if not op.on_commit.done():
             op.on_commit.set_exception(err)
+        # removing a head op may expose a fully-acked successor at the
+        # front of waiting_commit; complete it (guarded against the
+        # recursive call when _check_commit_queue itself failed this op)
+        self._check_commit_queue()
 
     # --- pipeline stage 2: encode + fan out ----------------------------------
 
@@ -668,6 +682,7 @@ class ECBackend:
             msg = MECSubOpWrite({
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": op.tid,
+                "epoch": self.last_epoch,
                 "at_version": list(op.version),
                 "trim_to": list(self.pg_log.tail),
                 "roll_forward_to": list(self.pg_log.can_rollback_to),
@@ -692,7 +707,24 @@ class ECBackend:
                     self.peer_missing.setdefault(shard, {})[op.oid] = \
                         op.version
         for shard, msg in local_msgs:
-            self.handle_sub_write(msg)
+            try:
+                reply = self.handle_sub_write(msg)
+                if not reply.get("committed", True):
+                    self._fail_op(op, ECError(
+                        f"write {op.oid}: local shard {shard} rejected "
+                        f"stale interval"))
+                    return
+            except Exception as e:  # noqa: BLE001 — failed local apply
+                # = this shard missed the write: record it missing and
+                # let peering repair, exactly like a failed remote send
+                dout("osd", 0, f"local sub_write shard {shard} failed: "
+                               f"{type(e).__name__}: {e}")
+                op.failed_shards.add(shard)
+                op.pending_commits.discard(shard)
+                self.peer_missing.setdefault(shard, {})[op.oid] = \
+                    op.version
+                self.local_missing[op.oid] = op.version
+                continue
             self._sub_write_committed(op, shard)
         self._check_commit_queue()
 
@@ -707,6 +739,15 @@ class ECBackend:
         (reference try_finish_rmw completes only waiting_commit.front(),
         ECBackend.cc:2103): an op whose acks arrive early must not
         advance roll_forward past a still-uncommitted predecessor."""
+        if getattr(self, "_checking_commit", False):
+            return   # reentry via _fail_op: the outer loop continues
+        self._checking_commit = True
+        try:
+            self._check_commit_queue_inner()
+        finally:
+            self._checking_commit = False
+
+    def _check_commit_queue_inner(self) -> None:
         while self.waiting_commit and \
                 not self.waiting_commit[0].pending_commits:
             op = self.waiting_commit[0]
@@ -742,8 +783,16 @@ class ECBackend:
 
     def handle_sub_write_reply(self, msg: MECSubOpWriteReply) -> None:
         op = self.tid_to_op.get(int(msg["tid"]))
-        if op is not None:
-            self._sub_write_committed(op, int(msg["shard"]))
+        if op is None:
+            return
+        if not msg.get("committed", True):
+            # shard rejected us as a deposed primary: never ack this op;
+            # the client will retry against the current primary
+            self._fail_op(op, ECError(
+                f"write {op.oid} v{op.version}: shard {msg['shard']} "
+                f"rejected stale interval"))
+            return
+        self._sub_write_committed(op, int(msg["shard"]))
 
     # ------------------------------------------------------------ shard side
 
@@ -751,6 +800,21 @@ class ECBackend:
         """Apply a per-shard transaction + log entries atomically
         (reference handle_sub_write ECBackend.cc:915)."""
         shard = int(msg["shard"])
+        if int(msg.get("epoch", 1 << 62)) < self.peered_epoch:
+            # a NEWER primary has already peered us: this sub-write is
+            # from a deposed interval and must not be applied — applying
+            # (or acking) it would let the old primary complete a write
+            # the new primary's peering never saw (reference: old-epoch
+            # ops are discarded, PeeringState same-interval checks)
+            dout("osd", 1,
+                 f"sub_write epoch {msg.get('epoch')} < peered "
+                 f"{self.peered_epoch}: rejecting deposed primary "
+                 f"osd.{msg.get('from_osd')}")
+            return MECSubOpWriteReply({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": int(msg["tid"]),
+                "committed": False, "applied": False,
+                "error": "stale interval"})
         cid = self.coll(shard)
         txn = dict(msg["txn"])
         bufs = unpack_buffers(list(msg.get("lens", [])), msg.data)
@@ -782,6 +846,11 @@ class ECBackend:
             for name, hexval in txn.get("attrs", {}).items():
                 t.setattr(cid, sid, name, bytes.fromhex(hexval))
 
+        # snapshot the in-memory log: if the store apply fails below, the
+        # log must not claim the entry was applied (a log ahead of the
+        # data would let peering elect a head no shard's bytes back)
+        log_snapshot = self.pg_log.to_dict()
+        gap_snapshot = self.log_gap_from
         for e in entries:
             if e.version > self.pg_log.head:
                 if e.version[1] > self.pg_log.head[1] + 1 and \
@@ -800,12 +869,17 @@ class ECBackend:
         for e in reaped:
             g = e.rollback.get("clone_gen")
             if g is not None:
-                gid = ObjectId(e.oid, shard, int(g))
-                if self.store.exists(cid, gid):
-                    t.remove(cid, gid)
+                # try_remove: a revived/pushed shard may never have held
+                # this rollback clone; reaping nothing is fine
+                t.try_remove(cid, ObjectId(e.oid, shard, int(g)))
         self.pg_log.trim_to(ver(msg.get("trim_to", [0, 0])))
         self._pg_meta_txn(t, cid)
-        self.store.apply_transaction(t)
+        try:
+            self.store.apply_transaction(t)
+        except Exception:
+            self.pg_log = PGLog.from_dict(log_snapshot)
+            self.log_gap_from = gap_snapshot
+            raise
         return MECSubOpWriteReply({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -1236,8 +1310,15 @@ class ECBackend:
     def handle_pg_query(self, msg: MPGQuery) -> MPGInfo:
         """Shard side: report our log, how far it is contiguous, our
         missing set, and our object list (reference MOSDPGQuery ->
-        MOSDPGNotify/MOSDPGLog exchange)."""
+        MOSDPGNotify/MOSDPGLog exchange).  Recording the querying
+        primary's epoch closes the deposed-primary window: once we
+        answer a peering query at epoch E, sub-writes from any primary
+        at epoch < E are rejected (handle_sub_write)."""
         shard = int(msg["shard"])
+        q_epoch = int(msg.get("epoch", 0))
+        if q_epoch > self.peered_epoch:
+            self.peered_epoch = q_epoch
+            self._persist_pg_meta(shard)
         return MPGInfo({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -1247,6 +1328,13 @@ class ECBackend:
                         for o, v in self.local_missing.items()},
             "objects": self._list_objects(shard)})
 
+    def _stale_interval(self, msg) -> bool:
+        """True if this peering message is from a primary of an older
+        interval than we last peered at — its rewinds/log adoptions must
+        not be applied (same gate as handle_sub_write; a deposed
+        primary's delayed rewind could destroy acked data)."""
+        return int(msg.get("epoch", 1 << 62)) < self.peered_epoch
+
     def handle_pg_log(self, msg: MPGLog) -> MPGLogAck:
         """Shard side: adopt the authoritative log and derive our missing
         set from the delta (reference PGLog::merge_log + pg_missing_t via
@@ -1254,6 +1342,11 @@ class ECBackend:
         the auth tail backfills: everything in the live object set is
         missing, and local objects absent from it are stale extras."""
         shard = int(msg["shard"])
+        if self._stale_interval(msg):
+            return MPGLogAck({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": int(msg["tid"]),
+                "rejected": True, "missing": {}})
         auth = PGLog.from_dict(msg["log"])
         complete = self._complete_to()
         missing: "Dict[str, Version]" = {}
@@ -1294,6 +1387,11 @@ class ECBackend:
     def handle_pg_rewind(self, msg: MPGRewind) -> MPGRewindAck:
         """Shard side: drop + roll back entries newer than ``to``."""
         shard = int(msg["shard"])
+        if self._stale_interval(msg):
+            return MPGRewindAck({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": int(msg["tid"]),
+                "rejected": True, "head": list(self.pg_log.head)})
         self._rewind_local(shard, ver(msg["to"]))
         return MPGRewindAck({
             "pgid": list(self.pgid), "shard": shard,
@@ -1363,7 +1461,8 @@ class ECBackend:
         try:
             await self.send(osd, MPGQuery({
                 "pgid": list(self.pgid), "shard": shard,
-                "from_osd": self.whoami, "tid": tid}))
+                "from_osd": self.whoami, "tid": tid,
+                "epoch": self.last_epoch}))
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
             return None
@@ -1381,7 +1480,8 @@ class ECBackend:
         try:
             await self.send(osd, MPGRewind({
                 "pgid": list(self.pgid), "shard": shard,
-                "from_osd": self.whoami, "tid": tid, "to": list(to)}))
+                "from_osd": self.whoami, "tid": tid, "to": list(to),
+                "epoch": self.last_epoch}))
             await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
             pass
@@ -1396,15 +1496,20 @@ class ECBackend:
         tid = self.new_tid()
         payload = {"pgid": list(self.pgid), "shard": shard,
                    "from_osd": self.whoami, "tid": tid,
-                   "log": auth_log.to_dict(), "objects": list(objects)}
+                   "log": auth_log.to_dict(), "objects": list(objects),
+                   "epoch": self.last_epoch}
         if osd == self.whoami:
             ack = self.handle_pg_log(MPGLog(payload))
+            if ack.get("rejected"):
+                return None
             return {o: ver(v) for o, v in ack["missing"].items()}
         fut = asyncio.get_event_loop().create_future()
         self.pending_queries[tid] = fut
         try:
             await self.send(osd, MPGLog(payload))
             ack = await asyncio.wait_for(fut, timeout)
+            if ack.get("rejected"):
+                return None
             return {o: ver(v) for o, v in ack["missing"].items()}
         except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
             return None
@@ -1476,6 +1581,9 @@ class ECBackend:
             self._drain_in_flight()
         up = self._avail_shards()
         infos: "Dict[int, dict]" = {}
+        # peering at this epoch deposes any older primary on our own
+        # shard too (remote shards record it via the query's epoch)
+        self.peered_epoch = max(self.peered_epoch, self.last_epoch)
         for s, osd in up.items():
             if osd == self.whoami:
                 infos[s] = {"log": self.pg_log.to_dict(),
@@ -1525,6 +1633,28 @@ class ECBackend:
         auth_log.can_rollback_to = min(auth_log.can_rollback_to,
                                        auth_head)
         auth_entries = list(auth_log.entries)
+
+        # ROLLBACK SAFETY: entries newer than auth_head may have been
+        # ACKED to a client if >= min_size shards durably hold them (the
+        # commit gate requires exactly that).  Rewinding is only allowed
+        # when that is provably false: counting every non-responding
+        # acting position as a potential holder, the divergent entries
+        # must still fall short of min_size.  Otherwise stay inactive
+        # and wait for the absent shards — rolling back could destroy
+        # the only surviving copies of acknowledged data (reference: a
+        # PG whose last maybe-went-rw interval cannot be excluded goes
+        # incomplete/down and blocks, PeeringState::build_prior /
+        # choose_acting, PeeringState.h:654-1240).
+        divergent = [s for s in infos if heads[s] > auth_head]
+        if divergent:
+            absent = (self.k + self.m) - len(infos)
+            if len(divergent) + absent >= self.min_size:
+                return {"status": "incomplete",
+                        "reason": "possibly-acked entries beyond "
+                                  f"auth head {list(auth_head)} on "
+                                  f"shards {sorted(divergent)} with "
+                                  f"{absent} shards absent",
+                        "have": sorted(infos)}
 
         # rewind anything newer than the decodable head (incl. ourselves)
         for s in sorted(infos):

@@ -100,15 +100,21 @@ class MiniCluster:
     # --- pools / clients ------------------------------------------------------
 
     def create_ec_pool(self, name: str, profile: "Optional[dict]" = None,
-                       pg_num: int = 8, stripe_unit: int = 4096):
+                       pg_num: int = 8, stripe_unit: int = 4096,
+                       min_size: "Optional[int]" = None):
         """Static-mode pool creation (direct map mutation)."""
         assert not self.mon_addrs, "mon mode: use create_ec_pool_cmd"
         profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
         prof_name = f"{name}-profile"
         self.osdmap.ec_profiles[prof_name] = profile
         k, m = int(profile.get("k", 4)), int(profile.get("m", 2))
+        if min_size is None:
+            # k+1 (the reference's EC default): a write acked at exactly
+            # k durable shards would become unreadable on the next
+            # single failure
+            min_size = min(k + 1, k + m)
         pool = self.osdmap.create_pool(
-            name, type=POOL_ERASURE, size=k + m, min_size=k,
+            name, type=POOL_ERASURE, size=k + m, min_size=min_size,
             pg_num=pg_num, ec_profile=prof_name, stripe_unit=stripe_unit)
         self.osdmap.bump()
         return pool

@@ -1,0 +1,172 @@
+"""Thrasher — kill/revive OSDs under a live workload (QA tier 4).
+
+Reference: qa/tasks/thrashosds.py (Thrasher :137, kill_osd :248, revive,
+mark out) driven by teuthology; the invariant it enforces is the one
+that matters most for a storage system: EVERY write the cluster ever
+acknowledged is readable, byte-equal, after any sequence of failures
+and recoveries.
+
+Components:
+- ``Workload``: continuously writes objects (random sizes, appends and
+  full rewrites) and immediately reads some back; records the last
+  acknowledged content per object.  Errors during degraded intervals
+  (below min_size, mid-peering ESTALE exhaustion) are expected and
+  counted, never fatal — only an ACKED write creates an obligation.
+- ``Thrasher``: kills a random live OSD, waits, revives it, peers —
+  keeping at least ``min_live`` OSDs up so the pool stays recoverable.
+- ``run_thrash``: wires both for a duration, then heals the cluster
+  (revive all + peer) and verifies every recorded object byte-equal.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..common.log import dout
+from .cluster import MiniCluster
+
+
+class Workload:
+    def __init__(self, cluster: MiniCluster, pool: str, seed: int = 0,
+                 n_objects: int = 12, max_size: int = 8192) -> None:
+        self.cluster = cluster
+        self.pool = pool
+        self.rng = random.Random(seed)
+        self.n_objects = n_objects
+        self.max_size = max_size
+        self.committed: "Dict[str, bytes]" = {}
+        self.dropped: "set[str]" = set()
+        self.acked = 0
+        self.failed = 0
+        self.read_mismatch: "Optional[str]" = None
+        self._stop = asyncio.Event()
+
+    async def run(self) -> None:
+        client = await self.cluster.client()
+        io = client.io_ctx(self.pool)
+        while not self._stop.is_set():
+            oid = f"obj-{self.rng.randrange(self.n_objects)}"
+            n = self.rng.randrange(1, self.max_size)
+            data = np.random.default_rng(
+                self.rng.randrange(1 << 30)).integers(
+                0, 256, n, dtype=np.uint8).tobytes()
+            append = self.rng.random() < 0.3 and oid in self.committed
+            try:
+                if append:
+                    await io.append(oid, data)
+                else:
+                    await io.write_full(oid, data)
+            except Exception as e:  # noqa: BLE001 — degraded intervals
+                self.failed += 1
+                dout("qa", 10, f"workload write {oid} failed: {e}")
+                # UNKNOWN outcome: the write may have committed before
+                # the error surfaced.  Drop the object from the content
+                # ledger (we can no longer assert its bytes); run_thrash
+                # still smoke-reads it after healing via ``dropped``.
+                self.committed.pop(oid, None)
+                self.dropped.add(oid)
+                await asyncio.sleep(0.02)
+                continue
+            self.acked += 1
+            self.committed[oid] = (self.committed.get(oid, b"") + data
+                                   if append else data)
+            if self.rng.random() < 0.25:
+                try:
+                    got = await io.read(oid)
+                    if got != self.committed[oid]:
+                        self.read_mismatch = oid
+                        return
+                except Exception:  # noqa: BLE001 — degraded read
+                    self.failed += 1
+            await asyncio.sleep(0)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class Thrasher:
+    def __init__(self, cluster: MiniCluster, seed: int = 0,
+                 min_live: int = 3, min_interval: float = 0.1,
+                 max_interval: float = 0.5) -> None:
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.min_live = min_live
+        self.min_interval = min_interval
+        self.max_interval = max_interval
+        self.kills = 0
+        self._stop = asyncio.Event()
+
+    def _live(self) -> "list[int]":
+        return [i for i, o in self.cluster.osds.items() if o.up]
+
+    async def run(self) -> None:
+        down: "list[int]" = []
+        while not self._stop.is_set():
+            await asyncio.sleep(self.rng.uniform(self.min_interval,
+                                                 self.max_interval))
+            live = self._live()
+            if down and (len(live) <= self.min_live
+                         or self.rng.random() < 0.5):
+                victim = down.pop(self.rng.randrange(len(down)))
+                dout("qa", 5, f"thrasher: revive osd.{victim}")
+                await self.cluster.revive_osd(victim)
+                await self.cluster.peer_all()
+            elif len(live) > self.min_live:
+                victim = self.rng.choice(live)
+                dout("qa", 5, f"thrasher: kill osd.{victim}")
+                await self.cluster.kill_osd(victim)
+                down.append(victim)
+                self.kills += 1
+        for victim in down:
+            await self.cluster.revive_osd(victim)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+async def run_thrash(cluster: MiniCluster, pool: str,
+                     duration: float = 10.0, seed: int = 0,
+                     min_live: int = 3) -> dict:
+    """Thrash ``pool`` for ``duration`` seconds, heal, verify.
+
+    Returns stats; raises AssertionError on any committed-data loss.
+    """
+    wl = Workload(cluster, pool, seed=seed)
+    th = Thrasher(cluster, seed=seed + 1, min_live=min_live)
+    wtask = asyncio.ensure_future(wl.run())
+    ttask = asyncio.ensure_future(th.run())
+    await asyncio.sleep(duration)
+    th.stop()
+    wl.stop()
+    await ttask
+    await wtask
+    assert wl.read_mismatch is None, \
+        f"read-after-ack mismatch on {wl.read_mismatch} during thrash"
+    # heal: everything up + peered
+    for i, osd in list(cluster.osds.items()):
+        if not osd.up:
+            await cluster.revive_osd(i)
+    await cluster.peer_all()
+    # the invariant: every acked write is readable byte-equal
+    client = await cluster.client()
+    io = client.io_ctx(pool)
+    for oid, want in sorted(wl.committed.items()):
+        got = await io.read(oid)
+        assert got == want, \
+            (f"DATA LOSS after thrash: {oid}: {len(got)} bytes vs "
+             f"{len(want)} committed (acked={wl.acked} kills={th.kills})")
+    # unknown-outcome objects: content unassertable, but reads must
+    # complete cleanly (data or a clean error — never hang or garbage)
+    for oid in sorted(wl.dropped - set(wl.committed)):
+        try:
+            await asyncio.wait_for(io.read(oid), timeout=10.0)
+        except asyncio.TimeoutError:
+            raise AssertionError(f"read of {oid} hung after heal")
+        except Exception:  # noqa: BLE001 — clean errors are acceptable
+            pass
+    return {"acked": wl.acked, "failed": wl.failed, "kills": th.kills,
+            "objects": len(wl.committed)}

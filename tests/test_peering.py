@@ -29,10 +29,12 @@ def payload(n, seed=0):
 
 
 def make_cluster(n=6):
+    # min_size=k: these tests deliberately write doubly-degraded (the
+    # operator-lowered-min_size regime) to exercise recovery convergence
     cluster = MiniCluster(n)
     cluster.create_ec_pool(
         "ecpool", {"plugin": "jax_rs", "k": "3", "m": "2"},
-        pg_num=4, stripe_unit=64)
+        pg_num=4, stripe_unit=64, min_size=3)
     return cluster
 
 

@@ -1,0 +1,47 @@
+"""Thrasher QA tier (qa/thrasher.py) — reference qa/tasks/thrashosds.py.
+
+Kill/revive OSDs at random intervals under a live write/read workload,
+then heal and assert every acknowledged write is readable byte-equal.
+This is the regime where round-1's silent-data-loss bugs lived (failed
+sub-write sends counted as commits, stale-shard adoption): the thrasher
+makes those regressions loud.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.qa.thrasher import run_thrash
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_thrash_ec_pool(loop):
+    async def go():
+        async with MiniCluster(n_osds=7) as c:
+            c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "3",
+                                    "m": "2"}, pg_num=8, stripe_unit=64)
+            stats = await run_thrash(c, "ec", duration=8.0, seed=7,
+                                     min_live=4)
+            assert stats["acked"] > 0
+            assert stats["kills"] > 0, "thrasher never killed an osd"
+    loop.run_until_complete(go())
+
+
+def test_thrash_replicated_pool(loop):
+    async def go():
+        async with MiniCluster(n_osds=6) as c:
+            c.create_replicated_pool("rep", size=3, pg_num=8,
+                                     stripe_unit=512)
+            stats = await run_thrash(c, "rep", duration=6.0, seed=11,
+                                     min_live=3)
+            assert stats["acked"] > 0
+            assert stats["kills"] > 0
+    loop.run_until_complete(go())
