@@ -113,6 +113,36 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
            enum_values=("wpq", "mclock"), desc="op scheduler implementation",
            services=("osd",)),
+    Option("osd_op_num_concurrent", int, 8, LEVEL_ADVANCED, min=1,
+           desc="op scheduler slots (the ShardedOpWQ thread-pool analog)",
+           services=("osd",)),
+    Option("osd_mclock_scheduler_client_res", float, 50.0, LEVEL_ADVANCED,
+           min=0, desc="mclock: client reservation (ops/s)"),
+    Option("osd_mclock_scheduler_client_wgt", float, 2.0, LEVEL_ADVANCED,
+           min=0.01, desc="mclock: client weight"),
+    Option("osd_mclock_scheduler_client_lim", float, 0.0, LEVEL_ADVANCED,
+           min=0, desc="mclock: client limit (ops/s, 0 = unlimited)"),
+    Option("osd_mclock_scheduler_background_recovery_res", float, 10.0,
+           LEVEL_ADVANCED, min=0,
+           desc="mclock: recovery reservation (ops/s)"),
+    Option("osd_mclock_scheduler_background_recovery_wgt", float, 1.0,
+           LEVEL_ADVANCED, min=0.01, desc="mclock: recovery weight"),
+    Option("osd_mclock_scheduler_background_recovery_lim", float, 100.0,
+           LEVEL_ADVANCED, min=0,
+           desc="mclock: recovery limit (ops/s, 0 = unlimited)"),
+    Option("osd_mclock_scheduler_background_scrub_res", float, 5.0,
+           LEVEL_ADVANCED, min=0, desc="mclock: scrub reservation (ops/s)"),
+    Option("osd_mclock_scheduler_background_scrub_wgt", float, 0.5,
+           LEVEL_ADVANCED, min=0.01, desc="mclock: scrub weight"),
+    Option("osd_mclock_scheduler_background_scrub_lim", float, 50.0,
+           LEVEL_ADVANCED, min=0,
+           desc="mclock: scrub limit (ops/s, 0 = unlimited)"),
+    Option("osd_mclock_scheduler_background_best_effort_res", float, 0.0,
+           LEVEL_ADVANCED, min=0, desc="mclock: best-effort reservation"),
+    Option("osd_mclock_scheduler_background_best_effort_wgt", float, 0.5,
+           LEVEL_ADVANCED, min=0.01, desc="mclock: best-effort weight"),
+    Option("osd_mclock_scheduler_background_best_effort_lim", float, 0.0,
+           LEVEL_ADVANCED, min=0, desc="mclock: best-effort limit"),
     Option("osd_ec_batch_max", int, 64, LEVEL_ADVANCED, min=1,
            desc="max sub-write encodes stacked into one device launch by "
                 "the cross-PG EncodeService"),

@@ -33,6 +33,7 @@ from .ecbackend import (EIO, ESTALE, ClientOp, ECBackend, ECError, NONE_OSD,
 from .ecutil import StripeInfo
 from .encode_service import EncodeService
 from .replicated import ReplicateCodec
+from .scheduler import CLIENT, MClockScheduler
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
                        MOSDPGPushReply, MOSDPing, MOSDPingReply,
@@ -81,6 +82,9 @@ class OSDDaemon(Dispatcher):
         # primary this OSD hosts funnels sub-write encodes through it
         # (BASELINE.json north-star deviation; see osd/encode_service.py)
         self.encode_service = EncodeService.from_config(self.config)
+        # op QoS: client vs recovery vs scrub share the op slots per the
+        # configured policy (reference ShardedOpWQ + mClockScheduler)
+        self.op_scheduler = MClockScheduler.from_config(self.config)
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
@@ -195,7 +199,8 @@ class OSDDaemon(Dispatcher):
         be = ECBackend(pgid, self.whoami, codec, sinfo, self.store,
                        self._send_to_osd, lambda p=pgid: self._acting(p),
                        min_size=pool.min_size,
-                       encode_service=self.encode_service)
+                       encode_service=self.encode_service,
+                       scheduler=self.op_scheduler)
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be
@@ -300,6 +305,10 @@ class OSDDaemon(Dispatcher):
     # --- client ops (reference PrimaryLogPG::do_op -> execute_ctx) -----------
 
     async def _handle_client_op(self, conn, msg: MOSDOp) -> None:
+        async with self.op_scheduler.queued(CLIENT):
+            await self._do_client_op(conn, msg)
+
+    async def _do_client_op(self, conn, msg: MOSDOp) -> None:
         self.perf.inc("op")
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]

@@ -135,6 +135,8 @@ class ReadOp:
     bad_shards: "Set[int]" = field(default_factory=set)
     complete: "Dict[str, Dict[int, Dict[int, bytes]]]" = field(
         default_factory=dict)                   # oid -> shard -> off -> bytes
+    sizes: "Dict[str, Dict[int, int]]" = field(
+        default_factory=dict)                   # oid -> shard -> full size
     attrs: "Dict[str, Dict[str, bytes]]" = field(default_factory=dict)
     errors: "Dict[str, int]" = field(default_factory=dict)
     done: "asyncio.Future" = None               # type: ignore[assignment]
@@ -169,7 +171,7 @@ class ECBackend:
                  send: "Callable[[int, Any], Any]",
                  get_acting: "Callable[[], List[int]]",
                  min_size: "Optional[int]" = None,
-                 encode_service=None) -> None:
+                 encode_service=None, scheduler=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -183,6 +185,9 @@ class ECBackend:
         # daemon-shared cross-PG batched device encode queue (None =
         # direct host/codec calls, the reference's per-op behavior)
         self.encode_service = encode_service
+        # daemon-shared op scheduler: recovery/scrub work queues behind
+        # it so client I/O keeps its QoS share (None = unthrottled)
+        self.scheduler = scheduler
         self.extent_cache = ExtentCache()
         # primary pipeline state
         self.waiting_state: "List[Op]" = []
@@ -224,6 +229,9 @@ class ECBackend:
         # suspect.  None = log is contiguous.
         self.log_gap_from: "Optional[Version]" = None
         self.last_epoch = 1
+        # cumulative bytes this shard served to sub-reads (repair-I/O
+        # accounting: clay repair must move less than full-chunk repair)
+        self.sub_read_bytes = 0
         # newest map epoch a primary has peered this shard at: sub-ops
         # from primaries of OLDER epochs are rejected, so a deposed
         # primary can never complete (and ack) a write behind the back
@@ -894,24 +902,41 @@ class ECBackend:
         buffers_read: "List[dict]" = []
         errors: "Dict[str, int]" = {}
         attrs_read: "Dict[str, dict]" = {}
+        sub_count = self.codec.get_sub_chunk_count()
         for req in msg["to_read"]:
             oid = req["oid"]
             sid = ObjectId(oid, shard)
+            subs = [tuple(x) for x in req.get("subchunks",
+                                              [(0, sub_count)])]
+            partial = subs != [(0, sub_count)]
             extents_out = []
             try:
                 st = self.store.stat(cid, sid)
                 for off, length in req["extents"]:
                     # length -1 = whole shard (recovery reads don't know
                     # the object size up front; the store clamps)
-                    data = bytes(self.store.read(
-                        cid, sid, int(off),
-                        None if int(length) < 0 else int(length)))
+                    if partial and int(length) < 0 and sub_count > 1 \
+                            and st["size"] % sub_count == 0:
+                        # sub-chunk plan (clay repair): serve only the
+                        # planned plane runs — 1/q of the chunk instead
+                        # of all of it (reference ECBackend.cc:1015-1036
+                        # reading ECSubRead subchunk lists)
+                        ss = st["size"] // sub_count
+                        data = b"".join(
+                            bytes(self.store.read(cid, sid, s * ss,
+                                                  n * ss))
+                            for s, n in subs)
+                    else:
+                        data = bytes(self.store.read(
+                            cid, sid, int(off),
+                            None if int(length) < 0 else int(length)))
                     extents_out.append([int(off), len(out_bufs)])
                     out_bufs.append(data)
                 self._verify_shard_crc(cid, sid, shard, st,
                                        req["extents"], out_bufs,
                                        extents_out)
-                buffers_read.append({"oid": oid, "extents": extents_out})
+                buffers_read.append({"oid": oid, "extents": extents_out,
+                                     "size": st["size"]})
             except (NotFound, ECError) as e:
                 dout("osd", 5, f"sub_read error {oid}@{shard}: {e}")
                 errors[oid] = EIO if isinstance(e, ECError) else ENOENT
@@ -924,6 +949,7 @@ class ECBackend:
             except NotFound:
                 errors.setdefault(oid, ENOENT)
         lens, blob = pack_buffers(out_bufs)
+        self.sub_read_bytes += sum(len(b) for b in out_bufs)
         return MECSubOpReadReply({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -1079,7 +1105,14 @@ class ECBackend:
             shard_bufs = rop.complete.setdefault(
                 rec["oid"], {}).setdefault(shard, {})
             for off, idx in rec["extents"]:
-                shard_bufs[int(off)] = bufs[int(idx)]
+                buf = bufs[int(idx)]
+                # never let a late partial (sub-chunk) reply downgrade a
+                # full-chunk buffer a re-plan already fetched
+                if len(buf) >= len(shard_bufs.get(int(off), b"")):
+                    shard_bufs[int(off)] = buf
+            if "size" in rec:
+                rop.sizes.setdefault(rec["oid"], {})[shard] = \
+                    int(rec["size"])
         for oid, attrs in msg.get("attrs_read", {}).items():
             rop.attrs.setdefault(oid, {}).update(
                 {k: bytes.fromhex(v) for k, v in attrs.items()})
@@ -1112,6 +1145,11 @@ class ECBackend:
             rop.retries_pending -= 1
             self._maybe_complete_read(rop)
             return
+        # a re-plan may switch from a sub-chunk (partial) plan to full
+        # chunks: stale partial buffers must not survive into the decode
+        # (zero-padded planes would reconstruct garbage)
+        for oid in oids:
+            rop.complete.pop(oid, None)
         await self._issue_shard_reads(rop, need, avail, oids)
         rop.retries_pending -= 1
         self._maybe_complete_read(rop)
@@ -1172,6 +1210,17 @@ class ECBackend:
 
     async def recover_object(self, oid: str, missing_on: "Set[int]",
                              exclude: "Optional[Set[int]]" = None) -> None:
+        if self.scheduler is not None:
+            # recovery work queues behind the QoS policy so client I/O
+            # keeps its share (reference mClockScheduler background
+            # recovery class)
+            async with self.scheduler.queued("recovery"):
+                return await self._recover_object(oid, missing_on,
+                                                  exclude)
+        return await self._recover_object(oid, missing_on, exclude)
+
+    async def _recover_object(self, oid: str, missing_on: "Set[int]",
+                              exclude: "Optional[Set[int]]" = None) -> None:
         """Rebuild ``oid``'s shards on ``missing_on`` (reference
         recover_object ECBackend.cc:738 + continue_recovery_op :570:
         IDLE -> READING -> WRITING -> COMPLETE).  ``exclude`` keeps
@@ -1195,13 +1244,27 @@ class ECBackend:
         shard_bufs = read.complete.get(oid, {})
         csize = max((sum(len(b) for b in by_off.values())
                      for by_off in shard_bufs.values()), default=0)
-        arrs = {}
-        for shard, by_off in shard_bufs.items():
-            buf = b"".join(by_off[o] for o in sorted(by_off))
-            arrs[shard] = np.frombuffer(buf.ljust(csize, b"\0"),
-                                        dtype=np.uint8)
-        decoded = ecutil.decode(self.sinfo, self.codec, arrs,
-                                sorted(rop.missing_on))
+        full_size = max(read.sizes.get(oid, {}).values(), default=csize)
+        if 0 < csize < full_size and len({
+                sum(len(b) for b in bo.values())
+                for bo in shard_bufs.values()}) == 1:
+            # helpers served sub-chunk repair planes, not whole chunks:
+            # hand the partial buffers plus the true chunk size to the
+            # codec's repair decode (clay reads ~1/q of each helper)
+            arrs = {s: np.frombuffer(
+                b"".join(bo[o] for o in sorted(bo)), dtype=np.uint8)
+                for s, bo in shard_bufs.items()}
+            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                    sorted(rop.missing_on),
+                                    chunk_size=full_size)
+        else:
+            arrs = {}
+            for shard, by_off in shard_bufs.items():
+                buf = b"".join(by_off[o] for o in sorted(by_off))
+                arrs[shard] = np.frombuffer(buf.ljust(csize, b"\0"),
+                                            dtype=np.uint8)
+            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                    sorted(rop.missing_on))
         rop.recovered = {s: bytes(a.tobytes()) for s, a in decoded.items()}
         rop.attrs = read.attrs.get(oid, {})
         # WRITING: push rebuilt shards to their peers

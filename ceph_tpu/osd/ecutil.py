@@ -144,11 +144,18 @@ def encode(sinfo: StripeInfo, codec: ErasureCodeInterface,
 
 def decode(sinfo: StripeInfo, codec: ErasureCodeInterface,
            shards: "Mapping[int, np.ndarray]",
-           want_to_read: "Sequence[int] | None" = None
-           ) -> "dict[int, np.ndarray]":
+           want_to_read: "Sequence[int] | None" = None,
+           chunk_size: "int | None" = None) -> "dict[int, np.ndarray]":
     """Reconstruct shard extents from available ones (full-chunk path,
-    reference ECUtil.cc:9-45).  All shard buffers must be equal length and
-    chunk-aligned; decode runs once over the whole extent."""
+    reference ECUtil.cc:9-45).  All shard buffers must be equal length;
+    decode runs once over the whole extent.
+
+    ``chunk_size``: the FULL per-shard extent when the buffers are
+    partial — the sub-chunk-aware path (reference ECUtil.cc:47-118):
+    helpers sent only the repair-plane runs minimum_to_decode planned
+    (clay single-failure repair reads ~1/q of each helper) and the
+    codec's decode reassembles the whole lost chunk from them.
+    """
     have = {i: np.asarray(b, dtype=np.uint8).reshape(-1)
             for i, b in shards.items()}
     if not have:
@@ -157,7 +164,9 @@ def decode(sinfo: StripeInfo, codec: ErasureCodeInterface,
     if len(sizes) != 1:
         raise ErasureCodeError(f"decode: mixed shard sizes {sizes}")
     total = sizes.pop()
-    if total % sinfo.chunk_size:
+    if chunk_size is not None:
+        total = chunk_size
+    elif total % sinfo.chunk_size:
         raise ErasureCodeError(
             f"decode: shard size {total} not chunk-aligned")
     if want_to_read is None:

@@ -1,0 +1,204 @@
+"""Op scheduler — mClock QoS between client, recovery, and scrub work.
+
+Reference: src/osd/scheduler/{OpScheduler,mClockScheduler}.h (:61) over
+the dmclock library (an empty submodule in the snapshot, so the
+algorithm is reimplemented here from the mClock paper's tag scheme):
+
+- every class c has (reservation r_c ops/s, weight w_c, limit l_c ops/s)
+- each request gets three tags: R (guaranteed service), P (proportional
+  share), L (cap); R-tags at or past due are served first (meeting
+  reservations), then the lowest P-tag among classes under their limit
+- limit 0 = unlimited; reservation 0 = no guarantee
+
+The OSD wraps each unit of work in ``async with scheduler.queued(c)``:
+client ops from dispatch, recovery pushes, scrub chunks.  A fixed slot
+count models the OSD's op thread pool (ShardedOpWQ); waiting requests
+park on futures and a timer wakes the dispatcher when the earliest
+limit tag matures.
+
+``wpq`` mode (the reference's default weighted-priority queue) degrades
+to plain FIFO over the same slots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+CLIENT = "client"
+RECOVERY = "recovery"
+SCRUB = "scrub"
+BEST_EFFORT = "best_effort"
+
+# (reservation ops/s, weight, limit ops/s) — defaults follow the
+# reference's high_client_ops profile shape: clients get the bulk,
+# background work is capped.
+DEFAULT_PARAMS: "Dict[str, Tuple[float, float, float]]" = {
+    CLIENT: (50.0, 2.0, 0.0),
+    RECOVERY: (10.0, 1.0, 100.0),
+    SCRUB: (5.0, 0.5, 50.0),
+    BEST_EFFORT: (0.0, 0.5, 0.0),
+}
+
+
+class _ClassState:
+    __slots__ = ("res", "wgt", "lim", "r_tag", "p_tag", "l_tag", "queue")
+
+    def __init__(self, res: float, wgt: float, lim: float) -> None:
+        self.res, self.wgt, self.lim = res, wgt, lim
+        self.r_tag = self.p_tag = self.l_tag = 0.0
+        self.queue: "Deque[asyncio.Future]" = deque()
+
+
+class MClockScheduler:
+    def __init__(self, slots: int = 8,
+                 params: "Optional[Dict[str, Tuple[float, float, float]]]"
+                 = None) -> None:
+        self.slots = max(1, int(slots))
+        self.in_flight = 0
+        self.classes = {name: _ClassState(*p) for name, p in
+                        (params or DEFAULT_PARAMS).items()}
+        self._timer: "Optional[asyncio.TimerHandle]" = None
+        self.stats = {name: 0 for name in self.classes}
+
+    @classmethod
+    def from_config(cls, config) -> "OpScheduler":
+        if str(config.get("osd_op_queue")) != "mclock":
+            return FifoScheduler(int(config.get("osd_op_num_concurrent")))
+        params = {}
+        for name in DEFAULT_PARAMS:
+            key = (f"osd_mclock_scheduler_{name}"
+                   if name == CLIENT else
+                   f"osd_mclock_scheduler_background_{name}")
+            params[name] = (float(config.get(f"{key}_res")),
+                            float(config.get(f"{key}_wgt")),
+                            float(config.get(f"{key}_lim")))
+        return cls(int(config.get("osd_op_num_concurrent")), params)
+
+    # --- public API -----------------------------------------------------------
+
+    def queued(self, klass: str) -> "_Slot":
+        return _Slot(self, klass)
+
+    async def _acquire(self, klass: str) -> None:
+        c = self.classes.get(klass) or self.classes[BEST_EFFORT]
+        now = time.monotonic()
+        # tag assignment (mClock): advance each tag from its last value
+        # at the class's configured rate, never behind now
+        c.r_tag = max(c.r_tag + (1.0 / c.res if c.res else 0.0), now) \
+            if c.res else float("inf")
+        c.p_tag = max(c.p_tag + 1.0 / c.wgt, now)
+        c.l_tag = max(c.l_tag + (1.0 / c.lim if c.lim else 0.0), now)
+        fut = asyncio.get_event_loop().create_future()
+        fut._mclock = (c.r_tag, c.p_tag, c.l_tag)  # type: ignore[attr-defined]
+        c.queue.append(fut)
+        self._dispatch()
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # the slot was already granted: hand it back, or it
+                # leaks and the scheduler eventually starves
+                self._release()
+            else:
+                try:
+                    c.queue.remove(fut)
+                except ValueError:
+                    pass
+            raise
+        self.stats[klass] = self.stats.get(klass, 0) + 1
+
+    def _release(self) -> None:
+        self.in_flight -= 1
+        self._dispatch()
+
+    # --- dispatch -------------------------------------------------------------
+
+    def _dispatch(self) -> None:
+        now = time.monotonic()
+        while self.in_flight < self.slots:
+            pick = self._pick(now)
+            if pick is None:
+                break
+            fut = pick.queue.popleft()
+            if fut.done():
+                continue
+            self.in_flight += 1
+            fut.set_result(None)
+        self._arm_timer(now)
+
+    def _pick(self, now: float) -> "Optional[_ClassState]":
+        # 1. overdue reservations first (constraint-based phase)
+        best = None
+        for c in self.classes.values():
+            if not c.queue:
+                continue
+            r = c.queue[0]._mclock[0]  # type: ignore[attr-defined]
+            if r <= now and (best is None or r < best[0]):
+                best = (r, c)
+        if best:
+            return best[1]
+        # 2. lowest proportional tag among classes under their limit
+        best = None
+        for c in self.classes.values():
+            if not c.queue:
+                continue
+            _r, p, l = c.queue[0]._mclock  # type: ignore[attr-defined]
+            if l <= now and (best is None or p < best[0]):
+                best = (p, c)
+        return best[1] if best else None
+
+    def _arm_timer(self, now: float) -> None:
+        """Wake when the earliest pending tag matures (limit/reservation
+        in the future is the only reason a slot can idle with work
+        queued)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self.in_flight >= self.slots:
+            return
+        nxt = None
+        for c in self.classes.values():
+            if not c.queue:
+                continue
+            r, _p, l = c.queue[0]._mclock  # type: ignore[attr-defined]
+            t = min(x for x in (r, l) if x != float("inf"))
+            nxt = t if nxt is None else min(nxt, t)
+        if nxt is not None and nxt > now:
+            self._timer = asyncio.get_event_loop().call_later(
+                max(0.001, nxt - now), self._dispatch)
+
+
+class FifoScheduler:
+    """osd_op_queue=wpq stand-in: plain slot limiting, no QoS."""
+
+    def __init__(self, slots: int = 8) -> None:
+        self._sem = asyncio.Semaphore(max(1, int(slots)))
+        self.stats: "Dict[str, int]" = {}
+
+    def queued(self, klass: str) -> "_Slot":
+        return _Slot(self, klass)
+
+    async def _acquire(self, klass: str) -> None:
+        await self._sem.acquire()
+        self.stats[klass] = self.stats.get(klass, 0) + 1
+
+    def _release(self) -> None:
+        self._sem.release()
+
+
+OpScheduler = "MClockScheduler | FifoScheduler"
+
+
+class _Slot:
+    def __init__(self, sched, klass: str) -> None:
+        self.sched = sched
+        self.klass = klass
+
+    async def __aenter__(self) -> None:
+        await self.sched._acquire(self.klass)
+
+    async def __aexit__(self, *exc) -> None:
+        self.sched._release()

@@ -130,60 +130,76 @@ async def run_scrub(backend, deep: bool = False,
            "deep_errors": [], "repaired": [], "hinfo_rebuilt": []}
 
     for oid in oids:
-        bad: "set[int]" = set()
-        present = {s: maps[s][oid] for s in live if oid in maps[s]}
-        # shards that should have the object but don't
-        for s in live - set(present):
-            res["shallow_errors"].append(
-                {"oid": oid, "shard": s, "error": "missing"})
-            bad.add(s)
-        auth_oi = _majority(e.get("oi") for e in present.values())
-        auth_size = Counter(e["size"] for e in present.values()
-                            ).most_common(1)[0][0]
-        for s, e in present.items():
-            if e["size"] != auth_size:
-                res["shallow_errors"].append(
-                    {"oid": oid, "shard": s, "error": "size",
-                     "got": e["size"], "want": auth_size})
-                bad.add(s)
-            elif auth_oi and e.get("oi") != auth_oi:
-                res["shallow_errors"].append(
-                    {"oid": oid, "shard": s, "error": "object_info"})
-                bad.add(s)
-
-        hinfo = None
-        auth_hinfo = _majority(e.get("hinfo") for e in present.values())
-        if auth_hinfo:
-            try:
-                hinfo = ecutil.HashInfo.decode(bytes.fromhex(auth_hinfo))
-            except Exception:  # noqa: BLE001 — corrupt xattr
-                hinfo = None
-        if deep and hinfo is not None and hinfo.valid():
-            for s, e in present.items():
-                if s in bad or "crc" not in e:
-                    continue
-                if int(e["crc"]) != hinfo.get_chunk_hash(s):
-                    res["deep_errors"].append(
-                        {"oid": oid, "shard": s, "error": "crc",
-                         "got": int(e["crc"]),
-                         "want": hinfo.get_chunk_hash(s)})
-                    bad.add(s)
-        elif deep and (hinfo is None or not hinfo.valid()):
-            # RMW-invalidated (or lost) hash chain: reconstruct the
-            # object from a decodable subset, re-encode, identify bad
-            # shards by majority-of-recomputation, rebuild the hinfo
-            rebuilt_bad = await _rebuild_hinfo(
-                backend, oid, present, res)
-            bad |= rebuilt_bad
-
+        if backend.scheduler is not None:
+            # the comparison/rebuild work runs INSIDE the scrub slot;
+            # repair runs after release (recover_object takes its own
+            # recovery slot — nesting would deadlock at slots=1)
+            async with backend.scheduler.queued("scrub"):
+                bad = await _scrub_object(backend, oid, maps, live, deep,
+                                          res)
+        else:
+            bad = await _scrub_object(backend, oid, maps, live, deep, res)
         if repair and bad:
             try:
-                await backend.recover_object(oid, set(bad), exclude=set(bad))
+                await backend.recover_object(oid, set(bad),
+                                             exclude=set(bad))
                 res["repaired"].append({"oid": oid, "shards": sorted(bad)})
             except Exception as e:  # noqa: BLE001 — record, keep scrubbing
                 res.setdefault("repair_failed", []).append(
                     {"oid": oid, "shards": sorted(bad), "error": str(e)})
     return res
+
+
+async def _scrub_object(backend, oid: str, maps, live, deep: bool,
+                        res: dict) -> "set[int]":
+    """Compare one object across shard maps; returns the bad-shard set
+    (repair happens in run_scrub, outside the scrub QoS slot)."""
+    bad: "set[int]" = set()
+    present = {s: maps[s][oid] for s in live if oid in maps[s]}
+    # shards that should have the object but don't
+    for s in live - set(present):
+        res["shallow_errors"].append(
+            {"oid": oid, "shard": s, "error": "missing"})
+        bad.add(s)
+    auth_oi = _majority(e.get("oi") for e in present.values())
+    auth_size = Counter(e["size"] for e in present.values()
+                        ).most_common(1)[0][0]
+    for s, e in present.items():
+        if e["size"] != auth_size:
+            res["shallow_errors"].append(
+                {"oid": oid, "shard": s, "error": "size",
+                 "got": e["size"], "want": auth_size})
+            bad.add(s)
+        elif auth_oi and e.get("oi") != auth_oi:
+            res["shallow_errors"].append(
+                {"oid": oid, "shard": s, "error": "object_info"})
+            bad.add(s)
+
+    hinfo = None
+    auth_hinfo = _majority(e.get("hinfo") for e in present.values())
+    if auth_hinfo:
+        try:
+            hinfo = ecutil.HashInfo.decode(bytes.fromhex(auth_hinfo))
+        except Exception:  # noqa: BLE001 — corrupt xattr
+            hinfo = None
+    if deep and hinfo is not None and hinfo.valid():
+        for s, e in present.items():
+            if s in bad or "crc" not in e:
+                continue
+            if int(e["crc"]) != hinfo.get_chunk_hash(s):
+                res["deep_errors"].append(
+                    {"oid": oid, "shard": s, "error": "crc",
+                     "got": int(e["crc"]),
+                     "want": hinfo.get_chunk_hash(s)})
+                bad.add(s)
+    elif deep and (hinfo is None or not hinfo.valid()):
+        # RMW-invalidated (or lost) hash chain: reconstruct the
+        # object from a decodable subset, re-encode, identify bad
+        # shards by majority-of-recomputation, rebuild the hinfo
+        rebuilt_bad = await _rebuild_hinfo(
+            backend, oid, present, res)
+        bad |= rebuilt_bad
+    return bad
 
 
 def _consistent_reconstruction(backend, arrs: "Dict[int, np.ndarray]"):

@@ -1,0 +1,41 @@
+"""Golden corpus non-regression (tools/ec_non_regression.py).
+
+The committed corpus pins every plugin family's encoded bytes; a codec
+change that alters outputs (making old data undecodable) fails here.
+Reference: ceph_erasure_code_non_regression.cc + ceph-erasure-code-corpus.
+"""
+
+import os
+
+import pytest
+
+from tools import ec_non_regression as nr
+
+
+def corpus_dirs():
+    if not os.path.isdir(nr.CORPUS):
+        return []
+    out = []
+    for plugin in sorted(os.listdir(nr.CORPUS)):
+        pd = os.path.join(nr.CORPUS, plugin)
+        if os.path.isdir(pd):
+            out.extend(os.path.join(pd, k) for k in sorted(os.listdir(pd)))
+    return out
+
+
+DIRS = corpus_dirs()
+
+
+def test_corpus_exists_and_covers_every_plugin():
+    assert DIRS, "corpus missing: run tools/ec_non_regression.py --create"
+    plugins = {os.path.basename(os.path.dirname(d)) for d in DIRS}
+    assert plugins >= {"jax_rs", "jerasure", "isa", "xor", "lrc", "shec",
+                       "clay"}
+
+
+@pytest.mark.parametrize("d", DIRS,
+                         ids=[os.sep.join(d.split(os.sep)[-2:])
+                              for d in DIRS])
+def test_corpus_entry(d):
+    errs = nr.check_entry(d)
+    assert not errs, errs

@@ -1,0 +1,123 @@
+"""Sub-chunk reads end-to-end: clay repair I/O < full-chunk repair I/O.
+
+Reference: ECSubRead carries per-shard subchunk lists
+(ECMsgTypes.h:105-116), handle_sub_read reads only those ranges
+(ECBackend.cc:1015-1036), and clay's minimum_to_decode plans ~1/q of
+each helper for single-failure repair — the plugin family's entire
+reason to exist.  These tests verify the plan survives the wire: the
+recovery of one lost shard moves measurably fewer bytes than the
+full-chunk equivalent, and the repaired data is byte-equal.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def total_sub_read_bytes(cluster) -> int:
+    return sum(be.sub_read_bytes
+               for osd in cluster.osds.values()
+               for be in osd.backends.values())
+
+
+async def recover_one_shard(profile, stripe_unit, n_osds=7, seed=3):
+    """Write, kill one shard's OSD, revive, recover; return (bytes moved
+    during recovery, roundtrip_ok, chunk_size)."""
+    async with MiniCluster(n_osds=n_osds) as c:
+        c.create_ec_pool("p", profile, pg_num=1, stripe_unit=stripe_unit,
+                         min_size=int(profile["k"]))
+        client = await c.client()
+        io = client.io_ctx("p")
+        data = payload(48 * 1024, seed)
+        await io.write_full("obj", data)
+        pool = c.osdmap.pool_by_name("p")
+        _u, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)
+        victim = acting[1]
+        await c.kill_osd(victim)
+        await c.revive_osd(victim)
+        # the revived OSD lost nothing on disk; force a real re-push by
+        # wiping its shard store collection for this pg
+        from ceph_tpu.objectstore.transaction import Transaction
+        from ceph_tpu.objectstore.types import Collection, ObjectId
+        osd = c.osds[victim]
+        cid = Collection(pool.pool_id, 0, 1)
+        t = Transaction()
+        t.remove(cid, ObjectId("obj", 1))
+        osd.store.apply_transaction(t)
+        be = osd.backends.get((pool.pool_id, 0))
+        if be is not None:
+            be.local_missing["obj"] = be.pg_log.head
+        before = total_sub_read_bytes(c)
+        primary = c.osdmap.primary_of(acting)
+        pbe = c.osds[primary]._get_backend((pool.pool_id, 0))
+        await pbe.recover_object("obj", {1}, exclude={1})
+        moved = total_sub_read_bytes(c) - before
+        ok = await io.read("obj") == data
+        csize = pbe.sinfo.chunk_size
+        return moved, ok, csize
+
+
+def test_clay_repair_reads_less_than_full(loop):
+    async def go():
+        clay_moved, clay_ok, csize = await recover_one_shard(
+            {"plugin": "clay", "k": "4", "m": "2"}, stripe_unit=2048)
+        rs_moved, rs_ok, csize2 = await recover_one_shard(
+            {"plugin": "jax_rs", "k": "4", "m": "2"}, stripe_unit=2048)
+        assert clay_ok and rs_ok
+        # clay (k=4, m=2, d=5): helpers send 1/q = 1/2 of each chunk
+        # from d=5 helpers vs k=4 full chunks for RS
+        assert clay_moved < rs_moved, (clay_moved, rs_moved)
+        assert clay_moved <= rs_moved * 0.7, (clay_moved, rs_moved)
+    loop.run_until_complete(go())
+
+
+def test_clay_repaired_shard_serves_reads(loop):
+    """After sub-chunk repair the rebuilt shard must be byte-correct:
+    read with enough OTHER shards down that it becomes a source."""
+    async def go():
+        async with MiniCluster(n_osds=7) as c:
+            c.create_ec_pool("p", {"plugin": "clay", "k": "4", "m": "2"},
+                             pg_num=1, stripe_unit=2048, min_size=4)
+            client = await c.client()
+            io = client.io_ctx("p")
+            data = payload(64 * 1024, 9)
+            await io.write_full("obj", data)
+            pool = c.osdmap.pool_by_name("p")
+            _u, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)
+            victim = acting[2]
+            await c.kill_osd(victim)
+            await c.revive_osd(victim)
+            from ceph_tpu.objectstore.transaction import Transaction
+            from ceph_tpu.objectstore.types import Collection, ObjectId
+            t = Transaction()
+            t.remove(Collection(pool.pool_id, 0, 2), ObjectId("obj", 2))
+            c.osds[victim].store.apply_transaction(t)
+            be = c.osds[victim].backends.get((pool.pool_id, 0))
+            if be is not None:
+                be.local_missing["obj"] = be.pg_log.head
+            primary = c.osdmap.primary_of(acting)
+            pbe = c.osds[primary]._get_backend((pool.pool_id, 0))
+            await pbe.recover_object("obj", {2}, exclude={2})
+            # make the repaired shard load-bearing: kill two others
+            others = [o for s, o in enumerate(acting)
+                      if s not in (2,) and o != primary][:2]
+            for o in others:
+                await c.kill_osd(o)
+            assert await io.read("obj") == data
+    loop.run_until_complete(go())
