@@ -20,12 +20,28 @@ from ..osd.osdmap import NONE_OSD, OSDMap
 
 
 class ObjecterError(Exception):
-    pass
+    """Client op failure; ``errno`` carries the OSD's wire errno when
+    one was returned (0 = transport/unknown), so callers can tell
+    object-absent (ENOENT) from transient failures."""
+
+    def __init__(self, msg: str, errno: int = 0) -> None:
+        super().__init__(msg)
+        self.errno = errno
 
 
 class Objecter(Dispatcher):
     def __init__(self, ms: Messenger, osdmap: OSDMap,
-                 max_retries: int = 6, backoff: float = 0.05) -> None:
+                 max_retries: "Optional[int]" = None,
+                 backoff: "Optional[float]" = None,
+                 op_timeout: "Optional[float]" = None) -> None:
+        # Messenger.conf falls back to the OPTIONS schema defaults, so
+        # config-less clients track the table instead of stale literals
+        if max_retries is None:
+            max_retries = int(ms.conf("objecter_retries"))
+        if backoff is None:
+            backoff = float(ms.conf("objecter_retry_backoff"))
+        self.op_timeout = (op_timeout if op_timeout is not None
+                           else float(ms.conf("rados_osd_op_timeout")))
         self.ms = ms
         self.osdmap = osdmap
         self.max_retries = max_retries
@@ -74,7 +90,7 @@ class Objecter(Dispatcher):
                 conn = self.ms.get_connection(
                     self.osdmap.get_addr(primary), Policy.lossy_client())
                 await conn.send_message(msg)
-                reply = await asyncio.wait_for(fut, timeout=10.0)
+                reply = await asyncio.wait_for(fut, self.op_timeout)
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_err = e
                 self._inflight.pop(tid, None)
@@ -92,7 +108,8 @@ class Objecter(Dispatcher):
             if result != 0:
                 errs = [o.get("error") for o in outs if "error" in o]
                 raise ObjecterError(
-                    f"op on {oid} failed: {errs or reply['result']}")
+                    f"op on {oid} failed: {errs or reply['result']}",
+                    errno=-result)
             return outs, reply.data
         raise ObjecterError(
             f"op on {oid} failed after {self.max_retries} tries: {last_err}")

@@ -1,0 +1,161 @@
+"""Striper — client-side RAID-0 of one logical blob over many objects.
+
+Reference: src/osdc/Striper.{h,cc} (:26, 503 LoC) + src/libradosstriper
+(2.8k LoC).  The "long-object" scaling axis (SURVEY.md §5): a logical
+byte stream is cut into stripe_unit pieces laid round-robin across
+stripe_count objects; after object_size bytes per object the layout
+moves to the next object set.  Each object lands in its own PG via
+CRUSH, so one blob's I/O fans out across the cluster — and every
+per-object write still rides the OSD's cross-PG batched encode service,
+which is exactly the TPU batching geometry.
+
+Layout math (Striper::file_to_extents):
+  su  = stripe_unit, sc = stripe_count, os = object_size (multiple of su)
+  stripe_no  = off // su
+  set_no     = stripe_no // (sc * (os // su))
+  obj_in_set = stripe_no % sc
+  blk_in_obj = (stripe_no // sc) % (os // su)
+  object     = f"{soid}.{set_no * sc + obj_in_set:016x}"
+  obj_off    = blk_in_obj * su + off % su
+
+The logical size is persisted as an xattr on the first object
+(libradosstriper's striper.size), so stat/read don't scan objects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Tuple
+
+SIZE_XATTR = "striper.size"
+
+
+class StripeLayout:
+    def __init__(self, stripe_unit: int = 64 * 1024,
+                 stripe_count: int = 4,
+                 object_size: int = 1024 * 1024) -> None:
+        if object_size % stripe_unit:
+            raise ValueError("object_size must be a multiple of "
+                             "stripe_unit")
+        if stripe_unit <= 0 or stripe_count <= 0:
+            raise ValueError("stripe_unit/stripe_count must be positive")
+        self.su = stripe_unit
+        self.sc = stripe_count
+        self.os = object_size
+
+    def object_name(self, soid: str, index: int) -> str:
+        return f"{soid}.{index:016x}"
+
+    def file_to_extents(self, off: int, length: int
+                        ) -> "List[Tuple[int, int, int, int]]":
+        """(logical off, len) -> [(obj_index, obj_off, length,
+        logical_off)] (reference Striper::file_to_extents)."""
+        out: "List[Tuple[int, int, int, int]]" = []
+        stripes_per_obj = self.os // self.su
+        pos, end = off, off + length
+        while pos < end:
+            stripe_no = pos // self.su
+            set_no = stripe_no // (self.sc * stripes_per_obj)
+            obj_in_set = stripe_no % self.sc
+            blk_in_obj = (stripe_no // self.sc) % stripes_per_obj
+            idx = set_no * self.sc + obj_in_set
+            in_su = pos % self.su
+            n = min(self.su - in_su, end - pos)
+            out.append((idx, blk_in_obj * self.su + in_su, n, pos))
+            pos += n
+        return out
+
+
+class RadosStriper:
+    """libradosstriper-style facade over an IoCtx."""
+
+    def __init__(self, ioctx, stripe_unit: int = 64 * 1024,
+                 stripe_count: int = 4,
+                 object_size: int = 1024 * 1024) -> None:
+        self.io = ioctx
+        self.layout = StripeLayout(stripe_unit, stripe_count, object_size)
+
+    async def _get_size(self, soid: str) -> int:
+        from .objecter import ObjecterError
+        ENOENT = 2
+        try:
+            raw = await self.io.getxattr(
+                self.layout.object_name(soid, 0), SIZE_XATTR)
+            return int(raw.decode())
+        except ObjecterError as e:
+            if e.errno == ENOENT:
+                return 0            # blob genuinely absent
+            raise                   # transient failure: NEVER treat as
+            # size 0 — append/remove acting on that lie would overwrite
+            # or orphan existing data
+
+    async def _set_size(self, soid: str, size: int) -> None:
+        await self.io.setxattr(self.layout.object_name(soid, 0),
+                               SIZE_XATTR, str(size).encode())
+
+    async def write(self, soid: str, data: bytes, off: int = 0) -> None:
+        """Write at a logical offset; object writes fan out in parallel
+        (each object is an independent PG op)."""
+        extents = self.layout.file_to_extents(off, len(data))
+        per_obj: "dict[int, list]" = {}
+        for idx, ooff, n, lpos in extents:
+            per_obj.setdefault(idx, []).append((ooff, lpos - off, n))
+
+        async def write_obj(idx: int, parts) -> None:
+            name = self.layout.object_name(soid, idx)
+            for ooff, dstart, n in parts:
+                await self.io.write(name, data[dstart:dstart + n], ooff)
+
+        await asyncio.gather(*(write_obj(i, p)
+                               for i, p in per_obj.items()))
+        old = await self._get_size(soid)
+        if off + len(data) > old:
+            await self._set_size(soid, off + len(data))
+
+    async def write_full(self, soid: str, data: bytes) -> None:
+        await self.remove(soid, missing_ok=True)
+        await self.write(soid, data, 0)
+
+    async def append(self, soid: str, data: bytes) -> None:
+        await self.write(soid, data, await self._get_size(soid))
+
+    async def read(self, soid: str, length: int = 0,
+                   off: int = 0) -> bytes:
+        size = await self._get_size(soid)
+        if length <= 0:
+            length = max(0, size - off)
+        length = min(length, max(0, size - off))
+        if length == 0:
+            return b""
+        extents = self.layout.file_to_extents(off, length)
+        out = bytearray(length)
+
+        async def read_ext(idx, ooff, n, lpos):
+            name = self.layout.object_name(soid, idx)
+            got = await self.io.read(name, n, ooff)
+            out[lpos - off:lpos - off + len(got)] = got
+
+        await asyncio.gather(*(read_ext(*e) for e in extents))
+        return bytes(out)
+
+    async def stat(self, soid: str) -> dict:
+        size = await self._get_size(soid)
+        n_objects = len({idx for idx, *_ in
+                         self.layout.file_to_extents(0, max(size, 1))})
+        return {"size": size, "objects": n_objects if size else 0}
+
+    async def remove(self, soid: str, missing_ok: bool = False) -> None:
+        size = await self._get_size(soid)
+        if size == 0 and not missing_ok:
+            return
+        idxs = {idx for idx, *_ in
+                self.layout.file_to_extents(0, max(size, 1))}
+        idxs.add(0)
+
+        async def rm(idx):
+            try:
+                await self.io.remove(self.layout.object_name(soid, idx))
+            except Exception:  # noqa: BLE001 — already absent
+                pass
+
+        await asyncio.gather(*(rm(i) for i in sorted(idxs)))

@@ -97,6 +97,112 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_heartbeat_interval", float, 1.0, LEVEL_ADVANCED,
            min=0.05, max=60, desc="seconds between peer pings",
            services=("osd",)),
+    Option("osd_heartbeat_min_peers", int, 10, LEVEL_ADVANCED, min=1,
+           desc="minimum heartbeat peers per osd", services=("osd",)),
+    Option("osd_mon_heartbeat_interval", float, 30.0, LEVEL_ADVANCED,
+           min=1, desc="seconds between mon pings when idle",
+           services=("osd",)),
+    Option("osd_beacon_report_interval", float, 5.0, LEVEL_ADVANCED,
+           min=0.1, desc="seconds between osd beacons to the mon",
+           services=("osd",)),
+    Option("osd_recovery_sleep", float, 0.0, LEVEL_ADVANCED, min=0,
+           desc="seconds to sleep between recovery ops (throttle)",
+           services=("osd",)),
+    Option("osd_recovery_op_priority", int, 3, LEVEL_ADVANCED, min=1,
+           max=63, desc="priority of recovery ops", services=("osd",)),
+    Option("osd_max_backfills", int, 1, LEVEL_ADVANCED, min=1,
+           desc="concurrent backfills per osd", services=("osd",)),
+    Option("osd_backfill_scan_min", int, 64, LEVEL_ADVANCED, min=1,
+           desc="min objects per backfill scan", services=("osd",)),
+    Option("osd_backfill_scan_max", int, 512, LEVEL_ADVANCED, min=1,
+           desc="max objects per backfill scan", services=("osd",)),
+    Option("osd_scrub_auto_repair", bool, False, LEVEL_ADVANCED,
+           desc="repair inconsistencies found by scrub automatically",
+           services=("osd",)),
+    Option("osd_scrub_min_interval", float, 86400.0, LEVEL_ADVANCED,
+           min=1, desc="seconds between shallow scrubs of a PG",
+           services=("osd",)),
+    Option("osd_deep_scrub_interval", float, 604800.0, LEVEL_ADVANCED,
+           min=1, desc="seconds between deep scrubs of a PG",
+           services=("osd",)),
+    Option("osd_scrub_chunk_max", int, 25, LEVEL_ADVANCED, min=1,
+           desc="max objects per scrub chunk", services=("osd",)),
+    Option("osd_scrub_sleep", float, 0.0, LEVEL_ADVANCED, min=0,
+           desc="seconds to sleep between scrub chunks",
+           services=("osd",)),
+    Option("osd_peering_op_timeout", float, 2.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds to wait for a peering query/rewind/log reply",
+           services=("osd",)),
+    Option("osd_scrub_map_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds to wait for a shard's scrub map",
+           services=("osd",)),
+    Option("osd_min_pg_log_entries", int, 250, LEVEL_ADVANCED, min=1,
+           desc="pg log entries kept below which no trim happens",
+           services=("osd",)),
+    Option("osd_max_pg_log_entries", int, 10000, LEVEL_ADVANCED, min=1,
+           desc="pg log entries above which the log is trimmed",
+           services=("osd",)),
+    Option("osd_object_max_size", int, 128 << 20, LEVEL_ADVANCED,
+           min=4096, desc="largest single object accepted",
+           services=("osd",)),
+    Option("osd_default_notify_timeout", int, 30, LEVEL_ADVANCED, min=1,
+           desc="default watch/notify timeout (s)", services=("osd",)),
+    Option("osd_recovery_retry_interval", float, 1.0, LEVEL_ADVANCED,
+           min=0.01, desc="seconds before retrying a failed recovery",
+           services=("osd",)),
+    Option("osd_fast_shutdown", bool, True, LEVEL_ADVANCED,
+           desc="skip per-PG teardown on shutdown", services=("osd",)),
+    # --- compressor ---------------------------------------------------------
+    Option("compressor_default", str, "zstd", LEVEL_ADVANCED,
+           enum_values=("none", "zlib", "zstd", "lz4", "snappy"),
+           desc="default compressor plugin"),
+    Option("compressor_min_blob_size", int, 8192, LEVEL_ADVANCED, min=0,
+           desc="blobs below this bypass compression"),
+    Option("compressor_max_ratio", float, 0.875, LEVEL_ADVANCED, min=0,
+           max=1, desc="keep compressed data only below this ratio"),
+    # --- mgr ----------------------------------------------------------------
+    Option("mgr_stats_period", float, 5.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds between mgr stat collections", services=("mgr",)),
+    Option("mgr_prometheus_port", int, 9283, LEVEL_ADVANCED, min=0,
+           desc="prometheus exporter port (0 = disabled)",
+           services=("mgr",)),
+    Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           desc="extra directory for mgr modules", services=("mgr",)),
+    # --- tracing / op tracking ---------------------------------------------
+    Option("osd_op_history_size", int, 20, LEVEL_ADVANCED, min=0,
+           desc="completed ops kept for dump_historic_ops",
+           services=("osd",)),
+    Option("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,
+           min=0, desc="seconds a completed op stays in history",
+           services=("osd",)),
+    Option("osd_op_complaint_time", float, 30.0, LEVEL_ADVANCED, min=0,
+           desc="ops older than this count as slow", services=("osd",)),
+    Option("osd_enable_op_tracker", bool, True, LEVEL_ADVANCED,
+           desc="track in-flight ops for admin-socket dumps",
+           services=("osd",)),
+    # --- client -------------------------------------------------------------
+    Option("rados_osd_op_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds a client op may wait for an OSD reply before "
+                "retrying", services=("client",)),
+    Option("rados_mon_op_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds a client mon command may wait",
+           services=("client",)),
+    Option("objecter_retries", int, 6, LEVEL_ADVANCED, min=1,
+           desc="client op retry attempts across map changes",
+           services=("client",)),
+    Option("objecter_retry_backoff", float, 0.05, LEVEL_ADVANCED,
+           min=0.001, desc="base client retry backoff (s), scales "
+                           "linearly per attempt", services=("client",)),
+    Option("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED, min=1,
+           desc="max concurrent client ops", services=("client",)),
+    Option("client_striper_stripe_unit", int, 64 << 10, LEVEL_ADVANCED,
+           min=512, desc="default striper stripe unit",
+           services=("client",)),
+    Option("client_striper_stripe_count", int, 4, LEVEL_ADVANCED, min=1,
+           desc="default striper stripe count", services=("client",)),
+    Option("client_striper_object_size", int, 1 << 20, LEVEL_ADVANCED,
+           min=4096, desc="default striper object size",
+           services=("client",)),
     Option("osd_heartbeat_grace", float, 6.0, LEVEL_ADVANCED,
            min=0.1, desc="seconds without reply before reporting a peer down",
            see_also=("osd_heartbeat_interval",), services=("osd", "mon")),
@@ -177,6 +283,11 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="reconnect backoff cap (seconds)"),
     Option("ms_dispatch_throttle_bytes", int, 100 << 20, LEVEL_ADVANCED,
            min=0, desc="max bytes queued for dispatch before backpressure"),
+    Option("ms_compress_mode", str, "none", LEVEL_ADVANCED,
+           enum_values=("none", "force"),
+           desc="compress messenger frame data segments"),
+    Option("ms_compression_algorithm", str, "zstd", LEVEL_ADVANCED,
+           desc="frame compression algorithm (compressor plugin name)"),
     Option("ms_inject_socket_failures", int, 0, LEVEL_DEV, min=0,
            desc="one-in-N chance to kill a socket on send/recv (QA)"),
     Option("ms_inject_delay_max", float, 0.0, LEVEL_DEV, min=0,

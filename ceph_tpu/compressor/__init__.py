@@ -1,0 +1,181 @@
+"""Compressor plugin family — rebuild of src/compressor/Compressor.h:33.
+
+The reference's second compute-plugin family, sharing the EC layer's
+registry pattern (same dlopen/entry-point handshake there; same module
+handshake here): ``__compressor_init__(registry, name)`` registers a
+factory, versioned by ``__compressor_version__``.  Built-ins: zstd
+(default, like the reference's modern default), zlib, and the
+``none`` passthrough; lz4/snappy register only when their libraries are
+importable (the reference builds them conditionally too).  The QAT
+hardware-offload precedent (QatAccel.cc) maps here to a future device
+codec slot — the registry accepts any module that honors the handshake.
+
+Consumers: the messenger's optional frame compression and the
+objectstore blob path use ``Compressor.create`` with the
+``compressor_default`` / ``compressor_min_blob_size`` /
+``compressor_max_ratio`` options (reference: bluestore_compression_*).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib as _zlib
+from typing import Callable, Dict, Optional
+
+PLUGIN_API_VERSION = "1"
+
+
+class CompressorError(Exception):
+    pass
+
+
+class Compressor:
+    """Abstract codec: compress/decompress bytes-like -> bytes."""
+
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(name: str) -> "Compressor":
+        return registry().factory(name)
+
+
+class NoneCompressor(Compressor):
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return bytes(data)
+
+    def decompress(self, data: bytes) -> bytes:
+        return bytes(data)
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    def __init__(self, level: int = 5) -> None:
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return _zlib.compress(bytes(data), self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        return _zlib.decompress(bytes(data))
+
+
+class ZstdCompressor(Compressor):
+    name = "zstd"
+
+    def __init__(self, level: int = 3) -> None:
+        import zstandard
+        self._c = zstandard.ZstdCompressor(level=level)
+        self._d = zstandard.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c.compress(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        return self._d.decompress(bytes(data))
+
+
+class CompressorRegistry:
+    """Name -> factory, with the same module handshake as the EC
+    registry (version attribute + init entry point)."""
+
+    _instance: "Optional[CompressorRegistry]" = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._factories: "Dict[str, Callable[[], Compressor]]" = {}
+        self.add("none", NoneCompressor)
+        self.add("zlib", ZlibCompressor)
+        try:
+            ZstdCompressor()
+            self.add("zstd", ZstdCompressor)
+        except ImportError:
+            pass
+        for mod, name in (("lz4.frame", "lz4"), ("snappy", "snappy")):
+            try:
+                __import__(mod)
+            except ImportError:
+                continue
+            self._add_external(mod, name)
+
+    def _add_external(self, mod: str, name: str) -> None:
+        import importlib
+
+        m = importlib.import_module(mod)
+
+        class _Ext(Compressor):  # pragma: no cover - env-dependent
+            def compress(self, data: bytes) -> bytes:
+                return m.compress(bytes(data))
+
+            def decompress(self, data: bytes) -> bytes:
+                return m.decompress(bytes(data))
+
+        _Ext.name = name
+        self.add(name, _Ext)
+
+    def add(self, name: str, factory: "Callable[[], Compressor]") -> None:
+        self._factories[name] = factory
+
+    def load_module(self, module, name: str) -> None:
+        """Out-of-tree plugin handshake (mirrors ec/registry.py)."""
+        if getattr(module, "__compressor_version__", None) \
+                != PLUGIN_API_VERSION:
+            raise CompressorError(f"plugin {name}: version mismatch")
+        init = getattr(module, "__compressor_init__", None)
+        if init is None:
+            raise CompressorError(f"plugin {name}: missing entry point")
+        init(self, name)
+        if name not in self._factories:
+            raise CompressorError(f"plugin {name}: failed to register")
+
+    def factory(self, name: str) -> Compressor:
+        f = self._factories.get(name)
+        if f is None:
+            raise CompressorError(
+                f"unknown compressor {name!r} "
+                f"(have {sorted(self._factories)})")
+        return f()
+
+    def names(self) -> "list[str]":
+        return sorted(self._factories)
+
+
+def registry() -> CompressorRegistry:
+    with CompressorRegistry._lock:
+        if CompressorRegistry._instance is None:
+            CompressorRegistry._instance = CompressorRegistry()
+    return CompressorRegistry._instance
+
+
+def maybe_compress(data: bytes, config=None) -> "tuple[str, bytes]":
+    """Policy helper (the bluestore_compression_* decision): returns
+    (algorithm, payload) — algorithm "" means stored uncompressed."""
+    algo = str(config.get("compressor_default")) if config else "zstd"
+    min_blob = int(config.get("compressor_min_blob_size")) if config \
+        else 8192
+    max_ratio = float(config.get("compressor_max_ratio")) if config \
+        else 0.875
+    if algo == "none" or len(data) < min_blob:
+        return "", data
+    try:
+        comp = Compressor.create(algo)
+    except CompressorError:
+        return "", data
+    out = comp.compress(data)
+    if len(out) > len(data) * max_ratio:
+        return "", data       # not worth it (incompressible data)
+    return algo, out
+
+
+def decompress(algo: str, payload: bytes) -> bytes:
+    if not algo:
+        return bytes(payload)
+    return Compressor.create(algo).decompress(payload)

@@ -138,6 +138,11 @@ class JaxRS(ErasureCode):
         prof.setdefault("plugin", "jax_rs")
         prof["k"], prof["m"] = str(self.k), str(self.m)
         prof["technique"] = self.technique
+        if self.technique in ("liberation", "blaum_roth", "liber8tion"):
+            # make the substitution visible to operators: these bit-
+            # matrix schedules are served by the m=2 Vandermonde MDS
+            # code (same erasure tolerance, different chunk bytes)
+            prof["technique_impl"] = "reed_sol_van"
         prof["w"] = "8"
         self._profile = prof
 

@@ -33,7 +33,9 @@ def make_encode_step(k: int, m: int, technique: str = "reed_sol_van",
         """(B, k, W) uint32 -> ((B, m, W) parity, (B, k+m) crcs)."""
         parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(data_u32)
         B, _, W = data_u32.shape
-        seg = crc_seg_words if W % crc_seg_words == 0 else 1
+        # non-dividing widths: crc32c_words_jax picks a sane
+        # segmentation itself (seg=1 would explode trace-time constants)
+        seg = crc_seg_words if W % crc_seg_words == 0 else 256
         # crc data and parity separately: a concatenate would
         # materialize an extra (k+m)/k copy of the batch in HBM
         dcrc = crc_ops.crc32c_words_jax(

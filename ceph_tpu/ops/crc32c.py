@@ -193,7 +193,13 @@ def crc32c_words_jax(words, seg_words: int = 256):
         from . import crc_pallas
         return crc_pallas.crc32c_words_mxu(words)
     if W % seg_words:
-        seg_words = 1
+        # the merge stage builds one host-side shift operator per
+        # segment at trace time: falling back to seg_words=1 (S=W
+        # segments) used to cost MINUTES of tracing for odd widths.
+        # Instead pick the largest segment count <= 64 dividing W
+        # (S=1, a single serial chain, always works).
+        S = next(s for s in range(64, 0, -1) if W % s == 0)
+        seg_words = W // S
     return _compiled_words_crc(C, W, seg_words)(words)
 
 

@@ -28,8 +28,8 @@ from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..objectstore.memstore import MemStore
 from ..objectstore.store import ObjectStore
-from .ecbackend import (EIO, ESTALE, ClientOp, ECBackend, ECError, NONE_OSD,
-                        NotActive)
+from .ecbackend import (EIO, ENOENT, ESTALE, ClientOp, ECBackend, ECError,
+                        NONE_OSD, NotActive)
 from .ecutil import StripeInfo
 from .encode_service import EncodeService
 from .replicated import ReplicateCodec
@@ -200,7 +200,7 @@ class OSDDaemon(Dispatcher):
                        self._send_to_osd, lambda p=pgid: self._acting(p),
                        min_size=pool.min_size,
                        encode_service=self.encode_service,
-                       scheduler=self.op_scheduler)
+                       scheduler=self.op_scheduler, config=self.config)
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be
@@ -369,10 +369,13 @@ class OSDDaemon(Dispatcher):
             # newer map and resend (reference: requeue on map change)
             result = -ESTALE
             outs.append({"error": str(e)})
-        except Exception as e:  # noqa: BLE001 — op errors become EIO replies
-            if not isinstance(e, (ECError, KeyError)):
+        except Exception as e:  # noqa: BLE001 — op errors become errno
+            from ..objectstore.store import NotFound
+            if not isinstance(e, (ECError, KeyError, NotFound)):
                 dout("osd", 0, f"op error: {type(e).__name__}: {e}")
-            result = -EIO
+            # absent objects map to ENOENT so clients (striper hole
+            # reads, stat probes) can distinguish them from I/O errors
+            result = -ENOENT if isinstance(e, NotFound) else -EIO
             outs.append({"error": str(e)})
         _lens, blob = pack_buffers(out_bufs)
         await conn.send_message(MOSDOpReply({

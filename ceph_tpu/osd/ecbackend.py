@@ -171,7 +171,8 @@ class ECBackend:
                  send: "Callable[[int, Any], Any]",
                  get_acting: "Callable[[], List[int]]",
                  min_size: "Optional[int]" = None,
-                 encode_service=None, scheduler=None) -> None:
+                 encode_service=None, scheduler=None,
+                 config=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -188,6 +189,7 @@ class ECBackend:
         # daemon-shared op scheduler: recovery/scrub work queues behind
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
+        self.config = config
         self.extent_cache = ExtentCache()
         # primary pipeline state
         self.waiting_state: "List[Op]" = []
@@ -256,6 +258,16 @@ class ECBackend:
     def new_tid(self) -> int:
         self._next_tid += 1
         return self._next_tid
+
+    def opt(self, name: str, default):
+        """Config knob with fallback (backends built without a daemon —
+        unit harnesses — keep the built-in defaults)."""
+        if self.config is None:
+            return default
+        try:
+            return type(default)(self.config.get(name))
+        except Exception:  # noqa: BLE001 — unknown option
+            return default
 
     # --------------------------------------------------------- pg metadata io
 
@@ -672,6 +684,17 @@ class ECBackend:
                          "delete" if op.delete else "modify",
                          prior_version=op.oi.version, rollback=rollback)
 
+        # log trimming: once the log exceeds osd_max_pg_log_entries,
+        # trim down to osd_min_pg_log_entries (never past the rollback
+        # horizon — trim_to clamps); the point rides every sub-write
+        trim_to = self.pg_log.tail
+        maxe = self.opt("osd_max_pg_log_entries", 10000)
+        mine = self.opt("osd_min_pg_log_entries", 250)
+        if len(self.pg_log.entries) > maxe:
+            keep_from = max(0, len(self.pg_log.entries) - mine)
+            trim_to = self.pg_log.entries[keep_from - 1].version \
+                if keep_from else self.pg_log.tail
+
         # encode done — now (atomically w.r.t. the event loop) enter the
         # commit stage with the full pending set before any send awaits
         op.pending_commits = {s for s in range(self.k + self.m)
@@ -692,7 +715,7 @@ class ECBackend:
                 "from_osd": self.whoami, "tid": op.tid,
                 "epoch": self.last_epoch,
                 "at_version": list(op.version),
-                "trim_to": list(self.pg_log.tail),
+                "trim_to": list(trim_to),
                 "roll_forward_to": list(self.pg_log.can_rollback_to),
                 "log_entries": [entry.to_dict()],
                 "txn": wire_txn, "lens": lens}, blob)
@@ -1517,7 +1540,9 @@ class ECBackend:
                 t.setattr(cid, sid, name, val)
 
     async def _query_shard(self, shard: int, osd: int,
-                           timeout: float = 2.0):
+                           timeout: "Optional[float]" = None):
+        if timeout is None:
+            timeout = self.opt("osd_peering_op_timeout", 2.0)
         tid = self.new_tid()
         fut = asyncio.get_event_loop().create_future()
         self.pending_queries[tid] = fut
@@ -1533,7 +1558,9 @@ class ECBackend:
             self.pending_queries.pop(tid, None)
 
     async def _rewind_shard(self, shard: int, osd: int, to: Version,
-                            timeout: float = 2.0) -> None:
+                            timeout: "Optional[float]" = None) -> None:
+        if timeout is None:
+            timeout = self.opt("osd_peering_op_timeout", 2.0)
         if osd == self.whoami:
             self._rewind_local(shard, to)
             return
@@ -1553,9 +1580,11 @@ class ECBackend:
 
     async def _send_pg_log(self, shard: int, osd: int, auth_log: PGLog,
                            objects: "List[str]",
-                           timeout: float = 2.0) -> "Optional[dict]":
+                           timeout: "Optional[float]" = None) -> "Optional[dict]":
         """Send the auth log to a stale shard; returns its missing set
         (None if unreachable)."""
+        if timeout is None:
+            timeout = self.opt("osd_peering_op_timeout", 2.0)
         tid = self.new_tid()
         payload = {"pgid": list(self.pgid), "shard": shard,
                    "from_osd": self.whoami, "tid": tid,
@@ -1752,24 +1781,40 @@ class ECBackend:
             elif prior:
                 self.peer_missing[s] = prior
 
-        # recovery: reconstruct + push every missing object
-        recovered = failed = 0
+        # recovery: reconstruct + push every missing object, bounded by
+        # osd_recovery_max_active concurrent ops (reference recovery
+        # reservations) with osd_recovery_sleep pacing between them
         missing_union: "Dict[str, Set[int]]" = {}
         for s, mset in self.peer_missing.items():
             for oid in mset:
                 missing_union.setdefault(oid, set()).add(s)
+        sem = asyncio.Semaphore(
+            max(1, self.opt("osd_recovery_max_active", 3)))
+        sleep_s = self.opt("osd_recovery_sleep", 0.0)
+        counts = {"recovered": 0, "failed": 0}
+
+        async def recover_one(oid: str, shards: "Set[int]") -> None:
+            async with sem:
+                try:
+                    await self.recover_object(oid, shards,
+                                              exclude=set(shards))
+                    counts["recovered"] += 1
+                except ECError as e:
+                    dout("osd", 1, f"peer: recover {oid} failed: {e}")
+                    counts["failed"] += 1
+                if sleep_s:
+                    await asyncio.sleep(sleep_s)
+
+        work = []
         for oid in sorted(missing_union):
             shards = missing_union[oid]
             if oid in deleted or oid not in all_objects:
                 await self._push_delete(oid, shards, up)
                 continue
-            try:
-                await self.recover_object(oid, shards,
-                                          exclude=set(shards))
-                recovered += 1
-            except ECError as e:
-                dout("osd", 1, f"peer: recover {oid} failed: {e}")
-                failed += 1
+            work.append(recover_one(oid, shards))
+        if work:
+            await asyncio.gather(*work)
+        recovered, failed = counts["recovered"], counts["failed"]
         return {"status": "ok", "auth_head": list(auth_head),
                 "auth_shard": auth_shard, "recovered": recovered,
                 "failed": failed, "backfilled_shards": backfill_shards,

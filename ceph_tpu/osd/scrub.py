@@ -90,7 +90,8 @@ async def _gather_maps(backend, deep: bool) -> "Dict[int, Dict[str, dict]]":
             await backend.send(osd, MScrubShard({
                 "pgid": list(backend.pgid), "shard": shard,
                 "from_osd": backend.whoami, "tid": tid, "deep": deep}))
-            reply = await asyncio.wait_for(fut, timeout=10.0)
+            reply = await asyncio.wait_for(
+                fut, backend.opt("osd_scrub_map_timeout", 10.0))
             maps[shard] = dict(reply["objects"])
         except Exception as e:  # noqa: BLE001 — scrub skips dead shards
             dout("osd", 1, f"scrub: shard {shard} unreachable: {e}")

@@ -40,13 +40,14 @@ class MiniCluster:
         self.osds: "Dict[int, OSDDaemon]" = {}
         self.clients: "List[RadosClient]" = []
         self._admin: "Optional[RadosClient]" = None
+        self._tcp = self.config.get("ms_type") == "async+tcp"
         if not self.mon_addrs:
             # static mode: one shared map, pre-populated
             self.osdmap = OSDMap()
             self.osdmap.crush.add_bucket("default", "root")
             for i in range(n_osds):
                 self.osdmap.add_osd(i)
-                self.osdmap.mark_up(i, f"local:osd.{i}")
+                self.osdmap.mark_up(i, self._initial_addr(i))
             self.osdmap.bump()
             for i in range(n_osds):
                 self.osds[i] = OSDDaemon(i, self.osdmap,
@@ -72,6 +73,22 @@ class MiniCluster:
         else:
             for osd in self.osds.values():
                 await osd.init()
+            self._publish_addrs()
+
+    def _initial_addr(self, osd_id: int) -> str:
+        # tcp: bind an ephemeral port, publish the real one after init
+        return "127.0.0.1:0" if self._tcp else f"local:osd.{osd_id}"
+
+    def _publish_addrs(self) -> None:
+        """Static-tcp mode: record each daemon's bound address in the
+        shared map (mon mode learns them from boot messages)."""
+        changed = False
+        for i, osd in self.osds.items():
+            if osd.up and self.osdmap.get_addr(i) != osd.ms.listen_addr:
+                self.osdmap.mark_up(i, osd.ms.listen_addr)
+                changed = True
+        if changed:
+            self.osdmap.bump()
 
     async def wait_for_leader(self, timeout: float = 5.0) -> int:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -159,7 +176,8 @@ class MiniCluster:
         c = RadosClient(self.osdmap if not self.mon_addrs else None,
                         name=f"client.{idx}", config=self.config,
                         mon_addrs=self.mon_addrs or None)
-        await c.connect(f"local:client.{idx}")
+        await c.connect("127.0.0.1:0" if self._tcp
+                        else f"local:client.{idx}")
         self.clients.append(c)
         return c
 
@@ -180,10 +198,12 @@ class MiniCluster:
         else:
             osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
                             config=self.config)
-            self.osdmap.mark_up(osd_id, f"local:osd.{osd_id}")
+            self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd
         await osd.init()
+        if not self.mon_addrs:
+            self._publish_addrs()
 
     async def peer_all(self) -> dict:
         """Run a peering sweep on every up OSD (static-mode recovery

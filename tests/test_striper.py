@@ -1,0 +1,125 @@
+"""Striper + rados CLI (client/striper.py, tools/rados.py).
+
+Reference: src/osdc/Striper.h:26 file_to_extents math, libradosstriper
+semantics (size xattr on the first object), and the rados CLI
+(src/tools/rados).  VERDICT done-criterion: a >4 MiB blob striped
+across >= 4 objects round-trips via the CLI.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.striper import RadosStriper, StripeLayout
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+class TestLayout:
+    def test_extents_cover_and_round_robin(self):
+        lo = StripeLayout(stripe_unit=4, stripe_count=3, object_size=8)
+        ext = lo.file_to_extents(0, 40)
+        # coverage: logical positions partition [0, 40)
+        covered = sorted((lpos, lpos + n) for _i, _o, n, lpos in ext)
+        pos = 0
+        for a, b in covered:
+            assert a == pos
+            pos = b
+        assert pos == 40
+        # first three stripe units round-robin across objects 0,1,2
+        assert [e[0] for e in ext[:3]] == [0, 1, 2]
+        # object 0's second stripe unit lands at offset 4 within it
+        assert ext[3][0] == 0 and ext[3][1] == 4
+        # after object_size bytes per object, the set advances
+        assert any(e[0] >= 3 for e in ext)
+
+    def test_mid_unit_offsets(self):
+        lo = StripeLayout(stripe_unit=8, stripe_count=2, object_size=16)
+        (idx, ooff, n, lpos), = lo.file_to_extents(3, 2)
+        assert (idx, ooff, n, lpos) == (0, 3, 2, 3)
+
+
+class TestStriper:
+    def test_blob_round_trip_across_objects(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", pg_num=8, stripe_unit=1024)
+                client = await c.client()
+                io = client.io_ctx("p")
+                st = RadosStriper(io, stripe_unit=64 * 1024,
+                                  stripe_count=4,
+                                  object_size=1024 * 1024)
+                data = payload(4 * 1024 * 1024 + 12345, 5)
+                await st.write_full("blob", data)
+                info = await st.stat("blob")
+                assert info["size"] == len(data)
+                assert info["objects"] >= 4   # spread across objects
+                assert await st.read("blob") == data
+                # partial read spanning object boundaries
+                assert (await st.read("blob", 200_000, 1_000_000)
+                        == data[1_000_000:1_200_000])
+                # append extends
+                await st.append("blob", b"tail!")
+                assert (await st.read("blob"))[-5:] == b"tail!"
+                # remove deletes every object
+                await st.remove("blob")
+                assert (await st.stat("blob"))["size"] == 0
+        loop.run_until_complete(go())
+
+    def test_sparse_write_reads_zero_filled_holes(self, loop):
+        """Objects never written inside the logical range read back as
+        zeros (libradosstriper hole semantics)."""
+        async def go():
+            async with MiniCluster(n_osds=6) as c:
+                c.create_ec_pool("p", pg_num=4, stripe_unit=1024)
+                client = await c.client()
+                st = RadosStriper(client.io_ctx("p"),
+                                  stripe_unit=4096, stripe_count=3,
+                                  object_size=16384)
+                tail = payload(2000, 11)
+                await st.write("holey", tail, off=10_000)
+                got = await st.read("holey")
+                assert got == b"\0" * 10_000 + tail
+        loop.run_until_complete(go())
+
+
+class TestRadosCli:
+    def test_striped_blob_round_trips_via_cli(self, tmp_path):
+        from tools import rados as cli
+        src = tmp_path / "in.bin"
+        dst = tmp_path / "out.bin"
+        data = payload(4 * 1024 * 1024 + 777, 8)
+        src.write_bytes(data)
+        script = tmp_path / "cmds"
+        script.write_text(
+            f"put blob {src}\nstat blob\nget blob {dst}\nls\n")
+        rc = cli.main(["--vstart", "6", "--pool", "data", "--striper",
+                       "--stripe-count", "4", "--script", str(script)])
+        assert rc == 0
+        assert dst.read_bytes() == data
+
+    def test_plain_object_cli(self, tmp_path):
+        from tools import rados as cli
+        src = tmp_path / "a"
+        dst = tmp_path / "b"
+        src.write_bytes(b"hello rados cli")
+        script = tmp_path / "cmds"
+        script.write_text(f"put o1 {src}\nget o1 {dst}\nrm o1\n")
+        rc = cli.main(["--vstart", "5", "--pool", "data",
+                       "--script", str(script)])
+        assert rc == 0
+        assert dst.read_bytes() == b"hello rados cli"

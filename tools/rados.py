@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""rados — operator CLI for object I/O (reference src/tools/rados).
+
+Commands: ls, put <obj> <file>, get <obj> <file>, stat <obj>, rm <obj>,
+bench <seconds> write|read.  ``--striper`` routes I/O through the
+client-side striper (reference: the rados CLI's --striper flag backed
+by libradosstriper), spreading each blob over --stripe-count objects.
+
+Cluster access:
+  --vstart N    spin an ephemeral in-process cluster (vstart.sh analog);
+                commands come from --script FILE (one per line) or argv
+  --mon ADDRS   connect to running mon daemons (host:port,host:port)
+
+Examples:
+  python tools/rados.py --vstart 6 --pool data --striper \
+      --script cmds.txt
+  python tools/rados.py --vstart 6 --pool data -- put obj /etc/hosts
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+
+async def run_command(io, striper, argv: "list[str]") -> int:
+    cmd = argv[0]
+    if cmd == "put":
+        obj, path = argv[1], argv[2]
+        with open(path, "rb") as f:
+            data = f.read()
+        if striper:
+            await striper.write_full(obj, data)
+        else:
+            await io.write_full(obj, data)
+        print(f"put {obj}: {len(data)} bytes")
+    elif cmd == "get":
+        obj, path = argv[1], argv[2]
+        data = await (striper.read(obj) if striper else io.read(obj))
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"get {obj}: {len(data)} bytes")
+    elif cmd == "stat":
+        st = await (striper.stat(argv[1]) if striper
+                    else io.stat(argv[1]))
+        print(st)
+    elif cmd == "rm":
+        if striper:
+            await striper.remove(argv[1])
+        else:
+            await io.remove(argv[1])
+        print(f"removed {argv[1]}")
+    elif cmd == "ls":
+        names = await list_pool_objects(io)
+        for n in names:
+            print(n)
+    elif cmd == "bench":
+        secs = float(argv[1])
+        mode = argv[2] if len(argv) > 2 else "write"
+        await bench(io, striper, secs, mode)
+    else:
+        print(f"unknown command {cmd!r}", file=sys.stderr)
+        return 22
+    return 0
+
+
+async def list_pool_objects(io) -> "list[str]":
+    """Aggregate object lists from every PG primary (the rados ls
+    analog; the reference asks the OSDs per PG the same way)."""
+    cluster = getattr(io, "_vstart_cluster", None)
+    if cluster is None:
+        raise SystemExit("ls requires --vstart mode in this build")
+    pool = cluster.osdmap.get_pool(io.pool_id)
+    names: "set[str]" = set()
+    for pg in range(pool.pg_num):
+        _u, acting = cluster.osdmap.pg_to_up_acting_osds(io.pool_id, pg)
+        primary = cluster.osdmap.primary_of(acting)
+        if primary < 0 or primary not in cluster.osds:
+            continue
+        be = cluster.osds[primary]._get_backend((io.pool_id, pg))
+        names.update(be._list_objects(be.my_shard))
+    return sorted(names)
+
+
+async def bench(io, striper, seconds: float, mode: str) -> None:
+    """rados bench analog: fixed 4 MiB objects, sequential."""
+    blob = os.urandom(4 * 1024 * 1024)
+    t0 = time.monotonic()
+    n = 0
+    if mode == "write":
+        while time.monotonic() - t0 < seconds:
+            name = f"bench_{n}"
+            await (striper.write_full(name, blob) if striper
+                   else io.write_full(name, blob))
+            n += 1
+    else:
+        while time.monotonic() - t0 < seconds:
+            name = f"bench_{n % 16}"
+            try:
+                await (striper.read(name) if striper else io.read(name))
+            except Exception:  # noqa: BLE001 — not written yet
+                break
+            n += 1
+    dt = time.monotonic() - t0
+    mb = n * len(blob) / 2**20
+    print(f"bench {mode}: {n} x 4 MiB in {dt:.2f}s = {mb / dt:.1f} MiB/s")
+
+
+async def amain(args) -> int:
+    from ceph_tpu.client.striper import RadosStriper
+
+    if args.vstart:
+        from ceph_tpu.qa.cluster import MiniCluster
+        cluster = MiniCluster(n_osds=args.vstart)
+        cluster.create_ec_pool(args.pool, {
+            "plugin": args.plugin, "k": str(args.k), "m": str(args.m)},
+            pg_num=args.pg_num, stripe_unit=args.stripe_unit)
+        await cluster.start()
+        client = await cluster.client()
+    else:
+        from ceph_tpu.client.rados import RadosClient
+        mons = {i: a for i, a in enumerate(args.mon.split(","))}
+        client = RadosClient(None, name="client.cli", mon_addrs=mons)
+        await client.connect()
+        cluster = None
+    io = client.io_ctx(args.pool)
+    if cluster is not None:
+        io._vstart_cluster = cluster
+    striper = RadosStriper(io, stripe_unit=args.stripe_unit * 16,
+                           stripe_count=args.stripe_count) \
+        if args.striper else None
+
+    rc = 0
+    if args.script:
+        with open(args.script) as f:
+            for line in f:
+                argv = line.split()
+                if argv and not argv[0].startswith("#"):
+                    rc |= await run_command(io, striper, argv)
+    elif args.command:
+        rc = await run_command(io, striper, args.command)
+    if cluster is not None:
+        await cluster.stop()
+    else:
+        await client.shutdown()
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--vstart", type=int, default=0,
+                   help="spin an ephemeral N-osd in-process cluster")
+    p.add_argument("--mon", default="",
+                   help="mon addresses host:port,host:port")
+    p.add_argument("--pool", default="rbd")
+    p.add_argument("--plugin", default="jax_rs")
+    p.add_argument("-k", type=int, default=4)
+    p.add_argument("-m", type=int, default=2)
+    p.add_argument("--pg-num", type=int, default=8)
+    p.add_argument("--stripe-unit", type=int, default=4096)
+    p.add_argument("--striper", action="store_true",
+                   help="route I/O through the client-side striper")
+    p.add_argument("--stripe-count", type=int, default=4)
+    p.add_argument("--script", default="",
+                   help="file with one command per line")
+    p.add_argument("command", nargs="*",
+                   help="single command (put/get/stat/rm/ls/bench ...)")
+    args = p.parse_args(argv)
+    if not args.vstart and not args.mon:
+        p.error("need --vstart N or --mon ADDRS")
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
