@@ -96,6 +96,16 @@ class IoCtx:
         outs, _ = await self._submit(oid, [{"op": "stat"}])
         return next(o for o in outs if o.get("op") == "stat")
 
+    async def exec(self, oid: str, cls: str, method: str,
+                   data: bytes = b"") -> bytes:
+        """Invoke an object-class method on the OSD next to the data
+        (reference IoCtx::exec / 'rados exec')."""
+        outs, blob = await self._submit(
+            oid, [{"op": "call", "cls": cls, "method": method,
+                   "dlen": len(data)}], bytes(data))
+        lens = [o["dlen"] for o in outs if o.get("op") == "call"]
+        return unpack_buffers(lens, blob)[0] if lens else b""
+
     async def getxattr(self, oid: str, name: str) -> bytes:
         outs, blob = await self._submit(
             oid, [{"op": "getxattr", "name": name}])

@@ -1,0 +1,145 @@
+"""Object classes — in-OSD stored procedures (reference src/cls, 38.8k
+LoC, + src/objclass).
+
+The reference loads ``libcls_<name>.so`` with the same dlopen pattern as
+EC plugins and lets clients invoke registered methods against an object
+inside the OSD (``rados exec``): the method runs next to the data with
+read/write primitives, so read-modify-write logic is atomic per object
+without client round-trips.
+
+Here a class is a Python module honoring the familiar handshake
+(``__objclass_version__`` / ``__objclass_init__(registry, name)``);
+methods take ``(ctx, input: bytes) -> bytes`` where ``ctx`` exposes the
+objclass op surface (cls_cxx_read/write/stat/getxattr/setxattr/map
+analogs).  Reads execute immediately; writes buffer into the ctx and
+commit as ONE transaction after the method returns — and the OSD holds
+the class-exec lock across read+commit, so concurrent calls to the same
+PG serialize exactly like the reference's do_op execution.
+
+Built-ins: ``hello`` (cls_hello), ``numops`` (cls_numops arithmetic),
+``lock`` (advisory locks, cls_lock), ``cas`` (compare-and-swap).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+PLUGIN_API_VERSION = "1"
+
+# method flags (reference CLS_METHOD_RD / CLS_METHOD_WR)
+RD = 1
+WR = 2
+
+Method = Callable[["ClsContext", bytes], bytes]
+
+
+class ClsError(Exception):
+    def __init__(self, msg: str, errno: int = 22) -> None:
+        super().__init__(msg)
+        self.errno = errno
+
+
+class ClsContext:
+    """The objclass op surface handed to methods (cls_cxx_* analogs).
+
+    Reads go straight to the backend's primary shard state; writes are
+    buffered as ClientOp mutations and committed atomically by the OSD
+    after the method returns.
+    """
+
+    def __init__(self, backend, oid: str) -> None:
+        self.backend = backend
+        self.oid = oid
+        self.mutations: "list" = []
+
+    # --- reads ---------------------------------------------------------------
+
+    async def read(self, off: int = 0, length: int = 0) -> bytes:
+        res = await self.backend.objects_read_and_reconstruct(
+            {self.oid: [(off, length)]})
+        return b"".join(d for _o, d in res[self.oid])
+
+    def stat(self) -> dict:
+        return {"size": self.backend.object_size(self.oid)}
+
+    def getxattr(self, name: str) -> bytes:
+        return bytes(self.backend.get_attr(self.oid, name))
+
+    # --- buffered writes ------------------------------------------------------
+
+    def _op(self, **kw) -> None:
+        from ..osd.ecbackend import ClientOp
+        self.mutations.append(ClientOp(**kw))
+
+    def write(self, data: bytes, off: int = 0) -> None:
+        self._op(op="write", off=off, data=bytes(data))
+
+    def write_full(self, data: bytes) -> None:
+        self._op(op="write_full", data=bytes(data))
+
+    def append(self, data: bytes) -> None:
+        self._op(op="append", data=bytes(data))
+
+    def truncate(self, size: int) -> None:
+        self._op(op="truncate", off=size)
+
+    def remove(self) -> None:
+        self._op(op="delete")
+
+    def setxattr(self, name: str, value: bytes) -> None:
+        self._op(op="setxattr", name=name, value=bytes(value))
+
+
+class ObjectClassRegistry:
+    _instance: "Optional[ObjectClassRegistry]" = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        # (cls, method) -> (fn, flags)
+        self._methods: "Dict[Tuple[str, str], Tuple[Method, int]]" = {}
+        from . import builtins
+        builtins.register_all(self)
+
+    def register(self, cls: str, method: str, flags: int,
+                 fn: Method) -> None:
+        self._methods[(cls, method)] = (fn, flags)
+
+    def load_module(self, module, name: str) -> None:
+        if getattr(module, "__objclass_version__", None) \
+                != PLUGIN_API_VERSION:
+            raise ClsError(f"class {name}: version mismatch")
+        init = getattr(module, "__objclass_init__", None)
+        if init is None:
+            raise ClsError(f"class {name}: missing entry point")
+        init(self, name)
+        if not any(c == name for c, _m in self._methods):
+            raise ClsError(f"class {name}: registered no methods")
+
+    def lookup(self, cls: str, method: str) -> "Tuple[Method, int]":
+        entry = self._methods.get((cls, method))
+        if entry is None:
+            raise ClsError(f"no such class method {cls}.{method}", 2)
+        return entry
+
+    def names(self) -> "list[str]":
+        return sorted({c for c, _ in self._methods})
+
+
+def registry() -> ObjectClassRegistry:
+    with ObjectClassRegistry._lock:
+        if ObjectClassRegistry._instance is None:
+            ObjectClassRegistry._instance = ObjectClassRegistry()
+    return ObjectClassRegistry._instance
+
+
+def jarg(data: bytes) -> dict:
+    try:
+        return json.loads(data.decode() or "{}")
+    except json.JSONDecodeError:
+        raise ClsError("input is not JSON")
+
+
+def jret(obj) -> bytes:
+    return json.dumps(obj).encode()

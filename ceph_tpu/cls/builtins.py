@@ -1,0 +1,124 @@
+"""Built-in object classes (reference src/cls/{hello,numops,lock}).
+
+Each method: async (ctx, input bytes) -> output bytes; write effects
+buffer in ctx and commit atomically after return.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import RD, WR, ClsError, jarg, jret
+
+
+# --- hello (reference src/cls/hello — the teaching class) -------------------
+
+async def hello_say(ctx, data: bytes) -> bytes:
+    who = data.decode() or "world"
+    return f"Hello, {who}!".encode()
+
+
+async def hello_record(ctx, data: bytes) -> bytes:
+    """writes greeting into the object (cls_hello's record_hello)."""
+    ctx.write_full(b"Hello, " + (data or b"world") + b"!")
+    return b""
+
+
+async def hello_replay(ctx, data: bytes) -> bytes:
+    return await ctx.read()
+
+
+# --- numops (reference src/cls/numops: arithmetic on stored values) ---------
+
+async def numops_add(ctx, data: bytes) -> bytes:
+    args = jarg(data)
+    try:
+        cur = float((await ctx.read()).decode() or "0")
+    except ValueError:
+        raise ClsError("stored value is not numeric")
+    cur += float(args.get("value", 0))
+    out = ("%d" % cur if cur == int(cur) else repr(cur)).encode()
+    ctx.write_full(out)
+    return out
+
+
+async def numops_mul(ctx, data: bytes) -> bytes:
+    args = jarg(data)
+    try:
+        cur = float((await ctx.read()).decode() or "0")
+    except ValueError:
+        raise ClsError("stored value is not numeric")
+    cur *= float(args.get("value", 1))
+    out = ("%d" % cur if cur == int(cur) else repr(cur)).encode()
+    ctx.write_full(out)
+    return out
+
+
+# --- lock (reference src/cls/lock: advisory locks in an xattr) --------------
+
+LOCK_XATTR = "lock.state"
+
+
+def _lock_state(ctx) -> dict:
+    try:
+        raw = ctx.getxattr(LOCK_XATTR)
+    except Exception:  # noqa: BLE001 — no lock yet
+        return {}
+    import json
+    st = json.loads(raw.decode())
+    if st.get("expires") and st["expires"] < time.time():
+        return {}
+    return st
+
+
+async def lock_lock(ctx, data: bytes) -> bytes:
+    args = jarg(data)
+    owner = args.get("owner", "")
+    if not owner:
+        raise ClsError("owner required")
+    st = _lock_state(ctx)
+    if st and st.get("owner") != owner:
+        raise ClsError(f"locked by {st['owner']}", 16)  # EBUSY
+    dur = float(args.get("duration", 0))
+    ctx.setxattr(LOCK_XATTR, jret({
+        "owner": owner,
+        "expires": time.time() + dur if dur else 0}))
+    return b""
+
+
+async def lock_unlock(ctx, data: bytes) -> bytes:
+    args = jarg(data)
+    st = _lock_state(ctx)
+    if st and st.get("owner") != args.get("owner"):
+        raise ClsError(f"locked by {st['owner']}", 16)
+    ctx.setxattr(LOCK_XATTR, jret({}))
+    return b""
+
+
+async def lock_info(ctx, data: bytes) -> bytes:
+    return jret(_lock_state(ctx))
+
+
+# --- cas (compare-and-swap: the read-modify-write atomicity showcase) -------
+
+async def cas_swap(ctx, data: bytes) -> bytes:
+    args = jarg(data)
+    expect = args.get("expect", "").encode()
+    cur = await ctx.read()
+    if cur != expect:
+        raise ClsError(f"expectation failed ({len(cur)} bytes stored)",
+                       17)  # EEXIST-style
+    ctx.write_full(args.get("value", "").encode())
+    return b""
+
+
+def register_all(reg) -> None:
+    reg.register("hello", "say_hello", RD, hello_say)
+    reg.register("hello", "record_hello", WR, hello_record)
+    reg.register("hello", "replay", RD, hello_replay)
+    reg.register("numops", "add", RD | WR, numops_add)
+    reg.register("numops", "mul", RD | WR, numops_mul)
+    reg.register("lock", "lock", RD | WR, lock_lock)
+    reg.register("lock", "unlock", RD | WR, lock_unlock)
+    reg.register("lock", "get_info", RD, lock_info)
+    reg.register("cas", "swap", RD | WR, cas_swap)

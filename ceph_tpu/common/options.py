@@ -164,7 +164,7 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("mgr_stats_period", float, 5.0, LEVEL_ADVANCED, min=0.1,
            desc="seconds between mgr stat collections", services=("mgr",)),
     Option("mgr_prometheus_port", int, 9283, LEVEL_ADVANCED, min=0,
-           desc="prometheus exporter port (0 = disabled)",
+           desc="prometheus exporter port (0 = ephemeral)",
            services=("mgr",)),
     Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="extra directory for mgr modules", services=("mgr",)),

@@ -1,0 +1,141 @@
+"""OpTracker / TrackedOp — per-op event timelines and historic ops.
+
+Reference: src/common/TrackedOp.h:101 (TrackedOp event marks, OpTracker
+in-flight registry) powering the admin-socket commands
+``dump_ops_in_flight`` / ``dump_historic_ops`` and the slow-op
+("currently waiting for ...") warnings in the cluster log.
+
+A TrackedOp records (monotonic ts, event) marks through its life;
+``finish`` moves it into a bounded history ring (osd_op_history_size /
+osd_op_history_duration) and logs a complaint if it exceeded
+osd_op_complaint_time.  Spans double as the distributed-trace hooks:
+``trace_id`` propagates through message headers the way the reference
+threads ZTracer/blkin spans across sub-ops (ECBackend.cc:2063-2068).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from .log import dout
+
+_ids = itertools.count(1)
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "op_id", "desc", "trace_id", "start",
+                 "events", "done")
+
+    def __init__(self, tracker: "Optional[OpTracker]", desc: str,
+                 trace_id: str = "") -> None:
+        self.tracker = tracker
+        self.op_id = next(_ids)
+        self.desc = desc
+        self.trace_id = trace_id or f"t{self.op_id:x}"
+        self.start = time.monotonic()
+        self.events: "List[tuple[float, str]]" = [(self.start,
+                                                   "initiated")]
+        self.done = False
+
+    def mark(self, event: str) -> None:
+        self.events.append((time.monotonic(), event))
+
+    @property
+    def age(self) -> float:
+        return ((self.events[-1][0] if self.done else time.monotonic())
+                - self.start)
+
+    def finish(self, event: str = "done") -> None:
+        if self.done:
+            return
+        self.mark(event)
+        self.done = True
+        if self.tracker is not None:
+            self.tracker._finish(self)
+
+    def __enter__(self) -> "TrackedOp":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.finish("error" if exc else "done")
+
+    def dump(self) -> dict:
+        return {"id": self.op_id, "description": self.desc,
+                "trace_id": self.trace_id,
+                "age": round(self.age, 6),
+                "type_events": [
+                    {"time": round(ts - self.start, 6), "event": ev}
+                    for ts, ev in self.events]}
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20,
+                 history_duration: float = 600.0,
+                 complaint_time: float = 30.0,
+                 enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.history_size = history_size
+        self.history_duration = history_duration
+        self.complaint_time = complaint_time
+        self.in_flight: "Dict[int, TrackedOp]" = {}
+        self.history: "Deque[TrackedOp]" = deque()
+        self.slow_ops_total = 0
+        # dumps run on the admin-socket THREAD while the event loop
+        # mutates; the lock keeps iteration safe
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, config) -> "OpTracker":
+        return cls(
+            history_size=int(config.get("osd_op_history_size")),
+            history_duration=float(config.get("osd_op_history_duration")),
+            complaint_time=float(config.get("osd_op_complaint_time")),
+            enabled=bool(config.get("osd_enable_op_tracker")))
+
+    def create(self, desc: str, trace_id: str = "") -> TrackedOp:
+        op = TrackedOp(self if self.enabled else None, desc, trace_id)
+        if self.enabled:
+            with self._lock:
+                self.in_flight[op.op_id] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        slow = op.age >= self.complaint_time
+        with self._lock:
+            self.in_flight.pop(op.op_id, None)
+            if slow:
+                self.slow_ops_total += 1
+            self.history.append(op)
+            self._trim()
+        if slow:
+            dout("osd", 0, f"slow op ({op.age:.1f}s >= "
+                           f"{self.complaint_time}s): {op.desc}")
+
+    def _trim(self) -> None:
+        cutoff = time.monotonic() - self.history_duration
+        while self.history and (
+                len(self.history) > self.history_size
+                or self.history[0].events[-1][0] < cutoff):
+            self.history.popleft()
+
+    # --- admin-socket surfaces (reference dump_historic_ops etc.) ------------
+
+    def dump_in_flight(self) -> dict:
+        with self._lock:
+            ops = sorted(self.in_flight.values(), key=lambda o: o.start)
+            return {"num_ops": len(ops), "ops": [o.dump() for o in ops]}
+
+    def dump_historic(self) -> dict:
+        with self._lock:
+            self._trim()
+            return {"num_ops": len(self.history),
+                    "ops": [o.dump() for o in self.history]}
+
+    def slow_ops(self) -> "List[TrackedOp]":
+        with self._lock:
+            return [o for o in self.in_flight.values()
+                    if o.age >= self.complaint_time]

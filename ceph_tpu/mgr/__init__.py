@@ -1,0 +1,1 @@
+from .daemon import MgrDaemon  # noqa: F401

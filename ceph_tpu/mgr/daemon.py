@@ -1,0 +1,186 @@
+"""Manager daemon — cluster-wide stat aggregation and module host.
+
+Reference: src/mgr (15.8k C++) + src/pybind/mgr (python module host).
+Daemons push periodic reports (MMgrReport: perf counter dump + status)
+to the mgr, which aggregates them cluster-wide; python-style modules
+consume the aggregate — here ``prometheus`` (text-format exporter over
+HTTP, reference src/pybind/mgr/prometheus) and ``status`` (the 'ceph
+status' data source) ship built in, and ``register_module`` accepts
+out-of-tree ones (the dashboard/balancer slot).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Callable, Dict, Optional
+
+from ..common.config import Config
+from ..common.log import dout
+from ..msg.message import Message, register_message
+from ..msg.messenger import Dispatcher, Messenger
+
+
+@register_message
+class MMgrReport(Message):
+    """Daemon -> mgr: fields: daemon ("osd.0"), perf (collection dump),
+    status (free-form dict), epoch."""
+    TYPE = "mgr_report"
+
+
+class MgrModule:
+    """Base for mgr modules (the pybind/mgr ActivePyModule analog)."""
+
+    name = "module"
+
+    def __init__(self, mgr: "MgrDaemon") -> None:
+        self.mgr = mgr
+
+    async def serve(self) -> None:
+        """Awaited by MgrDaemon.init; must return once ready."""
+
+    def shutdown(self) -> None:
+        pass
+
+
+class StatusModule(MgrModule):
+    name = "status"
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        daemons = {}
+        for name, rep in self.mgr.reports.items():
+            daemons[name] = {"age": round(now - rep["ts"], 1),
+                             "status": rep.get("status", {})}
+        return {"num_daemons": len(daemons), "daemons": daemons}
+
+
+class PrometheusModule(MgrModule):
+    """Text-format exporter (reference src/pybind/mgr/prometheus)."""
+
+    name = "prometheus"
+
+    def __init__(self, mgr: "MgrDaemon") -> None:
+        super().__init__(mgr)
+        self.port = int(mgr.config.get("mgr_prometheus_port"))
+        self._server: "Optional[asyncio.AbstractServer]" = None
+
+    async def serve(self) -> None:
+        # awaited at init: port is final before init() returns (a
+        # fire-and-forget task would let prometheus_port() race the bind)
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        dout("mgr", 1, f"prometheus exporter on 127.0.0.1:{self.port}")
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            await reader.readline()          # request line; drain headers
+            while (await reader.readline()).strip():
+                pass
+            body = self.render().encode()
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: text/plain; version=0.0.4\r\n"
+                         b"Content-Length: " + str(len(body)).encode()
+                         + b"\r\nConnection: close\r\n\r\n" + body)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    def render(self) -> str:
+        """Aggregate reports into prometheus exposition text."""
+        lines = ["# HELP ceph_daemon_up 1 if the daemon reported recently",
+                 "# TYPE ceph_daemon_up gauge"]
+        now = time.monotonic()
+        stale = float(self.mgr.config.get("mgr_stats_period")) * 3
+        for name, rep in sorted(self.mgr.reports.items()):
+            up = 1 if now - rep["ts"] < stale else 0
+            lines.append(f'ceph_daemon_up{{ceph_daemon="{name}"}} {up}')
+        seen: "set[str]" = set()
+        for name, rep in sorted(self.mgr.reports.items()):
+            for group, counters in rep.get("perf", {}).items():
+                for cname, val in counters.items():
+                    metric = f"ceph_{cname}"
+                    if isinstance(val, dict):
+                        val = val.get("sum", val.get("avgcount", 0))
+                    if metric not in seen:
+                        seen.add(metric)
+                        lines.append(f"# TYPE {metric} counter")
+                    lines.append(
+                        f'{metric}{{ceph_daemon="{name}"}} {val}')
+        return "\n".join(lines) + "\n"
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+
+class MgrDaemon(Dispatcher):
+    def __init__(self, config: "Optional[Config]" = None,
+                 addr: str = "local:mgr") -> None:
+        self.config = config or Config()
+        self.addr = addr
+        self.ms = Messenger.create("mgr", self.config)
+        self.ms.add_dispatcher(self)
+        # daemon name -> {ts, perf, status, epoch}
+        self.reports: "Dict[str, dict]" = {}
+        self.modules: "Dict[str, MgrModule]" = {}
+        self._tasks: "list[asyncio.Task]" = []
+        self.register_module(StatusModule)
+        self.register_module(PrometheusModule)
+
+    def register_module(self, cls: "Callable[[MgrDaemon], MgrModule]"
+                        ) -> MgrModule:
+        mod = cls(self)
+        self.modules[mod.name] = mod
+        return mod
+
+    async def init(self) -> None:
+        await self.ms.bind(self.addr)
+        self.addr = self.ms.listen_addr
+        for mod in self.modules.values():
+            await mod.serve()
+
+    async def shutdown(self) -> None:
+        for mod in self.modules.values():
+            mod.shutdown()
+        await self.ms.shutdown()
+
+    async def ms_dispatch(self, conn, msg: Message) -> bool:
+        if msg.TYPE != "mgr_report":
+            return False
+        self.reports[str(msg["daemon"])] = {
+            "ts": time.monotonic(), "perf": dict(msg.get("perf", {})),
+            "status": dict(msg.get("status", {})),
+            "epoch": int(msg.get("epoch", 0))}
+        return True
+
+    # --- convenience ----------------------------------------------------------
+
+    def cluster_status(self) -> dict:
+        return self.modules["status"].status()
+
+    def prometheus_port(self) -> int:
+        return self.modules["prometheus"].port
+
+
+async def report_loop(daemon, mgr_addr: str) -> None:
+    """OSD/mon side: push MMgrReport every mgr_stats_period (reference
+    DaemonServer report handling); cancelled on daemon shutdown."""
+    period = float(daemon.config.get("mgr_stats_period"))
+    name = f"osd.{daemon.whoami}"
+    while True:
+        try:
+            conn = daemon.ms.get_connection(mgr_addr)
+            await conn.send_message(MMgrReport({
+                "daemon": name,
+                "perf": daemon.perf_coll.dump(),
+                "status": {"up": daemon.up,
+                           "num_pgs": len(daemon.backends),
+                           "epoch": daemon.osdmap.epoch},
+                "epoch": daemon.osdmap.epoch}))
+        except Exception as e:  # noqa: BLE001 — mgr down: keep trying
+            dout("mgr", 10, f"{name}: mgr report failed: {e}")
+        await asyncio.sleep(period)

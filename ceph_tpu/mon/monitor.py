@@ -176,13 +176,28 @@ class MonDaemon(Dispatcher):
 
     async def _broadcast_map(self) -> None:
         payload = json.dumps(self.osdmap.to_dict()).encode()
-        for addr in list(self.subs):
+
+        async def one(addr: str) -> None:
+            # bounded wait: a lossless tcp send to a DEAD subscriber
+            # blocks until reconnect — unbounded, it wedges the caller
+            # (the mon tick hung exactly here publishing the mark-down
+            # of the very OSD it was marking down).  On timeout the
+            # frame is queued and replays when/if the peer returns.
             try:
                 conn = self.ms.get_connection(addr)
-                await conn.send_message(MOSDMapMsg(
-                    {"epoch": self.osdmap.epoch}, payload))
+                await asyncio.wait_for(conn.send_message(MOSDMapMsg(
+                    {"epoch": self.osdmap.epoch}, payload)), 0.5)
+            except asyncio.TimeoutError:
+                # MUST precede OSError: on py3.11+ asyncio.TimeoutError
+                # IS builtins.TimeoutError (an OSError subclass) — the
+                # clause below would permanently unsubscribe a merely
+                # slow peer.  The queued frame replays on reconnect.
+                pass
             except (ConnectionError, OSError):
                 self.subs.discard(addr)
+
+        if self.subs:
+            await asyncio.gather(*(one(a) for a in list(self.subs)))
 
     # --- proposals ------------------------------------------------------------
 
@@ -281,6 +296,8 @@ class MonDaemon(Dispatcher):
                 if peer != self.rank:
                     await self._send_election(peer, "lease", {})
             now = time.monotonic()
+            dout("mon", 10, f"tick: beacons "
+                            f"{ {o: round(now - t, 1) for o, t in self.last_beacon.items()} }")
             ops = []
             for osd, info in self.osdmap.osds.items():
                 seen = self.last_beacon.get(osd)

@@ -33,6 +33,7 @@ from .ecbackend import (EIO, ENOENT, ESTALE, ClientOp, ECBackend, ECError,
 from .ecutil import StripeInfo
 from .encode_service import EncodeService
 from .replicated import ReplicateCodec
+from ..common.tracked_op import OpTracker
 from .scheduler import CLIENT, MClockScheduler
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
@@ -68,7 +69,7 @@ class OSDDaemon(Dispatcher):
                  store: "Optional[ObjectStore]" = None,
                  config: "Optional[Config]" = None,
                  mon_addrs: "Optional[Dict[int, str]]" = None,
-                 addr: str = "") -> None:
+                 addr: str = "", mgr_addr: str = "") -> None:
         self.whoami = osd_id
         self.store = store or MemStore()
         self.config = config or Config()
@@ -85,9 +86,14 @@ class OSDDaemon(Dispatcher):
         # op QoS: client vs recovery vs scrub share the op slots per the
         # configured policy (reference ShardedOpWQ + mClockScheduler)
         self.op_scheduler = MClockScheduler.from_config(self.config)
+        # per-op event timelines + historic ops (reference TrackedOp)
+        self.op_tracker = OpTracker.from_config(self.config)
+        self.admin_socket = None
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
+        self.mgr_addr = mgr_addr
+        self._mgr_task = None
         self._beacon_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
         if self.monc is not None:
@@ -121,6 +127,11 @@ class OSDDaemon(Dispatcher):
         for c in self.store.list_collections():
             if c.pool in self.osdmap.pools:
                 self._get_backend((c.pool, c.pg))
+        self._start_admin_socket()
+        if self.mgr_addr:
+            from ..mgr.daemon import report_loop
+            self._mgr_task = asyncio.ensure_future(
+                report_loop(self, self.mgr_addr))
         self.up = True
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
 
@@ -171,10 +182,47 @@ class OSDDaemon(Dispatcher):
             await self.monc.send_beacon(self.whoami)
             await asyncio.sleep(interval)
 
+    def _start_admin_socket(self) -> None:
+        """Expose runtime introspection on a unix socket when the
+        admin_socket option is set (reference admin_socket.h:108; the
+        path template's $name expands to osd.<id>)."""
+        path = str(self.config.get("admin_socket"))
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+        path = path.replace("$name", f"osd.{self.whoami}")
+        a = AdminSocket(path)
+        a.register("perf dump", lambda _c: self.perf_coll.dump(),
+                   "per-daemon performance counters")
+        a.register("dump_ops_in_flight",
+                   lambda _c: self.op_tracker.dump_in_flight(),
+                   "ops currently being processed")
+        a.register("dump_historic_ops",
+                   lambda _c: self.op_tracker.dump_historic(),
+                   "recently completed ops with event timelines")
+        a.register("config get",
+                   lambda c: {c["key"]: self.config.get(c["key"])},
+                   "read a config value")
+        a.register("config set",
+                   lambda c: (self.config.set(c["key"], c["value"]),
+                              {"success": True})[1],
+                   "set a config value at runtime")
+        a.register("status",
+                   lambda _c: {"whoami": self.whoami, "up": self.up,
+                               "epoch": self.osdmap.epoch,
+                               "num_pgs": len(self.backends)},
+                   "daemon status")
+        a.start()
+        self.admin_socket = a
+
     async def shutdown(self) -> None:
         self.up = False
         if self._beacon_task:
             self._beacon_task.cancel()
+        if self._mgr_task:
+            self._mgr_task.cancel()
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
         await self.ms.shutdown()
         self.store.umount()
 
@@ -208,6 +256,39 @@ class OSDDaemon(Dispatcher):
     def _acting(self, pgid: "Tuple[int, int]") -> "List[int]":
         _up, acting = self.osdmap.pg_to_up_acting_osds(pgid[0], pgid[1])
         return acting
+
+    async def _exec_cls(self, be: ECBackend, oid: str, cls: str,
+                        method: str, payload: bytes,
+                        reqid: str = "") -> bytes:
+        """Run an object-class method next to the data.  The cls lock
+        spans the method's reads AND its buffered-write ADMISSION into
+        the pipeline (which commits in admission order), so no other
+        write — cls or plain — can land between a method's read and its
+        write: the read-modify-write is atomic, as in the reference
+        where cls methods run under the PG lock.  Replayed calls (client
+        retries) return the cached result instead of re-executing."""
+        from ..cls import ClsContext, registry
+        fn, _flags = registry().lookup(cls, method)
+        key = f"{reqid}/{cls}.{method}" if reqid else ""
+        if key and key in be.completed_cls:
+            return be.completed_cls[key]
+        async with be.cls_lock:
+            ctx = ClsContext(be, oid)
+            ret = await fn(ctx, payload)
+            if ctx.mutations:
+                # commit INSIDE the lock: cls reads see committed shard
+                # state, so the next method may only run after this
+                # one's writes are durable (plain writes queue on the
+                # same lock for their enqueue, so they can't interleave
+                # either)
+                op = await be.enqueue_transaction(oid, ctx.mutations)
+                await op.on_commit
+        out = bytes(ret or b"")
+        if key:
+            be.completed_cls[key] = out
+            while len(be.completed_cls) > 4096:
+                be.completed_cls.pop(next(iter(be.completed_cls)))
+        return out
 
     async def _send_to_osd(self, osd: int, msg: Message) -> None:
         addr = self.osdmap.get_addr(osd)
@@ -305,10 +386,17 @@ class OSDDaemon(Dispatcher):
     # --- client ops (reference PrimaryLogPG::do_op -> execute_ctx) -----------
 
     async def _handle_client_op(self, conn, msg: MOSDOp) -> None:
-        async with self.op_scheduler.queued(CLIENT):
-            await self._do_client_op(conn, msg)
+        ops = ",".join(o.get("op", "?") for o in msg.get("ops", []))
+        top = self.op_tracker.create(
+            f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
+            trace_id=str(msg.get("trace_id", "")))
+        with top:
+            top.mark("queued_for_pg")
+            async with self.op_scheduler.queued(CLIENT):
+                top.mark("reached_pg")
+                await self._do_client_op(conn, msg, top)
 
-    async def _do_client_op(self, conn, msg: MOSDOp) -> None:
+    async def _do_client_op(self, conn, msg: MOSDOp, top=None) -> None:
         self.perf.inc("op")
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
@@ -339,6 +427,18 @@ class OSDDaemon(Dispatcher):
                     doff += dlen
                     mutations.append(ClientOp(name, name=op["name"],
                                               value=payload))
+                elif name == "call":
+                    # object-class execution (reference 'rados exec' ->
+                    # PrimaryLogPG::do_osd_ops CEPH_OSD_OP_CALL)
+                    dlen = int(op.get("dlen", 0))
+                    payload = msg.data[doff:doff + dlen]
+                    doff += dlen
+                    out = await self._exec_cls(
+                        be, oid, str(op.get("cls", "")),
+                        str(op.get("method", "")), payload,
+                        reqid=str(msg.get("reqid", "")))
+                    outs.append({"op": "call", "dlen": len(out)})
+                    out_bufs.append(out)
                 elif name == "read":
                     self.perf.inc("op_r")
                     res = await be.objects_read_and_reconstruct(
@@ -360,8 +460,12 @@ class OSDDaemon(Dispatcher):
                     raise ECError(f"unknown op {name!r}")
             if mutations:
                 self.perf.inc("op_w")
+                if top:
+                    top.mark("started_write")
                 version = await be.submit_transaction(
                     oid, mutations, reqid=str(msg.get("reqid", "")))
+                if top:
+                    top.mark("commit_sent")
                 outs.append({"op": "commit", "version": list(version),
                              "dlen": 0})
         except NotActive as e:
@@ -370,12 +474,18 @@ class OSDDaemon(Dispatcher):
             result = -ESTALE
             outs.append({"error": str(e)})
         except Exception as e:  # noqa: BLE001 — op errors become errno
+            from ..cls import ClsError
             from ..objectstore.store import NotFound
-            if not isinstance(e, (ECError, KeyError, NotFound)):
+            if not isinstance(e, (ECError, KeyError, NotFound, ClsError)):
                 dout("osd", 0, f"op error: {type(e).__name__}: {e}")
             # absent objects map to ENOENT so clients (striper hole
             # reads, stat probes) can distinguish them from I/O errors
-            result = -ENOENT if isinstance(e, NotFound) else -EIO
+            if isinstance(e, ClsError):
+                result = -e.errno
+            elif isinstance(e, NotFound):
+                result = -ENOENT
+            else:
+                result = -EIO
             outs.append({"error": str(e)})
         _lens, blob = pack_buffers(out_bufs)
         await conn.send_message(MOSDOpReply({

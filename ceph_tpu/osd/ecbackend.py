@@ -190,6 +190,13 @@ class ECBackend:
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
         self.config = config
+        # serializes object-class read-modify-write executions against
+        # each other AND against plain write admissions (reference: cls
+        # methods run under the PG lock in do_op)
+        self.cls_lock = asyncio.Lock()
+        # reqid -> result bytes for replayed object-class calls (a
+        # retried numops.add must not double-apply)
+        self.completed_cls: "Dict[str, bytes]" = {}
         self.extent_cache = ExtentCache()
         # primary pipeline state
         self.waiting_state: "List[Op]" = []
@@ -382,6 +389,28 @@ class ECBackend:
         retries of a mutation that already committed."""
         if reqid and reqid in self.completed_reqids:
             return self.completed_reqids[reqid]
+        # brief cls_lock hold for the ENQUEUE only: object-class
+        # executions hold it across their reads + enqueue, so a plain
+        # write can never slip between a cls method's read and its
+        # buffered-write admission (lost-update window)
+        async with self.cls_lock:
+            op = await self.enqueue_transaction(oid, ops)
+        version = await op.on_commit
+        if reqid:
+            self.completed_reqids[reqid] = version
+            while len(self.completed_reqids) > 4096:
+                self.completed_reqids.pop(
+                    next(iter(self.completed_reqids)))
+        return version
+
+    async def enqueue_transaction(self, oid: str,
+                                  ops: "Sequence[ClientOp]") -> Op:
+        """Admit a mutation into the pipeline and return its Op without
+        waiting for commit.  The pipeline commits strictly in admission
+        order, so once op A is enqueued, no later op can commit before
+        it — the ordering handle object-class executions need for
+        read-modify-write atomicity (exec holds cls_lock across its
+        reads AND this enqueue)."""
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops))
         op.on_commit = asyncio.get_event_loop().create_future()
         # peering drains + blocks the pipeline (reference: client ops are
@@ -399,13 +428,7 @@ class ECBackend:
                 self.tid_to_op[op.tid] = op
                 await self._check_ops()
                 break
-        version = await op.on_commit
-        if reqid:
-            self.completed_reqids[reqid] = version
-            while len(self.completed_reqids) > 4096:
-                self.completed_reqids.pop(
-                    next(iter(self.completed_reqids)))
-        return version
+        return op
 
     def _projected_oi(self, oid: str) -> ObjectInfo:
         """Object info as seen *through* in-flight pipelined ops, so an

@@ -27,13 +27,16 @@ from ..osd.osdmap import OSDMap, POOL_ERASURE
 
 class MiniCluster:
     def __init__(self, n_osds: int = 6, n_mons: int = 0,
-                 config: "Optional[Config]" = None) -> None:
+                 config: "Optional[Config]" = None,
+                 mgr: bool = False) -> None:
         self.config = config or Config()
         if config is None or self.config.origin("ms_type") == "default":
             # default to the in-process transport; an explicit ms_type in
             # the caller's config (e.g. async+tcp for real sockets) wins
             self.config.set("ms_type", "async+local")
         self.n_osds = n_osds
+        self.with_mgr = mgr
+        self.mgr = None
         self.mon_addrs: "Dict[int, str]" = {
             r: f"local:mon.{r}" for r in range(n_mons)}
         self.mons: "Dict[int, object]" = {}
@@ -58,6 +61,14 @@ class MiniCluster:
     # --- lifecycle ------------------------------------------------------------
 
     async def start(self) -> None:
+        if self.with_mgr:
+            from ..mgr import MgrDaemon
+            self.mgr = MgrDaemon(
+                self.config,
+                addr="127.0.0.1:0" if self._tcp else "local:mgr")
+            await self.mgr.init()
+            for osd in self.osds.values():
+                osd.mgr_addr = self.mgr.addr
         if self.mon_addrs:
             from ..mon.monitor import MonDaemon
             for r in self.mon_addrs:
@@ -67,7 +78,8 @@ class MiniCluster:
             await self.wait_for_leader()
             for i in range(self.n_osds):
                 self.osds[i] = OSDDaemon(
-                    i, config=self.config, mon_addrs=self.mon_addrs)
+                    i, config=self.config, mon_addrs=self.mon_addrs,
+                    mgr_addr=self.mgr.addr if self.mgr else "")
             for osd in self.osds.values():
                 await osd.init()
         else:
@@ -106,6 +118,8 @@ class MiniCluster:
             await osd.shutdown()
         for mon in self.mons.values():
             await mon.shutdown()
+        if self.mgr is not None:
+            await self.mgr.shutdown()
 
     async def __aenter__(self) -> "MiniCluster":
         await self.start()
@@ -194,10 +208,11 @@ class MiniCluster:
         old = self.osds[osd_id]
         if self.mon_addrs:
             osd = OSDDaemon(osd_id, store=old.store, config=self.config,
-                            mon_addrs=self.mon_addrs)
+                            mon_addrs=self.mon_addrs,
+                            mgr_addr=old.mgr_addr)
         else:
             osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
-                            config=self.config)
+                            config=self.config, mgr_addr=old.mgr_addr)
             self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd

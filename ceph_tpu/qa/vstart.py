@@ -1,0 +1,114 @@
+"""vstart — multi-process dev cluster launcher (QA tier 3).
+
+Reference: src/vstart.sh + qa/standalone/ceph-helpers.sh: spin real
+mon/osd PROCESSES on localhost with throwaway data dirs, so tests cover
+real sockets, real process death (kill -9), and restart-from-disk —
+the regimes the in-process MiniCluster cannot reach.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DAEMON = os.path.join(REPO, "tools", "ceph_daemon.py")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ProcCluster:
+    """Launch/kill/revive mon+osd subprocesses."""
+
+    def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
+                 options: "Optional[List[str]]" = None) -> None:
+        self.base_dir = base_dir
+        self.options = list(options or [])
+        self.mon_addrs: "Dict[int, str]" = {
+            r: f"127.0.0.1:{free_port()}" for r in range(n_mons)}
+        self.n_osds = n_osds
+        self.procs: "Dict[str, subprocess.Popen]" = {}
+        self.osd_logs: "Dict[str, object]" = {}
+
+    @property
+    def mon_spec(self) -> str:
+        return ",".join(f"{r}={a}" for r, a in self.mon_addrs.items())
+
+    def _spawn(self, name: str, argv: "List[str]",
+               timeout: float = 30.0) -> dict:
+        log = open(os.path.join(self.base_dir, f"{name}.log"), "ab")
+        self.osd_logs[name] = log
+        proc = subprocess.Popen(
+            [sys.executable, DAEMON, *argv],
+            stdout=subprocess.PIPE, stderr=log, text=True)
+        self.procs[name] = proc
+        # non-blocking ready-line wait: a plain readline() would ignore
+        # the deadline entirely if the daemon hangs before printing
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        deadline = time.monotonic() + timeout
+        line = ""
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"{name} died during boot")
+            if sel.select(timeout=0.2):
+                line = proc.stdout.readline()
+                if line.strip():
+                    break
+        sel.close()
+        if not line.strip():
+            raise RuntimeError(f"{name} boot timeout after {timeout}s")
+        info = json.loads(line)
+        assert info.get("ready"), info
+        return info
+
+    def start(self) -> None:
+        os.makedirs(self.base_dir, exist_ok=True)
+        for r in self.mon_addrs:
+            self._spawn(f"mon.{r}", [
+                "mon", "--rank", str(r), "--mon-addrs", self.mon_spec,
+                *sum((["-o", o] for o in self.options), [])])
+        for i in range(self.n_osds):
+            self.start_osd(i)
+
+    def start_osd(self, osd_id: int) -> dict:
+        return self._spawn(f"osd.{osd_id}", [
+            "osd", "--id", str(osd_id), "--mon-addrs", self.mon_spec,
+            "--data", os.path.join(self.base_dir, f"osd.{osd_id}"),
+            *sum((["-o", o] for o in self.options), [])])
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
+        """kill -9 by default (reference thrasher kill_osd)."""
+        proc = self.procs.pop(name, None)
+        if proc is not None:
+            proc.send_signal(sig)
+            proc.wait(timeout=10)
+
+    def revive_osd(self, osd_id: int) -> dict:
+        """Respawn against the same data dir (restart-from-disk)."""
+        return self.start_osd(osd_id)
+
+    def stop(self) -> None:
+        for name in list(self.procs):
+            self.kill(name, signal.SIGKILL)
+        for log in self.osd_logs.values():
+            log.close()
+
+    def __enter__(self) -> "ProcCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,124 @@
+"""Object classes (ceph_tpu/cls) — reference src/cls + src/objclass.
+
+Covers the registry handshake, the built-in classes (hello, numops,
+lock, cas) via the full client exec path, the atomicity of buffered
+writes, and error propagation with errnos.
+"""
+
+import asyncio
+import types
+
+import pytest
+
+from ceph_tpu.client.objecter import ObjecterError
+from ceph_tpu.cls import (ClsError, ObjectClassRegistry, RD, WR, jret,
+                          registry)
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=5)
+    c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                     pg_num=2, stripe_unit=64)
+    return c
+
+
+def test_registry_builtins_and_handshake():
+    reg = registry()
+    assert {"hello", "numops", "lock", "cas"} <= set(reg.names())
+    with pytest.raises(ClsError):
+        reg.lookup("hello", "nope")
+
+    fresh = ObjectClassRegistry()
+    good = types.SimpleNamespace(
+        __objclass_version__="1",
+        __objclass_init__=lambda r, n: r.register(
+            n, "noop", RD, lambda ctx, d: b""))
+    fresh.load_module(good, "mycls")
+    assert "mycls" in fresh.names()
+    with pytest.raises(ClsError):
+        fresh.load_module(types.SimpleNamespace(
+            __objclass_version__="0"), "old")
+    with pytest.raises(ClsError):
+        fresh.load_module(types.SimpleNamespace(
+            __objclass_version__="1",
+            __objclass_init__=lambda r, n: None), "lazy")
+
+
+class TestExec:
+    def test_hello_and_numops(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                assert await io.exec("obj", "hello", "say_hello",
+                                     b"tpu") == b"Hello, tpu!"
+                await io.exec("obj", "hello", "record_hello", b"disk")
+                assert await io.read("obj") == b"Hello, disk!"
+                assert await io.exec("obj", "hello", "replay") \
+                    == b"Hello, disk!"
+                # numops read-modify-writes server-side
+                await io.write_full("n", b"10")
+                assert await io.exec("n", "numops", "add",
+                                     jret({"value": 5})) == b"15"
+                assert await io.exec("n", "numops", "mul",
+                                     jret({"value": 3})) == b"45"
+                assert await io.read("n") == b"45"
+        loop.run_until_complete(go())
+
+    def test_lock_class(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                await io.write_full("obj", b"x")
+                await io.exec("obj", "lock", "lock",
+                              jret({"owner": "alice"}))
+                # contended lock fails with EBUSY errno
+                with pytest.raises(ObjecterError) as ei:
+                    await io.exec("obj", "lock", "lock",
+                                  jret({"owner": "bob"}))
+                assert ei.value.errno == 16
+                await io.exec("obj", "lock", "unlock",
+                              jret({"owner": "alice"}))
+                await io.exec("obj", "lock", "lock",
+                              jret({"owner": "bob"}))
+        loop.run_until_complete(go())
+
+    def test_cas_and_concurrent_rmw_atomicity(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                await io.write_full("c", b"old")
+                await io.exec("c", "cas", "swap",
+                              jret({"expect": "old", "value": "new"}))
+                with pytest.raises(ObjecterError):
+                    await io.exec("c", "cas", "swap",
+                                  jret({"expect": "old", "value": "x"}))
+                assert await io.read("c") == b"new"
+                # concurrent numops adds must not lose updates
+                await io.write_full("ctr", b"0")
+                await asyncio.gather(*(
+                    io.exec("ctr", "numops", "add", jret({"value": 1}))
+                    for _ in range(20)))
+                assert await io.read("ctr") == b"20"
+        loop.run_until_complete(go())
+
+    def test_unknown_class_is_enoent(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                with pytest.raises(ObjecterError) as ei:
+                    await io.exec("obj", "nope", "m")
+                assert ei.value.errno == 2
+        loop.run_until_complete(go())

@@ -1,0 +1,99 @@
+"""QA tier 3: real multi-process daemons over tcp (qa/vstart.py).
+
+Reference: qa/standalone/ceph-helpers.sh clusters — real mon+osd
+processes, real sockets, kill -9, restart from on-disk state.  This is
+the tier the in-process MiniCluster cannot reach: process death drops
+every in-memory structure, so only FileStore-persisted state survives.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.qa.vstart import ProcCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+async def tcp_client(cluster) -> RadosClient:
+    cfg = Config()
+    cfg.set("ms_type", "async+tcp")
+    client = RadosClient(None, name="client.qa", config=cfg,
+                         mon_addrs=dict(cluster.mon_addrs))
+    await client.connect("127.0.0.1:0")
+    return client
+
+
+async def make_pool(client, name="p", k=2, m=2):
+    await client.mon_command({
+        "prefix": "osd erasure-code-profile set", "name": f"{name}-prof",
+        "profile": {"plugin": "jax_rs", "k": str(k), "m": str(m)}})
+    await client.mon_command({
+        "prefix": "osd pool create", "name": name,
+        "kwargs": {"type": "erasure", "pg_num": 2,
+                   "ec_profile": f"{name}-prof", "stripe_unit": 256}})
+    await client.monc.wait_for_map()
+
+
+def test_process_cluster_round_trip_and_kill9(tmp_path, loop):
+    async def go():
+        with ProcCluster(str(tmp_path), n_mons=1, n_osds=5,
+                         options=["osd_heartbeat_grace=2.0"]) as pc:
+            client = await tcp_client(pc)
+            await make_pool(client)
+            io = client.io_ctx("p")
+            data1 = payload(5000, 1)
+            await io.write_full("obj", data1)
+            assert await io.read("obj") == data1
+
+            # kill -9 one OSD holding the object; the mon must detect
+            # the silent death and the cluster serve degraded
+            pool = client.osdmap.pool_by_name("p")
+            pg = client.osdmap.object_to_pg(pool.pool_id, "obj")
+            _u, acting = client.osdmap.pg_to_up_acting_osds(
+                pool.pool_id, pg)
+            victim = acting[1]
+            pc.kill(f"osd.{victim}")
+            for _ in range(200):   # failure detection -> new map
+                await asyncio.sleep(0.1)
+                if not client.osdmap.is_up(victim):
+                    break
+            assert not client.osdmap.is_up(victim), \
+                "mon never marked the kill -9'd osd down"
+            data2 = payload(7000, 2)
+            await io.write_full("obj", data2)   # degraded write
+            assert await io.read("obj") == data2
+
+            # respawn from the same data dir; it must catch up and the
+            # object must survive reading after another member dies
+            pc.revive_osd(victim)
+            for _ in range(300):
+                await asyncio.sleep(0.1)
+                if client.osdmap.is_up(victim):
+                    break
+            assert client.osdmap.is_up(victim)
+            await asyncio.sleep(1.0)   # let peering push the delta
+            other = next(o for s, o in enumerate(acting)
+                         if o != victim and s != 0)
+            pc.kill(f"osd.{other}")
+            for _ in range(200):
+                await asyncio.sleep(0.1)
+                if not client.osdmap.is_up(other):
+                    break
+            assert await io.read("obj") == data2
+            await client.shutdown()
+    loop.run_until_complete(go())

@@ -1,0 +1,102 @@
+"""OpTracker / TrackedOp + admin-socket surfaces (common/tracked_op.py).
+
+Reference: src/common/TrackedOp.h:101, admin_socket dump_historic_ops.
+"""
+
+import asyncio
+import json
+import socket
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.tracked_op import OpTracker, TrackedOp
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_lifecycle_and_history():
+    tr = OpTracker(history_size=3, complaint_time=9999)
+    ops = []
+    for i in range(5):
+        op = tr.create(f"op-{i}")
+        op.mark("phase1")
+        ops.append(op)
+    assert tr.dump_in_flight()["num_ops"] == 5
+    for op in ops:
+        op.finish()
+    assert tr.dump_in_flight()["num_ops"] == 0
+    hist = tr.dump_historic()
+    assert hist["num_ops"] == 3          # bounded ring
+    assert hist["ops"][-1]["description"] == "op-4"
+    events = [e["event"] for e in hist["ops"][-1]["type_events"]]
+    assert events == ["initiated", "phase1", "done"]
+
+
+def test_slow_op_detection():
+    tr = OpTracker(complaint_time=0.0)
+    op = tr.create("slow one")
+    time.sleep(0.01)
+    assert tr.slow_ops() == [op]
+    op.finish()
+    assert tr.slow_ops_total == 1
+
+
+def test_context_manager_marks_errors():
+    tr = OpTracker()
+    with pytest.raises(ValueError):
+        with tr.create("boom"):
+            raise ValueError("x")
+    ops = tr.dump_historic()["ops"]
+    assert ops[-1]["type_events"][-1]["event"] == "error"
+
+
+def test_daemon_tracks_ops_and_serves_admin_socket(tmp_path, loop):
+    async def go():
+        cfg = Config()
+        cfg.set("admin_socket", str(tmp_path / "$name.asok"))
+        async with MiniCluster(n_osds=4, config=cfg) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=1, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("p")
+            await io.write_full("obj", b"q" * 500)
+            assert await io.read("obj") == b"q" * 500
+            pool = c.osdmap.pool_by_name("p")
+            _u, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)
+            primary = c.osdmap.primary_of(acting)
+            hist = c.osds[primary].op_tracker.dump_historic()
+            assert hist["num_ops"] >= 2
+            evs = [e["event"] for e in hist["ops"][0]["type_events"]]
+            assert "reached_pg" in evs and "done" in evs
+            # the unix socket serves dump_historic_ops
+            path = str(tmp_path / f"osd.{primary}.asok")
+            out = await asyncio.to_thread(_ask, path,
+                                          {"prefix": "dump_historic_ops"})
+            assert out["result"]["num_ops"] >= 2
+            st = await asyncio.to_thread(_ask, path, {"prefix": "status"})
+            assert st["result"]["whoami"] == primary
+            assert st["result"]["up"]
+    loop.run_until_complete(go())
+
+
+def _ask(path: str, cmd: dict) -> dict:
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(path)
+    s.sendall((json.dumps(cmd) + "\n").encode())
+    buf = b""
+    while not buf.endswith(b"\n"):
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    return json.loads(buf.decode())

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""ceph_daemon — run one mon or osd as a real OS process.
+
+The multi-process tier (reference: ceph_mon/ceph_osd binaries launched
+by vstart.sh / qa/standalone/ceph-helpers.sh): daemons talk over real
+tcp sockets, persist to sqlite-backed FileStores, and can be kill -9'd
+and respawned against the same data directory.
+
+  python tools/ceph_daemon.py mon --rank 0 \
+      --mon-addrs 0=127.0.0.1:7101,1=127.0.0.1:7102
+  python tools/ceph_daemon.py osd --id 3 --addr 127.0.0.1:0 \
+      --mon-addrs 0=127.0.0.1:7101 --data /tmp/osd3 [--mgr 127.0.0.1:7300]
+
+The process prints one JSON "ready" line on stdout once serving (the
+launcher waits for it) and runs until killed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# daemons are pure host-side asyncio; don't drag the TPU tunnel into
+# every subprocess (the data path only needs it for large device encodes)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.common.log import get_log  # noqa: E402
+
+
+def enable_stderr_log(level: int) -> None:
+    log = get_log()
+    log._stream = sys.stderr
+    for subsys in list(log._subsys):
+        log.set_level(subsys, max(level, 5), level)
+
+
+def parse_mon_addrs(spec: str) -> "dict[int, str]":
+    out = {}
+    for part in spec.split(","):
+        rank, addr = part.split("=", 1)
+        out[int(rank)] = addr
+    return out
+
+
+def base_config(args) -> Config:
+    cfg = Config()
+    cfg.set("ms_type", "async+tcp")
+    for kv in args.option or []:
+        k, v = kv.split("=", 1)
+        cfg.set(k, v)
+    enable_stderr_log(int(cfg.get("debug_default")))
+    return cfg
+
+
+async def run_mon(args) -> None:
+    from ceph_tpu.mon.monitor import MonDaemon
+
+    mon = MonDaemon(args.rank, parse_mon_addrs(args.mon_addrs),
+                    base_config(args))
+    await mon.init()
+    print(json.dumps({"ready": True, "role": "mon", "rank": args.rank,
+                      "addr": mon.ms.listen_addr}), flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_osd(args) -> None:
+    from ceph_tpu.objectstore.filestore import FileStore
+    from ceph_tpu.osd.daemon import OSDDaemon
+
+    os.makedirs(args.data, exist_ok=True)
+    store = FileStore(os.path.join(args.data, "store.db"))
+    if not os.path.exists(store.path):
+        store.mkfs()
+    osd = OSDDaemon(args.id, store=store, config=base_config(args),
+                    mon_addrs=parse_mon_addrs(args.mon_addrs),
+                    addr=args.addr, mgr_addr=args.mgr)
+    await osd.init()
+    print(json.dumps({"ready": True, "role": "osd", "id": args.id,
+                      "addr": osd.ms.listen_addr}), flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="role", required=True)
+    pm = sub.add_parser("mon")
+    pm.add_argument("--rank", type=int, required=True)
+    pm.add_argument("--mon-addrs", required=True)
+    pm.add_argument("-o", "--option", action="append",
+                    help="config override key=value")
+    po = sub.add_parser("osd")
+    po.add_argument("--id", type=int, required=True)
+    po.add_argument("--addr", default="127.0.0.1:0")
+    po.add_argument("--mon-addrs", required=True)
+    po.add_argument("--data", required=True)
+    po.add_argument("--mgr", default="")
+    po.add_argument("-o", "--option", action="append")
+    args = p.parse_args(argv)
+    try:
+        asyncio.run(run_mon(args) if args.role == "mon"
+                    else run_osd(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
