@@ -1,0 +1,1 @@
+from .fs import FileSystem, FSError  # noqa: F401

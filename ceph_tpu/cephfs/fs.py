@@ -1,0 +1,200 @@
+"""FileSystem — a POSIX-ish namespace over RADOS (reference src/mds +
+src/client, 110k LoC).
+
+The reference runs a distributed-cache metadata server cluster; this is
+the MDS-less lean core exercising the same storage layout ideas:
+
+- every inode is a metadata object ``inode.<ino>`` in the (replicated)
+  metadata pool; directory inodes keep their ENTRIES IN OMAP
+  (name -> child ino/type), exactly how the reference's MDS stores
+  dirfrags as omap of dir objects in the metadata pool.
+- file data is striped over the data pool (EC-friendly) via the client
+  striper as ``filedata.<ino>``, the reference's file-layout analog.
+- the inode counter lives in the ``fs.meta`` object, incremented
+  ATOMICALLY server-side via the numops object class.
+
+Multi-step namespace updates are not journaled (the reference gets
+atomicity from MDS journaling — an mdlog analog is future work), but
+each single omap/object update rides the PG pipeline atomically.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import time
+from typing import List, Optional, Tuple
+
+from ..client.striper import RadosStriper
+
+ROOT_INO = 1
+META_OID = "fs.meta"
+
+
+class FSError(Exception):
+    def __init__(self, msg: str, errno: int = 2) -> None:
+        super().__init__(msg)
+        self.errno = errno
+
+
+def _inode_oid(ino: int) -> str:
+    return f"inode.{ino:x}"
+
+
+class FileSystem:
+    def __init__(self, meta_io, data_io,
+                 stripe_count: int = 4,
+                 object_size: int = 1 << 20) -> None:
+        self.meta = meta_io
+        self.striper = RadosStriper(
+            data_io, stripe_unit=object_size // stripe_count,
+            stripe_count=stripe_count, object_size=object_size)
+
+    async def mkfs(self) -> None:
+        """Initialize root + counter (idempotent)."""
+        try:
+            raw = await self.meta.read(META_OID)
+        except Exception:  # noqa: BLE001 — absent
+            raw = b""
+        if raw:
+            return
+        await self.meta.write_full(META_OID, str(ROOT_INO).encode())
+        await self._write_inode(ROOT_INO, {"type": "dir", "mode": 0o755,
+                                           "mtime": time.time()})
+
+    async def _alloc_ino(self) -> int:
+        """Atomic server-side increment via the numops object class —
+        a client-side read-modify-write would hand the same inode to
+        concurrent creates."""
+        out = await self.meta.exec(META_OID, "numops", "add",
+                                   json.dumps({"value": 1}).encode())
+        return int(out.decode())
+
+    async def _write_inode(self, ino: int, meta: dict) -> None:
+        await self.meta.write_full(_inode_oid(ino),
+                                   json.dumps(meta).encode())
+
+    async def _read_inode(self, ino: int) -> dict:
+        raw = await self.meta.read(_inode_oid(ino))
+        if not raw:
+            raise FSError(f"stale inode {ino}")
+        return json.loads(raw.decode())
+
+    # --- path walking ---------------------------------------------------------
+
+    async def _lookup(self, path: str) -> "Tuple[int, dict]":
+        parts = [p for p in posixpath.normpath(path).split("/") if p]
+        ino = ROOT_INO
+        meta = await self._read_inode(ino)
+        for name in parts:
+            if meta["type"] != "dir":
+                raise FSError(f"{name}: not a directory", 20)
+            entry = await self.meta.omap_get(_inode_oid(ino), [name])
+            if not entry:
+                raise FSError(f"{path}: no such file or directory")
+            rec = json.loads(entry[name].decode())
+            ino = int(rec["ino"])
+            meta = await self._read_inode(ino)
+        return ino, meta
+
+    async def _parent_of(self, path: str) -> "Tuple[int, str]":
+        norm = posixpath.normpath(path)
+        parent, name = posixpath.split(norm)
+        if not name:
+            raise FSError("cannot operate on /", 22)
+        ino, meta = await self._lookup(parent)
+        if meta["type"] != "dir":
+            raise FSError(f"{parent}: not a directory", 20)
+        return ino, name
+
+    async def _link(self, dir_ino: int, name: str, ino: int,
+                    kind: str) -> None:
+        await self.meta.omap_set(_inode_oid(dir_ino), {
+            name: json.dumps({"ino": ino, "type": kind}).encode()})
+
+    # --- namespace ops --------------------------------------------------------
+
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        dir_ino, name = await self._parent_of(path)
+        if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
+            raise FSError(f"{path}: exists", 17)
+        ino = await self._alloc_ino()
+        await self._write_inode(ino, {"type": "dir", "mode": mode,
+                                      "mtime": time.time()})
+        await self._link(dir_ino, name, ino, "dir")
+
+    async def listdir(self, path: str = "/") -> "List[str]":
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "dir":
+            raise FSError(f"{path}: not a directory", 20)
+        return sorted(await self.meta.omap_keys(_inode_oid(ino)))
+
+    async def write_file(self, path: str, data: bytes) -> None:
+        dir_ino, name = await self._parent_of(path)
+        entry = await self.meta.omap_get(_inode_oid(dir_ino), [name])
+        if entry:
+            rec = json.loads(entry[name].decode())
+            if rec["type"] != "file":
+                raise FSError(f"{path}: is a directory", 21)
+            ino = int(rec["ino"])
+        else:
+            ino = await self._alloc_ino()
+            await self._link(dir_ino, name, ino, "file")
+        await self.striper.write_full(f"filedata.{ino:x}", data)
+        await self._write_inode(ino, {"type": "file", "mode": 0o644,
+                                      "size": len(data),
+                                      "mtime": time.time()})
+
+    async def read_file(self, path: str) -> bytes:
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "file":
+            raise FSError(f"{path}: is a directory", 21)
+        return await self.striper.read(f"filedata.{ino:x}")
+
+    async def append_file(self, path: str, data: bytes) -> None:
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "file":
+            raise FSError(f"{path}: is a directory", 21)
+        await self.striper.append(f"filedata.{ino:x}", data)
+        meta["size"] = int(meta.get("size", 0)) + len(data)
+        meta["mtime"] = time.time()
+        await self._write_inode(ino, meta)
+
+    async def stat(self, path: str) -> dict:
+        ino, meta = await self._lookup(path)
+        return {"ino": ino, **meta}
+
+    async def unlink(self, path: str) -> None:
+        dir_ino, name = await self._parent_of(path)
+        entry = await self.meta.omap_get(_inode_oid(dir_ino), [name])
+        if not entry:
+            raise FSError(f"{path}: no such file")
+        rec = json.loads(entry[name].decode())
+        if rec["type"] != "file":
+            raise FSError(f"{path}: is a directory (use rmdir)", 21)
+        ino = int(rec["ino"])
+        await self.striper.remove(f"filedata.{ino:x}", missing_ok=True)
+        await self.meta.remove(_inode_oid(ino))
+        await self.meta.omap_rm(_inode_oid(dir_ino), [name])
+
+    async def rmdir(self, path: str) -> None:
+        dir_ino, name = await self._parent_of(path)
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "dir":
+            raise FSError(f"{path}: not a directory", 20)
+        if await self.meta.omap_keys(_inode_oid(ino)):
+            raise FSError(f"{path}: directory not empty", 39)
+        await self.meta.remove(_inode_oid(ino))
+        await self.meta.omap_rm(_inode_oid(dir_ino), [name])
+
+    async def rename(self, src: str, dst: str) -> None:
+        sdir, sname = await self._parent_of(src)
+        ddir, dname = await self._parent_of(dst)
+        entry = await self.meta.omap_get(_inode_oid(sdir), [sname])
+        if not entry:
+            raise FSError(f"{src}: no such file or directory")
+        if await self.meta.omap_get(_inode_oid(ddir), [dname]):
+            raise FSError(f"{dst}: exists", 17)
+        await self.meta.omap_set(_inode_oid(ddir),
+                                 {dname: entry[sname]})
+        await self.meta.omap_rm(_inode_oid(sdir), [sname])

@@ -49,6 +49,8 @@ class Objecter(Dispatcher):
         self.ms.add_dispatcher(self)
         self._next_tid = 0
         self._inflight: "Dict[int, asyncio.Future]" = {}
+        # (pool_id, oid, watch_id) -> callback(oid, payload)
+        self.watch_callbacks: "Dict[tuple, Any]" = {}
 
     def new_tid(self) -> int:
         self._next_tid += 1
@@ -115,6 +117,26 @@ class Objecter(Dispatcher):
             f"op on {oid} failed after {self.max_retries} tries: {last_err}")
 
     async def ms_dispatch(self, conn, msg) -> bool:
+        if msg.TYPE == "watch_notify":
+            # deliver to the registered callback, then ack so the
+            # notifier's collect completes (reference Objecter watch
+            # session + MWatchNotifyAck).  Keyed by (pool, oid, wid):
+            # watch_ids are per-OSD counters and collide across targets.
+            cb = self.watch_callbacks.get(
+                (int(msg["pgid"][0]), str(msg["oid"]),
+                 int(msg["watch_id"])))
+            if cb is not None:
+                try:
+                    res = cb(msg["oid"], bytes(msg.data))
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception as e:  # noqa: BLE001 — user callback
+                    dout("client", 1, f"watch callback failed: {e}")
+            from ..osd.messages import MWatchNotifyAck
+            await conn.send_message(MWatchNotifyAck({
+                "notify_id": msg["notify_id"],
+                "watch_id": msg["watch_id"]}))
+            return True
         if msg.TYPE != "osd_op_reply":
             return False
         fut = self._inflight.get(int(msg["tid"]))

@@ -8,6 +8,7 @@ variants; an async-first API is the idiomatic rebuild).
 
 from __future__ import annotations
 
+import json
 from typing import Dict, List, Optional, Tuple
 
 from ..common.config import Config
@@ -95,6 +96,63 @@ class IoCtx:
     async def stat(self, oid: str) -> dict:
         outs, _ = await self._submit(oid, [{"op": "stat"}])
         return next(o for o in outs if o.get("op") == "stat")
+
+    async def omap_set(self, oid: str, kv: "dict[str, bytes]") -> None:
+        payload = json.dumps({k: bytes(v).hex()
+                              for k, v in kv.items()}).encode()
+        await self._submit(oid, [{"op": "omap_set",
+                                  "dlen": len(payload)}], payload)
+
+    async def omap_get(self, oid: str,
+                       keys: "Optional[list[str]]" = None
+                       ) -> "dict[str, bytes]":
+        op = {"op": "omap_get"}
+        if keys is not None:
+            op["keys"] = list(keys)
+        outs, blob = await self._submit(oid, [op])
+        lens = [o["dlen"] for o in outs if o.get("op") == "omap_get"]
+        raw = unpack_buffers(lens, blob)[0]
+        return {k: bytes.fromhex(v)
+                for k, v in json.loads(raw.decode()).items()}
+
+    async def omap_keys(self, oid: str) -> "list[str]":
+        outs, blob = await self._submit(oid, [{"op": "omap_keys"}])
+        lens = [o["dlen"] for o in outs if o.get("op") == "omap_keys"]
+        return json.loads(unpack_buffers(lens, blob)[0].decode())
+
+    async def omap_rm(self, oid: str, keys: "list[str]") -> None:
+        await self._submit(oid, [{"op": "omap_rm", "keys": list(keys)}])
+
+    # --- watch/notify ---------------------------------------------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register for notifies on ``oid``; returns the watch_id.
+        Watches are volatile on the primary (re-watch after failover,
+        as reference clients do on watch errors)."""
+        outs, _ = await self._submit(oid, [{"op": "watch"}])
+        wid = next(int(o["watch_id"]) for o in outs
+                   if o.get("op") == "watch")
+        self.client.objecter.watch_callbacks[
+            (self.pool_id, oid, wid)] = callback
+        return wid
+
+    async def unwatch(self, oid: str, watch_id: int) -> None:
+        self.client.objecter.watch_callbacks.pop(
+            (self.pool_id, oid, watch_id), None)
+        await self._submit(oid, [{"op": "unwatch",
+                                  "watch_id": watch_id}])
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout: "Optional[float]" = None) -> dict:
+        """Send a notify to every watcher; returns
+        {"acked": [...], "timed_out": [...]} after acks or timeout."""
+        op = {"op": "notify", "dlen": len(payload)}
+        if timeout is not None:
+            op["timeout"] = timeout
+        outs, _ = await self._submit(oid, [op], bytes(payload))
+        rec = next(o for o in outs if o.get("op") == "notify")
+        return {"acked": rec.get("acked", []),
+                "timed_out": rec.get("timed_out", [])}
 
     async def exec(self, oid: str, cls: str, method: str,
                    data: bytes = b"") -> bytes:

@@ -30,28 +30,24 @@ async def hello_replay(ctx, data: bytes) -> bytes:
 
 # --- numops (reference src/cls/numops: arithmetic on stored values) ---------
 
-async def numops_add(ctx, data: bytes) -> bytes:
+async def _numops(ctx, data: bytes, op, default: float) -> bytes:
     args = jarg(data)
     try:
         cur = float((await ctx.read()).decode() or "0")
     except ValueError:
         raise ClsError("stored value is not numeric")
-    cur += float(args.get("value", 0))
+    cur = op(cur, float(args.get("value", default)))
     out = ("%d" % cur if cur == int(cur) else repr(cur)).encode()
     ctx.write_full(out)
     return out
+
+
+async def numops_add(ctx, data: bytes) -> bytes:
+    return await _numops(ctx, data, lambda a, b: a + b, 0)
 
 
 async def numops_mul(ctx, data: bytes) -> bytes:
-    args = jarg(data)
-    try:
-        cur = float((await ctx.read()).decode() or "0")
-    except ValueError:
-        raise ClsError("stored value is not numeric")
-    cur *= float(args.get("value", 1))
-    out = ("%d" % cur if cur == int(cur) else repr(cur)).encode()
-    ctx.write_full(out)
-    return out
+    return await _numops(ctx, data, lambda a, b: a * b, 1)
 
 
 # --- lock (reference src/cls/lock: advisory locks in an xattr) --------------

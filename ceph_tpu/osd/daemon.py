@@ -15,6 +15,7 @@ build_pg_backend path (OSD.cc:4475-4508, PGBackend.cc:532-569).
 from __future__ import annotations
 
 import asyncio
+import json
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,7 +39,7 @@ from .scheduler import CLIENT, MClockScheduler
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
                        MOSDPGPushReply, MOSDPing, MOSDPingReply,
-                       pack_buffers, unpack_buffers)
+                       MWatchNotify, pack_buffers, unpack_buffers)
 from .osdmap import OSDMap
 
 
@@ -93,6 +94,14 @@ class OSDDaemon(Dispatcher):
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
         self.up = False
         self.mgr_addr = mgr_addr
+        # watch/notify state (reference Watch.cc): volatile, like the
+        # reference's in-memory watch sessions — clients re-watch after
+        # a primary change.  (pgid, oid) -> watch_id -> connection
+        self.watchers: "Dict[Tuple[Tuple[int, int], str], Dict[int, object]]" = {}
+        self._next_watch_id = 0
+        self._next_notify_id = 0
+        # notify_id -> (pending watch_ids, done future)
+        self._notifies: "Dict[int, Tuple[set, asyncio.Future]]" = {}
         self._mgr_task = None
         self._beacon_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
@@ -257,6 +266,53 @@ class OSDDaemon(Dispatcher):
         _up, acting = self.osdmap.pg_to_up_acting_osds(pgid[0], pgid[1])
         return acting
 
+    async def _do_notify(self, pgid, oid: str, payload: bytes,
+                         timeout: float) -> dict:
+        """Fan a notify out to every watcher and collect acks
+        (reference PrimaryLogPG::do_osd_op_effects + Watch::send_notify);
+        dead watchers drop from the table and count as timed out."""
+        watchers = dict(self.watchers.get((pgid, oid), {}))
+        if not watchers:
+            return {"acked": [], "timed_out": []}
+        # the notifier holds a client op slot and the client gives up at
+        # rados_osd_op_timeout: waiting longer than that only wedges
+        # slots and re-fans duplicate notifies on every client retry
+        timeout = min(timeout, 0.8 * float(
+            self.config.get("rados_osd_op_timeout")))
+        self._next_notify_id += 1
+        nid = self._next_notify_id
+        pending = set(watchers)
+        fut = asyncio.get_event_loop().create_future()
+        self._notifies[nid] = (pending, fut)
+        dead: "set" = set()
+        for wid, wconn in list(watchers.items()):
+            try:
+                await wconn.send_message(MWatchNotify({
+                    "notify_id": nid, "watch_id": wid, "oid": oid,
+                    "pgid": list(pgid)}, payload))
+            except (ConnectionError, OSError):
+                self.watchers.get((pgid, oid), {}).pop(wid, None)
+                pending.discard(wid)
+                dead.add(wid)   # never delivered: NOT acked
+        try:
+            if pending:
+                await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            got = self._notifies.pop(nid, (set(), None))[0]
+        acked = sorted(set(watchers) - got - dead)
+        return {"acked": acked, "timed_out": sorted(got | dead)}
+
+    def _handle_notify_ack(self, msg) -> None:
+        entry = self._notifies.get(int(msg["notify_id"]))
+        if entry is None:
+            return
+        pending, fut = entry
+        pending.discard(int(msg["watch_id"]))
+        if not pending and not fut.done():
+            fut.set_result(None)
+
     async def _exec_cls(self, be: ECBackend, oid: str, cls: str,
                         method: str, payload: bytes,
                         reqid: str = "") -> bytes:
@@ -375,6 +431,8 @@ class OSDDaemon(Dispatcher):
         elif t == "scrub_shard_reply":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)   # resolves the tid future
+        elif t == "watch_notify_ack":
+            self._handle_notify_ack(msg)
         elif t == "osd_ping":
             await conn.send_message(MOSDPingReply({
                 "from_osd": self.whoami, "epoch": self.osdmap.epoch,
@@ -427,6 +485,50 @@ class OSDDaemon(Dispatcher):
                     doff += dlen
                     mutations.append(ClientOp(name, name=op["name"],
                                               value=payload))
+                elif name == "omap_set":
+                    dlen = int(op.get("dlen", 0))
+                    payload = msg.data[doff:doff + dlen]
+                    doff += dlen
+                    kv = {k: bytes.fromhex(v) for k, v in
+                          json.loads(payload.decode()).items()}
+                    mutations.append(ClientOp("omap_set", kv=kv))
+                elif name == "omap_rm":
+                    mutations.append(ClientOp(
+                        "omap_rm", keys=list(op.get("keys", []))))
+                elif name == "omap_get":
+                    await be.ensure_active()
+                    kv = be.omap_get(oid, op.get("keys"))
+                    blob_out = json.dumps(
+                        {k: v.hex() for k, v in kv.items()}).encode()
+                    outs.append({"op": "omap_get", "dlen": len(blob_out)})
+                    out_bufs.append(blob_out)
+                elif name == "omap_keys":
+                    await be.ensure_active()
+                    blob_out = json.dumps(
+                        sorted(be.omap_get(oid))).encode()
+                    outs.append({"op": "omap_keys",
+                                 "dlen": len(blob_out)})
+                    out_bufs.append(blob_out)
+                elif name == "watch":
+                    self._next_watch_id += 1
+                    wid = self._next_watch_id
+                    self.watchers.setdefault((pgid, oid), {})[wid] = conn
+                    outs.append({"op": "watch", "watch_id": wid,
+                                 "dlen": 0})
+                elif name == "unwatch":
+                    self.watchers.get((pgid, oid), {}).pop(
+                        int(op.get("watch_id", 0)), None)
+                    outs.append({"op": "unwatch", "dlen": 0})
+                elif name == "notify":
+                    dlen = int(op.get("dlen", 0))
+                    payload = msg.data[doff:doff + dlen]
+                    doff += dlen
+                    res = await self._do_notify(
+                        pgid, oid, payload,
+                        float(op.get("timeout",
+                                     self.config.get(
+                                         "osd_default_notify_timeout"))))
+                    outs.append({"op": "notify", "dlen": 0, **res})
                 elif name == "call":
                     # object-class execution (reference 'rados exec' ->
                     # PrimaryLogPG::do_osd_ops CEPH_OSD_OP_CALL)

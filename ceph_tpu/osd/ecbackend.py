@@ -84,11 +84,13 @@ class ObjectInfo:
 class ClientOp:
     """One logical mutation/read carried by MOSDOp."""
     op: str                       # write|append|write_full|truncate|delete|
-    off: int = 0                  # read|stat|getxattr|setxattr
+    off: int = 0                  # read|stat|getxattr|setxattr|omap_*
     length: int = 0
     data: bytes = b""
     name: str = ""                # attr name for {get,set}xattr
     value: bytes = b""
+    kv: "Dict[str, bytes]" = field(default_factory=dict)   # omap_set
+    keys: "List[str]" = field(default_factory=list)        # omap_rm
 
 
 @dataclass
@@ -106,6 +108,8 @@ class Op:
     rewrite: bool = False         # write_full: fresh crc chain
     projection: "Optional[ObjectInfo]" = None
     attr_sets: "Dict[str, bytes]" = field(default_factory=dict)
+    omap_sets: "Dict[str, bytes]" = field(default_factory=dict)
+    omap_rms: "List[str]" = field(default_factory=list)
     read_data: "Dict[int, np.ndarray]" = field(default_factory=dict)
     reads_pending: bool = False
     pending_commits: "Set[int]" = field(default_factory=set)
@@ -138,6 +142,7 @@ class ReadOp:
     sizes: "Dict[str, Dict[int, int]]" = field(
         default_factory=dict)                   # oid -> shard -> full size
     attrs: "Dict[str, Dict[str, bytes]]" = field(default_factory=dict)
+    omap: "Dict[str, Dict[str, bytes]]" = field(default_factory=dict)
     errors: "Dict[str, int]" = field(default_factory=dict)
     done: "asyncio.Future" = None               # type: ignore[assignment]
 
@@ -151,6 +156,7 @@ class RecoveryOp:
     state: int = 0
     recovered: "Dict[int, bytes]" = field(default_factory=dict)
     attrs: "Dict[str, bytes]" = field(default_factory=dict)
+    omap: "Dict[str, bytes]" = field(default_factory=dict)
     waiting_on_pushes: "Set[int]" = field(default_factory=set)
     done: "asyncio.Future" = None               # type: ignore[assignment]
 
@@ -378,6 +384,23 @@ class ECBackend:
         return self.store.get_attr(self.coll(shard), ObjectId(oid, shard),
                                    name)
 
+    def omap_get(self, oid: str,
+                 keys: "Optional[List[str]]" = None) -> "Dict[str, bytes]":
+        """Primary-local omap read (replicated pools only: every shard
+        holds the full map, so the primary's copy is authoritative
+        once the PG is active)."""
+        if self.k != 1:
+            raise ECError("omap operations require a replicated pool")
+        shard = self.my_shard
+        try:
+            kv = self.store.omap_get(self.coll(shard),
+                                     ObjectId(oid, shard))
+        except NotFound:
+            return {}
+        if keys is not None:
+            return {k: kv[k] for k in keys if k in kv}
+        return dict(kv)
+
     # ================================================================ WRITES
 
     async def submit_transaction(self, oid: str,
@@ -464,6 +487,20 @@ class ECBackend:
                 size = 0
             elif cop.op == "setxattr":
                 op.attr_sets[cop.name] = bytes(cop.value)
+            elif cop.op == "omap_set":
+                # omap lives on every shard verbatim — only the k=1
+                # replicate code stores full copies, so EC pools reject
+                # it exactly like the reference (EC pools have no omap)
+                if self.k != 1:
+                    raise ECError("omap operations require a replicated "
+                                  "pool (EC pools store no omap)")
+                op.omap_sets.update({k: bytes(v)
+                                     for k, v in cop.kv.items()})
+            elif cop.op == "omap_rm":
+                if self.k != 1:
+                    raise ECError("omap operations require a replicated "
+                                  "pool (EC pools store no omap)")
+                op.omap_rms.extend(cop.keys)
             else:
                 raise ECError(f"unsupported mutation {cop.op!r}")
         if op.delete:
@@ -636,6 +673,7 @@ class ECBackend:
             extends = (not op.rewrite
                        and not op.plan.to_read
                        and op.truncate_to is None
+                       and not op.omap_sets and not op.omap_rms
                        and hinfo.valid() and len(stripes) == 1
                        and all(self.sinfo
                                .aligned_logical_offset_to_chunk_offset(o)
@@ -688,7 +726,9 @@ class ECBackend:
                     shard_txns[shard]["writes"].append(
                         (chunk_off, bytes(chunk.tobytes())))
                 self.extent_cache.present_rmw_update(op.oid, off, buf)
-            if not stripes:
+            if not stripes and (op.truncate_to is not None or op.writes):
+                # a bare truncate breaks the chain; pure xattr/omap ops
+                # leave the data (and its hashes) untouched
                 hinfo.invalidate()
             if op.truncate_to is not None:
                 ct = self.sinfo.aligned_logical_offset_to_chunk_offset(
@@ -702,6 +742,13 @@ class ECBackend:
             for name, value in op.attr_sets.items():
                 for st in shard_txns.values():
                     st.setdefault("attrs", {})[name] = value.hex()
+            if op.omap_sets:
+                kvhex = {k: v.hex() for k, v in op.omap_sets.items()}
+                for st in shard_txns.values():
+                    st["omap_set"] = kvhex
+            if op.omap_rms:
+                for st in shard_txns.values():
+                    st["omap_rm"] = list(op.omap_rms)
 
         entry = LogEntry(op.version, op.oid,
                          "delete" if op.delete else "modify",
@@ -899,6 +946,12 @@ class ECBackend:
                 t.setattr(cid, sid, HINFO_KEY, bytes.fromhex(txn["hinfo"]))
             for name, hexval in txn.get("attrs", {}).items():
                 t.setattr(cid, sid, name, bytes.fromhex(hexval))
+            if txn.get("omap_set"):
+                t.omap_setkeys(cid, sid, {
+                    k: bytes.fromhex(v)
+                    for k, v in txn["omap_set"].items()})
+            if txn.get("omap_rm"):
+                t.omap_rmkeys(cid, sid, list(txn["omap_rm"]))
 
         # snapshot the in-memory log: if the store apply fails below, the
         # log must not claim the entry was applied (a log ahead of the
@@ -986,12 +1039,18 @@ class ECBackend:
             except (NotFound, ECError) as e:
                 dout("osd", 5, f"sub_read error {oid}@{shard}: {e}")
                 errors[oid] = EIO if isinstance(e, ECError) else ENOENT
+        omap_read: "Dict[str, dict]" = {}
         for oid in msg.get("attrs_to_read", []):
             sid = ObjectId(oid, shard)
             try:
                 attrs_read[oid] = {
                     k: v.hex()
                     for k, v in self.store.get_attrs(cid, sid).items()}
+                if self.k == 1:
+                    # replicated recovery must carry the omap too
+                    omap_read[oid] = {
+                        k: v.hex() for k, v in
+                        self.store.omap_get(cid, sid).items()}
             except NotFound:
                 errors.setdefault(oid, ENOENT)
         lens, blob = pack_buffers(out_bufs)
@@ -1000,6 +1059,7 @@ class ECBackend:
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
             "buffers_read": buffers_read, "attrs_read": attrs_read,
+            "omap_read": omap_read,
             "errors": errors, "lens": lens}, blob)
 
     def _verify_shard_crc(self, cid: Collection, sid: ObjectId, shard: int,
@@ -1162,6 +1222,9 @@ class ECBackend:
         for oid, attrs in msg.get("attrs_read", {}).items():
             rop.attrs.setdefault(oid, {}).update(
                 {k: bytes.fromhex(v) for k, v in attrs.items()})
+        for oid, kv in msg.get("omap_read", {}).items():
+            rop.omap.setdefault(oid, {}).update(
+                {k: bytes.fromhex(v) for k, v in kv.items()})
         rop.in_progress.discard(shard)
         failed = dict(msg.get("errors", {}))
         if failed:
@@ -1313,6 +1376,7 @@ class ECBackend:
                                     sorted(rop.missing_on))
         rop.recovered = {s: bytes(a.tobytes()) for s, a in decoded.items()}
         rop.attrs = read.attrs.get(oid, {})
+        rop.omap = read.omap.get(oid, {})
         # WRITING: push rebuilt shards to their peers
         rop.state = RecoveryOp.WRITING
         await self._push_recovered(rop)
@@ -1336,7 +1400,8 @@ class ECBackend:
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": self.new_tid(),
                 "oid": rop.oid, "version": list(self.pg_log.head),
-                "whole": True, "off": 0, "attrs": attrs},
+                "whole": True, "off": 0, "attrs": attrs,
+                "omap": {k: v.hex() for k, v in rop.omap.items()}},
                 rop.recovered[shard])
             if acting[shard] == self.whoami:
                 local.append(msg)
@@ -1372,6 +1437,10 @@ class ECBackend:
             t.write(cid, sid, int(msg.get("off", 0)), msg.data)
             for name, hexval in msg.get("attrs", {}).items():
                 t.setattr(cid, sid, name, bytes.fromhex(hexval))
+            if msg.get("omap"):
+                t.omap_setkeys(cid, sid, {
+                    k: bytes.fromhex(v)
+                    for k, v in msg["omap"].items()})
         # the push satisfies our missing record for this object
         self.local_missing.pop(msg["oid"], None)
         self._pg_meta_txn(t, cid)

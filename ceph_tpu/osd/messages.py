@@ -175,6 +175,21 @@ class MPGLogAck(Message):
 
 
 @register_message
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on a watched object
+    (reference MWatchNotify).  fields: notify_id, watch_id, oid, pgid;
+    data = notify payload."""
+    TYPE = "watch_notify"
+
+
+@register_message
+class MWatchNotifyAck(Message):
+    """Client -> OSD: ack for a delivered notify.
+    fields: notify_id, watch_id."""
+    TYPE = "watch_notify_ack"
+
+
+@register_message
 class MScrubShard(Message):
     """Primary asks a shard for its scrub map (reference MOSDRepScrub).
     fields: pgid, shard, from_osd, tid, deep."""

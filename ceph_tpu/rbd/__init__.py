@@ -1,0 +1,1 @@
+from .image import RBD, Image  # noqa: F401

@@ -1,0 +1,208 @@
+"""RGW — object gateway over RADOS (reference src/rgw, 170k LoC).
+
+The lean core of the S3/Swift surface: buckets with listable keys,
+objects of arbitrary size, metadata, and an HTTP front end.
+
+Layout (mirroring the reference's pool usage):
+- bucket index: one ``".bucket.index.<bucket>"`` object per bucket in
+  the metadata (replicated) pool; keys live in its OMAP — the same
+  structure the reference's bucket index objects use (cls_rgw on omap).
+- bucket registry: omap of ``".buckets"``.
+- object data: striped over the data pool (EC-friendly) via the client
+  striper, one blob per key.
+
+HTTP API (S3-ish paths; asyncio server):
+  PUT /bucket            create bucket     GET /            list buckets
+  GET /bucket            list keys         PUT /bucket/key  upload
+  GET /bucket/key        download          DELETE /...      remove
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import time
+from typing import List, Optional
+from urllib.parse import unquote
+
+from ..client.striper import RadosStriper
+
+BUCKETS_OID = ".buckets"
+
+
+class RGWError(Exception):
+    def __init__(self, msg: str, status: int = 400) -> None:
+        super().__init__(msg)
+        self.status = status
+
+
+def _index_oid(bucket: str) -> str:
+    return f".bucket.index.{bucket}"
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"data.{bucket}.{hashlib.sha256(key.encode()).hexdigest()}"
+
+
+class Gateway:
+    """Bucket/object operations + optional HTTP front end.
+
+    ``meta_io``: IoCtx of a replicated pool (bucket indexes need omap).
+    ``data_io``: IoCtx of the data pool (EC or replicated).
+    """
+
+    def __init__(self, meta_io, data_io,
+                 stripe_count: int = 4,
+                 object_size: int = 1 << 20) -> None:
+        self.meta = meta_io
+        self.striper = RadosStriper(
+            data_io, stripe_unit=object_size // stripe_count,
+            stripe_count=stripe_count, object_size=object_size)
+        self._server: "Optional[asyncio.AbstractServer]" = None
+        self.port = 0
+
+    # --- buckets --------------------------------------------------------------
+
+    async def create_bucket(self, bucket: str) -> None:
+        if not bucket or "/" in bucket:
+            raise RGWError(f"bad bucket name {bucket!r}")
+        existing = await self.meta.omap_get(BUCKETS_OID, [bucket])
+        if existing:
+            raise RGWError(f"bucket {bucket!r} exists", 409)
+        await self.meta.write_full(_index_oid(bucket), b"")
+        await self.meta.omap_set(BUCKETS_OID, {
+            bucket: json.dumps({"created": time.time()}).encode()})
+
+    async def list_buckets(self) -> "List[str]":
+        return sorted(await self.meta.omap_keys(BUCKETS_OID))
+
+    async def delete_bucket(self, bucket: str) -> None:
+        await self._require_bucket(bucket)
+        if await self.list_objects(bucket):
+            raise RGWError(f"bucket {bucket!r} not empty", 409)
+        await self.meta.omap_rm(BUCKETS_OID, [bucket])
+        await self.meta.remove(_index_oid(bucket))
+
+    async def _require_bucket(self, bucket: str) -> None:
+        if not await self.meta.omap_get(BUCKETS_OID, [bucket]):
+            raise RGWError(f"no bucket {bucket!r}", 404)
+
+    # --- objects --------------------------------------------------------------
+
+    async def put_object(self, bucket: str, key: str,
+                         data: bytes) -> dict:
+        await self._require_bucket(bucket)
+        await self.striper.write_full(_data_oid(bucket, key), data)
+        etag = hashlib.md5(data).hexdigest()
+        meta = {"size": len(data), "etag": etag, "mtime": time.time()}
+        await self.meta.omap_set(_index_oid(bucket),
+                                 {key: json.dumps(meta).encode()})
+        return meta
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        meta = await self.head_object(bucket, key)
+        data = await self.striper.read(_data_oid(bucket, key))
+        return data[:meta["size"]]
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        await self._require_bucket(bucket)
+        entry = await self.meta.omap_get(_index_oid(bucket), [key])
+        if not entry:
+            raise RGWError(f"no key {key!r}", 404)
+        return json.loads(entry[key].decode())
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        await self.head_object(bucket, key)
+        await self.striper.remove(_data_oid(bucket, key))
+        await self.meta.omap_rm(_index_oid(bucket), [key])
+
+    async def list_objects(self, bucket: str,
+                           prefix: str = "") -> "List[str]":
+        await self._require_bucket(bucket)
+        keys = await self.meta.omap_keys(_index_oid(bucket))
+        return [k for k in keys if k.startswith(prefix)]
+
+    # --- HTTP front end -------------------------------------------------------
+
+    async def serve(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            req = (await reader.readline()).decode().split()
+            if len(req) < 2:
+                return
+            method, rawpath = req[0], req[1]
+            clen = 0
+            while True:
+                line = (await reader.readline()).decode().strip()
+                if not line:
+                    break
+                if line.lower().startswith("content-length:"):
+                    clen = int(line.split(":", 1)[1])
+            body = await reader.readexactly(clen) if clen else b""
+            status, payload, ctype = await self._route(
+                method, unquote(rawpath), body)
+        except RGWError as e:
+            status, payload, ctype = e.status, json.dumps(
+                {"error": str(e)}).encode(), "application/json"
+        except Exception as e:  # noqa: BLE001 — 500, keep serving
+            status, payload, ctype = 500, json.dumps(
+                {"error": str(e)}).encode(), "application/json"
+        try:
+            reason = {200: "OK", 201: "Created", 204: "No Content",
+                      404: "Not Found", 409: "Conflict"}.get(status,
+                                                             "Error")
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, path: str, body: bytes):
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            if method == "GET":
+                return 200, json.dumps(
+                    await self.list_buckets()).encode(), \
+                    "application/json"
+            raise RGWError("bad request")
+        if len(parts) == 1:
+            bucket = parts[0]
+            if method == "PUT":
+                await self.create_bucket(bucket)
+                return 201, b"", "text/plain"
+            if method == "GET":
+                return 200, json.dumps(
+                    await self.list_objects(bucket)).encode(), \
+                    "application/json"
+            if method == "DELETE":
+                await self.delete_bucket(bucket)
+                return 204, b"", "text/plain"
+            raise RGWError("bad method")
+        bucket, key = parts[0], "/".join(parts[1:])
+        if method == "PUT":
+            meta = await self.put_object(bucket, key, body)
+            return 201, json.dumps(meta).encode(), "application/json"
+        if method == "GET":
+            return 200, await self.get_object(bucket, key), \
+                "application/octet-stream"
+        if method == "HEAD":
+            await self.head_object(bucket, key)   # 404 when absent
+            return 200, b"", "text/plain"
+        if method == "DELETE":
+            await self.delete_object(bucket, key)
+            return 204, b"", "text/plain"
+        raise RGWError("bad method")

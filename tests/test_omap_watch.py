@@ -1,0 +1,118 @@
+"""omap client ops + watch/notify (PrimaryLogPG op-surface parity).
+
+Reference: omap ops (replicated pools only — EC pools store no omap,
+same restriction here) and Watch.cc/MWatchNotify pub-sub.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.objecter import ObjecterError
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=5)
+    c.create_replicated_pool("rep", size=3, pg_num=2, stripe_unit=256)
+    c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                     pg_num=2, stripe_unit=64)
+    return c
+
+
+class TestOmap:
+    def test_set_get_rm_round_trip(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("rep")
+                await io.write_full("obj", b"base")
+                await io.omap_set("obj", {"a": b"1", "b": b"two"})
+                await io.omap_set("obj", {"c": b"\x00\xff"})
+                assert await io.omap_get("obj") == {
+                    "a": b"1", "b": b"two", "c": b"\x00\xff"}
+                assert await io.omap_get("obj", ["b"]) == {"b": b"two"}
+                assert await io.omap_keys("obj") == ["a", "b", "c"]
+                await io.omap_rm("obj", ["a"])
+                assert await io.omap_keys("obj") == ["b", "c"]
+        loop.run_until_complete(go())
+
+    def test_omap_rejected_on_ec_pool(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("ec")
+                await io.write_full("obj", b"x" * 100)
+                with pytest.raises(ObjecterError):
+                    await io.omap_set("obj", {"k": b"v"})
+        loop.run_until_complete(go())
+
+    def test_omap_survives_replica_recovery(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("rep")
+                await io.write_full("obj", b"data")
+                await io.omap_set("obj", {"k1": b"v1"})
+                pool = c.osdmap.pool_by_name("rep")
+                pg = c.osdmap.object_to_pg(pool.pool_id, "obj")
+                _u, acting = c.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                victim = acting[1]
+                await c.kill_osd(victim)
+                await io.omap_set("obj", {"k2": b"v2"})   # degraded
+                await c.revive_osd(victim)
+                await c.peer_all()
+                # the recovered replica must serve the full omap: kill
+                # everyone else
+                for s, o in enumerate(acting):
+                    if o != victim and o != -1:
+                        await c.kill_osd(o)
+                assert await io.omap_get("obj") == {"k1": b"v1",
+                                                    "k2": b"v2"}
+        loop.run_until_complete(go())
+
+
+class TestWatchNotify:
+    def test_notify_reaches_watchers_and_collects_acks(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                c1 = await c.client()
+                c2 = await c.client()
+                io1 = c1.io_ctx("rep")
+                io2 = c2.io_ctx("rep")
+                await io1.write_full("obj", b"watched")
+                got1, got2 = [], []
+                w1 = await io1.watch("obj", lambda o, p: got1.append(
+                    (o, p)))
+                w2 = await io2.watch("obj", lambda o, p: got2.append(
+                    (o, p)))
+                res = await io1.notify("obj", b"ping", timeout=5.0)
+                assert sorted(res["acked"]) == sorted([w1, w2])
+                assert res["timed_out"] == []
+                assert got1 == [("obj", b"ping")]
+                assert got2 == [("obj", b"ping")]
+                # unwatch: only the remaining watcher fires
+                await io2.unwatch("obj", w2)
+                res = await io1.notify("obj", b"again", timeout=5.0)
+                assert res["acked"] == [w1]
+                assert len(got1) == 2 and len(got2) == 1
+        loop.run_until_complete(go())
+
+    def test_notify_without_watchers(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("rep")
+                await io.write_full("obj", b"x")
+                res = await io.notify("obj", b"anyone?")
+                assert res == {"acked": [], "timed_out": []}
+        loop.run_until_complete(go())
