@@ -249,7 +249,7 @@ OPTIONS: "dict[str, Option]" = _opts(
            LEVEL_ADVANCED, min=0.01, desc="mclock: best-effort weight"),
     Option("osd_mclock_scheduler_background_best_effort_lim", float, 0.0,
            LEVEL_ADVANCED, min=0, desc="mclock: best-effort limit"),
-    Option("osd_ec_batch_max", int, 64, LEVEL_ADVANCED, min=1,
+    Option("osd_ec_batch_max", int, 128, LEVEL_ADVANCED, min=1,
            desc="max sub-write encodes stacked into one device launch by "
                 "the cross-PG EncodeService"),
     Option("osd_ec_batch_min_device_bytes", int, 64 << 10, LEVEL_ADVANCED,

@@ -1,0 +1,2 @@
+from .keyvaluedb import (KeyValueDB, KVError, KVTransaction, MemDB,
+                         SqliteDB, create)  # noqa: F401

@@ -1,0 +1,210 @@
+"""KeyValueDB — the transactional KV abstraction under the object store.
+
+Reference: src/kv (5.5k LoC): ``KeyValueDB`` wraps RocksDB (and memdb)
+behind prefixed key spaces, atomic write batches, and iterators;
+BlueStore keeps ALL metadata (onodes, extents, allocator bitmap, omap)
+in it, with data blobs on the raw device.
+
+Backends here:
+- ``MemDB``: dict-backed (the reference's memdb), for tests/ephemeral.
+- ``SqliteDB``: one sqlite table in WAL mode — the RocksDB stand-in
+  with the same crash-consistency contract (a batch commits atomically
+  or not at all).
+
+API shape follows the reference: ``get/get_prefix``, ordered
+``iterator(prefix)``, and ``transaction()`` returning a batch with
+set/rmkey/rm_range_prefix that ``submit_transaction`` applies
+atomically.  The KVStore object store (objectstore/kvstore.py) builds
+the BlueStore-style layout on top.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class KVError(Exception):
+    pass
+
+
+def prefix_upper_bound(prefix: str) -> "Optional[str]":
+    """Smallest string greater than every string with ``prefix``:
+    increment the last incrementable code point (None = no bound,
+    i.e. the prefix is entirely U+10FFFF).  Appending a sentinel char
+    instead would EXCLUDE keys whose next char sorts above it."""
+    for i in range(len(prefix) - 1, -1, -1):
+        c = ord(prefix[i])
+        if c < 0x10FFFF:
+            return prefix[:i] + chr(c + 1)
+    return None
+
+
+class KVTransaction:
+    """Atomic write batch (reference KeyValueDB::Transaction)."""
+
+    def __init__(self) -> None:
+        self.ops: "List[Tuple[str, str, bytes]]" = []
+
+    def set(self, key: str, value: bytes) -> "KVTransaction":
+        self.ops.append(("set", key, bytes(value)))
+        return self
+
+    def rmkey(self, key: str) -> "KVTransaction":
+        self.ops.append(("rm", key, b""))
+        return self
+
+    def rm_range_prefix(self, prefix: str) -> "KVTransaction":
+        self.ops.append(("rmp", prefix, b""))
+        return self
+
+
+class KeyValueDB:
+    """Abstract ordered KV store with atomic batches."""
+
+    def open(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> "Optional[bytes]":
+        raise NotImplementedError
+
+    def iterator(self, prefix: str = "") -> "Iterator[Tuple[str, bytes]]":
+        """Ordered iteration over keys with ``prefix``."""
+        raise NotImplementedError
+
+    def get_prefix(self, prefix: str) -> "Dict[str, bytes]":
+        return dict(self.iterator(prefix))
+
+    def transaction(self) -> KVTransaction:
+        return KVTransaction()
+
+    def submit_transaction(self, txn: KVTransaction) -> None:
+        raise NotImplementedError
+
+
+class MemDB(KeyValueDB):
+    def __init__(self) -> None:
+        self._data: "Dict[str, bytes]" = {}
+        self._lock = threading.Lock()
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def get(self, key: str) -> "Optional[bytes]":
+        with self._lock:
+            return self._data.get(key)
+
+    def iterator(self, prefix: str = ""):
+        with self._lock:
+            keys = sorted(k for k in self._data if k.startswith(prefix))
+            items = [(k, self._data[k]) for k in keys]
+        return iter(items)
+
+    def submit_transaction(self, txn: KVTransaction) -> None:
+        with self._lock:
+            for kind, key, val in txn.ops:
+                if kind == "set":
+                    self._data[key] = val
+                elif kind == "rm":
+                    self._data.pop(key, None)
+                elif kind == "rmp":
+                    for k in [k for k in self._data
+                              if k.startswith(key)]:
+                        del self._data[k]
+                else:
+                    raise KVError(f"unknown op kind {kind!r}")
+
+
+class SqliteDB(KeyValueDB):
+    """WAL-mode sqlite as the RocksDB stand-in."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._db: "Optional[sqlite3.Connection]" = None
+        self._lock = threading.Lock()
+
+    def open(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv "
+            "(k TEXT PRIMARY KEY, v BLOB NOT NULL)")
+        self._db.commit()
+
+    def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._db is None:
+            raise KVError("db not open")
+        return self._db
+
+    def get(self, key: str) -> "Optional[bytes]":
+        row = self._conn().execute(
+            "SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def iterator(self, prefix: str = ""):
+        upper = prefix_upper_bound(prefix) if prefix else None
+        if prefix and upper is not None:
+            rows = self._conn().execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                (prefix, upper))
+        elif prefix:
+            rows = self._conn().execute(
+                "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (prefix,))
+        else:
+            rows = self._conn().execute("SELECT k, v FROM kv ORDER BY k")
+        for k, v in rows:
+            if prefix and not k.startswith(prefix):
+                continue
+            yield k, bytes(v)
+
+    def submit_transaction(self, txn: KVTransaction) -> None:
+        with self._lock:
+            db = self._conn()
+            try:
+                for kind, key, val in txn.ops:
+                    if kind == "set":
+                        db.execute(
+                            "INSERT INTO kv (k, v) VALUES (?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                            (key, val))
+                    elif kind == "rm":
+                        db.execute("DELETE FROM kv WHERE k=?", (key,))
+                    elif kind == "rmp":
+                        upper = prefix_upper_bound(key)
+                        if upper is not None:
+                            db.execute(
+                                "DELETE FROM kv WHERE k >= ? AND k < ?",
+                                (key, upper))
+                        else:
+                            db.execute(
+                                "DELETE FROM kv WHERE k >= ?", (key,))
+                    else:
+                        raise KVError(f"unknown op kind {kind!r}")
+                db.commit()
+            except Exception:
+                db.rollback()
+                raise
+
+
+def create(kind: str, path: str = "") -> KeyValueDB:
+    """Factory (reference KeyValueDB::create by backend name)."""
+    if kind in ("mem", "memdb"):
+        return MemDB()
+    if kind in ("sqlite", "rocksdb"):   # rocksdb name accepted for
+        return SqliteDB(path)           # config compatibility
+    raise KVError(f"unknown kv backend {kind!r}")

@@ -15,6 +15,7 @@ from .transaction import Transaction  # noqa: F401
 from .store import ObjectStore, StoreError  # noqa: F401
 from .memstore import MemStore  # noqa: F401
 from .filestore import FileStore  # noqa: F401
+from .kvstore import KVStore  # noqa: F401
 
 
 def create_store(kind: str, path: str = "") -> ObjectStore:
@@ -25,4 +26,8 @@ def create_store(kind: str, path: str = "") -> ObjectStore:
         if not path:
             raise StoreError("file store needs objectstore_path")
         return FileStore(path)
+    if kind in ("kv", "kvstore", "bluestore"):
+        # the BlueStore-shaped backend: all state in a KeyValueDB
+        # (sqlite WAL when a path is given, memdb otherwise)
+        return KVStore(path=path)
     raise StoreError(f"unknown objectstore type {kind!r}")

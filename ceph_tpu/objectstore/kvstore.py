@@ -1,0 +1,342 @@
+"""KVStore — object store with ALL state in a KeyValueDB (the
+BlueStore-shaped backend).
+
+Reference: src/os/bluestore keeps onodes/extents/omap in RocksDB and
+data on a raw device; src/os/kstore keeps everything in the KV.  This
+is the kstore layout over the ceph_tpu.kv.KeyValueDB abstraction — one
+ObjectStore Transaction becomes ONE atomic KV batch, so crash
+consistency comes from the KV's WAL exactly as the reference's does.
+
+Key space (prefix design follows BlueStore's column prefixes):
+  C/<cid>                    collection marker
+  O/<cid>/<oid>              onode JSON {"size": n}
+  D/<cid>/<oid>/<blk:08x>    data block (BLOCK bytes)
+  A/<cid>/<oid>/<name>       xattr
+  M/<cid>/<oid>/<key>        omap entry
+
+In-flight transactions keep a write overlay so multi-op transactions
+(write then RMW of the same block, clone of a just-written object) read
+their own pending effects while the batch stays atomic.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from urllib.parse import quote
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..kv import KeyValueDB, KVTransaction, create as kv_create
+from .store import NotFound, ObjectStore, StoreError
+from .types import Collection, ObjectId
+
+BLOCK = 64 * 1024
+
+
+class KVStore(ObjectStore):
+    def __init__(self, db: "KeyValueDB | None" = None,
+                 path: str = "", backend: str = "sqlite") -> None:
+        super().__init__()
+        self.db = db or kv_create(backend if path else "mem", path)
+        self._txn: "Optional[KVTransaction]" = None
+        self._overlay: "Dict[str, Optional[bytes]]" = {}
+        # one big lock around transactions AND reads (the ObjectStore
+        # contract the other backends honor): queries from other
+        # threads must never observe the uncommitted overlay
+        self._kv_lock = threading.RLock()
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def mkfs(self) -> None:
+        self.db.open()
+        self.db.close()
+
+    def mount(self) -> None:
+        self.db.open()
+
+    def umount(self) -> None:
+        self.db.close()
+
+    # --- kv access with txn overlay ------------------------------------------
+
+    def _get(self, key: str) -> "Optional[bytes]":
+        if self._txn is not None and key in self._overlay:
+            return self._overlay[key]
+        return self.db.get(key)
+
+    def _put(self, key: str, value: bytes) -> None:
+        self._txn.set(key, value)
+        self._overlay[key] = bytes(value)
+
+    def _del(self, key: str) -> None:
+        self._txn.rmkey(key)
+        self._overlay[key] = None
+
+    def _del_prefix(self, prefix: str) -> None:
+        self._txn.rm_range_prefix(prefix)
+        for k, _v in list(self.db.iterator(prefix)):
+            self._overlay[k] = None
+        for k in [k for k, v in self._overlay.items()
+                  if k.startswith(prefix) and v is not None]:
+            self._overlay[k] = None
+
+    def _keys_prefix(self, prefix: str) -> "List[str]":
+        keys = {k for k, _ in self.db.iterator(prefix)}
+        if self._txn is not None:
+            for k, v in self._overlay.items():
+                if k.startswith(prefix):
+                    if v is None:
+                        keys.discard(k)
+                    else:
+                        keys.add(k)
+        return sorted(keys)
+
+    # --- txn hooks ------------------------------------------------------------
+
+    def _txn_begin(self) -> None:
+        self._kv_lock.acquire()
+        self._txn = KVTransaction()
+        self._overlay = {}
+
+    def _txn_commit(self) -> None:
+        # the overlay MUST clear even when the submit fails (disk full,
+        # sqlite error): stale overlay would serve rolled-back phantom
+        # data to every later read
+        try:
+            self.db.submit_transaction(self._txn)
+        finally:
+            self._txn = None
+            self._overlay = {}
+            self._kv_lock.release()
+
+    def _txn_rollback(self) -> None:
+        self._txn = None
+        self._overlay = {}
+        self._kv_lock.release()
+
+    # --- key helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _esc(component: str) -> str:
+        """Escape a key component: names may contain '/' (RGW keys,
+        CephFS paths) which would alias another object's prefix."""
+        return quote(component, safe="")
+
+    @staticmethod
+    def _c(cid: Collection) -> str:
+        return f"C/{KVStore._esc(cid.key())}"
+
+    @staticmethod
+    def _o(cid: Collection, oid: ObjectId) -> str:
+        return f"O/{KVStore._esc(cid.key())}/{KVStore._esc(oid.key())}"
+
+    @staticmethod
+    def _d(cid: Collection, oid: ObjectId, blk: "int | None" = None) -> str:
+        base = (f"D/{KVStore._esc(cid.key())}/"
+                f"{KVStore._esc(oid.key())}/")
+        return base if blk is None else f"{base}{blk:08x}"
+
+    @staticmethod
+    def _a(cid: Collection, oid: ObjectId, name: str = "") -> str:
+        return (f"A/{KVStore._esc(cid.key())}/"
+                f"{KVStore._esc(oid.key())}/{name}")
+
+    @staticmethod
+    def _m(cid: Collection, oid: ObjectId, key: str = "") -> str:
+        return (f"M/{KVStore._esc(cid.key())}/"
+                f"{KVStore._esc(oid.key())}/{key}")
+
+    def _onode(self, cid: Collection, oid: ObjectId) -> dict:
+        raw = self._get(self._o(cid, oid))
+        if raw is None:
+            raise NotFound(f"{cid}/{oid.key()} does not exist")
+        return json.loads(raw.decode())
+
+    def _require_coll(self, cid: Collection) -> None:
+        if self._get(self._c(cid)) is None:
+            raise NotFound(f"collection {cid} does not exist")
+
+    # --- mutations ------------------------------------------------------------
+
+    def _mkcoll(self, cid: Collection) -> None:
+        if self._get(self._c(cid)) is not None:
+            raise StoreError(f"collection {cid} exists")
+        self._put(self._c(cid), b"1")
+
+    def _rmcoll(self, cid: Collection) -> None:
+        if self._keys_prefix(f"O/{self._esc(cid.key())}/"):
+            raise StoreError(f"collection {cid} not empty")
+        self._del(self._c(cid))
+
+    def _ensure(self, cid: Collection, oid: ObjectId) -> dict:
+        self._require_coll(cid)
+        try:
+            return self._onode(cid, oid)
+        except NotFound:
+            onode = {"size": 0}
+            self._put(self._o(cid, oid), json.dumps(onode).encode())
+            return onode
+
+    def _set_onode(self, cid, oid, onode: dict) -> None:
+        self._put(self._o(cid, oid), json.dumps(onode).encode())
+
+    def _touch(self, cid, oid) -> None:
+        self._ensure(cid, oid)
+
+    def _block(self, cid, oid, blk: int) -> bytearray:
+        raw = self._get(self._d(cid, oid, blk))
+        return bytearray(raw) if raw is not None else bytearray()
+
+    def _write(self, cid, oid, off: int, data: bytes) -> None:
+        onode = self._ensure(cid, oid)
+        pos, end = off, off + len(data)
+        while pos < end:
+            blk, boff = divmod(pos, BLOCK)
+            n = min(BLOCK - boff, end - pos)
+            cur = self._block(cid, oid, blk)
+            if len(cur) < boff + n:
+                cur.extend(b"\0" * (boff + n - len(cur)))
+            cur[boff:boff + n] = data[pos - off:pos - off + n]
+            self._put(self._d(cid, oid, blk), bytes(cur))
+            pos += n
+        if end > onode["size"]:
+            onode["size"] = end
+            self._set_onode(cid, oid, onode)
+
+    def _zero(self, cid, oid, off: int, length: int) -> None:
+        self._write(cid, oid, off, b"\0" * length)
+
+    def _truncate(self, cid, oid, size: int) -> None:
+        onode = self._ensure(cid, oid)
+        old = onode["size"]
+        if size < old:
+            first_gone = -(-size // BLOCK)
+            for key in self._keys_prefix(self._d(cid, oid)):
+                if int(key.rsplit("/", 1)[1], 16) >= first_gone:
+                    self._del(key)
+            if size % BLOCK:
+                blk = size // BLOCK
+                cur = self._block(cid, oid, blk)
+                self._put(self._d(cid, oid, blk),
+                          bytes(cur[:size % BLOCK]))
+        elif size > old:
+            self._zero(cid, oid, old, size - old)
+        onode["size"] = size
+        self._set_onode(cid, oid, onode)
+
+    def _remove(self, cid, oid) -> None:
+        self._onode(cid, oid)   # NotFound when absent
+        self._del(self._o(cid, oid))
+        self._del_prefix(self._d(cid, oid))
+        self._del_prefix(self._a(cid, oid))
+        self._del_prefix(self._m(cid, oid))
+
+    def _clone(self, cid, src, dst) -> None:
+        onode = self._onode(cid, src)
+        self._del_prefix(self._d(cid, dst))
+        self._del_prefix(self._a(cid, dst))
+        self._del_prefix(self._m(cid, dst))
+        self._set_onode(cid, dst, dict(onode))
+        for kind in ("D", "A", "M"):
+            prefix = (f"{kind}/{self._esc(cid.key())}/"
+                      f"{self._esc(src.key())}/")
+            dprefix = (f"{kind}/{self._esc(cid.key())}/"
+                       f"{self._esc(dst.key())}/")
+            for key in self._keys_prefix(prefix):
+                val = self._get(key)
+                if val is not None:
+                    self._put(dprefix + key[len(prefix):], val)
+
+    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
+        self._ensure(cid, oid)
+        self._put(self._a(cid, oid, name), value)
+
+    def _rmattr(self, cid, oid, name: str) -> None:
+        self._del(self._a(cid, oid, name))
+
+    def _omap_set(self, cid, oid, kv) -> None:
+        self._ensure(cid, oid)
+        for k, v in kv.items():
+            self._put(self._m(cid, oid, k), bytes(v))
+
+    def _omap_rm(self, cid, oid, keys) -> None:
+        for k in keys:
+            self._del(self._m(cid, oid, k))
+
+    def _omap_clear(self, cid, oid) -> None:
+        self._del_prefix(self._m(cid, oid))
+
+    # --- queries (non-txn) ----------------------------------------------------
+
+    def exists(self, cid: Collection, oid: ObjectId) -> bool:
+        with self._kv_lock:
+            return self._get(self._o(cid, oid)) is not None
+
+    def read(self, cid, oid, off: int = 0,
+             length: "Optional[int]" = None) -> np.ndarray:
+        with self._kv_lock:
+            return self._read_locked(cid, oid, off, length)
+
+    def _read_locked(self, cid, oid, off: int,
+                     length: "Optional[int]") -> np.ndarray:
+        onode = self._onode(cid, oid)
+        size = onode["size"]
+        end = size if length is None else min(size, off + length)
+        if end <= off:
+            return np.zeros(0, dtype=np.uint8)
+        out = np.zeros(end - off, dtype=np.uint8)
+        for blk in range(off // BLOCK, (end + BLOCK - 1) // BLOCK):
+            raw = self._get(self._d(cid, oid, blk))
+            if not raw:
+                continue
+            bstart = blk * BLOCK
+            lo, hi = max(off, bstart), min(end, bstart + len(raw))
+            if hi > lo:
+                out[lo - off:hi - off] = np.frombuffer(
+                    raw[lo - bstart:hi - bstart], dtype=np.uint8)
+        return out
+
+    def stat(self, cid, oid) -> dict:
+        with self._kv_lock:
+            return {"size": self._onode(cid, oid)["size"]}
+
+    def get_attr(self, cid, oid, name: str) -> bytes:
+        with self._kv_lock:
+            self._onode(cid, oid)
+            raw = self._get(self._a(cid, oid, name))
+            if raw is None:
+                raise NotFound(f"no attr {name!r} on {oid.key()}")
+            return raw
+
+    def get_attrs(self, cid, oid) -> "Dict[str, bytes]":
+        with self._kv_lock:
+            self._onode(cid, oid)
+            prefix = self._a(cid, oid)
+            return {k[len(prefix):]: v
+                    for k, v in self.db.iterator(prefix)}
+
+    def omap_get(self, cid, oid) -> "Dict[str, bytes]":
+        with self._kv_lock:
+            self._onode(cid, oid)
+            prefix = self._m(cid, oid)
+            return {k[len(prefix):]: v
+                    for k, v in self.db.iterator(prefix)}
+
+    def list_collections(self) -> "List[Collection]":
+        from urllib.parse import unquote
+        with self._kv_lock:
+            return [Collection.from_key(unquote(k[2:]))
+                    for k, _ in self.db.iterator("C/")]
+
+    def collection_exists(self, cid: Collection) -> bool:
+        with self._kv_lock:
+            return self._get(self._c(cid)) is not None
+
+    def list_objects(self, cid: Collection) -> "List[ObjectId]":
+        from urllib.parse import unquote
+        prefix = f"O/{self._esc(cid.key())}/"
+        with self._kv_lock:
+            return [ObjectId.from_key(unquote(k[len(prefix):]))
+                    for k, _ in self.db.iterator(prefix)]

@@ -63,7 +63,7 @@ class EncodeService:
     the host fallback path, where the caller hashes as before).
     """
 
-    def __init__(self, max_batch: int = 64,
+    def __init__(self, max_batch: int = 128,
                  min_device_bytes: int = 64 * 1024) -> None:
         self.max_batch = max(1, int(max_batch))
         self.min_device_bytes = int(min_device_bytes)

@@ -1,0 +1,88 @@
+"""KeyValueDB abstraction (ceph_tpu/kv) + KVStore durability.
+
+Reference: src/kv KeyValueDB over RocksDB/memdb; BlueStore's
+all-metadata-in-KV design (src/os/bluestore, kstore layout here).
+"""
+
+import pytest
+
+from ceph_tpu.kv import KVTransaction, MemDB, SqliteDB, create
+from ceph_tpu.objectstore import Collection, KVStore, ObjectId
+from ceph_tpu.objectstore.transaction import Transaction
+
+
+@pytest.fixture(params=["mem", "sqlite"])
+def db(request, tmp_path):
+    d = create(request.param, str(tmp_path / "kv.db"))
+    d.open()
+    yield d
+    d.close()
+
+
+class TestKeyValueDB:
+    def test_batch_atomic_set_get_rm(self, db):
+        t = db.transaction()
+        t.set("a/1", b"one").set("a/2", b"two").set("b/1", b"bee")
+        db.submit_transaction(t)
+        assert db.get("a/1") == b"one"
+        assert db.get("missing") is None
+        assert dict(db.iterator("a/")) == {"a/1": b"one", "a/2": b"two"}
+        assert [k for k, _ in db.iterator()] == ["a/1", "a/2", "b/1"]
+        t2 = db.transaction()
+        t2.rmkey("a/1").rm_range_prefix("b/")
+        db.submit_transaction(t2)
+        assert db.get("a/1") is None
+        assert db.get_prefix("b/") == {}
+        assert db.get("a/2") == b"two"
+
+    def test_batch_rolls_back_on_error(self, tmp_path):
+        """An unknown op kind fails LOUDLY and the whole batch rolls
+        back — a half-applied 'atomic' batch would be silent data
+        loss."""
+        from ceph_tpu.kv import KVError
+        d = SqliteDB(str(tmp_path / "x.db"))
+        d.open()
+        t = KVTransaction()
+        t.set("k", b"v")
+        t.ops.append(("bogus", "k2", b""))
+        with pytest.raises(KVError):
+            d.submit_transaction(t)
+        assert d.get("k") is None            # nothing from the batch
+        d.close()
+
+    def test_prefix_bound_handles_high_codepoints(self, db):
+        """Keys containing supplementary-plane characters must be seen
+        by prefix iteration and prefix deletes on every backend."""
+        t = db.transaction()
+        t.set("M/obj/\U0001f642.txt", b"smile").set("M/obj/plain", b"p")
+        db.submit_transaction(t)
+        assert dict(db.iterator("M/obj/")) == {
+            "M/obj/\U0001f642.txt": b"smile", "M/obj/plain": b"p"}
+        t2 = db.transaction()
+        t2.rm_range_prefix("M/obj/")
+        db.submit_transaction(t2)
+        assert db.get_prefix("M/obj/") == {}
+
+
+class TestKVStoreDurability:
+    def test_state_survives_remount(self, tmp_path):
+        cid = Collection(1, 0, 0)
+        oid = ObjectId("obj", 0)
+        path = str(tmp_path / "store.db")
+        s = KVStore(path=path)
+        s.mkfs()
+        s.mount()
+        t = (Transaction().create_collection(cid)
+             .write(cid, oid, 0, b"x" * 100_000)
+             .setattr(cid, oid, "k", b"v")
+             .omap_setkeys(cid, oid, {"m": b"1"}))
+        s.apply_transaction(t)
+        s.umount()
+
+        s2 = KVStore(path=path)
+        s2.mount()
+        assert bytes(s2.read(cid, oid)) == b"x" * 100_000
+        assert s2.get_attr(cid, oid, "k") == b"v"
+        assert s2.omap_get(cid, oid) == {"m": b"1"}
+        assert s2.list_objects(cid) == [oid]
+        s2.umount()

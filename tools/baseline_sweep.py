@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ceph_tpu.ops import gf8  # noqa: E402
 
-BATCH = 64       # the OSD EncodeService's max_batch operating point
+BATCH = 128      # the OSD EncodeService's max_batch operating point
 TRIALS = 20
 BASELINE_CORES = 96
 BASELINE_DRAM_BYTES = 280e9      # dual-socket DDR4-2933 x 12ch host

@@ -73,14 +73,20 @@ async def run_mon(args) -> None:
 
 
 async def run_osd(args) -> None:
-    from ceph_tpu.objectstore.filestore import FileStore
+    from ceph_tpu.objectstore import create_store
     from ceph_tpu.osd.daemon import OSDDaemon
 
     os.makedirs(args.data, exist_ok=True)
-    store = FileStore(os.path.join(args.data, "store.db"))
-    if not os.path.exists(store.path):
-        store.mkfs()
-    osd = OSDDaemon(args.id, store=store, config=base_config(args),
+    cfg = base_config(args)
+    kind = str(cfg.get("objectstore_type"))
+    if kind == "mem":       # processes need durable state to survive
+        kind = "file"       # kill -9 + respawn; -o objectstore_type=kv
+    store_path = os.path.join(args.data, "store.db")
+    store = create_store(kind, store_path)
+    if not os.path.exists(store_path):
+        store.mkfs()   # only a genuinely fresh dir formats; a corrupt
+        # or locked store must fail loudly at mount, not be re-formatted
+    osd = OSDDaemon(args.id, store=store, config=cfg,
                     mon_addrs=parse_mon_addrs(args.mon_addrs),
                     addr=args.addr, mgr_addr=args.mgr)
     await osd.init()
