@@ -1,0 +1,129 @@
+"""Auth — entity keyrings and connection authentication (reference
+src/auth, 5.9k LoC: cephx tickets + AuthRegistry).
+
+The lean core: a ``Keyring`` maps entity names (``osd.0``, ``mon.1``,
+``client.admin``) to secret keys, and the ``shared_key`` method makes
+every messenger banner carry an HMAC proof binding the connection's
+fresh salt to the sender's identity; the receiver verifies against its
+keyring and drops the session otherwise.  Like cephx, authentication
+composes with the secure (AES-GCM) wire mode for integrity — in crc
+mode the proof authenticates the handshake only, exactly the guarantee
+split the reference documents.
+
+The full cephx ticket economy (mon-issued, service-key-encrypted
+rotating tickets) is future work; the AuthRegistry surface
+(``supported_methods``, per-connection verify) matches, so it can slot
+in without touching the messenger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, Optional
+
+METHOD_NONE = "none"
+METHOD_SHARED_KEY = "shared_key"
+
+
+class AuthError(Exception):
+    pass
+
+
+class Keyring:
+    """Entity -> key map (the /etc/ceph/keyring analog).
+
+    Accepts an inline spec (``"osd.0=<hex>,client.admin=<hex>"``), a
+    file of ``name = hexkey`` lines, or programmatic adds.  ``*``
+    defines a cluster-wide default key (the common deployment where one
+    cluster key is shared — what the messenger's secure mode used
+    implicitly before).
+    """
+
+    def __init__(self, spec: str = "") -> None:
+        self._keys: "Dict[str, bytes]" = {}
+        if spec:
+            if os.path.exists(spec):
+                with open(spec) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line and not line.startswith("#"):
+                            name, key = line.split("=", 1)
+                            self.add(name.strip(), key.strip())
+            else:
+                for part in spec.split(","):
+                    name, key = part.split("=", 1)
+                    self.add(name.strip(), key.strip())
+
+    def add(self, name: str, hexkey: str) -> None:
+        self._keys[name] = bytes.fromhex(hexkey)
+
+    def get(self, name: str) -> "Optional[bytes]":
+        return self._keys.get(name) or self._keys.get("*")
+
+    def names(self) -> "list[str]":
+        return sorted(self._keys)
+
+    @staticmethod
+    def generate_key() -> str:
+        return os.urandom(32).hex()
+
+
+class AuthRegistry:
+    """Per-messenger auth policy (reference AuthRegistry): which method
+    is required, and proof construction/verification for it."""
+
+    def __init__(self, method: str = METHOD_NONE,
+                 keyring: "Optional[Keyring]" = None,
+                 entity: str = "") -> None:
+        if method not in (METHOD_NONE, METHOD_SHARED_KEY):
+            raise AuthError(f"unknown auth method {method!r}")
+        if method == METHOD_SHARED_KEY and keyring is None:
+            raise AuthError("shared_key auth requires a keyring")
+        self.method = method
+        self.keyring = keyring
+        self.entity = entity
+
+    @classmethod
+    def from_config(cls, config, entity: str) -> "AuthRegistry":
+        try:
+            method = str(config.get("auth_cluster_required"))
+            spec = str(config.get("keyring"))
+        except Exception:  # noqa: BLE001 — bare configs: auth off
+            return cls()
+        if method == METHOD_NONE:
+            return cls()
+        return cls(method, Keyring(spec), entity)
+
+    # --- proofs ---------------------------------------------------------------
+
+    def build_proof(self, salt: bytes) -> "Optional[dict]":
+        """Banner payload proving this entity knows its key, bound to
+        the connection's fresh salt (no replay across sessions in
+        secure mode, where the salt also feeds the AEAD nonces)."""
+        if self.method == METHOD_NONE:
+            return None
+        key = self.keyring.get(self.entity)
+        if key is None:
+            raise AuthError(f"no key for {self.entity!r} in keyring")
+        mac = hmac.new(key, salt + self.entity.encode(),
+                       hashlib.sha256).hexdigest()
+        return {"method": self.method, "name": self.entity,
+                "proof": mac}
+
+    def verify_proof(self, auth: "Optional[dict]", salt: bytes) -> None:
+        """Raises AuthError unless the peer's banner proof checks out
+        against our keyring."""
+        if self.method == METHOD_NONE:
+            return
+        if not auth or auth.get("method") != self.method:
+            raise AuthError("peer did not authenticate")
+        name = str(auth.get("name", ""))
+        key = self.keyring.get(name)
+        if key is None:
+            raise AuthError(f"unknown entity {name!r}")
+        want = hmac.new(key, salt + name.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, str(auth.get("proof", ""))):
+            raise AuthError(f"bad proof from {name!r}")

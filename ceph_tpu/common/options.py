@@ -152,6 +152,14 @@ OPTIONS: "dict[str, Option]" = _opts(
            services=("osd",)),
     Option("osd_fast_shutdown", bool, True, LEVEL_ADVANCED,
            desc="skip per-PG teardown on shutdown", services=("osd",)),
+    # --- auth ---------------------------------------------------------------
+    Option("auth_cluster_required", str, "none", LEVEL_ADVANCED,
+           (FLAG_STARTUP,), enum_values=("none", "shared_key"),
+           desc="authentication required for cluster connections "
+                "(cephx-analog shared-key HMAC)"),
+    Option("keyring", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
+           desc="keyring: file path or inline name=hexkey,... "
+                "('*' entry = cluster-wide key)"),
     # --- compressor ---------------------------------------------------------
     Option("compressor_default", str, "zstd", LEVEL_ADVANCED,
            enum_values=("none", "zlib", "zstd", "lz4", "snappy"),

@@ -126,6 +126,10 @@ class CrushMap:
             p = self.get(parent)
             p.children.append(b.id)
 
+
+    def buckets(self):
+        """Public bucket iteration (the 'ceph osd tree' surface)."""
+        return list(self._buckets.values())
     def remove(self, name: str) -> None:
         bid = self._by_name.pop(name, None)
         if bid is None:

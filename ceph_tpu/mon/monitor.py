@@ -314,6 +314,33 @@ class MonDaemon(Dispatcher):
 
     # --- commands (the 'ceph' CLI surface) ------------------------------------
 
+    def _health(self) -> "tuple[str, list]":
+        """One health ruleset feeding BOTH 'status' and 'health' — the
+        two surfaces must never disagree."""
+        checks = []
+        down = [i for i, o in self.osdmap.osds.items()
+                if not o.up and o.in_cluster]
+        if down:
+            checks.append({"check": "OSD_DOWN",
+                           "severity": "HEALTH_WARN",
+                           "message": f"{len(down)} osds down: "
+                                      f"{sorted(down)}"})
+        out = [i for i, o in self.osdmap.osds.items()
+               if not o.in_cluster]
+        if out:
+            checks.append({"check": "OSD_OUT",
+                           "severity": "HEALTH_WARN",
+                           "message": f"{len(out)} osds out: "
+                                      f"{sorted(out)}"})
+        if len(self.elector.quorum) <= len(self.mon_addrs) // 2:
+            checks.append({"check": "MON_QUORUM",
+                           "severity": "HEALTH_ERR",
+                           "message": "mon quorum at risk"})
+        status = ("HEALTH_ERR" if any(
+            c["severity"] == "HEALTH_ERR" for c in checks)
+            else "HEALTH_WARN" if checks else "HEALTH_OK")
+        return status, checks
+
     async def _handle_command(self, conn, msg: MMonCommand) -> None:
         cmd = dict(msg["cmd"])
         tid = msg["tid"]
@@ -396,6 +423,7 @@ class MonDaemon(Dispatcher):
             return 0, {"map": self.osdmap.to_dict()}
         if prefix == "status":
             up = sum(1 for o in self.osdmap.osds.values() if o.up)
+            status, _checks = self._health()
             return 0, {
                 "mon": {"rank": self.rank, "quorum": self.elector.quorum,
                         "leader": self.elector.leader},
@@ -403,8 +431,23 @@ class MonDaemon(Dispatcher):
                            "num_osds": len(self.osdmap.osds),
                            "num_up_osds": up},
                 "pools": len(self.osdmap.pools),
-                "health": "HEALTH_OK" if up == len(self.osdmap.osds)
-                          else "HEALTH_WARN"}
+                "health": status}
+        if prefix == "health":
+            status, checks = self._health()
+            return 0, {"status": status, "checks": checks}
+        if prefix == "osd tree":
+            # crush hierarchy + per-osd state (the 'ceph osd tree' view)
+            nodes = []
+            for i in sorted(self.osdmap.osds):
+                o = self.osdmap.osds[i]
+                nodes.append({"id": i, "name": f"osd.{i}",
+                              "status": "up" if o.up else "down",
+                              "reweight": o.weight,
+                              "in": o.in_cluster, "addr": o.addr})
+            buckets = [{"id": b.id, "name": b.name,
+                        "type": b.type_name}
+                       for b in self.osdmap.crush.buckets()]
+            return 0, {"nodes": nodes, "buckets": buckets}
         if prefix == "config set":
             value = json.dumps({"service": "config", "ops": [
                 {"op": "set", "name": cmd["name"],

@@ -290,13 +290,27 @@ class Connection:
                     d.ms_handle_reset(self)
                 return
 
-    def _banner(self) -> bytes:
+    def _banner(self, peer_salt: bytes = b"") -> bytes:
+        """Handshake banner.  Challenge-response auth (cephx-style):
+        only the side that has SEEN the peer's fresh salt embeds a
+        proof (HMAC over peer_salt + own_salt), so a recorded banner
+        cannot be replayed — the other side authenticates with a
+        follow-up __auth control frame after learning our salt."""
         self.out_seq += 1
+        from ..auth import AuthError
+        auth = None
+        if peer_salt:
+            try:
+                auth = self.messenger.auth.build_proof(
+                    peer_salt + self._salt)
+            except AuthError as e:
+                raise MessageError(f"cannot authenticate: {e}")
         banner = {"type": "__banner", "name": self.messenger.name,
                   "addr": self.messenger.listen_addr,
                   "salt": self._salt.hex(),
                   "in_seq": self.in_seq, "secure": self.messenger.secure,
-                  "compress": self.messenger.compress_algo}
+                  "compress": self.messenger.compress_algo,
+                  "auth": auth}
         return self._frame(json.dumps(banner).encode(), b"",
                            self.out_seq, self.in_seq, force_plain=True)
 
@@ -310,7 +324,10 @@ class Connection:
         if ph.get("compress", "") != self.messenger.compress_algo:
             raise MessageError("compression-algorithm mismatch")
         self.peer_name = ph.get("name", "")
-        self._peer_salt = bytes.fromhex(ph.get("salt", "00000000"))
+        try:
+            self._peer_salt = bytes.fromhex(ph.get("salt", "00000000"))
+        except (ValueError, TypeError):
+            raise MessageError("malformed banner salt")
         if ph.get("addr") and not self.peer_addr:
             self.peer_addr = ph["addr"]
         return ph
@@ -319,12 +336,28 @@ class Connection:
                        writer: asyncio.StreamWriter,
                        client_side: bool) -> None:
         self._writer = writer
+        from ..auth import AuthError
+        auth_on = self.messenger.auth.method != "none"
         if client_side:
             # client speaks first; server replies with how far it had
             # received from us, so replay resends exactly the lost tail
             writer.write(self._banner())
             await writer.drain()
             ph = await self._read_banner(reader)
+            if auth_on:
+                # the server's proof binds OUR fresh salt: not replayable
+                try:
+                    self.messenger.auth.verify_proof(
+                        ph.get("auth"), self._salt + self._peer_salt)
+                except (AuthError, TypeError, ValueError) as e:
+                    raise MessageError(f"server failed auth: {e}")
+                # now prove ourselves against the server's fresh salt
+                try:
+                    proof = self.messenger.auth.build_proof(
+                        self._peer_salt + self._salt)
+                except AuthError as e:
+                    raise MessageError(f"cannot authenticate: {e}")
+                await self._send_ctrl({"type": "__auth", "auth": proof})
             peer_in_seq = int(ph.get("in_seq", 0))
             if not self.policy.lossy:
                 self.unacked = [(s, f) for s, f in self.unacked
@@ -340,7 +373,11 @@ class Connection:
             # restore receive progress for this peer (survives reconnects)
             key = self.peer_addr or self.peer_name
             self.in_seq = self.messenger._peer_in_seq.get(key, 0)
-            writer.write(self._banner())
+            # server's banner carries its proof bound to the client salt;
+            # the client must answer with an __auth frame before any
+            # message is accepted
+            self._auth_pending = auth_on
+            writer.write(self._banner(peer_salt=self._peer_salt))
             await writer.drain()
             self._connected.set()
         await self._read_loop(reader)
@@ -360,6 +397,19 @@ class Connection:
                 continue
             if h.get("type") == "__banner":
                 continue
+            if h.get("type") == "__auth":
+                from ..auth import AuthError
+                try:
+                    self.messenger.auth.verify_proof(
+                        h.get("auth"), self._salt + self._peer_salt)
+                except (AuthError, TypeError, ValueError) as e:
+                    raise MessageError(f"peer failed auth: {e}")
+                self._auth_pending = False
+                continue
+            if getattr(self, "_auth_pending", False):
+                raise MessageError(
+                    f"message from unauthenticated peer "
+                    f"{self.peer_name!r}")
             if seq:
                 if seq <= self.in_seq:
                     continue  # replayed duplicate
@@ -455,6 +505,17 @@ class Messenger:
         if self.compress_algo:
             from ..compressor import Compressor
             self.compressor = Compressor.create(self.compress_algo)
+        # connection authentication (reference AuthRegistry/cephx):
+        # banners carry an HMAC proof over the fresh salt when required
+        from ..auth import AuthRegistry
+        self.auth = AuthRegistry.from_config(config, name) \
+            if config is not None else AuthRegistry()
+        if self.auth.method != "none" and self.local:
+            # the in-process transport has no wire handshake to carry
+            # proofs: requiring auth there would silently not enforce
+            dout("ms", 0, f"{name}: auth_cluster_required="
+                          f"{self.auth.method} is NOT enforced on the "
+                          f"async+local transport (use async+tcp)")
 
     @classmethod
     def create(cls, name: str, config=None, **kw) -> "Messenger":

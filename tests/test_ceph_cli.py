@@ -1,0 +1,84 @@
+"""ceph operator CLI (tools/ceph.py) against real daemon processes.
+
+Reference: src/ceph.in — mon-command JSON RPC + admin-socket daemon
+commands.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.qa.vstart import ProcCluster
+
+
+def run_ceph(*args) -> dict:
+    out = subprocess.run(
+        [sys.executable, "tools/ceph.py", *args],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("ceph-cli")
+    with ProcCluster(str(base), n_mons=1, n_osds=3,
+                     options=["osd_heartbeat_grace=2.0"]) as pc:
+        yield pc
+
+
+def test_status_health_and_tree(cluster):
+    mon = cluster.mon_spec
+    st = run_ceph("--mon", mon, "status")
+    assert st["osdmap"]["num_osds"] == 3
+    assert st["osdmap"]["num_up_osds"] == 3
+    assert st["health"] == "HEALTH_OK"
+
+    h = run_ceph("--mon", mon, "health")
+    assert h["status"] == "HEALTH_OK" and h["checks"] == []
+
+    tree = run_ceph("--mon", mon, "osd tree")
+    assert [n["name"] for n in tree["nodes"]] == ["osd.0", "osd.1",
+                                                  "osd.2"]
+    assert all(n["status"] == "up" for n in tree["nodes"])
+
+
+def test_profile_and_pool_lifecycle(cluster):
+    mon = cluster.mon_spec
+    run_ceph("--mon", mon, "osd", "erasure-code-profile", "set", "prof1",
+             "--kw", "plugin=jax_rs", "--kw", "k=2", "--kw", "m=1")
+    prof = run_ceph("--mon", mon, "osd", "erasure-code-profile", "get",
+                    "prof1")
+    assert prof["profile"]["k"] == "2"
+    assert "prof1" in run_ceph("--mon", mon, "osd",
+                               "erasure-code-profile", "ls")["profiles"]
+    run_ceph("--mon", mon, "osd", "pool", "create", "cli-pool",
+             "--kw", "type=erasure", "--kw", "pg_num=2",
+             "--kw", "ec_profile=prof1")
+    assert "cli-pool" in run_ceph("--mon", mon, "osd", "pool",
+                                  "ls")["pools"]
+
+
+def test_health_degrades_on_osd_down(cluster):
+    mon = cluster.mon_spec
+    cluster.kill("osd.2")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = run_ceph("--mon", mon, "health")
+        if h["status"] == "HEALTH_WARN":
+            break
+        time.sleep(0.5)
+    assert h["status"] == "HEALTH_WARN"
+    assert any(c["check"] == "OSD_DOWN" for c in h["checks"])
+    tree = run_ceph("--mon", mon, "osd tree")
+    assert any(n["status"] == "down" for n in tree["nodes"])
+    cluster.revive_osd(2)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if run_ceph("--mon", mon, "health")["status"] == "HEALTH_OK":
+            break
+        time.sleep(0.5)
+    assert run_ceph("--mon", mon, "health")["status"] == "HEALTH_OK"

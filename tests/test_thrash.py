@@ -35,6 +35,24 @@ def test_thrash_ec_pool(loop):
     loop.run_until_complete(go())
 
 
+def test_thrash_with_socket_fault_injection(loop):
+    """Thrash PLUS messenger fault injection (reference msgr-failures
+    qa suites: ms_inject_socket_failures): random delays and drops on
+    every connection while OSDs die — acked data must still survive."""
+    async def go():
+        from ceph_tpu.common.config import Config
+        cfg = Config()
+        cfg.set("ms_inject_delay_max", 0.005)
+        cfg.set("ms_inject_drop_ratio", 0.02)
+        async with MiniCluster(n_osds=7, config=cfg) as c:
+            c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "3",
+                                    "m": "2"}, pg_num=8, stripe_unit=64)
+            stats = await run_thrash(c, "ec", duration=6.0, seed=23,
+                                     min_live=5)
+            assert stats["acked"] > 0
+    loop.run_until_complete(go())
+
+
 def test_thrash_replicated_pool(loop):
     async def go():
         async with MiniCluster(n_osds=6) as c:

@@ -161,7 +161,9 @@ def _decode_config(name: str, k: int, m: int, technique: str,
         np.ascontiguousarray(G[k:]), data)], axis=0)
     rec = gf8.gf_mat_encode(D, allc[rows])
     assert np.array_equal(rec, data), f"{name}: decode mismatch"
-    return _config(name, D, k, chunk_bytes, with_crc=False)
+    # batch 8: recovery decodes batch far fewer ops than the write-path
+    # encode service, and the smaller working set stays VMEM-resident
+    return _config(name, D, k, chunk_bytes, with_crc=False, batch=8)
 
 
 def _lrc_matrix(k: int, m: int, l: int) -> np.ndarray:

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""ceph — the operator CLI (reference src/ceph.in: mon-command JSON RPC).
+
+Connects to running mons over tcp and speaks the same JSON command
+surface the mon serves in-cluster.  Also passes commands through to a
+daemon's admin socket (the 'ceph daemon <sock> <cmd>' form).
+
+  python tools/ceph.py --mon 0=127.0.0.1:7101 status
+  python tools/ceph.py --mon ... health
+  python tools/ceph.py --mon ... osd tree
+  python tools/ceph.py --mon ... osd pool create data \
+      --kw type=erasure --kw pg_num=8 --kw ec_profile=myprof
+  python tools/ceph.py --mon ... osd erasure-code-profile set myprof \
+      --kw k=4 --kw m=2 --kw plugin=jax_rs
+  python tools/ceph.py daemon /run/osd.0.asok dump_historic_ops
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+# commands taking a trailing name argument
+_NAMED = {"osd pool create", "osd erasure-code-profile set",
+          "osd erasure-code-profile get", "osd erasure-code-profile rm",
+          "config get", "config set"}
+_PREFIXES = ["osd erasure-code-profile set", "osd erasure-code-profile get",
+             "osd erasure-code-profile ls", "osd erasure-code-profile rm",
+             "osd pool create", "osd pool ls", "osd dump", "osd tree",
+             "osd down", "osd out", "osd in", "status", "health",
+             "config get", "config set"]
+
+
+def build_cmd(words: "list[str]", kwargs: dict) -> dict:
+    joined = " ".join(words)
+    prefix = next((p for p in sorted(_PREFIXES, key=len, reverse=True)
+                   if joined == p or joined.startswith(p + " ")), None)
+    if prefix is None:
+        raise SystemExit(f"unknown command {joined!r} "
+                         f"(have: {', '.join(sorted(_PREFIXES))})")
+    rest = joined[len(prefix):].split()
+    cmd = {"prefix": prefix}
+    if prefix in ("osd down", "osd out", "osd in"):
+        if not rest:
+            raise SystemExit(f"{prefix}: needs an osd id")
+        cmd["id"] = int(rest[0])
+    elif prefix in _NAMED:
+        if not rest:
+            raise SystemExit(f"{prefix}: needs a name")
+        cmd["name"] = rest[0]
+    if prefix == "osd erasure-code-profile set":
+        cmd["profile"] = kwargs
+    elif prefix == "osd pool create":
+        cmd["kwargs"] = {k: (int(v) if v.isdigit() else v)
+                         for k, v in kwargs.items()}
+    elif prefix == "config set":
+        # the value is everything after the name (spaces preserved)
+        cmd["value"] = (" ".join(rest[1:]) if len(rest) > 1
+                        else kwargs.get("value"))
+    return cmd
+
+
+async def mon_command(mon_spec: str, cmd: dict) -> dict:
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.client.rados import RadosClient
+
+    mons = {}
+    for part in mon_spec.split(","):
+        rank, addr = part.split("=", 1)
+        mons[int(rank)] = addr
+    cfg = Config()
+    cfg.set("ms_type", "async+tcp")
+    client = RadosClient(None, name="client.admin", config=cfg,
+                         mon_addrs=mons)
+    await client.connect("127.0.0.1:0")
+    try:
+        return await client.mon_command(cmd)
+    finally:
+        await client.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--mon", default="",
+                   help="mon addresses rank=host:port,...")
+    p.add_argument("--kw", action="append", default=[],
+                   help="key=value argument (profile/pool kwargs)")
+    p.add_argument("words", nargs="+")
+    args = p.parse_args(argv)
+
+    if args.words[0] == "daemon":
+        # admin-socket passthrough (reference 'ceph daemon <sock> cmd')
+        from ceph_tpu.common.admin_socket import admin_command
+        path, prefix = args.words[1], " ".join(args.words[2:])
+        kwargs = dict(kv.split("=", 1) for kv in args.kw)
+        print(json.dumps(admin_command(path, prefix, **kwargs), indent=1))
+        return 0
+
+    if not args.mon:
+        p.error("need --mon (or the 'daemon <sock>' form)")
+    kwargs = dict(kv.split("=", 1) for kv in args.kw)
+    cmd = build_cmd(args.words, kwargs)
+    out = asyncio.run(mon_command(args.mon, cmd))
+    print(json.dumps(out, indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
