@@ -87,9 +87,12 @@ class IoCtx:
 
     # --- reads ----------------------------------------------------------------
 
-    async def read(self, oid: str, length: int = 0, off: int = 0) -> bytes:
-        outs, blob = await self._submit(
-            oid, [{"op": "read", "off": off, "len": length}])
+    async def read(self, oid: str, length: int = 0, off: int = 0,
+                   snap: "Optional[str]" = None) -> bytes:
+        op = {"op": "read", "off": off, "len": length}
+        if snap is not None:
+            op["snap"] = snap     # read AT a pool snapshot
+        outs, blob = await self._submit(oid, [op])
         lens = [o["dlen"] for o in outs if o.get("op") == "read"]
         return b"".join(unpack_buffers(lens, blob))
 

@@ -1,0 +1,89 @@
+"""Balancer — PG distribution evening via upmap overrides.
+
+Reference: src/pybind/mgr/balancer (upmap mode): compute per-OSD PG
+counts, move membership from the most- to the least-loaded OSDs with
+pg-upmap overrides until the spread is within tolerance.
+
+``plan(osdmap)`` is pure (returns the override list); ``optimize``
+applies them through the mon command surface.  Moves preserve the PG's
+width and only substitute a single member per move (the upmap-items
+behavior), so data movement per step is one shard's backfill.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional
+
+from ..osd.osdmap import NONE_OSD, OSDMap
+from .daemon import MgrModule
+
+
+class BalancerModule(MgrModule):
+    name = "balancer"
+
+    def __init__(self, mgr=None, max_deviation: int = 1) -> None:
+        if mgr is not None:
+            super().__init__(mgr)
+        self.max_deviation = max_deviation
+
+    # --- analysis -------------------------------------------------------------
+
+    def pg_counts(self, osdmap: OSDMap) -> "Counter":
+        counts: "Counter" = Counter(
+            {i: 0 for i, o in osdmap.osds.items()
+             if o.up and o.in_cluster})
+        for pool_id, pool in osdmap.pools.items():
+            for pg in range(pool.pg_num):
+                _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
+                for o in acting:
+                    if o in counts:
+                        counts[o] += 1
+        return counts
+
+    def plan(self, osdmap: OSDMap,
+             max_moves: int = 10) -> "List[dict]":
+        """Upmap overrides that shrink the max-min PG-count spread.
+        Each move swaps ONE over-loaded member of one PG for the
+        currently least-loaded OSD not already in that PG."""
+        counts = self.pg_counts(osdmap)
+        if len(counts) < 2:
+            return []
+        moves: "List[dict]" = []
+        # iterate over PG memberships looking for profitable swaps
+        for pool_id, pool in osdmap.pools.items():
+            for pg in range(pool.pg_num):
+                if len(moves) >= max_moves:
+                    return moves
+                hi = max(counts, key=lambda o: counts[o])
+                lo = min(counts, key=lambda o: counts[o])
+                if counts[hi] - counts[lo] <= self.max_deviation:
+                    return moves
+                _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
+                if hi not in acting or lo in acting:
+                    continue
+                mapping = [lo if o == hi else o for o in acting]
+                if NONE_OSD in mapping:
+                    continue
+                moves.append({"pool": pool_id, "pg": pg,
+                              "mapping": mapping})
+                counts[hi] -= 1
+                counts[lo] += 1
+        return moves
+
+    def spread(self, osdmap: OSDMap) -> int:
+        counts = self.pg_counts(osdmap)
+        return (max(counts.values()) - min(counts.values())
+                if counts else 0)
+
+    # --- application ----------------------------------------------------------
+
+    async def optimize(self, client, osdmap: "Optional[OSDMap]" = None,
+                       max_moves: int = 10) -> "List[dict]":
+        """Plan against the client's current map and apply each move
+        via 'osd pg-upmap' (the active-balancer loop body)."""
+        osdmap = osdmap or client.osdmap
+        moves = self.plan(osdmap, max_moves)
+        for mv in moves:
+            await client.mon_command({"prefix": "osd pg-upmap", **mv})
+        return moves

@@ -173,6 +173,21 @@ class MonDaemon(Dispatcher):
             m.ec_profiles.pop(op["name"], None)
         elif kind == "create_pool":
             m.create_pool(op["name"], **op.get("kwargs", {}))
+        elif kind == "pool_mksnap":
+            pool = m.get_pool(int(op["pool"]))
+            pool.snap_seq += 1
+            pool.snaps[str(op["snap"])] = pool.snap_seq
+        elif kind == "pool_rmsnap":
+            m.get_pool(int(op["pool"])).snaps.pop(str(op["snap"]), None)
+        elif kind == "pg_upmap":
+            # balancer override: pin a PG's acting set (reference
+            # pg-upmap-items / pg_temp)
+            key = f"{int(op['pool'])}.{int(op['pg'])}"
+            mapping = [int(o) for o in op.get("mapping", [])]
+            if mapping:
+                m.pg_temp[key] = mapping
+            else:
+                m.pg_temp.pop(key, None)
 
     async def _broadcast_map(self) -> None:
         payload = json.dumps(self.osdmap.to_dict()).encode()
@@ -448,6 +463,44 @@ class MonDaemon(Dispatcher):
                         "type": b.type_name}
                        for b in self.osdmap.crush.buckets()]
             return 0, {"nodes": nodes, "buckets": buckets}
+        if prefix in ("osd pool mksnap", "osd pool rmsnap"):
+            pool = self.osdmap.pool_by_name(cmd["name"])
+            if pool is None:
+                return -2, {"error": f"no pool {cmd['name']!r}"}
+            kind = ("pool_mksnap" if prefix.endswith("mksnap")
+                    else "pool_rmsnap")
+            if kind == "pool_mksnap" and cmd["snap"] in pool.snaps:
+                return -17, {"error": f"snap {cmd['snap']!r} exists"}
+            v = await self._propose_osd_ops([{
+                "op": kind, "pool": pool.pool_id,
+                "snap": str(cmd["snap"])}])
+            return 0, {"epoch": v,
+                       "snapid": pool.snaps.get(cmd["snap"], 0)}
+        if prefix == "osd pg-upmap":
+            # 'ceph osd pg-upmap-items' analog: [] clears the override
+            pool = self.osdmap.pools.get(int(cmd["pool"]))
+            if pool is None:
+                return -2, {"error": f"no pool {cmd['pool']}"}
+            pg = int(cmd["pg"])
+            if not 0 <= pg < pool.pg_num:
+                return -22, {"error": f"pg {pg} out of range "
+                                      f"(pg_num {pool.pg_num})"}
+            mapping = [int(o) for o in cmd.get("mapping", [])]
+            if mapping:
+                unknown = [o for o in mapping
+                           if o not in self.osdmap.osds]
+                if unknown:
+                    return -2, {"error": f"unknown osds {unknown}"}
+                if len(mapping) != pool.size:
+                    return -22, {"error": f"mapping width "
+                                          f"{len(mapping)} != pool "
+                                          f"size {pool.size}"}
+                if len(set(mapping)) != len(mapping):
+                    return -22, {"error": "duplicate osds in mapping"}
+            await self._propose_osd_ops([{
+                "op": "pg_upmap", "pool": pool.pool_id, "pg": pg,
+                "mapping": mapping}])
+            return 0, {}
         if prefix == "config set":
             value = json.dumps({"service": "config", "ops": [
                 {"op": "set", "name": cmd["name"],

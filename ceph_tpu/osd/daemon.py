@@ -460,6 +460,7 @@ class OSDDaemon(Dispatcher):
         oid = msg["oid"]
         be = self._get_backend(pgid)
         be.last_epoch = self.osdmap.epoch
+        be.pool_snap_seq = self.osdmap.get_pool(pgid[0]).snap_seq
         outs: "List[dict]" = []
         out_bufs: "List[bytes]" = []
         result = 0
@@ -543,13 +544,27 @@ class OSDDaemon(Dispatcher):
                     out_bufs.append(out)
                 elif name == "read":
                     self.perf.inc("op_r")
-                    res = await be.objects_read_and_reconstruct(
-                        {oid: [(int(op.get("off", 0)),
-                                int(op.get("len", 0)))]})
-                    for _off, data in res[oid]:
+                    ext = [(int(op.get("off", 0)),
+                            int(op.get("len", 0)))]
+                    if op.get("snap"):
+                        pool = self.osdmap.get_pool(pgid[0])
+                        snapid = pool.snaps.get(str(op["snap"]))
+                        if snapid is None:
+                            raise ECError(
+                                f"no snap {op['snap']!r} in pool "
+                                f"{pool.name}")
+                        await be.ensure_active()
+                        pieces = await be.objects_read_at_snap(
+                            oid, ext, snapid,
+                            snapids=sorted(pool.snaps.values()))
+                    else:
+                        res = await be.objects_read_and_reconstruct(
+                            {oid: ext})
+                        pieces = res[oid]
+                    for _off, data in pieces:
                         outs.append({"op": "read", "dlen": len(data)})
                         out_bufs.append(data)
-                    if not res[oid]:
+                    if not pieces:
                         outs.append({"op": "read", "dlen": 0})
                 elif name == "stat":
                     outs.append({"op": "stat", "size": be.object_size(oid),

@@ -66,18 +66,26 @@ class NotActive(ECError):
 
 @dataclass
 class ObjectInfo:
-    """Minimal object_info_t: logical size + last mutating version."""
+    """Minimal object_info_t: logical size, last mutating version, and
+    the newest pool snapid this object has been COW-cloned for."""
     size: int = 0
     version: Version = ZERO
+    snap_seq: int = 0
+    born_seq: int = 0    # pool snap_seq when created: the object is
+    #                      absent from snaps with id <= born_seq
 
     def encode(self) -> bytes:
         return json.dumps({"size": self.size,
-                           "version": list(self.version)}).encode()
+                           "version": list(self.version),
+                           "snap_seq": self.snap_seq,
+                           "born_seq": self.born_seq}).encode()
 
     @classmethod
     def decode(cls, payload: bytes) -> "ObjectInfo":
         d = json.loads(payload.decode())
-        return cls(int(d["size"]), ver(d["version"]))
+        return cls(int(d["size"]), ver(d["version"]),
+                   int(d.get("snap_seq", 0)),
+                   int(d.get("born_seq", 0)))
 
 
 @dataclass
@@ -125,6 +133,7 @@ class ReadRequest:
     to_read: "List[Extent]"                     # logical extents wanted
     chunk_extents: "List[Extent]"               # same extents in chunk space
     want_attrs: bool = False
+    gen: int = NO_GEN                           # snapshot clone to read
 
 
 @dataclass
@@ -196,6 +205,9 @@ class ECBackend:
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
         self.config = config
+        # newest pool snapid (daemon refreshes per op): a mutation of an
+        # object whose oi.snap_seq is older clones it first (COW)
+        self.pool_snap_seq = 0
         # serializes object-class read-modify-write executions against
         # each other AND against plain write admissions (reference: cls
         # methods run under the PG lock in do_op)
@@ -459,7 +471,8 @@ class ECBackend:
         plans against the earlier op's projected size."""
         stack = self.projected.get(oid)
         if stack:
-            return ObjectInfo(stack[-1].size, stack[-1].version)
+            return ObjectInfo(stack[-1].size, stack[-1].version,
+                              stack[-1].snap_seq, stack[-1].born_seq)
         return self._get_object_info(oid)
 
     def _prepare_plan(self, op: Op) -> None:
@@ -510,7 +523,14 @@ class ECBackend:
             op.plan = get_write_plan(
                 self.sinfo, [(o, len(d)) for o, d in op.writes],
                 op.oi.size, op.truncate_to)
-        op.projection = ObjectInfo(op.plan.projected_size, op.version)
+        # projections carry the snap lineage: a pipelined successor
+        # must see this op's COW as done (or it would re-clone over the
+        # snap with post-write bytes) and must not look newly born
+        op.projection = ObjectInfo(
+            op.plan.projected_size, op.version,
+            max(op.oi.snap_seq, self.pool_snap_seq),
+            op.oi.born_seq if op.oi.version != ZERO
+            else self.pool_snap_seq)
         self.projected.setdefault(op.oid, []).append(op.projection)
 
     def _unproject(self, op: Op) -> None:
@@ -657,14 +677,26 @@ class ECBackend:
             # cached pre-truncate/pre-delete stripes
             self.extent_cache.invalidate(op.oid)
 
+        # pool-snapshot COW: first mutation after a newer pool snap
+        # clones every shard's chunk to the snap generation (negative
+        # gens: the rollback machinery reaps only its own version gens)
+        snap_clone = 0
+        if self.pool_snap_seq > op.oi.snap_seq and op.oi.version != ZERO:
+            snap_clone = self.pool_snap_seq
         shard_txns: "Dict[int, dict]" = {}
         if op.delete:
             rollback = {"clone_gen": op.version[1]}
             for shard in range(self.k + self.m):
                 shard_txns[shard] = {"delete": True, "gen": op.version[1]}
+                if snap_clone:
+                    shard_txns[shard]["snap_clone"] = snap_clone
         else:
             stripes = self._materialize_stripes(op)
-            new_oi = ObjectInfo(op.plan.projected_size, op.version)
+            born = (op.oi.born_seq if op.oi.version != ZERO
+                    else self.pool_snap_seq)
+            new_oi = ObjectInfo(op.plan.projected_size, op.version,
+                                max(op.oi.snap_seq, self.pool_snap_seq),
+                                born)
             hinfo = (ecutil.HashInfo(self.k + self.m) if op.rewrite
                      else self._get_hinfo(op.oid))
             # crc chain: a full rewrite starts fresh; a pure
@@ -690,6 +722,8 @@ class ECBackend:
                 shard_txns[shard] = {"writes": [],
                                      "oi": new_oi.encode().hex(),
                                      "rollback": rollback}
+                if snap_clone:
+                    shard_txns[shard]["snap_clone"] = snap_clone
             for off, buf in sorted(stripes.items()):
                 crcs = None
                 if self.encode_service is not None:
@@ -927,6 +961,11 @@ class ECBackend:
         sid = ObjectId(oid, shard)
 
         rollback = txn.get("rollback", {})
+        if txn.get("snap_clone") and self.store.exists(cid, sid):
+            # COW for a pool snapshot: preserve the pre-write chunk at
+            # the snap generation (gen -(snapid+2); NO_GEN is -1)
+            t.clone(cid, sid,
+                    sid.with_gen(-(int(txn["snap_clone"]) + 2)))
         if txn.get("delete"):
             # keep a rollback copy at generation until roll_forward reaps
             if self.store.exists(cid, sid):
@@ -1004,7 +1043,7 @@ class ECBackend:
         sub_count = self.codec.get_sub_chunk_count()
         for req in msg["to_read"]:
             oid = req["oid"]
-            sid = ObjectId(oid, shard)
+            sid = ObjectId(oid, shard, int(req.get("gen", NO_GEN)))
             subs = [tuple(x) for x in req.get("subchunks",
                                               [(0, sub_count)])]
             partial = subs != [(0, sub_count)]
@@ -1107,8 +1146,8 @@ class ECBackend:
     async def _start_read(self, reads: "Dict[str, List[Extent]]",
                           for_recovery: bool, want_attrs: bool = False,
                           want_to_read: "Optional[List[int]]" = None,
-                          exclude: "Optional[Set[int]]" = None
-                          ) -> ReadOp:
+                          exclude: "Optional[Set[int]]" = None,
+                          gen: int = NO_GEN) -> ReadOp:
         """Build + launch a ReadOp (reference start_read_op
         ECBackend.cc:1679 -> do_read_op :1707).  ``exclude`` drops shards
         known stale/missing for these objects from the source set."""
@@ -1147,7 +1186,8 @@ class ECBackend:
                     self.sinfo.aligned_logical_offset_to_chunk_offset(start),
                     self.sinfo.aligned_logical_offset_to_chunk_offset(span)))
             rop.requests[oid] = ReadRequest(oid, list(extents),
-                                            chunk_extents, want_attrs)
+                                            chunk_extents, want_attrs,
+                                            gen=gen)
         self.in_flight_reads[rop.tid] = rop
         await self._issue_shard_reads(rop, need, avail,
                                       list(rop.requests))
@@ -1166,7 +1206,7 @@ class ECBackend:
                 per_shard.setdefault(shard, []).append({
                     "oid": oid,
                     "extents": [[o, l] for o, l in req.chunk_extents],
-                    "subchunks": subs})
+                    "subchunks": subs, "gen": req.gen})
         if not per_shard:
             self._maybe_complete_read(rop)
             return
@@ -1262,6 +1302,87 @@ class ECBackend:
         await self._issue_shard_reads(rop, need, avail, oids)
         rop.retries_pending -= 1
         self._maybe_complete_read(rop)
+
+    def snap_gen_for(self, oid: str, snapid: int,
+                     snapids: "Optional[List[int]]" = None
+                     ) -> "Optional[int]":
+        """Which content serves a read AT pool snap ``snapid``:
+        the COW clone with the smallest snap >= snapid, NO_GEN when the
+        head is unchanged since the snap, None when the object did not
+        exist at the snap (born later, or never existed).
+
+        ``snapids``: the pool's known snap ids — probed directly
+        (bounded by snap count) instead of scanning the whole
+        collection per read."""
+        cid = self.coll(self.my_shard)
+        best: "Optional[int]" = None
+        if snapids is not None:
+            for s in sorted(s for s in snapids if s >= snapid):
+                if self.store.exists(cid, ObjectId(oid, self.my_shard,
+                                                   -(s + 2))):
+                    best = s
+                    break
+        elif self.store.collection_exists(cid):
+            for o in self.store.list_objects(cid):
+                if o.name == oid and o.generation <= -2:
+                    s = -o.generation - 2
+                    if s >= snapid and (best is None or s < best):
+                        best = s
+        if best is not None:
+            gen = -(best + 2)
+            # the CLONE's object_info says when the object was born —
+            # an object created after the requested snap is absent from
+            # it even though a later clone exists
+            try:
+                oi = ObjectInfo.decode(bytes(self.store.get_attr(
+                    cid, ObjectId(oid, self.my_shard, gen), OI_KEY)))
+                if oi.born_seq >= snapid:
+                    return None
+            except (NotFound, KeyError):
+                pass
+            return gen
+        oi = self._get_object_info(oid)
+        if oi.version == ZERO or oi.born_seq >= snapid:
+            return None          # absent at snap time
+        return NO_GEN            # unchanged since the snap: head serves
+
+    async def objects_read_at_snap(self, oid: str,
+                                   extents: "List[Extent]",
+                                   snapid: int,
+                                   snapids: "Optional[List[int]]" = None
+                                   ) -> "List[Tuple[int, bytes]]":
+        gen = self.snap_gen_for(oid, snapid, snapids)
+        if gen is None:
+            return []
+        if gen == NO_GEN:
+            res = await self.objects_read_and_reconstruct(
+                {oid: extents})
+            return res[oid]
+        # size at snap comes from the clone's object_info
+        try:
+            size = ObjectInfo.decode(bytes(self.store.get_attr(
+                self.coll(self.my_shard),
+                ObjectId(oid, self.my_shard, gen), OI_KEY))).size
+        except (NotFound, KeyError):
+            size = 0
+        clipped = []
+        for off, length in extents:
+            if length == 0:
+                length = max(0, size - off)
+            length = min(length, max(0, size - off))
+            if length > 0:
+                clipped.append((off, length))
+        if not clipped:
+            return []
+        rop = await self._start_read({oid: clipped},
+                                     for_recovery=False, gen=gen)
+        await rop.done
+        if oid in rop.errors:
+            raise ECError(f"snap read {oid} failed: errno "
+                          f"{rop.errors[oid]}")
+        shard_bufs = rop.complete.get(oid, {})
+        return [(off, self._reconstruct_extent(shard_bufs, off, length))
+                for off, length in clipped]
 
     async def objects_read_and_reconstruct(
             self, reads: "Dict[str, List[Extent]]"
@@ -1381,6 +1502,74 @@ class ECBackend:
         rop.state = RecoveryOp.WRITING
         await self._push_recovered(rop)
         await rop.done
+        # snapshot clones must survive shard rebuilds too: re-derive
+        # every clone generation the primary holds for this object and
+        # push it to the recovering shards (best effort; deep scrub
+        # backstops any miss)
+        for gen in self._local_snap_gens(oid):
+            try:
+                await self._recover_clone(oid, gen, set(missing_on),
+                                          exclude or set(missing_on))
+            except ECError as e:
+                dout("osd", 1,
+                     f"clone {oid}@{gen} recovery failed: {e}")
+
+    def _local_snap_gens(self, oid: str) -> "List[int]":
+        cid = self.coll(self.my_shard)
+        if not self.store.collection_exists(cid):
+            return []
+        return sorted(o.generation for o in self.store.list_objects(cid)
+                      if o.name == oid and o.generation <= -2)
+
+    async def _recover_clone(self, oid: str, gen: int,
+                             missing_on: "Set[int]",
+                             exclude: "Set[int]") -> None:
+        """Rebuild one snapshot clone on the recovering shards (same
+        read+decode as head recovery, pushed at the clone's gen)."""
+        read = await self._start_read({oid: [(0, -1)]},
+                                      for_recovery=True,
+                                      want_to_read=sorted(missing_on),
+                                      exclude=exclude, gen=gen)
+        await read.done
+        if oid in read.errors:
+            raise ECError(f"clone read failed: errno "
+                          f"{read.errors[oid]}")
+        shard_bufs = read.complete.get(oid, {})
+        csize = max((sum(len(b) for b in bo.values())
+                     for bo in shard_bufs.values()), default=0)
+        if csize == 0:
+            return
+        arrs = {s: np.frombuffer(
+            b"".join(bo[o] for o in sorted(bo)).ljust(csize, b"\0"),
+            dtype=np.uint8) for s, bo in shard_bufs.items()}
+        decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                sorted(missing_on))
+        cid = self.coll(self.my_shard)
+        attrs = {}
+        try:
+            attrs = {k: v.hex() for k, v in self.store.get_attrs(
+                cid, ObjectId(oid, self.my_shard, gen)).items()}
+        except NotFound:
+            pass
+        acting = self.get_acting()
+        for shard in sorted(missing_on):
+            if shard >= len(acting) or acting[shard] == NONE_OSD:
+                continue
+            msg = MOSDPGPush({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": self.new_tid(),
+                "oid": oid, "gen": gen,
+                "version": list(self.pg_log.head),
+                "whole": True, "off": 0, "attrs": attrs},
+                bytes(np.asarray(decoded[shard]).tobytes()))
+            if acting[shard] == self.whoami:
+                self.handle_push(msg)
+            else:
+                try:
+                    await self.send(acting[shard], msg)
+                except (ConnectionError, OSError, ECError) as e:
+                    dout("osd", 1,
+                         f"clone push to shard {shard} failed: {e}")
 
     async def _push_recovered(self, rop: RecoveryOp) -> None:
         acting = self.get_acting()
@@ -1423,7 +1612,7 @@ class ECBackend:
         a propagated deletion)."""
         shard = int(msg["shard"])
         cid = self.coll(shard)
-        sid = ObjectId(msg["oid"], shard)
+        sid = ObjectId(msg["oid"], shard, int(msg.get("gen", NO_GEN)))
         t = Transaction()
         if not self.store.collection_exists(cid):
             t.create_collection(cid)

@@ -39,6 +39,12 @@ class Pool:
     ec_profile: str = ""          # name into OSDMap.ec_profiles
     stripe_unit: int = 4096       # EC chunk granularity
     fast_read: bool = False
+    snap_seq: int = 0             # newest pool snapid (0 = no snaps)
+    snaps: "dict" = None          # snap name -> snapid
+
+    def __post_init__(self):
+        if self.snaps is None:
+            self.snaps = {}
 
     def is_erasure(self) -> bool:
         return self.type == POOL_ERASURE
@@ -48,6 +54,9 @@ class Pool:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Pool":
+        d = dict(d)
+        d.setdefault("snap_seq", 0)
+        d.setdefault("snaps", {})
         return cls(**d)
 
 
@@ -138,7 +147,13 @@ class OSDMap:
         (reference OSDMap::pg_to_up_acting_osds)."""
         up = self.pg_to_raw_up(pool_id, pg)
         temp = self.pg_temp.get(f"{pool_id}.{pg}")
-        acting = list(temp) if temp else list(up)
+        if temp:
+            # overrides never resurrect dead members: down OSDs become
+            # holes exactly like the raw mapping, so peering/recovery
+            # proceed instead of pinning a dead acting set forever
+            acting = [o if self.is_up(o) else NONE_OSD for o in temp]
+        else:
+            acting = list(up)
         return up, acting
 
     def primary_of(self, acting: "Sequence[int]") -> int:

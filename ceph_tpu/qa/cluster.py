@@ -229,6 +229,22 @@ class MiniCluster:
                 out.update(await osd.peer_all_pgs())
         return out
 
+    def pool_mksnap(self, pool_name: str, snap: str) -> int:
+        """Static-mode pool snapshot (the 'osd pool mksnap' analog)."""
+        assert not self.mon_addrs, "mon mode: use mon_command"
+        pool = self.osdmap.pool_by_name(pool_name)
+        if snap in pool.snaps:
+            raise KeyError(f"snap {snap!r} exists")
+        pool.snap_seq += 1
+        pool.snaps[snap] = pool.snap_seq
+        self.osdmap.bump()
+        return pool.snap_seq
+
+    def pool_rmsnap(self, pool_name: str, snap: str) -> None:
+        assert not self.mon_addrs, "mon mode: use mon_command"
+        self.osdmap.pool_by_name(pool_name).snaps.pop(snap, None)
+        self.osdmap.bump()
+
     async def scrub_pool(self, name: str, deep: bool = False,
                          repair: bool = True) -> "Dict[tuple, dict]":
         """Run a scrub on every PG of a pool from its primary (the

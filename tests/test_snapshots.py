@@ -1,0 +1,185 @@
+"""Pool snapshots: COW clones on first-write-after-snap, snap reads.
+
+Reference: pool snapshots ('osd pool mksnap') with copy-on-write via
+the same generation-clone machinery the EC rollback path uses
+(ghobject generations; reference doc/dev/osd_internals/erasure_coding).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.objecter import ObjecterError
+from ceph_tpu.qa.cluster import MiniCluster
+from tests.test_mon import fast_config
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3", "m": "2"},
+                     pg_num=4, stripe_unit=64)
+    return c
+
+
+class TestSnapshots:
+    def test_cow_preserves_snap_content(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                v1 = payload(3000, 1)
+                await io.write_full("obj", v1)
+                c.pool_mksnap("p", "s1")
+                v2 = payload(4000, 2)
+                await io.write_full("obj", v2)     # first write: COW
+                await io.write("obj", b"X" * 10, 100)
+                assert await io.read("obj") == \
+                    v2[:100] + b"X" * 10 + v2[110:]
+                assert await io.read("obj", snap="s1") == v1
+        loop.run_until_complete(go())
+
+    def test_unchanged_object_reads_head_at_snap(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                data = payload(1000, 3)
+                await io.write_full("obj", data)
+                c.pool_mksnap("p", "s1")
+                # no writes after the snap: head serves the snap read
+                assert await io.read("obj", snap="s1") == data
+        loop.run_until_complete(go())
+
+    def test_object_born_after_snap_absent_from_it(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                c.pool_mksnap("p", "s1")
+                await io.write_full("newobj", payload(500, 4))
+                assert await io.read("newobj", snap="s1") == b""
+                c.pool_mksnap("p", "s2")
+                assert (await io.read("newobj", snap="s2")
+                        == payload(500, 4))
+        loop.run_until_complete(go())
+
+    def test_multiple_snaps_layer_correctly(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                versions = {}
+                for i, snap in enumerate(["s1", "s2", "s3"]):
+                    data = payload(2000 + i * 100, 10 + i)
+                    await io.write_full("obj", data)
+                    versions[snap] = data
+                    c.pool_mksnap("p", snap)
+                await io.write_full("obj", payload(999, 99))
+                for snap, want in versions.items():
+                    assert await io.read("obj", snap=snap) == want, snap
+                assert await io.read("obj") == payload(999, 99)
+        loop.run_until_complete(go())
+
+    def test_snap_of_deleted_object(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                data = payload(800, 5)
+                await io.write_full("obj", data)
+                c.pool_mksnap("p", "s1")
+                await io.remove("obj")
+                assert await io.read("obj") == b""        # head gone
+                assert await io.read("obj", snap="s1") == data
+        loop.run_until_complete(go())
+
+    def test_snap_clones_survive_shard_rebuild(self, loop):
+        """Recovery rebuilds snapshot clones, not just heads: after a
+        shard is wiped and recovered, snap reads still serve the
+        snapshotted bytes."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                v1 = payload(3000, 21)
+                await io.write_full("obj", v1)
+                c.pool_mksnap("p", "s1")
+                v2 = payload(3500, 22)
+                await io.write_full("obj", v2)   # COW clone everywhere
+                pool = c.osdmap.pool_by_name("p")
+                pg = c.osdmap.object_to_pg(pool.pool_id, "obj")
+                _u, acting = c.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                victim = acting[1]
+                await c.kill_osd(victim)
+                await c.revive_osd(victim)
+                # wipe the revived shard completely (head AND clone)
+                from ceph_tpu.objectstore.transaction import Transaction
+                from ceph_tpu.objectstore.types import (Collection,
+                                                        ObjectId)
+                osd = c.osds[victim]
+                cid = Collection(pool.pool_id, pg, 1)
+                t = Transaction()
+                for o in osd.store.list_objects(cid):
+                    if o.name == "obj":
+                        t.remove(cid, o)
+                osd.store.apply_transaction(t)
+                be = osd.backends.get((pool.pool_id, pg))
+                if be is not None:
+                    be.local_missing["obj"] = be.pg_log.head
+                primary = c.osdmap.primary_of(acting)
+                pbe = c.osds[primary]._get_backend((pool.pool_id, pg))
+                await pbe.recover_object("obj", {1}, exclude={1})
+                # the rebuilt shard serves BOTH head and snap once the
+                # others die
+                for s, o in enumerate(acting):
+                    if o not in (victim, primary) and o != -1 \
+                            and s >= 3:
+                        await c.kill_osd(o)
+                assert await io.read("obj") == v2
+                assert await io.read("obj", snap="s1") == v1
+        loop.run_until_complete(go())
+
+    def test_unknown_snap_errors(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                await io.write_full("obj", b"x")
+                with pytest.raises(ObjecterError):
+                    await io.read("obj", snap="nope")
+        loop.run_until_complete(go())
+
+    def test_mon_mode_mksnap_command(self, loop):
+        async def go():
+            async with MiniCluster(n_osds=5, n_mons=1,
+                                   config=fast_config()) as c:
+                await c.create_ec_pool_cmd(
+                    "p", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=2, stripe_unit=64)
+                admin = await c.client()
+                io = admin.io_ctx("p")
+                v1 = payload(600, 6)
+                await io.write_full("obj", v1)
+                out = await admin.mon_command({
+                    "prefix": "osd pool mksnap", "name": "p",
+                    "snap": "snappy"})
+                assert out.get("snapid", 0) >= 1
+                await admin.monc.wait_for_map()
+                await io.write_full("obj", payload(700, 7))
+                assert await io.read("obj", snap="snappy") == v1
+        loop.run_until_complete(go())
