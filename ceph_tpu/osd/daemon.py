@@ -556,7 +556,10 @@ class OSDDaemon(Dispatcher):
                         await be.ensure_active()
                         pieces = await be.objects_read_at_snap(
                             oid, ext, snapid,
-                            snapids=sorted(pool.snaps.values()))
+                            # probe every id ever allocated: a clone
+                            # created under a since-removed snap may be
+                            # the only copy serving older snaps
+                            snapids=list(range(1, pool.snap_seq + 1)))
                     else:
                         res = await be.objects_read_and_reconstruct(
                             {oid: ext})

@@ -1539,11 +1539,24 @@ class ECBackend:
                      for bo in shard_bufs.values()), default=0)
         if csize == 0:
             return
+        full_size = max(read.sizes.get(oid, {}).values(), default=csize)
         arrs = {s: np.frombuffer(
-            b"".join(bo[o] for o in sorted(bo)).ljust(csize, b"\0"),
-            dtype=np.uint8) for s, bo in shard_bufs.items()}
-        decoded = ecutil.decode(self.sinfo, self.codec, arrs,
-                                sorted(missing_on))
+            b"".join(bo[o] for o in sorted(bo)), dtype=np.uint8)
+            for s, bo in shard_bufs.items()}
+        if 0 < csize < full_size and len(
+                {a.size for a in arrs.values()}) == 1:
+            # helpers served sub-chunk repair planes (clay): pass the
+            # true chunk size through, exactly like head recovery
+            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                    sorted(missing_on),
+                                    chunk_size=full_size)
+        else:
+            arrs = {s: np.frombuffer(
+                b"".join(bo[o] for o in sorted(bo))
+                .ljust(csize, b"\0"), dtype=np.uint8)
+                for s, bo in shard_bufs.items()}
+            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                    sorted(missing_on))
         cid = self.coll(self.my_shard)
         attrs = {}
         try:
@@ -1630,19 +1643,24 @@ class ECBackend:
                 t.omap_setkeys(cid, sid, {
                     k: bytes.fromhex(v)
                     for k, v in msg["omap"].items()})
-        # the push satisfies our missing record for this object
-        self.local_missing.pop(msg["oid"], None)
+        # a HEAD push satisfies our missing record; a snapshot-clone
+        # push must not (the head may still be absent here)
+        if int(msg.get("gen", NO_GEN)) == NO_GEN:
+            self.local_missing.pop(msg["oid"], None)
         self._pg_meta_txn(t, cid)
         self.store.apply_transaction(t)
         return MOSDPGPushReply({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
-            "oid": msg["oid"], "result": 0})
+            "oid": msg["oid"], "gen": int(msg.get("gen", NO_GEN)),
+            "result": 0})
 
     def handle_push_reply(self, msg: MOSDPGPushReply) -> None:
         shard = int(msg["shard"])
-        # shard is no longer missing this object
-        self.peer_missing.get(shard, {}).pop(msg["oid"], None)
+        if int(msg.get("gen", NO_GEN)) == NO_GEN:
+            # shard is no longer missing this object (head pushes only:
+            # clone pushes say nothing about the head)
+            self.peer_missing.get(shard, {}).pop(msg["oid"], None)
         rop = self.recovery_ops.get(msg["oid"])
         if rop is None:
             return

@@ -128,6 +128,40 @@ class Thrasher:
         self._stop.set()
 
 
+def _forensics(cluster: MiniCluster, pool, oid: str) -> str:
+    """Per-shard state dump for a lost object — a rare thrash failure
+    must leave enough evidence to diagnose post-hoc."""
+    try:
+        from ..objectstore.types import Collection, ObjectId
+        from ..osd.ecbackend import ObjectInfo
+        pg = cluster.osdmap.object_to_pg(pool.pool_id, oid)
+        _u, acting = cluster.osdmap.pg_to_up_acting_osds(
+            pool.pool_id, pg)
+        lines = [f"forensics pg={pool.pool_id}.{pg} acting={acting}"]
+        for s, o in enumerate(acting):
+            if o < 0 or o not in cluster.osds:
+                lines.append(f"  shard {s}: HOLE")
+                continue
+            osd = cluster.osds[o]
+            be = osd.backends.get((pool.pool_id, pg))
+            head = be.pg_log.head if be else None
+            missing = oid in (be.local_missing if be else {})
+            try:
+                oi = ObjectInfo.decode(bytes(osd.store.get_attr(
+                    Collection(pool.pool_id, pg, s), ObjectId(oid, s),
+                    "_")))
+                lines.append(f"  shard {s} osd.{o}: oi={oi.size}"
+                             f"@{oi.version} head={head} "
+                             f"missing={missing}")
+            except Exception as e:  # noqa: BLE001
+                lines.append(f"  shard {s} osd.{o}: no object "
+                             f"({type(e).__name__}) head={head} "
+                             f"missing={missing}")
+        return "\n".join(lines)
+    except Exception as e:  # noqa: BLE001 — forensics must never mask
+        return f"(forensics failed: {e})"
+
+
 async def run_thrash(cluster: MiniCluster, pool: str,
                      duration: float = 10.0, seed: int = 0,
                      min_live: int = 3) -> dict:
@@ -154,11 +188,13 @@ async def run_thrash(cluster: MiniCluster, pool: str,
     # the invariant: every acked write is readable byte-equal
     client = await cluster.client()
     io = client.io_ctx(pool)
+    pool_obj = cluster.osdmap.pool_by_name(pool)
     for oid, want in sorted(wl.committed.items()):
         got = await io.read(oid)
         assert got == want, \
             (f"DATA LOSS after thrash: {oid}: {len(got)} bytes vs "
-             f"{len(want)} committed (acked={wl.acked} kills={th.kills})")
+             f"{len(want)} committed (acked={wl.acked} kills={th.kills})\n"
+             + _forensics(cluster, pool_obj, oid))
     # unknown-outcome objects: content unassertable, but reads must
     # complete cleanly (data or a clean error — never hang or garbage)
     for oid in sorted(wl.dropped - set(wl.committed)):

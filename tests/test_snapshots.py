@@ -164,6 +164,22 @@ class TestSnapshots:
                     await io.read("obj", snap="nope")
         loop.run_until_complete(go())
 
+    def test_rmsnap_of_newer_snap_keeps_older_readable(self, loop):
+        """A clone stored under a since-removed snapid may be the only
+        copy serving an OLDER snap — rmsnap must not orphan it."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                io = client.io_ctx("p")
+                v1 = payload(1200, 31)
+                await io.write_full("obj", v1)
+                c.pool_mksnap("p", "s1")
+                c.pool_mksnap("p", "s2")
+                await io.write_full("obj", payload(1300, 32))  # COW @s2
+                c.pool_rmsnap("p", "s2")
+                assert await io.read("obj", snap="s1") == v1
+        loop.run_until_complete(go())
+
     def test_mon_mode_mksnap_command(self, loop):
         async def go():
             async with MiniCluster(n_osds=5, n_mons=1,
