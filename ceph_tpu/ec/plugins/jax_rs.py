@@ -40,7 +40,8 @@ from ..interface import ChunkMap, ErasureCodeError, Profile
 __erasure_code_version__ = "1"
 
 TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy", "cauchy_orig",
-              "cauchy_good", "liberation", "blaum_roth", "liber8tion", "xor")
+              "cauchy_good", "cauchy_tpu", "liberation", "blaum_roth",
+              "liber8tion", "xor")
 
 # Below this many bytes per stripe the host SWAR/native path beats a device
 # round trip; dispatch overhead is ~20-30 us.
@@ -63,6 +64,11 @@ def _coding_matrix(k: int, m: int, technique: str) -> np.ndarray:
         return gf8.vandermonde_matrix(k, 2)
     if technique in ("cauchy", "cauchy_orig", "cauchy_good"):
         return gf8.cauchy_matrix(k, m)
+    if technique == "cauchy_tpu":
+        # XOR-minimized MDS (gf8.xor_min_matrix) — the flagship device
+        # technique; the cauchy_good-style schedule optimization done as
+        # matrix search (see ROOFLINE.md)
+        return gf8.xor_min_matrix(k, m)
     if technique == "xor":
         if m != 1:
             raise ErasureCodeError("xor requires m=1")

@@ -25,12 +25,39 @@ from ..ops import gf8, gf_jax
 @functools.lru_cache(maxsize=32)
 def make_encode_step(k: int, m: int, technique: str = "reed_sol_van",
                      crc_seg_words: int = 1024):
-    """Build the jittable fused encode+crc step for a (k, m) geometry."""
+    """Build the jittable fused encode+crc step for a (k, m) geometry.
+
+    On TPU with supported geometry this dispatches to the single-kernel
+    fused Pallas path (ops/fused_pallas.py: encode + all k+m crcs in one
+    HBM pass, ~2.6x the split path); otherwise it composes the XLA SWAR
+    encode with the batched crc kernel.
+    """
+    from ..ops import fused_pallas
+
     C = gf8.generator_matrix(k, m, technique)[k:]
 
-    @jax.jit
     def step(data_u32: jax.Array):
-        """(B, k, W) uint32 -> ((B, m, W) parity, (B, k+m) crcs)."""
+        """(B, k, W) or segmented (B, k, S, 512) uint32 ->
+        (parity (input rank), (B, k+m) crcs).
+
+        Prefer the segmented 4-D layout on TPU: it is the fused
+        kernel's native layout (a traced 3-D reshape costs a relayout).
+        """
+        W = (data_u32.shape[-2] * data_u32.shape[-1]
+             if data_u32.ndim == 4 else data_u32.shape[-1])
+        fused_ok = fused_pallas.supported(k, m, W) and (
+            data_u32.ndim != 4 or data_u32.shape[-1] == fused_pallas.SEG_W)
+        if fused_ok:
+            return fused_pallas.fused_encode_crc(data_u32, k, m,
+                                                 technique=technique)
+        if data_u32.ndim == 4:
+            B, _, S, sw = data_u32.shape
+            parity, crcs = _split_step(data_u32.reshape(B, k, W))
+            return parity.reshape(B, m, S, sw), crcs
+        return _split_step(data_u32)
+
+    @jax.jit
+    def _split_step(data_u32: jax.Array):
         parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(data_u32)
         B, _, W = data_u32.shape
         # non-dividing widths: crc32c_words_jax picks a sane
@@ -69,8 +96,16 @@ def make_decode_step(k: int, m: int, rows: "tuple[int, ...]",
 
 
 def example_batch(B: int = 8, k: int = 8, chunk_bytes: int = 128 * 1024,
-                  seed: int = 0) -> np.ndarray:
-    """Deterministic example input for compile checks and benchmarks."""
+                  seed: int = 0, segmented: bool = False) -> np.ndarray:
+    """Deterministic example input for compile checks and benchmarks.
+
+    ``segmented=True`` returns the (B, k, S, 512) device-native layout
+    (free host-side view; avoids the on-device relayout — see
+    fused_pallas.fused_encode_crc).
+    """
     rng = np.random.default_rng(seed)
-    return rng.integers(0, 2 ** 32, size=(B, k, chunk_bytes // 4),
-                        dtype=np.uint32)
+    out = rng.integers(0, 2 ** 32, size=(B, k, chunk_bytes // 4),
+                       dtype=np.uint32)
+    if segmented:
+        return out.reshape(B, k, chunk_bytes // 4 // 512, 512)
+    return out

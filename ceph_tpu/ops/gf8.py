@@ -205,6 +205,81 @@ def cauchy_matrix(k: int, m: int) -> np.ndarray:
     return C
 
 
+def _swar_col_cost(col: "tuple[int, ...]") -> int:
+    """VPU op estimate of encoding one input chunk against column ``col``
+    with the shared-doubling-chain SWAR formulation (gf_jax.gf_mat_encode_u32):
+    ~6 ops per doubling + 1 XOR per set coefficient bit."""
+    max_bit = max(int(c).bit_length() for c in col)
+    return 6 * max(0, max_bit - 1) + sum(bin(c).count("1") for c in col)
+
+
+def _is_mds_with_new_col(cols: "list[tuple[int, ...]]",
+                         new: "tuple[int, ...]") -> bool:
+    """Check every square minor touching ``new`` stays nonsingular when it
+    joins ``cols`` (systematic [I; C] is MDS iff ALL square submatrices of C
+    are nonsingular)."""
+    import itertools
+    m = len(new)
+    all_cols = cols + [new]
+    j_new = len(all_cols) - 1
+    for size in range(1, m + 1):
+        for rows in itertools.combinations(range(m), size):
+            for js in itertools.combinations(range(len(all_cols)), size):
+                if j_new not in js:
+                    continue  # minors without the new column already checked
+                sub = np.array([[all_cols[j][r] for j in js] for r in rows],
+                               dtype=np.uint8)
+                try:
+                    gf_matrix_invert(sub)
+                except ValueError:
+                    return False
+    return True
+
+
+@functools.lru_cache(maxsize=32)
+def xor_min_matrix(k: int, m: int, limit: int = 32) -> np.ndarray:
+    """Search an MDS (m, k) coding matrix minimizing SWAR encode cost.
+
+    The TPU analog of jerasure's ``cauchy_good`` XOR-schedule optimization
+    (reference src/erasure-code/jerasure/ErasureCodeJerasure.h:183: same
+    code family, matrix chosen to minimize XOR work): row 0 is all-ones
+    (plain XOR parity, zero doublings) and remaining entries are chosen
+    greedily from low-bit-length values subject to the full MDS minor
+    check.  Any such matrix yields identical durability semantics — any k
+    of k+m chunks reconstruct — while the short doubling chains cut the
+    VPU cost of the flagship fused encode kernel ~3x vs reed_sol_van.
+    """
+    if m == 1:
+        return np.ones((1, k), dtype=np.uint8)
+    # Lazy cost-ordered candidate stream (heap): only the cheapest few
+    # dozen columns are ever consumed, so never materialize the full
+    # limit**(m-1) product (which is minutes of init work for m >= 5).
+    import heapq
+    import itertools
+    start = (1,) * (m - 1)
+    heap = [(_swar_col_cost((1,) + start), start)]
+    seen = {start}
+
+    def _next_cands(rest):
+        for i in range(m - 1):
+            nxt = rest[:i] + (rest[i] + 1,) + rest[i + 1:]
+            if nxt[i] < limit and nxt not in seen:
+                seen.add(nxt)
+                yield nxt
+
+    cols: "list[tuple[int, ...]]" = []
+    while heap and len(cols) < k:
+        _, rest = heapq.heappop(heap)
+        for nxt in _next_cands(rest):
+            heapq.heappush(heap, (_swar_col_cost((1,) + nxt), nxt))
+        col = (1,) + rest
+        if _is_mds_with_new_col(cols, col):
+            cols.append(col)
+    if len(cols) < k:
+        raise ValueError(f"no MDS matrix found for k={k} m={m} limit={limit}")
+    return np.array(cols, dtype=np.uint8).T.copy()
+
+
 def generator_matrix(k: int, m: int, technique: str = "reed_sol_van") -> np.ndarray:
     """Full systematic generator [I_k; C], shape (k+m, k)."""
     if technique in ("reed_sol_van", "vandermonde", "reed_sol_r6_op", "liberation",
@@ -212,6 +287,8 @@ def generator_matrix(k: int, m: int, technique: str = "reed_sol_van") -> np.ndar
         C = vandermonde_matrix(k, m)
     elif technique in ("cauchy_good", "cauchy_orig", "cauchy"):
         C = cauchy_matrix(k, m)
+    elif technique == "cauchy_tpu":
+        C = xor_min_matrix(k, m)
     elif technique == "xor":
         if m != 1:
             raise ValueError("xor technique requires m=1")

@@ -1,0 +1,119 @@
+"""Fused encode+crc kernel: host-golden correctness + dispatch gating.
+
+The Pallas kernel itself only runs on real TPU (pltpu.bitcast and the
+int8 MXU path have no interpret-mode support), so the bit-exactness
+tests are TPU-gated; what always runs is the host-side constant algebra
+(operator chains, combine matrices), the cauchy_tpu matrix properties,
+and the make_encode_step fallback dispatch the CPU suite relies on.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import crc32c as crc_ops
+from ceph_tpu.ops import fused_pallas, gf8
+
+
+def _on_tpu() -> bool:
+    return fused_pallas._on_tpu()
+
+
+class TestCauchyTpuMatrix:
+    def test_mds_exhaustive_k8m3(self):
+        G = gf8.generator_matrix(8, 3, "cauchy_tpu")
+        for er in itertools.combinations(range(11), 3):
+            rows = [r for r in range(11) if r not in er][:8]
+            gf8.decode_matrix(G, 8, rows)  # raises if singular
+
+    def test_cheaper_than_vandermonde(self):
+        C = gf8.xor_min_matrix(8, 3)
+        V = gf8.vandermonde_matrix(8, 3)
+        cost = lambda M: sum(gf8._swar_col_cost(tuple(int(v) for v in M[:, j]))
+                             for j in range(M.shape[1]))
+        assert cost(C) < cost(V) / 2
+        assert (C[0] == 1).all()  # XOR-parity first row
+
+    def test_round_trip_host(self):
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=(8, 1024), dtype=np.uint8)
+        full = gf8.encode_stripe(data, 8, 3, technique="cauchy_tpu")
+        for er in ((1, 9), (0, 1, 2)):
+            chunks = {i: full[i] for i in range(11) if i not in er}
+            dec = gf8.decode_stripe(chunks, 8, 3, technique="cauchy_tpu")
+            assert np.array_equal(dec, data)
+
+
+class TestOperatorAlgebra:
+    def test_op_chain_matches_shift_operator(self):
+        ops = fused_pallas._op_chain(1, 4, 8)
+        for i in range(8):
+            assert np.array_equal(ops[i], crc_ops.shift_operator(1 + 4 * i))
+
+    def test_regs_table(self):
+        op = crc_ops.shift_operator(7)
+        tbl = fused_pallas._regs_for_bytes(op)
+        for v in (0, 1, 0x80, 0xA5):
+            reg = crc_ops._matvec(op, v)
+            bits = (reg >> np.arange(32)) & 1
+            assert np.array_equal(tbl[v], bits)
+
+
+class TestDispatch:
+    def test_supported_gating(self):
+        if not _on_tpu():
+            assert not fused_pallas.supported(8, 3, 32768)
+        # 4-map trick bounds
+        assert not fused_pallas.supported(8, 4, 32768) or 32 * 5 <= 128
+        assert not fused_pallas.supported(8, 3, 100)  # not segment-aligned
+
+    def test_make_encode_step_fallback(self):
+        # off-TPU this exercises the split path on both ranks
+        import jax
+        from ceph_tpu.models import make_encode_step
+        step = make_encode_step(4, 2, technique="cauchy_tpu")
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 2 ** 32, size=(2, 4, 1024), dtype=np.uint32)
+        p3, c3 = step(jax.device_put(data))
+        p4, c4 = step(jax.device_put(data.reshape(2, 4, 2, 512)))
+        assert np.array_equal(np.asarray(p3),
+                              np.asarray(p4).reshape(2, 2, 1024))
+        assert np.array_equal(np.asarray(c3), np.asarray(c4))
+        C = gf8.generator_matrix(4, 2, "cauchy_tpu")[4:]
+        for b in range(2):
+            exp = gf8.gf_mat_encode(
+                C, data[b].view(np.uint8).reshape(4, 4096))
+            assert np.array_equal(
+                np.asarray(p3)[b].view(np.uint8).reshape(2, 4096), exp)
+            for j in range(4):
+                assert int(np.asarray(c3)[b, j]) == crc_ops.crc32c(
+                    data[b, j].tobytes())
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="fused kernel requires TPU")
+class TestFusedOnTpu:
+    @pytest.mark.parametrize("B,k,m,W,tech", [
+        (2, 8, 3, 32768, "cauchy_tpu"),
+        (2, 8, 3, 16384, "reed_sol_van"),
+        (1, 4, 2, 8192, "cauchy_tpu"),
+        (1, 6, 1, 512, "xor"),
+    ])
+    def test_bit_exact(self, B, k, m, W, tech):
+        import jax
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 2 ** 32, size=(B, k, W), dtype=np.uint32)
+        par, crcs = fused_pallas.fused_encode_crc(
+            jax.device_put(data), k, m, technique=tech)
+        par = np.asarray(par)
+        crcs = np.asarray(crcs)
+        C = gf8.generator_matrix(k, m, tech)[k:]
+        for b in range(B):
+            exp = gf8.gf_mat_encode(C, data[b].view(np.uint8).reshape(k, W * 4))
+            assert np.array_equal(par[b].view(np.uint8).reshape(m, W * 4), exp)
+            for j in range(k):
+                assert int(crcs[b, j]) == crc_ops.crc32c(data[b, j].tobytes())
+            for i in range(m):
+                assert int(crcs[b, k + i]) == crc_ops.crc32c(par[b, i].tobytes())
