@@ -80,14 +80,31 @@ def _coding_matrix(k: int, m: int, technique: str) -> np.ndarray:
 
 @functools.lru_cache(maxsize=128)
 def _device_encode_step(c_bytes: bytes, m: int, k: int, with_crc: bool):
-    """Cached jitted fused encode(+crc) step for a fixed coding matrix."""
+    """Cached jitted fused encode(+crc) step for a fixed coding matrix.
+
+    On TPU with a supported geometry the with_crc path runs the
+    single-kernel fused Pallas step (ops/fused_pallas.py) — the SAME
+    path bench.py measures — so the OSD's EncodeService launches the
+    fused kernel in production, not just in the benchmark.
+    """
     import jax
     import jax.numpy as jnp
 
     C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
 
-    @jax.jit
     def run(d):
+        from ...ops import fused_pallas
+        if (with_crc and d.ndim == 4 and fused_pallas.supported_matrix(
+                m, d.shape[-2] * d.shape[-1])):
+            return fused_pallas.fused_encode_crc_matrix(C, d)
+        if d.ndim == 4:            # segmented layout, fused unsupported
+            B, k_, S, sw = d.shape
+            parity, crcs = _split(d.reshape(B, k_, S * sw))
+            return parity.reshape(B, m, S, sw), crcs
+        return _split(d)
+
+    @jax.jit
+    def _split(d):
         if d.ndim == 2:
             parity = gf_jax.gf_mat_encode_u32(C, d)
         else:

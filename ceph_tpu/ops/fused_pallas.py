@@ -157,25 +157,9 @@ def _m2_matrix(n_blk: int, blk_segs: int, seg_w: int,
 
 
 def _emit_encode(C: np.ndarray, d_rows):
-    """SWAR GF matmul on uint32 tiles; same math as gf_jax.gf_mat_encode_u32."""
-    import jax.numpy as jnp
-    from .gf_jax import gf_double_u32
-
-    m, k = C.shape
-    acc: list = [None] * m
-    for j in range(k):
-        col = C[:, j]
-        if not col.any():
-            continue
-        xp = d_rows[j]
-        max_bit = max(int(c).bit_length() for c in col)
-        for b in range(max_bit):
-            for i in range(m):
-                if (int(col[i]) >> b) & 1:
-                    acc[i] = xp if acc[i] is None else acc[i] ^ xp
-            if b + 1 < max_bit:
-                xp = gf_double_u32(xp)
-    return [a if a is not None else jnp.zeros_like(d_rows[0]) for a in acc]
+    """SWAR GF matmul on uint32 tiles (single emission point: gf_jax)."""
+    from .gf_jax import gf_encode_rows
+    return gf_encode_rows(C, d_rows)
 
 
 @functools.lru_cache(maxsize=16)
@@ -263,9 +247,8 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int):
     return run
 
 
-def fused_encode_crc(data_u32, k: int, m: int,
-                     technique: str = "cauchy_tpu"):
-    """Fused encode + crc32c of all k+m chunks on TPU.
+def fused_encode_crc_matrix(C: np.ndarray, data_u32):
+    """Fused encode + crc32c for an explicit (m, k) coding matrix.
 
     data_u32: (B, k, W) or segmented (B, k, W//SEG_W, SEG_W) uint32.
     Returns (parity (same rank as input), crcs (B, k+m) uint32); crcs
@@ -276,9 +259,11 @@ def fused_encode_crc(data_u32, k: int, m: int,
     whole step (measured v5e; tiled layouts differ).  Host-side numpy
     reshapes to 4-D are free.
 
-    Requires ``supported(k, m, W)``; callers fall back to the split
+    Requires ``supported_matrix(m, W)``; callers fall back to the split
     encode/crc path otherwise.
     """
+    C = np.ascontiguousarray(C, dtype=np.uint8)
+    m, k = C.shape
     seg4 = data_u32.ndim == 4
     if seg4:
         B, k_, S, sw = data_u32.shape
@@ -291,12 +276,22 @@ def fused_encode_crc(data_u32, k: int, m: int,
         B, k_, W = data_u32.shape
         d4 = data_u32.reshape(B, k, W // SEG_W, SEG_W)
     assert k_ == k
-    C = np.ascontiguousarray(gf8.generator_matrix(k, m, technique)[k:])
     run = _build_fused(C.tobytes(), m, k, W)
     parity4, crcs = run(d4)
     return (parity4 if seg4 else parity4.reshape(B, m, W)), crcs
 
 
-def supported(k: int, m: int, W: int) -> bool:
+def fused_encode_crc(data_u32, k: int, m: int,
+                     technique: str = "cauchy_tpu"):
+    """fused_encode_crc_matrix with the matrix derived from a technique."""
+    C = gf8.generator_matrix(k, m, technique)[k:]
+    return fused_encode_crc_matrix(C, data_u32)
+
+
+def supported_matrix(m: int, W: int) -> bool:
     """m <= 3 (4-map trick needs 32*(1+m) <= 128 lanes), whole segments."""
     return (_on_tpu() and 1 <= m <= 3 and W % SEG_W == 0 and W >= SEG_W)
+
+
+def supported(k: int, m: int, W: int) -> bool:
+    return supported_matrix(m, W)

@@ -59,22 +59,22 @@ def gf_double_u32(x: jax.Array) -> jax.Array:
     return ((x << 1) & _MASK_FE) ^ (msb * np.uint32(gf8.POLY_LOW))
 
 
-def gf_mat_encode_u32(C: np.ndarray, data_u32: jax.Array) -> jax.Array:
-    """Static-matrix GF matmul on packed uint32 data.
+def gf_encode_rows(C: np.ndarray, rows: "list[jax.Array]") -> "list[jax.Array]":
+    """Shared-doubling-chain SWAR GF matmul over a list of uint32 tiles.
 
-    C: concrete numpy (m, k) uint8 — baked into the trace.
-    data_u32: (k, W) uint32 -> (m, W) uint32.
+    The single emission point for the formulation (also used inside the
+    fused Pallas kernel, ops/fused_pallas.py): returns the m parity
+    tiles for the k input tiles of any matching shape.
     """
     C = np.asarray(C, dtype=np.uint8)
     m, k = C.shape
-    assert data_u32.shape[0] == k, (C.shape, data_u32.shape)
-    W = data_u32.shape[-1]
+    assert len(rows) == k, (C.shape, len(rows))
     acc: list = [None] * m
     for j in range(k):
         col = C[:, j]
         if not col.any():
             continue
-        xp = data_u32[j]
+        xp = rows[j]
         max_bit = max(int(c).bit_length() for c in col)
         for b in range(max_bit):
             for i in range(m):
@@ -82,8 +82,19 @@ def gf_mat_encode_u32(C: np.ndarray, data_u32: jax.Array) -> jax.Array:
                     acc[i] = xp if acc[i] is None else acc[i] ^ xp
             if b + 1 < max_bit:
                 xp = gf_double_u32(xp)
-    zeros = jnp.zeros((W,), dtype=jnp.uint32)
-    return jnp.stack([a if a is not None else zeros for a in acc])
+    return [a if a is not None else jnp.zeros_like(rows[0]) for a in acc]
+
+
+def gf_mat_encode_u32(C: np.ndarray, data_u32: jax.Array) -> jax.Array:
+    """Static-matrix GF matmul on packed uint32 data.
+
+    C: concrete numpy (m, k) uint8 — baked into the trace.
+    data_u32: (k, W) uint32 -> (m, W) uint32.
+    """
+    C = np.asarray(C, dtype=np.uint8)
+    k = C.shape[1]
+    assert data_u32.shape[0] == k, (C.shape, data_u32.shape)
+    return jnp.stack(gf_encode_rows(C, [data_u32[j] for j in range(k)]))
 
 
 def gf_mat_encode(C: np.ndarray, data: jax.Array) -> jax.Array:

@@ -1802,7 +1802,30 @@ class ECBackend:
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
         for e in div:
+            # NEVER roll back an entry this shard never APPLIED: a shard
+            # that adopted the auth log without receiving the data
+            # (handle_pg_log recorded the object missing at >= this
+            # version) still holds its OLDER copy on disk — the rollback
+            # payload would misread the absent generation clone as
+            # "entry created the object" and REMOVE that older copy (or,
+            # for appends, truncate it and stamp a wrong ObjectInfo),
+            # destroying acked data the cluster may still need
+            # (reference: PGLog::_merge_divergent_entries consults the
+            # missing set for exactly this reason, src/osd/PGLog.h).
+            miss = self.local_missing.get(e.oid)
+            if miss is not None and miss >= e.version:
+                continue
             self._rollback_entry(t, cid, shard, e)
+        # missing records that pointed past the new head now name a
+        # version that no longer exists; retarget to the newest surviving
+        # entry for the object (or the new head as a conservative marker
+        # — recovery re-pushes, which is safe; claiming "not missing"
+        # when the on-disk copy is stale would not be)
+        for oid, v in list(self.local_missing.items()):
+            if v > to:
+                newer = [e.version for e in self.pg_log.entries
+                         if e.oid == oid]
+                self.local_missing[oid] = max(newer) if newer else to
         self._pg_meta_txn(t, cid)
         self.store.apply_transaction(t)
 

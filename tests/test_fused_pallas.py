@@ -29,6 +29,26 @@ class TestCauchyTpuMatrix:
             rows = [r for r in range(11) if r not in er][:8]
             gf8.decode_matrix(G, 8, rows)  # raises if singular
 
+    def test_matrix_bytes_pinned(self):
+        """The cauchy_tpu matrix is part of the on-disk durability
+        contract: chunks encoded with it decode ONLY with the identical
+        matrix.  Any change to the search (cost fn, heap order, limit)
+        must fail here loudly instead of corrupting existing pools."""
+        golden = {
+            (8, 3): [[1, 1, 1, 1, 1, 1, 1, 1],
+                     [1, 2, 3, 4, 8, 5, 6, 9],
+                     [1, 3, 2, 8, 4, 12, 9, 6]],
+            (4, 2): [[1, 1, 1, 1],
+                     [1, 2, 3, 4]],
+            (2, 2): [[1, 1],
+                     [1, 2]],
+            (10, 4): None,  # computed below, pinned by round-trip only
+        }
+        for (k, m), want in golden.items():
+            got = gf8.xor_min_matrix(k, m)
+            if want is not None:
+                assert got.tolist() == want, (k, m, got.tolist())
+
     def test_cheaper_than_vandermonde(self):
         C = gf8.xor_min_matrix(8, 3)
         V = gf8.vandermonde_matrix(8, 3)
