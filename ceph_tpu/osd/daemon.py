@@ -70,8 +70,14 @@ class OSDDaemon(Dispatcher):
                  store: "Optional[ObjectStore]" = None,
                  config: "Optional[Config]" = None,
                  mon_addrs: "Optional[Dict[int, str]]" = None,
-                 addr: str = "", mgr_addr: str = "") -> None:
+                 addr: str = "", mgr_addr: str = "",
+                 mesh_plane=None) -> None:
         self.whoami = osd_id
+        # device-mesh data plane shared by co-hosted OSDs (None = the
+        # messenger carries all chunk bytes, the reference behavior)
+        self.mesh_plane = mesh_plane
+        if mesh_plane is not None:
+            mesh_plane.register(osd_id)
         self.store = store or MemStore()
         self.config = config or Config()
         self.ms = Messenger.create(f"osd.{osd_id}", self.config)
@@ -257,7 +263,9 @@ class OSDDaemon(Dispatcher):
                        self._send_to_osd, lambda p=pgid: self._acting(p),
                        min_size=pool.min_size,
                        encode_service=self.encode_service,
-                       scheduler=self.op_scheduler, config=self.config)
+                       scheduler=self.op_scheduler, config=self.config,
+                       mesh_plane=self.mesh_plane,
+                       device_mesh=getattr(pool, "device_mesh", False))
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be

@@ -123,6 +123,7 @@ class Op:
     pending_commits: "Set[int]" = field(default_factory=set)
     failed_shards: "Set[int]" = field(default_factory=set)
     acting: "List[int]" = field(default_factory=list)   # at issue time
+    mesh_handles: "List[int]" = field(default_factory=list)
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
 
 
@@ -187,7 +188,8 @@ class ECBackend:
                  get_acting: "Callable[[], List[int]]",
                  min_size: "Optional[int]" = None,
                  encode_service=None, scheduler=None,
-                 config=None) -> None:
+                 config=None, mesh_plane=None,
+                 device_mesh: bool = False) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -205,6 +207,13 @@ class ECBackend:
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
         self.config = config
+        # device-mesh collective data plane (pool flag device_mesh):
+        # sub-write encode/fan-out + recovery decode ride XLA collectives
+        # over a (pg, shard) mesh; the messenger carries only metadata
+        # for shard servers sharing the plane (parallel/plane.py,
+        # reference seam src/osd/ECBackend.cc:2074-2084, :2345)
+        self.mesh_plane = mesh_plane
+        self.device_mesh = bool(device_mesh)
         # newest pool snapid (daemon refreshes per op): a mutation of an
         # object whose oi.snap_seq is older clones it first (COW)
         self.pool_snap_seq = 0
@@ -351,6 +360,12 @@ class ECBackend:
             if o != NONE_OSD:
                 return o == self.whoami
         return False
+
+    def _mesh_usable(self) -> bool:
+        """Pool opted in, a plane is attached, and the codec's shard
+        ring fits the device mesh with an identity chunk mapping."""
+        return (self.device_mesh and self.mesh_plane is not None
+                and self.mesh_plane.usable_for(self.codec))
 
     async def ensure_active(self) -> None:
         """Gate client I/O on the PG being peered for the CURRENT acting
@@ -620,6 +635,7 @@ class ECBackend:
             await self._check_ops()
 
     def _fail_op(self, op: Op, err: Exception) -> None:
+        self._release_mesh_handles(op)
         for q in (self.waiting_state, self.waiting_reads,
                   self.waiting_commit):
             if op in q:
@@ -724,8 +740,47 @@ class ECBackend:
                                      "rollback": rollback}
                 if snap_clone:
                     shard_txns[shard]["snap_clone"] = snap_clone
+            use_mesh = self._mesh_usable()
             for off, buf in sorted(stripes.items()):
                 crcs = None
+                if use_mesh:
+                    # device-mesh plane: ring-encode + per-shard crc as
+                    # XLA collectives; chunk bytes stay on the sharded
+                    # device array, the sub-write message carries only a
+                    # handle for plane-sharing shard servers (reference
+                    # fan-out seam ECBackend.cc:2074-2084)
+                    arr8 = (np.frombuffer(bytes(buf), np.uint8)
+                            if not isinstance(buf, np.ndarray)
+                            else buf.reshape(-1))
+                    shards_k = self.sinfo.split_to_shards(arr8)
+                    # off-loop: the crc fetch inside encode() blocks on
+                    # the device; other PG pipelines keep running
+                    handle, crcs_b = await asyncio.get_event_loop() \
+                        .run_in_executor(None, self.mesh_plane.encode,
+                                         self.codec, shards_k[None])
+                    op.mesh_handles.append(handle)
+                    chunk_off = self.sinfo \
+                        .aligned_logical_offset_to_chunk_offset(off)
+                    Wb = int(shards_k.shape[1])
+                    if is_append:
+                        hinfo.append_crcs(chunk_off, crcs_b[0], Wb)
+                    else:
+                        hinfo.invalidate()
+                    for shard in range(self.k + self.m):
+                        tgt = (acting[shard] if shard < len(acting)
+                               else NONE_OSD)
+                        if tgt != NONE_OSD and self.mesh_plane.shares(tgt):
+                            shard_txns[shard].setdefault(
+                                "mesh_writes", []).append(
+                                [chunk_off, handle, 0, Wb])
+                        else:
+                            # cross-host (or hole): inline bytes ride the
+                            # messenger exactly as before
+                            shard_txns[shard]["writes"].append(
+                                (chunk_off,
+                                 self.mesh_plane.take(handle, 0, shard)))
+                    self.extent_cache.present_rmw_update(op.oid, off, buf)
+                    continue
                 if self.encode_service is not None:
                     # daemon-wide batched device encode: this op's stripes
                     # ride one (B, k, W) launch with every other PG's
@@ -845,6 +900,13 @@ class ECBackend:
             try:
                 reply = self.handle_sub_write(msg)
                 if not reply.get("committed", True):
+                    if reply.get("missing"):
+                        op.failed_shards.add(shard)
+                        op.pending_commits.discard(shard)
+                        self.peer_missing.setdefault(shard, {})[op.oid] \
+                            = op.version
+                        self.local_missing[op.oid] = op.version
+                        continue
                     self._fail_op(op, ECError(
                         f"write {op.oid}: local shard {shard} rejected "
                         f"stale interval"))
@@ -900,9 +962,16 @@ class ECBackend:
                 continue
             self._try_finish_rmw(op)
 
+    def _release_mesh_handles(self, op: Op) -> None:
+        if self.mesh_plane is not None:
+            for h in op.mesh_handles:
+                self.mesh_plane.release(h)
+        op.mesh_handles = []
+
     def _try_finish_rmw(self, op: Op) -> None:
         """Head op fully durable (reference try_finish_rmw
         ECBackend.cc:2103): advance the roll-forward point and complete."""
+        self._release_mesh_handles(op)
         self.pg_log.roll_forward_to(op.version)
         if op in self.waiting_commit:
             self.waiting_commit.remove(op)
@@ -921,6 +990,17 @@ class ECBackend:
         if op is None:
             return
         if not msg.get("committed", True):
+            if msg.get("missing"):
+                # shard couldn't fetch its mesh payload (evicted
+                # handle): same contract as a dropped send — record
+                # missing, let the durable count decide the ack
+                shard = int(msg["shard"])
+                op.failed_shards.add(shard)
+                op.pending_commits.discard(shard)
+                self.peer_missing.setdefault(shard, {})[op.oid] = \
+                    op.version
+                self._check_commit_queue()
+                return
             # shard rejected us as a deposed primary: never ack this op;
             # the client will retry against the current primary
             self._fail_op(op, ECError(
@@ -977,6 +1057,26 @@ class ECBackend:
             t.touch(cid, sid)
             for i, (choff, _dlen) in enumerate(txn.get("writes", [])):
                 t.write(cid, sid, int(choff), bufs[i])
+            for mw in txn.get("mesh_writes", []):
+                # chunk bytes come off the shared device-mesh plane (our
+                # position's slice is device-local); an evicted handle
+                # degrades to the dropped-payload contract: reply
+                # missing=True, the primary records the object missing
+                # on this shard and the durable count decides the ack
+                choff, h, idx, ln = (int(x) for x in mw)
+                try:
+                    if self.mesh_plane is None:
+                        raise KeyError("no mesh plane attached")
+                    data = self.mesh_plane.take(h, idx, shard)
+                except KeyError:
+                    dout("osd", 1, f"mesh handle {h} gone on shard "
+                                   f"{shard}: degrading to missing")
+                    return MECSubOpWriteReply({
+                        "pgid": list(self.pgid), "shard": shard,
+                        "from_osd": self.whoami, "tid": int(msg["tid"]),
+                        "committed": False, "applied": False,
+                        "missing": True, "error": "mesh handle evicted"})
+                t.write(cid, sid, choff, data[:ln])
             if "truncate" in txn:
                 t.truncate(cid, sid, int(txn["truncate"]))
             if txn.get("oi"):
@@ -1493,8 +1593,18 @@ class ECBackend:
                 buf = b"".join(by_off[o] for o in sorted(by_off))
                 arrs[shard] = np.frombuffer(buf.ljust(csize, b"\0"),
                                             dtype=np.uint8)
-            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
-                                    sorted(rop.missing_on))
+            if (self._mesh_usable() and csize % 4 == 0
+                    and len(arrs) >= self.k):
+                # recovery decode on the mesh: all-gather survivors
+                # along the shard ring + per-position decode matrix,
+                # absent positions poisoned first (parallel/plane.py;
+                # reference seam objects_read_and_reconstruct
+                # ECBackend.cc:2345)
+                decoded = self.mesh_plane.reconstruct(
+                    self.codec, arrs, sorted(rop.missing_on))
+            else:
+                decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                        sorted(rop.missing_on))
         rop.recovered = {s: bytes(a.tobytes()) for s, a in decoded.items()}
         rop.attrs = read.attrs.get(oid, {})
         rop.omap = read.omap.get(oid, {})

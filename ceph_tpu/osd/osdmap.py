@@ -39,6 +39,10 @@ class Pool:
     ec_profile: str = ""          # name into OSDMap.ec_profiles
     stripe_unit: int = 4096       # EC chunk granularity
     fast_read: bool = False
+    # run the sub-write fan-out / recovery decode over the device-mesh
+    # collective plane when the shard ring fits the attached devices
+    # (parallel/plane.py); host messenger still carries metadata
+    device_mesh: bool = False
     snap_seq: int = 0             # newest pool snapid (0 = no snaps)
     snaps: "dict" = None          # snap name -> snapid
 
@@ -57,6 +61,7 @@ class Pool:
         d = dict(d)
         d.setdefault("snap_seq", 0)
         d.setdefault("snaps", {})
+        d.setdefault("device_mesh", False)
         return cls(**d)
 
 

@@ -1,3 +1,4 @@
 """Device-mesh parallelism: sharded EC pipelines over (pg, shard) meshes."""
 
 from .distributed import DistributedEC, default_geometry, make_mesh  # noqa: F401
+from .plane import MeshDataPlane  # noqa: F401

@@ -76,12 +76,17 @@ class DistributedEC:
     """Sharded EC write/read pipeline over a (pg, shard) mesh."""
 
     def __init__(self, mesh: Mesh, k: int, m: int,
-                 technique: str = "reed_sol_van"):
+                 technique: str = "reed_sol_van",
+                 generator: "np.ndarray | None" = None):
         s = mesh.shape["shard"]
         if s != k + m:
             raise ValueError(f"shard axis {s} != k+m={k + m}")
         self.mesh, self.k, self.m, self.technique = mesh, k, m, technique
-        self._G = gf8.generator_matrix(k, m, technique)
+        # explicit generator (e.g. a codec's own matrix, MeshDataPlane)
+        # wins over the technique name
+        self._G = (np.ascontiguousarray(generator, dtype=np.uint8)
+                   if generator is not None
+                   else gf8.generator_matrix(k, m, technique))
 
     # --- write: encode + per-shard crc --------------------------------------
 

@@ -36,6 +36,11 @@ class MiniCluster:
             self.config.set("ms_type", "async+local")
         self.n_osds = n_osds
         self.with_mgr = mgr
+        # one device-mesh data plane shared by all in-process OSDs (the
+        # "co-hosted on one slice" topology); pools opt in per-pool via
+        # device_mesh=True
+        from ..parallel.plane import MeshDataPlane
+        self.mesh_plane = MeshDataPlane()
         self.mgr = None
         self.mon_addrs: "Dict[int, str]" = {
             r: f"local:mon.{r}" for r in range(n_mons)}
@@ -54,7 +59,8 @@ class MiniCluster:
             self.osdmap.bump()
             for i in range(n_osds):
                 self.osds[i] = OSDDaemon(i, self.osdmap,
-                                         config=self.config)
+                                         config=self.config,
+                                         mesh_plane=self.mesh_plane)
         else:
             self.osdmap = None  # authoritative map lives on the mons
 
@@ -79,7 +85,8 @@ class MiniCluster:
             for i in range(self.n_osds):
                 self.osds[i] = OSDDaemon(
                     i, config=self.config, mon_addrs=self.mon_addrs,
-                    mgr_addr=self.mgr.addr if self.mgr else "")
+                    mgr_addr=self.mgr.addr if self.mgr else "",
+                    mesh_plane=self.mesh_plane)
             for osd in self.osds.values():
                 await osd.init()
         else:
@@ -132,7 +139,8 @@ class MiniCluster:
 
     def create_ec_pool(self, name: str, profile: "Optional[dict]" = None,
                        pg_num: int = 8, stripe_unit: int = 4096,
-                       min_size: "Optional[int]" = None):
+                       min_size: "Optional[int]" = None,
+                       device_mesh: bool = False):
         """Static-mode pool creation (direct map mutation)."""
         assert not self.mon_addrs, "mon mode: use create_ec_pool_cmd"
         profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
@@ -146,7 +154,8 @@ class MiniCluster:
             min_size = min(k + 1, k + m)
         pool = self.osdmap.create_pool(
             name, type=POOL_ERASURE, size=k + m, min_size=min_size,
-            pg_num=pg_num, ec_profile=prof_name, stripe_unit=stripe_unit)
+            pg_num=pg_num, ec_profile=prof_name, stripe_unit=stripe_unit,
+            device_mesh=device_mesh)
         self.osdmap.bump()
         return pool
 
@@ -209,10 +218,12 @@ class MiniCluster:
         if self.mon_addrs:
             osd = OSDDaemon(osd_id, store=old.store, config=self.config,
                             mon_addrs=self.mon_addrs,
-                            mgr_addr=old.mgr_addr)
+                            mgr_addr=old.mgr_addr,
+                            mesh_plane=self.mesh_plane)
         else:
             osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
-                            config=self.config, mgr_addr=old.mgr_addr)
+                            config=self.config, mgr_addr=old.mgr_addr,
+                            mesh_plane=self.mesh_plane)
             self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd
