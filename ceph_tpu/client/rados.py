@@ -96,6 +96,44 @@ class IoCtx:
         lens = [o["dlen"] for o in outs if o.get("op") == "read"]
         return b"".join(unpack_buffers(lens, blob))
 
+    async def pool_mksnap(self, snap: str) -> int:
+        """Create a pool snapshot ('osd pool mksnap'): O(metadata) — COW
+        clones happen lazily at each object's next write (osd side)."""
+        pool = self.client.osdmap.get_pool(self.pool_id)
+        if self.client.monc is not None:
+            res = await self.client.mon_command(
+                {"prefix": "osd pool mksnap", "name": pool.name,
+                 "snap": snap})
+            if res.get("rc", 0) != 0:
+                raise ObjecterError(f"mksnap failed: {res}")
+            await self.client.monc.wait_for_map(
+                min_epoch=int(res.get("epoch", 1)))
+            return int(self.client.osdmap.get_pool(
+                self.pool_id).snaps[snap])
+        # static mode: shared-map mutation (MiniCluster.pool_mksnap)
+        if snap in pool.snaps:
+            raise ObjecterError(f"snap {snap!r} exists")
+        pool.snap_seq += 1
+        pool.snaps[snap] = pool.snap_seq
+        self.client.osdmap.bump()
+        return pool.snap_seq
+
+    async def pool_rmsnap(self, snap: str) -> None:
+        pool = self.client.osdmap.get_pool(self.pool_id)
+        if self.client.monc is not None:
+            res = await self.client.mon_command(
+                {"prefix": "osd pool rmsnap", "name": pool.name,
+                 "snap": snap})
+            if res.get("rc", 0) != 0:
+                # a silently-leaked pool snap would keep COW-cloning
+                # every write in the pool forever
+                raise ObjecterError(f"rmsnap failed: {res}")
+            await self.client.monc.wait_for_map(
+                min_epoch=int(res.get("epoch", 1)))
+            return
+        pool.snaps.pop(snap, None)
+        self.client.osdmap.bump()
+
     async def stat(self, oid: str) -> dict:
         outs, _ = await self._submit(oid, [{"op": "stat"}])
         return next(o for o in outs if o.get("op") == "stat")

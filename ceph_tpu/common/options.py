@@ -136,6 +136,11 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_scrub_map_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
            desc="seconds to wait for a shard's scrub map",
            services=("osd",)),
+    Option("osd_ec_sub_read_timeout", float, 5.0, LEVEL_ADVANCED, min=0.1,
+           desc="seconds before a silent shard read is treated as EIO "
+                "and the read re-plans around it (a dropped reply must "
+                "never hang a ReadOp forever)",
+           services=("osd",)),
     Option("osd_min_pg_log_entries", int, 250, LEVEL_ADVANCED, min=1,
            desc="pg log entries kept below which no trim happens",
            services=("osd",)),

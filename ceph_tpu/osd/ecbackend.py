@@ -749,36 +749,48 @@ class ECBackend:
                     # device array, the sub-write message carries only a
                     # handle for plane-sharing shard servers (reference
                     # fan-out seam ECBackend.cc:2074-2084)
-                    arr8 = (np.frombuffer(bytes(buf), np.uint8)
-                            if not isinstance(buf, np.ndarray)
-                            else buf.reshape(-1))
-                    shards_k = self.sinfo.split_to_shards(arr8)
-                    # off-loop: the crc fetch inside encode() blocks on
-                    # the device; other PG pipelines keep running
-                    handle, crcs_b = await asyncio.get_event_loop() \
-                        .run_in_executor(None, self.mesh_plane.encode,
-                                         self.codec, shards_k[None])
-                    op.mesh_handles.append(handle)
-                    chunk_off = self.sinfo \
-                        .aligned_logical_offset_to_chunk_offset(off)
-                    Wb = int(shards_k.shape[1])
-                    if is_append:
-                        hinfo.append_crcs(chunk_off, crcs_b[0], Wb)
-                    else:
-                        hinfo.invalidate()
-                    for shard in range(self.k + self.m):
-                        tgt = (acting[shard] if shard < len(acting)
-                               else NONE_OSD)
-                        if tgt != NONE_OSD and self.mesh_plane.shares(tgt):
-                            shard_txns[shard].setdefault(
-                                "mesh_writes", []).append(
-                                [chunk_off, handle, 0, Wb])
+                    try:
+                        arr8 = (np.frombuffer(bytes(buf), np.uint8)
+                                if not isinstance(buf, np.ndarray)
+                                else buf.reshape(-1))
+                        shards_k = self.sinfo.split_to_shards(arr8)
+                        # off-loop: the crc fetch inside encode() blocks
+                        # on the device; other PG pipelines keep running
+                        handle, crcs_b = await asyncio.get_event_loop() \
+                            .run_in_executor(None, self.mesh_plane.encode,
+                                             self.codec, shards_k[None])
+                        op.mesh_handles.append(handle)
+                        chunk_off = self.sinfo \
+                            .aligned_logical_offset_to_chunk_offset(off)
+                        Wb = int(shards_k.shape[1])
+                        if is_append:
+                            hinfo.append_crcs(chunk_off, crcs_b[0], Wb)
                         else:
-                            # cross-host (or hole): inline bytes ride the
-                            # messenger exactly as before
-                            shard_txns[shard]["writes"].append(
-                                (chunk_off,
-                                 self.mesh_plane.take(handle, 0, shard)))
+                            hinfo.invalidate()
+                        for shard in range(self.k + self.m):
+                            tgt = (acting[shard] if shard < len(acting)
+                                   else NONE_OSD)
+                            if tgt == NONE_OSD:
+                                continue  # hole: no txn will be sent
+                            if self.mesh_plane.shares(tgt):
+                                shard_txns[shard].setdefault(
+                                    "mesh_writes", []).append(
+                                    [chunk_off, handle, 0, Wb])
+                            else:
+                                # cross-host: inline bytes ride the
+                                # messenger exactly as before
+                                shard_txns[shard]["writes"].append(
+                                    (chunk_off,
+                                     self.mesh_plane.take(handle, 0,
+                                                          shard)))
+                    except Exception as e:  # noqa: BLE001 — fail cleanly
+                        # mirror the encode_service contract: the client
+                        # gets the error and pipeline state is unwound
+                        # (a raised exception here would leak an
+                        # unresolved on_commit future forever)
+                        self._fail_op(op, ECError(
+                            f"mesh encode failed for {op.oid}: {e}"))
+                        return
                     self.extent_cache.present_rmw_update(op.oid, off, buf)
                     continue
                 if self.encode_service is not None:
@@ -1291,7 +1303,32 @@ class ECBackend:
         self.in_flight_reads[rop.tid] = rop
         await self._issue_shard_reads(rop, need, avail,
                                       list(rop.requests))
+        if not rop.done.done():
+            asyncio.ensure_future(self._read_watchdog(rop))
         return rop
+
+    async def _read_watchdog(self, rop: ReadOp) -> None:
+        """A shard whose reply is silently lost (injected drop, dying
+        peer) must never pin a ReadOp forever: after the timeout,
+        synthesize EIO for the stuck shards so the normal re-plan path
+        (get_remaining_shards, ECBackend.cc:1633) widens around them."""
+        timeout = self.opt("osd_ec_sub_read_timeout", 5.0)
+        while not rop.done.done():
+            await asyncio.sleep(timeout)
+            if rop.done.done():
+                return
+            stuck = set(rop.in_progress)
+            if not stuck:
+                continue  # retries in flight; give them their own window
+            dout("osd", 1, f"read tid {rop.tid}: shards {sorted(stuck)} "
+                           f"silent for {timeout}s, treating as EIO")
+            for shard in stuck:
+                self.handle_sub_read_reply(MECSubOpReadReply({
+                    "pgid": list(self.pgid), "shard": shard,
+                    "from_osd": self.whoami, "tid": rop.tid,
+                    "buffers_read": [], "attrs_read": {},
+                    "errors": {oid: EIO for oid in rop.requests},
+                    "lens": []}))
 
     async def _issue_shard_reads(self, rop: ReadOp,
                                  need: "Dict[int, list]",
@@ -1599,8 +1636,10 @@ class ECBackend:
                 # along the shard ring + per-position decode matrix,
                 # absent positions poisoned first (parallel/plane.py;
                 # reference seam objects_read_and_reconstruct
-                # ECBackend.cc:2345)
-                decoded = self.mesh_plane.reconstruct(
+                # ECBackend.cc:2345).  Off-loop: first call per erasure
+                # signature compiles; keep heartbeats and other PGs live.
+                decoded = await asyncio.get_event_loop().run_in_executor(
+                    None, self.mesh_plane.reconstruct,
                     self.codec, arrs, sorted(rop.missing_on))
             else:
                 decoded = ecutil.decode(self.sinfo, self.codec, arrs,

@@ -87,13 +87,20 @@ class DistributedEC:
         self._G = (np.ascontiguousarray(generator, dtype=np.uint8)
                    if generator is not None
                    else gf8.generator_matrix(k, m, technique))
+        # jit-cache: write_step/reconstruct_step build fresh jax.jit
+        # closures — rebuilding per call would retrace+recompile every
+        # invocation (hundreds of ms each)
+        self._write_step = None
+        self._reconstruct_steps: dict = {}
 
     # --- write: encode + per-shard crc --------------------------------------
 
     def write_step(self):
         """jitted fn: data (B, s, W) uint32 [B sharded over pg, chunk dim
         over shard; parity positions' input ignored] -> (shards, crcs)
-        with the same sharding."""
+        with the same sharding.  Cached per instance."""
+        if self._write_step is not None:
+            return self._write_step
         k, m, s = self.k, self.m, self.k + self.m
         C = self._G[k:]
 
@@ -123,13 +130,19 @@ class DistributedEC:
                 mine, seg_words=_pick_seg_words(mine.shape[-1]))
             return mine[:, None, :], crcs[:, None]
 
-        return jax.jit(step)
+        self._write_step = jax.jit(step)
+        return self._write_step
 
     # --- read repair: all-gather survivors, decode locally -------------------
 
     def reconstruct_step(self, erased: "tuple[int, ...]"):
         """jitted fn for a static erasure signature: shards (B, s, W) with
-        garbage at erased positions -> repaired (B, s, W)."""
+        garbage at erased positions -> repaired (B, s, W).  Cached per
+        signature (the jit-level ErasureCodeIsaTableCache analog)."""
+        erased = tuple(erased)
+        cached = self._reconstruct_steps.get(erased)
+        if cached is not None:
+            return cached
         k, m, s = self.k, self.m, self.k + self.m
         rows = tuple(i for i in range(s) if i not in erased)[:k]
         D = gf8.decode_matrix(self._G, k, list(rows))     # (k, k)
@@ -158,7 +171,8 @@ class DistributedEC:
             out = jnp.where(is_erased, rebuilt, mine)
             return out[:, None, :]
 
-        return jax.jit(step)
+        self._reconstruct_steps[erased] = jax.jit(step)
+        return self._reconstruct_steps[erased]
 
     # --- sharding helpers ----------------------------------------------------
 

@@ -5,10 +5,24 @@ is a header object (JSON metadata: size, object order, snapshots) plus
 ``rbd_data.<id>.<index>`` data objects of 2^order bytes each; reads and
 writes map block offsets to object extents (the reference's default
 striping: stripe_unit = object size, stripe_count = 1) and fan out in
-parallel.  Sparse ranges read back zero-filled.  Snapshots here are
-full-copy (``<data>@<snap>`` objects written at snap_create) rather
-than the reference's COW clone chains — correct semantics, simpler
-mechanics; COW belongs to a later round.
+parallel.  Sparse ranges read back zero-filled.
+
+Snapshots are COW on the RADOS pool-snapshot machinery (reference
+src/librbd/Operations.cc snap handling + src/cls/rbd/cls_rbd.cc clone
+metadata): ``snap_create`` is O(metadata) — it takes a pool snapshot
+named ``rbd.<image>.<snap>`` and records the snapid in the header; the
+first write after the snap COWs ONLY the touched object (the OSD-side
+generation clone, osd/ecbackend.py snap_clone).  Snap reads go through
+the RADOS read-at-snap path.  ``clone`` layers a child image over a
+protected parent snapshot: child objects start absent and reads fall
+through to the parent chain within the overlap; the first write to an
+absent child object copies the parent block up (reference copy-up), and
+``flatten`` severs the chain by copying every remaining block.
+
+One deviation from librbd's self-managed snap contexts: pool snapshots
+are pool-wide, so writes to OTHER images in the pool after a snap also
+COW their touched objects until the snap is removed — same correctness,
+some extra space, far less machinery.
 
 Works on EC and replicated pools alike (metadata lives in the header
 object's data, not omap, so EC-backed images need no second pool).
@@ -76,18 +90,50 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await self.open(name)
+        for snap, info in img.hdr["snaps"].items():
+            if info.get("children"):
+                raise RBDError(
+                    f"image {name!r} snap {snap!r} has clone children "
+                    f"{info['children']}; flatten or remove them first")
+        for snap in list(img.hdr["snaps"]):
+            await img.snap_unprotect(snap, force=True)
+            await img.snap_remove(snap)
+        if img.parent is not None:
+            await img._deregister_child()
         for idx in range(img._objects()):
             try:
                 await self.io.remove(img._data(idx))
             except Exception:  # noqa: BLE001 — sparse
                 pass
-        for snap in list(img.hdr["snaps"]):
-            await img.snap_remove(snap)
         await self.io.remove(self._header(name))
         names = set(await self.list())
         names.discard(name)
         await self.io.write_full("rbd_directory",
                                  json.dumps(sorted(names)).encode())
+
+    async def clone(self, parent_name: str, snap: str,
+                    child_name: str) -> None:
+        """Layer a new image over a protected parent snapshot
+        (reference librbd clone: child starts as pure metadata; reads
+        fall through to the parent, writes copy-up per object)."""
+        parent = await self.open(parent_name)
+        info = parent.hdr["snaps"].get(snap)
+        if info is None:
+            raise RBDError(f"no snap {snap!r} on {parent_name!r}")
+        if not info.get("protected"):
+            raise RBDError(
+                f"snap {parent_name}@{snap} is not protected "
+                f"(snap_protect first, reference clone prerequisite)")
+        size = int(info["size"])
+        await self.create(child_name, size,
+                          order=int(parent.hdr["order"]))
+        child = await self.open(child_name)
+        child.hdr["parent"] = {
+            "image": parent_name, "snap": snap,
+            "pool_snap": parent._pool_snap(snap), "overlap": size}
+        await child._save()
+        info.setdefault("children", []).append(child_name)
+        await parent._save()
 
 
 class Image:
@@ -95,6 +141,8 @@ class Image:
         self.io = ioctx
         self.name = name
         self.hdr: dict = {}
+        self._present: "set[int]" = set()   # known-existing data objects
+        self._parent_img: "Optional[Image]" = None  # cached parent handle
 
     async def _load(self) -> None:
         try:
@@ -120,11 +168,63 @@ class Image:
     def _objects(self) -> int:
         return -(-self.size // self.obj_bytes) if self.size else 0
 
-    def _data(self, idx: int, snap: "Optional[str]" = None) -> str:
-        base = f"rbd_data.{self.name}"
-        if snap:
-            base += f"@{snap}"
-        return f"{base}.{idx:016x}"
+    def _data(self, idx: int) -> str:
+        return f"rbd_data.{self.name}.{idx:016x}"
+
+    def _pool_snap(self, snap: str) -> str:
+        return f"rbd.{self.name}.{snap}"
+
+    @property
+    def parent(self) -> "Optional[dict]":
+        return self.hdr.get("parent")
+
+    async def _deregister_child(self) -> None:
+        p = self.parent
+        if p is None:
+            return
+        try:
+            parent = await RBD(self.io).open(p["image"])
+        except RBDError:
+            return
+        info = parent.hdr["snaps"].get(p["snap"])
+        if info and self.name in info.get("children", []):
+            info["children"].remove(self.name)
+            await parent._save()
+
+    async def _exists(self, idx: int) -> bool:
+        """Does the child data object exist (vs falling through to the
+        parent)?  Cached positively: objects never un-exist under us
+        except via discard, which invalidates."""
+        if idx in self._present:
+            return True
+        try:
+            st = await self.io.stat(self._data(idx))
+        except Exception:  # noqa: BLE001 — absent
+            return False
+        # stat of an absent object reports size 0 (ObjectInfo default);
+        # a zero-size child object holds no bytes a copy-up could lose,
+        # so size==0 counts as absent either way
+        if int(st.get("size", 0)) <= 0:
+            return False
+        self._present.add(idx)
+        return True
+
+    async def _parent_read(self, idx: int, ooff: int, n: int) -> bytes:
+        """Read a block range through the parent chain at its snap."""
+        p = self.parent
+        if p is None:
+            return b""
+        start = idx * self.obj_bytes + ooff
+        end = min(start + n, int(p["overlap"]))
+        if end <= start:
+            return b""
+        if self._parent_img is None:
+            # cached: the parent snap is immutable while protected, so
+            # one header read serves every fall-through block
+            self._parent_img = await RBD(self.io).open(p["image"])
+        got = await self._parent_img.read(start, end - start,
+                                          snap=p["snap"])
+        return got
 
     def _extents(self, off: int, length: int):
         pos, end = off, off + length
@@ -137,11 +237,22 @@ class Image:
 
     # --- I/O ------------------------------------------------------------------
 
+    async def _copyup(self, idx: int) -> None:
+        """First write to an absent child object: copy the parent's
+        block up so partial writes land on the inherited bytes
+        (reference librbd copy-up)."""
+        base = await self._parent_read(idx, 0, self.obj_bytes)
+        if base:
+            await self.io.write_full(self._data(idx), base)
+        self._present.add(idx)
+
     async def write(self, off: int, data: bytes) -> None:
         if off + len(data) > self.size:
             raise RBDError("write beyond image size")
 
         async def one(idx, ooff, n, lpos):
+            if self.parent is not None and not await self._exists(idx):
+                await self._copyup(idx)
             await self.io.write(self._data(idx),
                                 data[lpos - off:lpos - off + n], ooff)
 
@@ -150,14 +261,25 @@ class Image:
 
     async def read(self, off: int, length: int,
                    snap: "Optional[str]" = None) -> bytes:
-        length = min(length, max(0, self.size - off))
+        if snap is not None and snap not in self.hdr["snaps"]:
+            raise RBDError(f"no snap {snap!r}")
+        size = (int(self.hdr["snaps"][snap]["size"]) if snap is not None
+                else self.size)
+        length = min(length, max(0, size - off))
         out = bytearray(length)
+        pool_snap = self._pool_snap(snap) if snap is not None else None
 
         async def one(idx, ooff, n, lpos):
+            got = b""
             try:
-                got = await self.io.read(self._data(idx, snap), n, ooff)
-            except Exception:  # noqa: BLE001 — sparse object: zeros
-                return
+                got = await self.io.read(self._data(idx), n, ooff,
+                                         snap=pool_snap)
+            except Exception:  # noqa: BLE001 — absent object
+                got = b""
+            if not got and self.parent is not None:
+                # child object absent (or absent at the snap): fall
+                # through to the parent chain within the overlap
+                got = await self._parent_read(idx, ooff, n)
             out[lpos - off:lpos - off + len(got)] = got
 
         await asyncio.gather(*(one(*e)
@@ -165,25 +287,38 @@ class Image:
         return bytes(out)
 
     async def discard(self, off: int, length: int) -> None:
-        """Zero a range (punch holes at object granularity)."""
+        """Zero a range (punch holes at object granularity).  A cloned
+        child must WRITE zeros — removing its object would re-expose the
+        parent's bytes through the fall-through read."""
         for idx, ooff, n, _ in self._extents(off, length):
-            if ooff == 0 and n == self.obj_bytes:
+            if (ooff == 0 and n == self.obj_bytes
+                    and self.parent is None):
                 try:
                     await self.io.remove(self._data(idx))
                 except Exception:  # noqa: BLE001 — already sparse
                     pass
+                self._present.discard(idx)
             else:
+                if self.parent is not None and not await self._exists(idx):
+                    await self._copyup(idx)
                 await self.io.write(self._data(idx), b"\0" * n, ooff)
 
     async def resize(self, new_size: int) -> None:
         old_size = self.size
         old_objects = self._objects()
         self.hdr["size"] = int(new_size)
+        if (self.parent is not None
+                and int(new_size) < int(self.parent["overlap"])):
+            # shrinking below the inherited range permanently narrows
+            # it: a later grow must read zeros there, not parent bytes
+            # (reference: resize shrinks the parent overlap)
+            self.hdr["parent"]["overlap"] = int(new_size)
         for idx in range(self._objects(), old_objects):
             try:
                 await self.io.remove(self._data(idx))
             except Exception:  # noqa: BLE001
                 pass
+            self._present.discard(idx)
         if new_size < old_size and new_size % self.obj_bytes:
             # truncate the boundary object: a later grow must read
             # zeros, never the pre-shrink bytes (the reference truncates
@@ -197,53 +332,103 @@ class Image:
         await self._save()
 
     async def stat(self) -> dict:
-        return {"size": self.size, "order": int(self.hdr["order"]),
-                "num_objs": self._objects(),
-                "snaps": sorted(self.hdr["snaps"])}
+        out = {"size": self.size, "order": int(self.hdr["order"]),
+               "num_objs": self._objects(),
+               "snaps": sorted(self.hdr["snaps"])}
+        if self.parent is not None:
+            out["parent"] = dict(self.parent)
+        return out
 
-    # --- snapshots (full-copy; the reference does COW clone chains) ----------
+    # --- snapshots: COW on the RADOS pool-snapshot machinery -----------------
 
     async def snap_create(self, snap: str) -> None:
+        """O(metadata): take a pool snapshot; NO data is copied — the
+        first write after the snap COWs only the touched object (the
+        OSD-side generation clone, osd/ecbackend.py snap_clone path)."""
         if snap in self.hdr["snaps"]:
             raise RBDError(f"snap {snap!r} exists")
-        for idx in range(self._objects()):
-            try:
-                data = await self.io.read(self._data(idx))
-            except Exception:  # noqa: BLE001 — sparse
-                continue
-            if data:
-                await self.io.write_full(self._data(idx, snap), data)
+        snapid = await self.io.pool_mksnap(self._pool_snap(snap))
         self.hdr["snaps"][snap] = {"size": self.size,
-                                   "taken": time.time()}
+                                   "snapid": int(snapid),
+                                   "taken": time.time(),
+                                   "protected": False, "children": []}
+        await self._save()
+
+    async def snap_protect(self, snap: str) -> None:
+        """Clone prerequisite (reference: clones require a protected
+        snap so the parent data cannot be removed from under them)."""
+        info = self.hdr["snaps"].get(snap)
+        if info is None:
+            raise RBDError(f"no snap {snap!r}")
+        info["protected"] = True
+        await self._save()
+
+    async def snap_unprotect(self, snap: str, force: bool = False) -> None:
+        await self._load()   # another handle may have registered clones
+        info = self.hdr["snaps"].get(snap)
+        if info is None:
+            raise RBDError(f"no snap {snap!r}")
+        if info.get("children") and not force:
+            raise RBDError(
+                f"snap {snap!r} has clone children {info['children']}")
+        info["protected"] = False
         await self._save()
 
     async def snap_remove(self, snap: str) -> None:
-        # iterate the SNAPSHOT's extent, not the current size: the image
-        # may have shrunk since the snap was taken
-        info = self.hdr["snaps"].pop(snap, None)
-        snap_size = int(info["size"]) if info else self.size
-        n_objs = -(-snap_size // self.obj_bytes) if snap_size else 0
-        for idx in range(max(n_objs, self._objects()) + 1):
-            try:
-                await self.io.remove(self._data(idx, snap))
-            except Exception:  # noqa: BLE001
-                pass
+        await self._load()   # another handle may have registered clones
+        info = self.hdr["snaps"].get(snap)
+        if info is None:
+            return
+        if info.get("protected"):
+            raise RBDError(f"snap {snap!r} is protected")
+        if info.get("children"):
+            raise RBDError(
+                f"snap {snap!r} has clone children {info['children']}")
+        self.hdr["snaps"].pop(snap)
+        # pool rmsnap reaps the OSD-side clones lazily (rmsnap handling)
+        await self.io.pool_rmsnap(self._pool_snap(snap))
         await self._save()
 
     async def snap_rollback(self, snap: str) -> None:
+        """Restore head content from the snap (data movement inherent:
+        the reference's rollback copies the clone back over the head)."""
         if snap not in self.hdr["snaps"]:
             raise RBDError(f"no snap {snap!r}")
+        old_objects = self._objects()
         self.hdr["size"] = int(self.hdr["snaps"][snap]["size"])
-        for idx in range(self._objects()):
-            try:
-                data = await self.io.read(self._data(idx, snap))
-            except Exception:  # noqa: BLE001
-                data = b""
-            if data:
-                await self.io.write_full(self._data(idx), data)
+        for idx in range(max(old_objects, self._objects())):
+            data = await self.read(idx * self.obj_bytes, self.obj_bytes,
+                                   snap=snap)
+            if data.strip(b"\0") or self.parent is not None:
+                # a cloned child always writes: removing its object
+                # would re-expose the parent through fall-through reads
+                await self.io.write_full(
+                    self._data(idx), data.ljust(
+                        min(self.obj_bytes,
+                            max(0, self.size - idx * self.obj_bytes)),
+                        b"\0") if self.parent is not None else data)
+                self._present.add(idx)
             else:
                 try:
                     await self.io.remove(self._data(idx))
                 except Exception:  # noqa: BLE001
                     pass
+                self._present.discard(idx)
+        await self._save()
+
+    # --- clone layering -------------------------------------------------------
+
+    async def flatten(self) -> None:
+        """Sever the parent link by copying every still-inherited block
+        up into the child (reference librbd flatten)."""
+        p = self.parent
+        if p is None:
+            return
+        overlap_objs = -(-int(p["overlap"]) // self.obj_bytes)
+        for idx in range(min(overlap_objs, self._objects())):
+            if not await self._exists(idx):
+                await self._copyup(idx)
+        await self._deregister_child()
+        self.hdr.pop("parent", None)
+        self._parent_img = None
         await self._save()

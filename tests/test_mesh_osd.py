@@ -129,3 +129,42 @@ class TestMeshRecovery:
                 assert bytes(stored) == bytes(
                     shards[victim_shard].tobytes())
         loop.run_until_complete(go())
+
+
+class TestReadWatchdog:
+    def test_dropped_sub_read_reply_does_not_hang(self, loop):
+        """A silently-lost shard read reply (injected drop) must not pin
+        the ReadOp forever: the watchdog EIOs the silent shard and the
+        re-plan serves the read from the others."""
+        async def go():
+            from ceph_tpu.common.config import Config
+            cfg = Config()
+            cfg.set("osd_ec_sub_read_timeout", 0.3)
+            async with MiniCluster(6, config=cfg) as cluster:
+                cluster.create_ec_pool(
+                    "p", {"plugin": "jax_rs", "k": "3", "m": "2"},
+                    pg_num=4, stripe_unit=64)
+                client = await cluster.client()
+                io = client.io_ctx("p")
+                data = payload(3 * 64 * 4, 9)
+                await io.write_full("obj", data)
+                pool = cluster.osdmap.pool_by_name("p")
+                pg = cluster.osdmap.object_to_pg(pool.pool_id, "obj")
+                _u, acting = cluster.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                primary = cluster.osds[acting[0]]
+                be = primary._get_backend((pool.pool_id, pg))
+                real_send = be.send
+                dropped = []
+
+                async def swallowing_send(osd, msg):
+                    if msg.TYPE == "ec_sub_read" and osd == acting[1]:
+                        dropped.append(osd)   # accepted, never delivered
+                        return
+                    return await real_send(osd, msg)
+                be.send = swallowing_send
+                got = await asyncio.wait_for(io.read("obj"), timeout=20)
+                be.send = real_send
+                assert got == data
+                assert dropped, "the drop never fired"
+        loop.run_until_complete(go())
