@@ -1,0 +1,94 @@
+"""Capability strings — per-entity authorization (reference cephx caps,
+src/mon/AuthMonitor.cc entity caps + src/osd OSDCap / src/mon MonCap
+grammars, reduced to the widely-used core).
+
+Grammar (clauses separated by ';' or ','):
+
+    <service> allow <perms> [pool=<name>]
+    <service> allow *
+
+services: mon | osd | mgr.  perms: any subset of r, w, x (or '*').
+Multiple clauses for one service OR together; a pool-qualified osd
+clause only matches ops on that pool.
+
+Examples (the reference's common profiles):
+    "mon allow r, osd allow rw pool=data"
+    "mon allow *, osd allow *"          (client.admin)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class CapsError(Exception):
+    pass
+
+
+class _Clause:
+    __slots__ = ("service", "perms", "pool")
+
+    def __init__(self, service: str, perms: str,
+                 pool: "Optional[str]") -> None:
+        self.service = service
+        self.perms = perms          # subset of "rwx" or "*"
+        self.pool = pool
+
+    def allows(self, service: str, need: str,
+               pool: "Optional[str]") -> bool:
+        if self.service != service:
+            return False
+        if self.pool is not None and pool != self.pool:
+            return False
+        if self.perms == "*":
+            return True
+        return all(p in self.perms for p in need)
+
+    def __repr__(self) -> str:
+        pool = f" pool={self.pool}" if self.pool else ""
+        return f"{self.service} allow {self.perms}{pool}"
+
+
+class Caps:
+    """Parsed capability set with ``allows(service, need, pool)``."""
+
+    SERVICES = ("mon", "osd", "mgr")
+
+    def __init__(self, spec: str = "") -> None:
+        self.spec = spec.strip()
+        self.clauses: "List[_Clause]" = []
+        for raw in self.spec.replace(";", ",").split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split()
+            if len(parts) < 3 or parts[1] != "allow":
+                raise CapsError(f"bad cap clause {raw!r} "
+                                f"(want '<svc> allow <perms> [pool=x]')")
+            service = parts[0]
+            if service not in self.SERVICES:
+                raise CapsError(f"unknown service {service!r} in {raw!r}")
+            perms = parts[2]
+            if perms != "*" and (not perms
+                                 or any(p not in "rwx" for p in perms)):
+                raise CapsError(f"bad perms {perms!r} in {raw!r}")
+            pool = None
+            for extra in parts[3:]:
+                if extra.startswith("pool="):
+                    pool = extra[5:]
+                else:
+                    raise CapsError(f"unknown qualifier {extra!r}")
+            self.clauses.append(_Clause(service, perms, pool))
+
+    def allows(self, service: str, need: str,
+               pool: "Optional[str]" = None) -> bool:
+        """Every permission in ``need`` granted for (service, pool)?"""
+        if not need:
+            return True
+        return any(c.allows(service, need, pool) for c in self.clauses)
+
+    def __bool__(self) -> bool:
+        return bool(self.clauses)
+
+    def __repr__(self) -> str:
+        return f"Caps({self.spec!r})"

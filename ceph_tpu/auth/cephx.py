@@ -1,0 +1,125 @@
+"""cephx-style service tickets (reference src/auth/cephx/CephxProtocol.h).
+
+The reference flow, kept: a client authenticates to the mon (here: the
+messenger's per-entity banner proof), the mon issues a TIME-LIMITED
+ticket naming the entity and its caps, sealed under a ROTATING service
+secret shared by the mon and the service daemons; a daemon validates a
+presented ticket locally — no mon round trip per op — and enforces the
+caps at dispatch.  Expired tickets force the client back to the mon.
+
+One deliberate deviation: the reference encrypts a per-session key into
+the ticket and optionally signs every message with it
+(cephx_sign_messages).  Here the messenger already authenticates and
+(optionally) AEAD-seals the whole connection, so the ticket carries
+identity+caps+expiry only, sealed with HMAC-SHA256 under the service
+secret — the authenticated channel does the session-binding work.
+
+Rotating secrets (reference RotatingSecrets / KeyServer): the authority
+keeps the last ``keep`` generations; tickets name their generation so
+daemons accept tickets sealed under any still-valid generation, and a
+rotation does not invalidate outstanding tickets early.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .caps import Caps
+
+DEFAULT_TTL = 3600.0
+
+
+class TicketError(Exception):
+    pass
+
+
+def _seal(key: bytes, payload: bytes) -> str:
+    mac = hmac.new(key, payload, hashlib.sha256).digest()
+    return base64.b64encode(payload + mac).decode()
+
+
+def _unseal(key: bytes, blob: str) -> bytes:
+    raw = base64.b64decode(blob.encode())
+    payload, mac = raw[:-32], raw[-32:]
+    want = hmac.new(key, payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(want, mac):
+        raise TicketError("ticket MAC mismatch")
+    return payload
+
+
+class TicketAuthority:
+    """Mon-side issuer with rotating service secrets."""
+
+    def __init__(self, service: str = "osd", keep: int = 2,
+                 secrets: "Optional[Dict[int, str]]" = None) -> None:
+        self.service = service
+        self.keep = max(1, keep)
+        # generation -> secret (hex); deterministic state so a mon
+        # quorum replaying the paxos log rebuilds the same authority
+        self.secrets: "Dict[int, str]" = dict(secrets or {})
+        if not self.secrets:
+            self.secrets[1] = os.urandom(32).hex()
+
+    @property
+    def generation(self) -> int:
+        return max(self.secrets)
+
+    def rotate(self, secret: "Optional[str]" = None) -> int:
+        gen = self.generation + 1
+        self.secrets[gen] = secret or os.urandom(32).hex()
+        for old in sorted(self.secrets)[:-self.keep]:
+            del self.secrets[old]
+        return gen
+
+    def issue(self, entity: str, caps: str, ttl: float = DEFAULT_TTL,
+              now: "Optional[float]" = None) -> str:
+        Caps(caps)  # validate before sealing
+        gen = self.generation
+        payload = json.dumps({
+            "service": self.service, "entity": entity, "caps": caps,
+            "gen": gen, "expires": (now or time.time()) + ttl,
+        }, sort_keys=True).encode()
+        return f"{gen}:" + _seal(bytes.fromhex(self.secrets[gen]), payload)
+
+    def export_secrets(self) -> "Dict[int, str]":
+        """For distribution to service daemons (rides the authenticated
+        mon channel, like the reference's rotating-key delivery)."""
+        return dict(self.secrets)
+
+
+class TicketVerifier:
+    """Daemon-side validation against the distributed rotating secrets."""
+
+    def __init__(self, service: str = "osd",
+                 secrets: "Optional[Dict[int, str]]" = None) -> None:
+        self.service = service
+        self.secrets: "Dict[int, str]" = dict(secrets or {})
+
+    def update_secrets(self, secrets: "Dict[int, str]") -> None:
+        self.secrets = {int(g): s for g, s in secrets.items()}
+
+    def verify(self, blob: str,
+               now: "Optional[float]" = None) -> "Tuple[str, Caps]":
+        """-> (entity, caps); raises TicketError on any defect."""
+        try:
+            gen_s, sealed = blob.split(":", 1)
+            gen = int(gen_s)
+        except ValueError:
+            raise TicketError("malformed ticket")
+        secret = self.secrets.get(gen)
+        if secret is None:
+            raise TicketError(f"unknown service-key generation {gen}")
+        payload = json.loads(_unseal(bytes.fromhex(secret), sealed))
+        if payload.get("service") != self.service:
+            raise TicketError(f"ticket for service "
+                              f"{payload.get('service')!r}, not "
+                              f"{self.service!r}")
+        if float(payload.get("expires", 0)) < (now or time.time()):
+            raise TicketError("ticket expired")
+        return str(payload["entity"]), Caps(str(payload.get("caps", "")))

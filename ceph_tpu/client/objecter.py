@@ -51,6 +51,11 @@ class Objecter(Dispatcher):
         self._inflight: "Dict[int, asyncio.Future]" = {}
         # (pool_id, oid, watch_id) -> callback(oid, payload)
         self.watch_callbacks: "Dict[tuple, Any]" = {}
+        # cephx: service ticket attached to every op; ``ticket_renewer``
+        # (async callable -> blob) runs once when an op bounces with an
+        # expired/stale ticket, then the op retries with the fresh one
+        self.ticket: "Optional[str]" = None
+        self.ticket_renewer = None
 
     def new_tid(self) -> int:
         self._next_tid += 1
@@ -77,6 +82,7 @@ class Objecter(Dispatcher):
         # mutation whose ack was lost from applying twice
         tid = self.new_tid()
         reqid = f"{self.ms.name}:{tid}"
+        renewed = False
         for attempt in range(self.max_retries):
             pg, primary = self.calc_target(pool_id, oid)
             if primary == NONE_OSD:
@@ -85,9 +91,12 @@ class Objecter(Dispatcher):
                 continue
             fut = asyncio.get_event_loop().create_future()
             self._inflight[tid] = fut
-            msg = MOSDOp({"tid": tid, "pool": pool_id, "pg": pg,
-                          "oid": oid, "ops": ops, "reqid": reqid,
-                          "map_epoch": self.osdmap.epoch}, data)
+            fields = {"tid": tid, "pool": pool_id, "pg": pg,
+                      "oid": oid, "ops": ops, "reqid": reqid,
+                      "map_epoch": self.osdmap.epoch}
+            if self.ticket:
+                fields["ticket"] = self.ticket
+            msg = MOSDOp(fields, data)
             try:
                 conn = self.ms.get_connection(
                     self.osdmap.get_addr(primary), Policy.lossy_client())
@@ -109,6 +118,14 @@ class Objecter(Dispatcher):
                 continue
             if result != 0:
                 errs = [o.get("error") for o in outs if "error" in o]
+                if (result == -13 and not renewed
+                        and self.ticket_renewer is not None
+                        and "ticket" in str(errs)):
+                    # expired/stale service ticket: renew at the mon
+                    # once, then retry the op with the fresh one
+                    self.ticket = await self.ticket_renewer()
+                    renewed = True
+                    continue
                 raise ObjecterError(
                     f"op on {oid} failed: {errs or reply['result']}",
                     errno=-result)

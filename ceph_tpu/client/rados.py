@@ -39,6 +39,31 @@ class RadosClient:
             raise ObjecterError("no mon connection")
         return await self.monc.command(cmd)
 
+    async def fetch_ticket(self, service: str = "osd",
+                           entity: str = "") -> str:
+        """Fetch a cephx service ticket from the mon and attach it to
+        every subsequent op; expiry auto-renews through the same call."""
+        cmd = {"prefix": "auth ticket", "service": service}
+        if entity:
+            cmd["entity"] = entity
+        out = await self.mon_command(cmd)
+        self.objecter.ticket = str(out["ticket"])
+        self.objecter.ticket_renewer = \
+            lambda: self._renew_ticket(service, entity)
+        return self.objecter.ticket
+
+    async def _renew_ticket(self, service: str, entity: str) -> str:
+        cmd = {"prefix": "auth ticket", "service": service}
+        if entity:
+            cmd["entity"] = entity
+        out = await self.mon_command(cmd)
+        return str(out["ticket"])
+
+    def set_ticket(self, blob: str, renewer=None) -> None:
+        """Static-mode harnesses inject tickets directly (no mon)."""
+        self.objecter.ticket = blob
+        self.objecter.ticket_renewer = renewer
+
     async def shutdown(self) -> None:
         await self.ms.shutdown()
 

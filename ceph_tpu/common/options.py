@@ -165,6 +165,14 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("keyring", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="keyring: file path or inline name=hexkey,... "
                 "('*' entry = cluster-wide key)"),
+    Option("auth_client_required", str, "none", LEVEL_ADVANCED,
+           enum_values=("none", "cephx"),
+           desc="client op authorization: cephx = every osd op must "
+                "carry a valid mon-issued service ticket and pass the "
+                "entity's caps (mon commands check mon caps likewise)"),
+    Option("auth_ticket_ttl", float, 3600.0, LEVEL_ADVANCED, min=0.1,
+           desc="service ticket lifetime in seconds; expiry forces the "
+                "client back to the mon for renewal"),
     # --- compressor ---------------------------------------------------------
     Option("compressor_default", str, "zstd", LEVEL_ADVANCED,
            enum_values=("none", "zlib", "zstd", "lz4", "snappy"),

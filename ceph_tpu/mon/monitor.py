@@ -73,6 +73,10 @@ class MonDaemon(Dispatcher):
         self.osdmap = OSDMap()
         self.osdmap.crush.add_bucket("default", "root")
         self.central_config: "Dict[str, str]" = {}
+        # auth service state (paxos-replicated, AuthMonitor analog):
+        # entity -> {key, caps}; per-service rotating ticket secrets
+        self.auth_entities: "Dict[str, dict]" = {}
+        self.ticket_authorities: "Dict[str, object]" = {}
         # volatile control state
         self.subs: "Set[str]" = set()            # subscriber addresses
         self.last_beacon: "Dict[int, float]" = {}
@@ -151,6 +155,32 @@ class MonDaemon(Dispatcher):
                     self.central_config[op["name"]] = op["value"]
                 elif op["op"] == "rm":
                     self.central_config.pop(op["name"], None)
+        elif txn.get("service") == "auth":
+            # AuthMonitor analog (reference src/mon/AuthMonitor.cc):
+            # entity db + rotating service secrets are paxos state so a
+            # re-elected quorum rebuilds identical tickets/keys
+            for op in txn["ops"]:
+                kind = op["op"]
+                if kind == "entity_set":
+                    self.auth_entities[op["entity"]] = {
+                        "key": op["key"], "caps": op.get("caps", "")}
+                elif kind == "entity_caps":
+                    if op["entity"] in self.auth_entities:
+                        self.auth_entities[op["entity"]]["caps"] = \
+                            op.get("caps", "")
+                elif kind == "entity_rm":
+                    self.auth_entities.pop(op["entity"], None)
+                elif kind == "service_secret":
+                    from ..auth.cephx import TicketAuthority
+                    svc = op.get("svc", "osd")
+                    auth = self.ticket_authorities.get(svc)
+                    if auth is None:
+                        self.ticket_authorities[svc] = TicketAuthority(
+                            svc, secrets={int(op["gen"]): op["secret"]})
+                    else:
+                        auth.secrets[int(op["gen"])] = op["secret"]
+                        for old in sorted(auth.secrets)[:-auth.keep]:
+                            del auth.secrets[old]
 
     def _apply_osd_op(self, op: dict) -> None:
         m = self.osdmap
@@ -223,6 +253,23 @@ class MonDaemon(Dispatcher):
         # never races its own map broadcast to the OSDs
         await self._broadcast_map()
         return v
+
+    async def _propose_auth_ops(self, ops: "List[dict]") -> int:
+        value = json.dumps({"service": "auth", "ops": ops}).encode()
+        return await self.paxos.propose(value)
+
+    async def _ticket_authority(self, service: str):
+        """Get (bootstrapping through paxos if needed) the rotating
+        ticket authority for a service — the secret must be proposed so
+        every quorum member seals/validates identically."""
+        auth = self.ticket_authorities.get(service)
+        if auth is None:
+            import os as _os
+            await self._propose_auth_ops([{
+                "op": "service_secret", "svc": service, "gen": 1,
+                "secret": _os.urandom(32).hex()}])
+            auth = self.ticket_authorities[service]
+        return auth
 
     # --- dispatch -------------------------------------------------------------
 
@@ -368,7 +415,12 @@ class MonDaemon(Dispatcher):
             return
         async with self._cmd_lock:
             try:
-                result, out = await self._do_command(cmd)
+                denied = self._check_mon_caps(conn, cmd)
+                if denied is not None:
+                    result, out = denied
+                else:
+                    result, out = await self._do_command(
+                        cmd, peer=getattr(conn, "peer_name", ""))
             except PaxosError as e:
                 result, out = -EAGAIN, {"error": str(e)}
             except Exception as e:  # noqa: BLE001 — command errors -> reply
@@ -376,8 +428,119 @@ class MonDaemon(Dispatcher):
         await conn.send_message(MMonCommandReply({
             "tid": tid, "result": result, "out": out}))
 
-    async def _do_command(self, cmd: dict) -> "Tuple[int, dict]":
+    # mutating prefixes need 'mon w'; everything else 'mon r'
+    _MON_WRITE_PREFIXES = (
+        "osd pool", "osd erasure-code-profile", "osd pg-upmap",
+        "osd set", "osd unset", "osd out", "osd in", "osd down",
+        "config set", "config rm", "auth get-or-create", "auth caps",
+        "auth rm", "auth rotate")
+
+    def _check_mon_caps(self, conn, cmd: dict):
+        """Per-entity mon caps at command dispatch (reference MonCap
+        check in Monitor::handle_command).  Only active when the cluster
+        requires cephx; daemons (osd./mon./mgr.) carry implicit caps."""
+        if str(self.config.get("auth_client_required")) != "cephx":
+            return None
+        peer = str(getattr(conn, "peer_name", "") or "")
+        if peer.split(".", 1)[0] in ("osd", "mon", "mgr"):
+            return None
+        if cmd.get("prefix", "") == "auth ticket":
+            # the authentication bootstrap itself: entity resolution and
+            # per-entity denial happen inside the command (reference:
+            # auth requests precede session caps)
+            return None
+        ent = self.auth_entities.get(peer)
+        if ent is None and peer == "client.admin":
+            return None   # bootstrap admin (reference initial keyring)
+        if ent is None:
+            return -13, {"error": f"entity {peer!r} not authorized"}
+        from ..auth.caps import Caps
         prefix = cmd.get("prefix", "")
+        need = "w" if any(prefix.startswith(p)
+                          for p in self._MON_WRITE_PREFIXES) else "r"
+        if not Caps(ent.get("caps", "")).allows("mon", need):
+            return -13, {"error": f"{peer}: mon cap {need!r} required "
+                                  f"for {prefix!r}"}
+        return None
+
+    async def _do_command(self, cmd: dict,
+                          peer: str = "") -> "Tuple[int, dict]":
+        prefix = cmd.get("prefix", "")
+        if prefix == "auth get-or-create":
+            entity = str(cmd["entity"])
+            caps = str(cmd.get("caps", ""))
+            from ..auth.caps import Caps
+            Caps(caps)  # validate before proposing
+            ent = self.auth_entities.get(entity)
+            if ent is None:
+                from ..auth import Keyring
+                key = Keyring.generate_key()
+                await self._propose_auth_ops([{
+                    "op": "entity_set", "entity": entity, "key": key,
+                    "caps": caps}])
+            elif caps and caps != ent.get("caps", ""):
+                await self._propose_auth_ops([{
+                    "op": "entity_caps", "entity": entity, "caps": caps}])
+            ent = self.auth_entities[entity]
+            return 0, {"entity": entity, "key": ent["key"],
+                       "caps": ent.get("caps", "")}
+        if prefix == "auth caps":
+            entity = str(cmd["entity"])
+            if entity not in self.auth_entities:
+                return -2, {"error": f"no entity {entity!r}"}
+            from ..auth.caps import Caps
+            Caps(str(cmd.get("caps", "")))
+            await self._propose_auth_ops([{
+                "op": "entity_caps", "entity": entity,
+                "caps": str(cmd.get("caps", ""))}])
+            return 0, {}
+        if prefix == "auth rm":
+            await self._propose_auth_ops([{
+                "op": "entity_rm", "entity": str(cmd["entity"])}])
+            return 0, {}
+        if prefix == "auth list":
+            return 0, {"entities": {
+                n: {"caps": e.get("caps", "")}
+                for n, e in sorted(self.auth_entities.items())}}
+        if prefix == "auth rotate":
+            svc = str(cmd.get("service", "osd"))
+            auth = await self._ticket_authority(svc)
+            import os as _os
+            await self._propose_auth_ops([{
+                "op": "service_secret", "svc": svc,
+                "gen": auth.generation + 1,
+                "secret": _os.urandom(32).hex()}])
+            return 0, {"generation": self.ticket_authorities[svc].generation}
+        if prefix == "auth ticket":
+            # issue a service ticket for the REQUESTING entity (banner
+            # identity when messenger auth is on; the named entity in
+            # dev/no-banner-auth mode), carrying its stored caps
+            svc = str(cmd.get("service", "osd"))
+            banner_auth = str(
+                self.config.get("auth_cluster_required")) != "none"
+            entity = (peer if banner_auth and peer
+                      else str(cmd.get("entity", peer)))
+            ent = self.auth_entities.get(entity)
+            if ent is None and entity == "client.admin":
+                ent = {"caps": "mon allow *, osd allow *, mgr allow *"}
+            if ent is None:
+                return -13, {"error": f"no entity {entity!r}"}
+            auth = await self._ticket_authority(svc)
+            ttl = float(cmd.get("ttl",
+                                self.config.get("auth_ticket_ttl")))
+            blob = auth.issue(entity, ent.get("caps", ""), ttl=ttl)
+            return 0, {"ticket": blob, "entity": entity,
+                       "generation": auth.generation}
+        if prefix == "auth service-keys":
+            # rotating secrets for service daemons (authenticated mon
+            # channel; reference rotating-key delivery to daemons)
+            svc = str(cmd.get("service", "osd"))
+            if str(self.config.get("auth_cluster_required")) != "none":
+                p = peer.split(".", 1)[0]
+                if p not in ("osd", "mon", "mgr"):
+                    return -13, {"error": "daemons only"}
+            auth = await self._ticket_authority(svc)
+            return 0, {"secrets": auth.export_secrets()}
         if prefix == "osd erasure-code-profile set":
             name = cmd["name"]
             profile = dict(cmd.get("profile", {}))

@@ -29,6 +29,7 @@ from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..objectstore.memstore import MemStore
 from ..objectstore.store import ObjectStore
+from .messages import EACCES
 from .ecbackend import (EIO, ENOENT, ESTALE, ClientOp, ECBackend, ECError,
                         NONE_OSD, NotActive)
 from .ecutil import StripeInfo
@@ -98,6 +99,11 @@ class OSDDaemon(Dispatcher):
         self.admin_socket = None
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
+        # cephx ticket validation (rotating secrets arrive from the mon
+        # at boot / lazily on unknown generations; static-mode harnesses
+        # inject them directly)
+        from ..auth.cephx import TicketVerifier
+        self.ticket_verifier = TicketVerifier("osd")
         self.up = False
         self.mgr_addr = mgr_addr
         # watch/notify state (reference Watch.cc): volatile, like the
@@ -138,6 +144,8 @@ class OSDDaemon(Dispatcher):
                 dout("osd", 0, f"osd.{self.whoami}: boot not acknowledged "
                                f"by any mon; serving anyway")
             self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+            if str(self.config.get("auth_client_required")) == "cephx":
+                await self._refresh_service_keys()
         # load_pgs: re-instantiate backends for collections on disk
         for c in self.store.list_collections():
             if c.pool in self.osdmap.pools:
@@ -462,10 +470,71 @@ class OSDDaemon(Dispatcher):
                 top.mark("reached_pg")
                 await self._do_client_op(conn, msg, top)
 
+    # op name -> required osd permission: mutations 'w', class exec 'x',
+    # everything else 'r' (reference OSDCap check in do_op)
+    _W_OPS = frozenset(("write", "append", "write_full", "truncate",
+                        "delete", "setxattr", "omap_set", "omap_rm"))
+    _X_OPS = frozenset(("call",))
+
+    def _check_osd_caps(self, msg: MOSDOp) -> "Optional[str]":
+        """cephx enforcement at dispatch: every op must carry a valid
+        mon-issued ticket whose caps cover the op class on this pool.
+        Returns an error string (EACCES) or None.  Enforced on EVERY
+        transport, including in-process (the ticket rides the message,
+        not the socket)."""
+        if str(self.config.get("auth_client_required")) != "cephx":
+            return None
+        from ..auth.cephx import TicketError
+        blob = msg.get("ticket")
+        if not blob:
+            return "no service ticket"
+        try:
+            entity, caps = self.ticket_verifier.verify(str(blob))
+        except TicketError as e:
+            return f"ticket rejected: {e}"
+        need = set()
+        for op in msg.get("ops", []):
+            name = op.get("op", "")
+            if name in self._W_OPS:
+                need.add("w")
+            elif name in self._X_OPS:
+                need.add("x")
+            else:
+                need.add("r")
+        pool = self.osdmap.get_pool(int(msg["pool"]))
+        pool_name = pool.name if pool else None
+        if not caps.allows("osd", "".join(sorted(need)), pool=pool_name):
+            return (f"{entity}: osd caps {caps.spec!r} do not allow "
+                    f"{''.join(sorted(need))!r} on pool {pool_name!r}")
+        return None
+
+    async def _refresh_service_keys(self) -> None:
+        if self.monc is None:
+            return
+        try:
+            res = await self.monc.command(
+                {"prefix": "auth service-keys", "service": "osd"})
+            self.ticket_verifier.update_secrets(
+                dict(res.get("secrets", {})))
+        except Exception as e:  # noqa: BLE001 — retried on next op
+            dout("osd", 1, f"service-key fetch failed: {e}")
+
     async def _do_client_op(self, conn, msg: MOSDOp, top=None) -> None:
         self.perf.inc("op")
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
+        deny = self._check_osd_caps(msg)
+        if deny is not None and "generation" in deny \
+                and self.monc is not None:
+            # ticket sealed under a newer rotation than we hold:
+            # refresh the rotating secrets once and re-check
+            await self._refresh_service_keys()
+            deny = self._check_osd_caps(msg)
+        if deny is not None:
+            await conn.send_message(MOSDOpReply({
+                "tid": msg["tid"], "result": -EACCES,
+                "outs": [{"error": deny}]}))
+            return
         be = self._get_backend(pgid)
         be.last_epoch = self.osdmap.epoch
         be.pool_snap_seq = self.osdmap.get_pool(pgid[0]).snap_seq

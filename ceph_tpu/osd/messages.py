@@ -25,7 +25,7 @@ from ..msg.message import Message, register_message
 # (the reference wire protocol encodes Linux errnos regardless of the
 # host platform; comparing against the platform's ``errno`` module would
 # mis-route replies on BSD/Darwin where ESTALE is 70).
-EIO, ENOENT, ESTALE = 5, 2, 116
+EIO, ENOENT, ESTALE, EACCES = 5, 2, 116, 13
 
 
 def pack_buffers(bufs: "List[bytes]") -> "Tuple[List[int], bytes]":

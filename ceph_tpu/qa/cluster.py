@@ -41,6 +41,7 @@ class MiniCluster:
         # device_mesh=True
         from ..parallel.plane import MeshDataPlane
         self.mesh_plane = MeshDataPlane()
+        self._cephx_auth = None
         self.mgr = None
         self.mon_addrs: "Dict[int, str]" = {
             r: f"local:mon.{r}" for r in range(n_mons)}
@@ -191,16 +192,17 @@ class MiniCluster:
 
     async def _admin_client(self) -> RadosClient:
         if self._admin is None:
-            self._admin = await self.client()
+            self._admin = await self.client(name="client.admin")
         return self._admin
 
-    async def client(self) -> RadosClient:
+    async def client(self, name: str = "") -> RadosClient:
         idx = len(self.clients)
+        name = name or f"client.{idx}"
         c = RadosClient(self.osdmap if not self.mon_addrs else None,
-                        name=f"client.{idx}", config=self.config,
+                        name=name, config=self.config,
                         mon_addrs=self.mon_addrs or None)
         await c.connect("127.0.0.1:0" if self._tcp
-                        else f"local:client.{idx}")
+                        else f"local:{name}.{idx}")
         self.clients.append(c)
         return c
 
@@ -224,6 +226,9 @@ class MiniCluster:
             osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
                             config=self.config, mgr_addr=old.mgr_addr,
                             mesh_plane=self.mesh_plane)
+        if self._cephx_auth is not None:
+            osd.ticket_verifier.update_secrets(
+                self._cephx_auth.export_secrets())
             self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd
@@ -239,6 +244,18 @@ class MiniCluster:
             if osd.up:
                 out.update(await osd.peer_all_pgs())
         return out
+
+    def cephx_authority(self):
+        """Static-mode cephx harness: one ticket authority whose
+        rotating secrets are injected into every daemon's verifier (mon
+        mode distributes them via 'auth service-keys' instead)."""
+        from ..auth.cephx import TicketAuthority
+        if self._cephx_auth is None:
+            self._cephx_auth = TicketAuthority("osd")
+        for osd in self.osds.values():
+            osd.ticket_verifier.update_secrets(
+                self._cephx_auth.export_secrets())
+        return self._cephx_auth
 
     def pool_mksnap(self, pool_name: str, snap: str) -> int:
         """Static-mode pool snapshot (the 'osd pool mksnap' analog)."""

@@ -1,0 +1,223 @@
+"""cephx tickets + per-entity caps enforced at dispatch (verdict item 5).
+
+Reference: src/auth/cephx/CephxProtocol.h (time-limited service tickets
+under rotating secrets) + src/mon/AuthMonitor.cc (entity db, caps) +
+OSDCap/MonCap checks at op dispatch.  The key property: a wrong-cap or
+unticketed client gets EACCES ON THE OP (including over the in-process
+transport — the ticket rides the message, not the socket), and ticket
+expiry forces a renewal round trip to the mon.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.auth.caps import Caps, CapsError
+from ceph_tpu.auth.cephx import TicketAuthority, TicketError, TicketVerifier
+from ceph_tpu.client.objecter import ObjecterError
+from ceph_tpu.common.config import Config
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+class TestCaps:
+    def test_parse_and_allow(self):
+        caps = Caps("mon allow r, osd allow rw pool=data")
+        assert caps.allows("mon", "r")
+        assert not caps.allows("mon", "w")
+        assert caps.allows("osd", "rw", pool="data")
+        assert caps.allows("osd", "r", pool="data")
+        assert not caps.allows("osd", "r", pool="other")
+        assert not caps.allows("osd", "x", pool="data")
+
+    def test_star_and_multiple_clauses(self):
+        caps = Caps("osd allow r; osd allow w pool=wr, mon allow *")
+        assert caps.allows("osd", "r", pool="anything")
+        assert caps.allows("osd", "w", pool="wr")
+        assert not caps.allows("osd", "w", pool="rd")
+        assert caps.allows("mon", "rwx")
+
+    def test_rejects_garbage(self):
+        for bad in ("osd r", "foo allow r", "osd allow q",
+                    "osd allow r cluster=x"):
+            with pytest.raises(CapsError):
+                Caps(bad)
+
+    def test_empty_caps_allow_nothing(self):
+        assert not Caps("").allows("osd", "r")
+
+
+class TestTickets:
+    def test_round_trip(self):
+        auth = TicketAuthority("osd")
+        blob = auth.issue("client.foo", "osd allow r pool=p")
+        ver = TicketVerifier("osd", auth.export_secrets())
+        entity, caps = ver.verify(blob)
+        assert entity == "client.foo"
+        assert caps.allows("osd", "r", pool="p")
+
+    def test_expiry(self):
+        auth = TicketAuthority("osd")
+        blob = auth.issue("client.foo", "", ttl=0.05)
+        ver = TicketVerifier("osd", auth.export_secrets())
+        ver.verify(blob)
+        with pytest.raises(TicketError, match="expired"):
+            ver.verify(blob, now=time.time() + 1)
+
+    def test_tamper_rejected(self):
+        auth = TicketAuthority("osd")
+        blob = auth.issue("client.foo", "osd allow r")
+        ver = TicketVerifier("osd", auth.export_secrets())
+        bad = blob[:-8] + ("AAAAAAA=" if not blob.endswith("AAAAAAA=")
+                           else "BBBBBBB=")
+        with pytest.raises(TicketError):
+            ver.verify(bad)
+
+    def test_rotation_keeps_old_generations(self):
+        auth = TicketAuthority("osd", keep=2)
+        old = auth.issue("e", "")
+        auth.rotate()
+        new = auth.issue("e", "")
+        ver = TicketVerifier("osd", auth.export_secrets())
+        ver.verify(old)   # still within keep window
+        ver.verify(new)
+        auth.rotate()     # old generation expires out of the window
+        ver.update_secrets(auth.export_secrets())
+        with pytest.raises(TicketError, match="generation"):
+            ver.verify(old)
+
+    def test_wrong_service(self):
+        auth = TicketAuthority("mgr")
+        blob = auth.issue("e", "")
+        ver = TicketVerifier("osd", auth.export_secrets())
+        with pytest.raises(TicketError, match="service"):
+            ver.verify(blob)
+
+
+def cephx_cluster():
+    cfg = Config()
+    cfg.set("auth_client_required", "cephx")
+    cluster = MiniCluster(5, config=cfg)
+    cluster.create_ec_pool("data", {"plugin": "jax_rs", "k": "2",
+                                    "m": "1"}, pg_num=4, stripe_unit=64)
+    cluster.create_ec_pool("other", {"plugin": "jax_rs", "k": "2",
+                                     "m": "1"}, pg_num=4, stripe_unit=64)
+    return cluster
+
+
+class TestOsdEnforcement:
+    def test_op_without_ticket_gets_eacces(self, loop):
+        """The op itself — not just the connection — is rejected, on the
+        in-process transport (round-2 weak item 6)."""
+        async def go():
+            async with cephx_cluster() as cluster:
+                client = await cluster.client()
+                io = client.io_ctx("data")
+                with pytest.raises(ObjecterError) as ei:
+                    await io.write_full("obj", b"x" * 128)
+                assert ei.value.errno == 13
+        loop.run_until_complete(go())
+
+    def test_caps_enforced_per_pool_and_perm(self, loop):
+        async def go():
+            async with cephx_cluster() as cluster:
+                auth = cluster.cephx_authority()
+                admin = await cluster.client()
+                admin.set_ticket(auth.issue(
+                    "client.admin", "osd allow *"))
+                data = payload(256, 1)
+                await admin.io_ctx("data").write_full("obj", data)
+
+                ro = await cluster.client()
+                ro.set_ticket(auth.issue(
+                    "client.ro", "osd allow r pool=data"))
+                io = ro.io_ctx("data")
+                assert await io.read("obj") == data
+                with pytest.raises(ObjecterError) as ei:
+                    await io.write_full("obj2", b"nope")
+                assert ei.value.errno == 13
+                with pytest.raises(ObjecterError) as ei:
+                    await ro.io_ctx("other").read("obj")
+                assert ei.value.errno == 13
+        loop.run_until_complete(go())
+
+    def test_expired_ticket_renews(self, loop):
+        async def go():
+            async with cephx_cluster() as cluster:
+                auth = cluster.cephx_authority()
+                client = await cluster.client()
+                renewals = []
+
+                async def renew():
+                    renewals.append(1)
+                    return auth.issue("client.rw", "osd allow rw pool=data")
+
+                client.set_ticket(
+                    auth.issue("client.rw", "osd allow rw pool=data",
+                               ttl=0.25),
+                    renewer=renew)
+                io = client.io_ctx("data")
+                await io.write_full("obj", b"a" * 128)
+                await asyncio.sleep(0.35)       # ticket now expired
+                await io.write_full("obj", b"b" * 128)   # auto-renews
+                assert renewals == [1]
+                assert await io.read("obj") == b"b" * 128
+        loop.run_until_complete(go())
+
+
+class TestMonManagedCephx:
+    def test_end_to_end_ticket_economy(self, loop):
+        """Mon issues keys/caps/tickets; OSDs learn rotating secrets
+        from the mon; enforcement + caps changes round-trip."""
+        async def go():
+            from tests.test_mon import fast_config
+            cfg = fast_config()
+            cfg.set("auth_client_required", "cephx")
+            async with MiniCluster(4, n_mons=1, config=cfg) as cluster:
+                await cluster.create_ec_pool_cmd(
+                    "data", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=4)
+                admin = await cluster._admin_client()
+                out = await admin.mon_command({
+                    "prefix": "auth get-or-create",
+                    "entity": "client.app",
+                    "caps": "mon allow r, osd allow r pool=data"})
+                assert out["key"]
+                # admin gets a full-caps ticket; app a read-only one
+                await admin.fetch_ticket(entity="client.admin")
+                data = payload(256, 2)
+                await admin.io_ctx("data").write_full("obj", data)
+
+                app = await cluster.client()
+                await app.fetch_ticket(entity="client.app")
+                io = app.io_ctx("data")
+                assert await io.read("obj") == data
+                with pytest.raises(ObjecterError) as ei:
+                    await io.write_full("obj", b"no")
+                assert ei.value.errno == 13
+
+                # caps upgrade takes effect on the next ticket
+                await admin.mon_command({
+                    "prefix": "auth caps", "entity": "client.app",
+                    "caps": "mon allow r, osd allow rw pool=data"})
+                await app.fetch_ticket(entity="client.app")
+                await io.write_full("obj", b"yes!")
+                assert await io.read("obj") == b"yes!"
+
+                listing = await admin.mon_command({"prefix": "auth list"})
+                assert "client.app" in listing["entities"]
+        loop.run_until_complete(go())
