@@ -229,6 +229,10 @@ class MiniCluster:
         if self._cephx_auth is not None:
             osd.ticket_verifier.update_secrets(
                 self._cephx_auth.export_secrets())
+        if not self.mon_addrs:
+            # Static mode has no mon to mark the revived OSD up; do it
+            # unconditionally here (the local: transport keeps the same
+            # address, so _publish_addrs alone would never re-add it).
             self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd
