@@ -203,6 +203,19 @@ class MonDaemon(Dispatcher):
             m.ec_profiles.pop(op["name"], None)
         elif kind == "create_pool":
             m.create_pool(op["name"], **op.get("kwargs", {}))
+        elif kind == "pool_set":
+            # values are validated+typed at command time (below); the
+            # apply path must never raise — a malformed committed op
+            # would crash every monitor on apply AND on log replay
+            try:
+                pool = m.get_pool(int(op["pool"]))
+                key = op["key"]
+                if key == "fast_read":
+                    pool.fast_read = bool(op["value"])
+                elif key == "min_size":
+                    pool.min_size = int(op["value"])
+            except (KeyError, ValueError, TypeError) as e:
+                dout("mon", 0, f"pool_set apply skipped: {e}")
         elif kind == "pool_mksnap":
             pool = m.get_pool(int(op["pool"]))
             pool.snap_seq += 1
@@ -588,6 +601,47 @@ class MonDaemon(Dispatcher):
                 "op": "create_pool", "name": name, "kwargs": kwargs}])
             pool = self.osdmap.pool_by_name(name)
             return 0, {"pool_id": pool.pool_id, "epoch": v}
+        if prefix == "osd pool set":
+            # 'ceph osd pool set <pool> <key> <value>' (reference
+            # OSDMonitor prepare_command_pool_set).  Only keys that are
+            # safe to change on a live pool are accepted: pg_num needs
+            # PG-split machinery, stripe_unit a re-stripe, size a
+            # backfill — none exist, so changing them would strand or
+            # corrupt existing data.  Values are validated HERE, before
+            # they can enter the paxos log.
+            pool = self.osdmap.pool_by_name(cmd["name"])
+            if pool is None:
+                return -2, {"error": f"no pool {cmd['name']!r}"}
+            key = cmd["key"]
+            raw = cmd.get("value")
+            if key == "fast_read":
+                sval = str(raw).lower()
+                if sval not in ("0", "1", "true", "false", "yes", "no",
+                                "on", "off"):
+                    return -22, {"error": f"invalid bool {raw!r}"}
+                value = sval in ("1", "true", "yes", "on")
+            elif key == "min_size":
+                try:
+                    value = int(raw)
+                except (TypeError, ValueError):
+                    return -22, {"error": f"invalid int {raw!r}"}
+                # EC pools: min_size below k would ack writes that a
+                # subsequent shard loss makes undecodable (reference:
+                # 'min_size must be between k and size')
+                lo = 1
+                if pool.is_erasure():
+                    prof = self.osdmap.ec_profiles.get(
+                        pool.ec_profile, {})
+                    lo = int(prof.get("k", 2))
+                if not lo <= value <= pool.size:
+                    return -22, {"error": f"min_size {value} out of "
+                                          f"[{lo}, {pool.size}]"}
+            else:
+                return -22, {"error": f"cannot set pool key {key!r}"}
+            v = await self._propose_osd_ops([{
+                "op": "pool_set", "pool": pool.pool_id,
+                "key": key, "value": value}])
+            return 0, {"epoch": v}
         if prefix == "osd pool ls":
             return 0, {"pools": [p.name for p in
                                  self.osdmap.pools.values()]}

@@ -194,11 +194,11 @@ class Connection:
 
     async def _transmit(self, frame: bytes) -> None:
         inj = self.messenger.injector
-        if inj.drop():
+        dropped = inj.drop()
+        if dropped and self.policy.lossy:
             dout("ms", 5, f"{self.messenger.name}: injected drop to "
                  f"{self.peer_addr}")
             return
-        await inj.maybe_delay()
         if inj.kill_socket():
             dout("ms", 5, f"{self.messenger.name}: injected socket kill to "
                  f"{self.peer_addr}")
@@ -216,6 +216,19 @@ class Connection:
         if writer is None:
             return
         async with self._send_lock:
+            # injection sleeps run INSIDE the send lock: later frames
+            # queue behind the delayed one, so lossless FIFO ordering
+            # survives (real TCP never reorders within a connection)
+            if dropped:
+                # lossless drop = retransmit, never loss.  Aborting the
+                # session instead would strand the unacked tail on
+                # ACCEPTED connections, which have no reconnect replay
+                # loop (only outgoing ones run _run_outgoing).
+                dout("ms", 5, f"{self.messenger.name}: injected drop to "
+                     f"{self.peer_addr}, lossless retransmit")
+                await asyncio.sleep(0.02 + inj.rng.random() * 0.05)
+            else:
+                await inj.maybe_delay()
             try:
                 writer.write(frame)
                 await writer.drain()
@@ -434,6 +447,11 @@ class _LocalConnection:
         self.policy = policy
         self.closed = False
         self._reverse: "Optional[_LocalConnection]" = None
+        # FIFO guard for injected delays: while one frame sleeps, later
+        # sends queue here instead of overtaking it (a real TCP session
+        # never reorders within a connection)
+        self._backlog: "List[Message]" = []
+        self._delaying = False
 
     def _get_reverse(self) -> "_LocalConnection":
         if self._reverse is None:
@@ -457,11 +475,59 @@ class _LocalConnection:
             self.peer = new
             self.peer_name = new.name
             self._reverse = None
-        inj = self.messenger.injector
-        if inj.drop() or inj.kill_socket():
-            dout("ms", 5, f"{self.messenger.name}: injected local drop")
+        if self._delaying:
+            # a delayed frame is in flight: keep FIFO order by queueing
+            # behind it (the delaying task drains the backlog)
+            self._backlog.append(msg)
             return
-        await inj.maybe_delay()
+        inj = self.messenger.injector
+        delay = 0.0
+        if inj.drop() or inj.kill_socket():
+            if self.policy.lossy:
+                dout("ms", 5, f"{self.messenger.name}: injected local drop")
+                return
+            # lossless: never silently lose a frame — the tcp transport
+            # retransmits after an injected drop; the in-process
+            # transport simulates that with a redelivery delay
+            dout("ms", 5, f"{self.messenger.name}: injected local drop, "
+                 f"lossless retransmit")
+            delay = 0.05 + inj.rng.random() * 0.1
+        dmax = float(self.messenger.conf("ms_inject_delay_max"))
+        if dmax > 0:
+            delay += inj.rng.random() * dmax
+        if delay > 0:
+            self._delaying = True
+            try:
+                await asyncio.sleep(delay)
+                try:
+                    await self._deliver_msg(msg)
+                finally:
+                    # drain even when the principal frame's delivery
+                    # raised (peer died mid-sleep): stranded backlog
+                    # frames would otherwise be silently lost AND
+                    # redelivered out of order by a later delay cycle
+                    while self._backlog:
+                        nxt = self._backlog.pop(0)
+                        try:
+                            await self._deliver_msg(nxt)
+                        except ConnectionError as e:
+                            # the enqueuing caller is long gone; this is
+                            # the in-flight-loss-on-crash case
+                            dout("ms", 1, f"backlog frame to "
+                                 f"{self.peer_addr} lost: {e}")
+            finally:
+                self._delaying = False
+            return
+        await self._deliver_msg(msg)
+
+    async def _deliver_msg(self, msg: Message) -> None:
+        if self.peer.stopped:
+            new = Messenger._local_registry.get(self.peer_addr)
+            if new is None or new.stopped:
+                raise ConnectionError(f"peer at {self.peer_addr} is down")
+            self.peer = new
+            self.peer_name = new.name
+            self._reverse = None
         # re-encode/decode: no shared mutable state between daemons
         header, data = msg.encode()
         peer_msg = decode_message(header, data,

@@ -269,11 +269,14 @@ class OSDDaemon(Dispatcher):
         sinfo = StripeInfo.for_codec(codec, pool.stripe_unit)
         be = ECBackend(pgid, self.whoami, codec, sinfo, self.store,
                        self._send_to_osd, lambda p=pgid: self._acting(p),
-                       min_size=pool.min_size,
+                       min_size=lambda p=pgid[0]: self.osdmap.get_pool(
+                           p).min_size,
                        encode_service=self.encode_service,
                        scheduler=self.op_scheduler, config=self.config,
                        mesh_plane=self.mesh_plane,
-                       device_mesh=getattr(pool, "device_mesh", False))
+                       device_mesh=getattr(pool, "device_mesh", False),
+                       fast_read=lambda p=pgid[0]: getattr(
+                           self.osdmap.get_pool(p), "fast_read", False))
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be

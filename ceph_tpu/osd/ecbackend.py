@@ -124,6 +124,11 @@ class Op:
     failed_shards: "Set[int]" = field(default_factory=set)
     acting: "List[int]" = field(default_factory=list)   # at issue time
     mesh_handles: "List[int]" = field(default_factory=list)
+    # extents this op actually pinned in the ExtentCache — release must
+    # unpin exactly these (unpinning will_write extents the op never
+    # pinned would decrement ANOTHER in-flight op's pin and trim its
+    # post-image early, corrupting that op's successors)
+    pinned: "List[Extent]" = field(default_factory=list)
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
 
 
@@ -144,9 +149,16 @@ class ReadOp:
     requests: "Dict[str, ReadRequest]"
     for_recovery: bool
     want_to_read: "List[int]"
+    # fast_read (reference do_redundant_reads, ECBackend.h:375): reads
+    # were issued to EVERY available shard; complete as soon as any
+    # decodable subset has answered and ignore straggler replies
+    fast_read: bool = False
     in_progress: "Set[int]" = field(default_factory=set)
     retries_pending: int = 0
     bad_shards: "Set[int]" = field(default_factory=set)
+    # fast_read failures are per (object, shard): a shard erroring on
+    # one object may still have served valid chunks of the others
+    obj_bad: "Dict[str, Set[int]]" = field(default_factory=dict)
     complete: "Dict[str, Dict[int, Dict[int, bytes]]]" = field(
         default_factory=dict)                   # oid -> shard -> off -> bytes
     sizes: "Dict[str, Dict[int, int]]" = field(
@@ -189,7 +201,8 @@ class ECBackend:
                  min_size: "Optional[int]" = None,
                  encode_service=None, scheduler=None,
                  config=None, mesh_plane=None,
-                 device_mesh: bool = False) -> None:
+                 device_mesh: bool = False,
+                 fast_read=False) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -199,7 +212,9 @@ class ECBackend:
         self.get_acting = get_acting
         self.k = codec.get_data_chunk_count()
         self.m = codec.get_coding_chunk_count()
-        self.min_size = min_size if min_size is not None else self.k
+        # int, or a zero-arg callable so runtime `osd pool set <pool>
+        # min_size` takes effect without rebuilding the cached backend
+        self._min_size_src = min_size
         # daemon-shared cross-PG batched device encode queue (None =
         # direct host/codec calls, the reference's per-op behavior)
         self.encode_service = encode_service
@@ -214,6 +229,10 @@ class ECBackend:
         # reference seam src/osd/ECBackend.cc:2074-2084, :2345)
         self.mesh_plane = mesh_plane
         self.device_mesh = bool(device_mesh)
+        # pool fast_read flag — bool, or a zero-arg callable so runtime
+        # `osd pool set <pool> fast_read` changes take effect without
+        # rebuilding the backend (reference reads pool.fast_read per op)
+        self._pool_fast_read = fast_read
         # newest pool snapid (daemon refreshes per op): a mutation of an
         # object whose oi.snap_seq is older clones it first (COW)
         self.pool_snap_seq = 0
@@ -277,6 +296,13 @@ class ECBackend:
         self._load_pg_meta()
 
     # ------------------------------------------------------------------ utils
+
+    @property
+    def min_size(self) -> int:
+        src = self._min_size_src
+        if src is None:
+            return self.k
+        return int(src() if callable(src) else src)
 
     @property
     def my_shard(self) -> int:
@@ -636,6 +662,15 @@ class ECBackend:
 
     def _fail_op(self, op: Op, err: Exception) -> None:
         self._release_mesh_handles(op)
+        if op.pinned:
+            # unpin the op's cached post-image stripes: a failed write's
+            # extents otherwise stay pinned FOREVER, and a later RMW
+            # append would read its never-committed bytes as the stripe
+            # base — acked-write corruption (found by the thrasher: a
+            # below-min_size write during a kill leaked its pins).  The
+            # reference clears the ExtentCache wholesale in on_change.
+            self.extent_cache.release_write(op.oid, op.pinned)
+            op.pinned = []
         for q in (self.waiting_state, self.waiting_reads,
                   self.waiting_commit):
             if op in q:
@@ -792,6 +827,7 @@ class ECBackend:
                             f"mesh encode failed for {op.oid}: {e}"))
                         return
                     self.extent_cache.present_rmw_update(op.oid, off, buf)
+                    op.pinned.append((off, int(np.size(buf))))
                     continue
                 if self.encode_service is not None:
                     # daemon-wide batched device encode: this op's stripes
@@ -827,6 +863,7 @@ class ECBackend:
                     shard_txns[shard]["writes"].append(
                         (chunk_off, bytes(chunk.tobytes())))
                 self.extent_cache.present_rmw_update(op.oid, off, buf)
+                op.pinned.append((off, int(np.size(buf))))
             if not stripes and (op.truncate_to is not None or op.writes):
                 # a bare truncate breaks the chain; pure xattr/omap ops
                 # leave the data (and its hashes) untouched
@@ -989,8 +1026,9 @@ class ECBackend:
             self.waiting_commit.remove(op)
         self.tid_to_op.pop(op.tid, None)
         self._unproject(op)
-        if op.plan:
-            self.extent_cache.release_write(op.oid, op.plan.will_write)
+        if op.pinned:
+            self.extent_cache.release_write(op.oid, op.pinned)
+            op.pinned = []
         if not op.on_commit.done():
             op.on_commit.set_result(op.version)
         if self.waiting_state:
@@ -1240,6 +1278,16 @@ class ECBackend:
         return {s: o for s, o in enumerate(self.get_acting())
                 if o != NONE_OSD}
 
+    def fast_read_enabled(self) -> bool:
+        """pool.fast_read OR the osd_fast_read override (reference
+        ECBackend.cc:2400 chooses do_redundant_reads from
+        pool.info.is_fast_read(); common/options osd_fast_read)."""
+        if self.k <= 1:
+            return False
+        pf = (self._pool_fast_read() if callable(self._pool_fast_read)
+              else bool(self._pool_fast_read))
+        return pf or bool(self.opt("osd_fast_read", False))
+
     def _min_to_read(self, avail: "Set[int]",
                      want: "Sequence[int]") -> "Dict[int, list]":
         """reference get_min_avail_to_read_shards ECBackend.cc:1594:
@@ -1281,8 +1329,17 @@ class ECBackend:
             need = self._min_to_read(set(avail), want)
         except ErasureCodeError as e:
             raise ECError(f"object unreadable: {e}")
+        fast = not for_recovery and self.fast_read_enabled()
+        if fast:
+            # redundant reads (reference do_redundant_reads,
+            # ECBackend.cc:2400): ask EVERY available shard for its full
+            # chunk and decode from whichever k answer first.  The
+            # minimum plan above still gates decodability up front.
+            sub_count = self.codec.get_sub_chunk_count()
+            need = {s: [[0, sub_count]] for s in avail}
         rop = ReadOp(tid=self.new_tid(), requests={},
-                     for_recovery=for_recovery, want_to_read=want)
+                     for_recovery=for_recovery, want_to_read=want,
+                     fast_read=fast)
         rop.done = asyncio.get_event_loop().create_future()
         for oid, extents in reads.items():
             chunk_extents: "List[Extent]" = []
@@ -1359,21 +1416,30 @@ class ECBackend:
             if avail[shard] == self.whoami:
                 local.append(msg)
             else:
-                try:
-                    await self.send(avail[shard], msg)
-                except (ConnectionError, OSError, ECError) as e:
-                    # treat an unreachable shard like an EIO reply so the
-                    # normal re-plan path widens the shard set
-                    dout("osd", 1,
-                         f"sub_read to shard {shard} failed: {e}")
-                    self.handle_sub_read_reply(MECSubOpReadReply({
-                        "pgid": list(self.pgid), "shard": shard,
-                        "from_osd": self.whoami, "tid": rop.tid,
-                        "buffers_read": [], "attrs_read": {},
-                        "errors": {r["oid"]: EIO for r in to_read},
-                        "lens": []}))
+                # concurrent issue: the in-process transport delivers
+                # inline, so a serial loop would stall every later shard
+                # (and fast_read's whole point) behind one slow peer
+                asyncio.ensure_future(
+                    self._send_sub_read(avail[shard], shard, to_read,
+                                        msg, rop))
         for msg in local:
             self.handle_sub_read_reply(self.handle_sub_read(msg))
+
+    async def _send_sub_read(self, osd: int, shard: int,
+                             to_read: "List[dict]", msg: MECSubOpRead,
+                             rop: ReadOp) -> None:
+        try:
+            await self.send(osd, msg)
+        except (ConnectionError, OSError, ECError) as e:
+            # treat an unreachable shard like an EIO reply so the
+            # normal re-plan path widens the shard set
+            dout("osd", 1, f"sub_read to shard {shard} failed: {e}")
+            self.handle_sub_read_reply(MECSubOpReadReply({
+                "pgid": list(self.pgid), "shard": shard,
+                "from_osd": self.whoami, "tid": rop.tid,
+                "buffers_read": [], "attrs_read": {},
+                "errors": {r["oid"]: EIO for r in to_read},
+                "lens": []}))
 
     def handle_sub_read_reply(self, msg: MECSubOpReadReply) -> None:
         """Collect shard replies; on error widen the shard set
@@ -1406,14 +1472,46 @@ class ECBackend:
         failed = dict(msg.get("errors", {}))
         if failed:
             rop.bad_shards.add(shard)
-            rop.retries_pending += 1
-            asyncio.ensure_future(self._retry_reads(rop, list(failed)))
-            return
+            for oid in failed:
+                rop.obj_bad.setdefault(oid, set()).add(shard)
+            if not rop.fast_read:
+                rop.retries_pending += 1
+                asyncio.ensure_future(self._retry_reads(rop, list(failed)))
+                return
+            # fast_read already asked every available shard: there is no
+            # wider set to re-plan over; completion below decides per
+            # object whether the survivors still decode
         self._maybe_complete_read(rop)
 
+    def _fast_read_decodable(self, rop: ReadOp, oid: str) -> bool:
+        have = set(rop.complete.get(oid, {})) - rop.obj_bad.get(oid, set())
+        try:
+            self._min_to_read(have, rop.want_to_read)
+        except ErasureCodeError:
+            return False
+        return True
+
     def _maybe_complete_read(self, rop: ReadOp) -> None:
-        if (not rop.in_progress and not rop.retries_pending
-                and not rop.done.done()):
+        if rop.done.done():
+            return
+        if rop.fast_read and rop.in_progress:
+            # early completion: finish as soon as every object can be
+            # decoded from the shards that already answered; straggler
+            # replies find no in-flight op and are dropped (reference
+            # complete_read_op fires once enough redundant reads land)
+            if all(oid in rop.errors or self._fast_read_decodable(rop, oid)
+                   for oid in rop.requests):
+                self.in_flight_reads.pop(rop.tid, None)
+                rop.done.set_result(rop)
+            return
+        if not rop.in_progress and not rop.retries_pending:
+            if rop.fast_read:
+                # every shard has answered: any object still missing a
+                # decodable set is genuinely unreadable
+                for oid in rop.requests:
+                    if (oid not in rop.errors
+                            and not self._fast_read_decodable(rop, oid)):
+                        rop.errors[oid] = EIO
             self.in_flight_reads.pop(rop.tid, None)
             rop.done.set_result(rop)
 
@@ -2142,6 +2240,11 @@ class ECBackend:
     async def _do_peer(self) -> dict:
         async with self._lock:
             self._drain_in_flight()
+            # interval change resets ALL pipeline caches (reference
+            # ECBackend::on_change): while another primary ruled, our
+            # cached stripe bytes went stale — an RMW read hitting them
+            # after we regain primariship would corrupt the stripe
+            self.extent_cache = ExtentCache()
         up = self._avail_shards()
         infos: "Dict[int, dict]" = {}
         # peering at this epoch deposes any older primary on our own

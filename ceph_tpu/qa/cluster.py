@@ -141,7 +141,8 @@ class MiniCluster:
     def create_ec_pool(self, name: str, profile: "Optional[dict]" = None,
                        pg_num: int = 8, stripe_unit: int = 4096,
                        min_size: "Optional[int]" = None,
-                       device_mesh: bool = False):
+                       device_mesh: bool = False,
+                       fast_read: bool = False):
         """Static-mode pool creation (direct map mutation)."""
         assert not self.mon_addrs, "mon mode: use create_ec_pool_cmd"
         profile = dict(profile or {"plugin": "jax_rs", "k": "4", "m": "2"})
@@ -156,7 +157,7 @@ class MiniCluster:
         pool = self.osdmap.create_pool(
             name, type=POOL_ERASURE, size=k + m, min_size=min_size,
             pg_num=pg_num, ec_profile=prof_name, stripe_unit=stripe_unit,
-            device_mesh=device_mesh)
+            device_mesh=device_mesh, fast_read=fast_read)
         self.osdmap.bump()
         return pool
 

@@ -1,0 +1,91 @@
+"""ExtentCache pin hygiene (thrasher-found data corruption): a write
+that FAILS (below min_size during kills) must unpin its cached
+post-image stripes, and an interval change must reset the primary's
+cache.  Leaked pins survive on a long-lived daemon; once the cluster's
+content moves on through a DIFFERENT primary, the stale cached bytes
+diverge from the store, and a later RMW append through the leaky
+primary reads them as the stripe base — an acked write whose stored
+stripes disagree with the cluster's real prior content
+(read-after-ack mismatch).
+
+Reference behavior: ECBackend::on_change clears pipeline state
+(including the ExtentCache) on every interval change, and completed ops
+release their pins via pin_state (src/osd/ExtentCache.h:15-40).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+
+PROFILE = {"plugin": "jax_rs", "k": "3", "m": "2"}
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_failed_write_pins_never_corrupt_later_appends(loop):
+    """The thrash corruption, deterministically:
+
+    1. write X via primary A.
+    2. A and one parity holder die; interim primary B's write Y FAILS
+       below min_size — its post-image pins leak into B's cache
+       (Y still applied on the 3 reachable shards).
+    3. everyone revives; A re-peers: Y sits on 3 >= k shards, wins the
+       auth election, becomes the content.
+    4. write W via A — content moves on while B's cache holds Y.
+    5. A dies again: B is interim primary once more, cache stale.
+    6. append Z via B: the RMW stripe base must be W, not the leaked
+       cached Y bytes.
+    """
+    async def go():
+        async with MiniCluster(n_osds=7) as c:
+            c.create_ec_pool("p", PROFILE, pg_num=4, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("p")
+            rng = np.random.default_rng(5)
+            oid = "victim"
+            pool = c.osdmap.pool_by_name("p")
+            pg = c.osdmap.object_to_pg(pool.pool_id, oid)
+            _up, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+            a_osd = acting[0]
+            x = rng.integers(0, 256, 874, dtype=np.uint8).tobytes()
+            await io.write_full(oid, x)
+            # 2) A + one parity holder die; B's write fails below
+            # min_size (3 durable < 4) AFTER pinning its stripes
+            await c.kill_osd(a_osd)
+            await c.kill_osd(acting[4])
+            await c.peer_all()
+            y = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+            with pytest.raises(Exception):
+                await io.write_full(oid, y)
+            # 3) heal; Y was applied on 3 >= k shards so it wins the
+            # auth election and becomes the object's content
+            await c.revive_osd(a_osd)
+            await c.revive_osd(acting[4])
+            await c.peer_all()
+            assert await io.read(oid) == y, \
+                "k-shard-applied write should win the auth election"
+            # 4) content moves on through primary A
+            w = rng.integers(0, 256, 900, dtype=np.uint8).tobytes()
+            await io.write_full(oid, w)
+            assert await io.read(oid) == w
+            # 5) A dies: B interim primary again with its stale cache
+            await c.kill_osd(a_osd)
+            await c.peer_all()
+            # 6) unaligned append through B: RMW base must be W
+            z = rng.integers(0, 256, 500, dtype=np.uint8).tobytes()
+            await io.append(oid, z)
+            got = await io.read(oid)
+            assert got == w + z, (
+                f"append corrupted by stale extent-cache pins: "
+                f"{len(got)} bytes, first diff at "
+                f"{next((i for i, (g, e) in enumerate(zip(got, w + z)) if g != e), -1)}")
+    loop.run_until_complete(go())

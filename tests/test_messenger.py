@@ -203,18 +203,30 @@ class TestLocalTransport:
         run(main())
 
     def test_drop_injection(self):
+        """Injected drops lose frames on LOSSY connections only; a
+        lossless peer retransmits (the reference injects socket kills
+        and replay-on-reconnect resends the unacked tail — silent loss
+        would violate the lossless contract)."""
         async def main():
+            from ceph_tpu.msg.messenger import Policy
             cfg = make_config(ms_type="async+local", ms_inject_drop_ratio=1.0)
             server = Messenger.create("osd.0", cfg)
             coll = Collector()
             server.add_dispatcher(coll)
             await server.bind("local:osdX")
             client = Messenger.create("client.1", cfg)
-            conn = client.get_connection("local:osdX")
+            conn = client.get_connection("local:osdX",
+                                         Policy.lossy_client())
             await conn.send_message(MTest({"n": 1}))
             await asyncio.sleep(0.05)
             assert coll.received == []
+            client2 = Messenger.create("client.2", cfg)
+            lossless = client2.get_connection("local:osdX")
+            await lossless.send_message(MTest({"n": 2}))
+            await asyncio.sleep(0.3)
+            assert [m["n"] for m in coll.received] == [2]
             await server.shutdown()
             await client.shutdown()
+            await client2.shutdown()
 
         run(main())
