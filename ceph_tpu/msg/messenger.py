@@ -477,8 +477,12 @@ class _LocalConnection:
             self._reverse = None
         if self._delaying:
             # a delayed frame is in flight: keep FIFO order by queueing
-            # behind it (the delaying task drains the backlog)
-            self._backlog.append(msg)
+            # behind it; await our own delivery so failures still reach
+            # the sender (the write path's commit gate depends on send
+            # errors surfacing, not being logged away)
+            fut = asyncio.get_event_loop().create_future()
+            self._backlog.append((msg, fut))
+            await fut
             return
         inj = self.messenger.injector
         delay = 0.0
@@ -507,14 +511,17 @@ class _LocalConnection:
                     # frames would otherwise be silently lost AND
                     # redelivered out of order by a later delay cycle
                     while self._backlog:
-                        nxt = self._backlog.pop(0)
+                        nxt, fut = self._backlog.pop(0)
                         try:
                             await self._deliver_msg(nxt)
-                        except ConnectionError as e:
-                            # the enqueuing caller is long gone; this is
-                            # the in-flight-loss-on-crash case
-                            dout("ms", 1, f"backlog frame to "
-                                 f"{self.peer_addr} lost: {e}")
+                        except Exception as e:  # noqa: BLE001 — route to
+                            # the enqueuing sender (incl. dispatch errors
+                            # that inline delivery would have raised)
+                            if not fut.done():
+                                fut.set_exception(e)
+                        else:
+                            if not fut.done():
+                                fut.set_result(None)
             finally:
                 self._delaying = False
             return

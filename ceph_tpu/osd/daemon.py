@@ -578,6 +578,7 @@ class OSDDaemon(Dispatcher):
                         "omap_rm", keys=list(op.get("keys", []))))
                 elif name == "omap_get":
                     await be.ensure_active()
+                    await be.wait_readable(oid)
                     kv = be.omap_get(oid, op.get("keys"))
                     blob_out = json.dumps(
                         {k: v.hex() for k, v in kv.items()}).encode()
@@ -585,6 +586,7 @@ class OSDDaemon(Dispatcher):
                     out_bufs.append(blob_out)
                 elif name == "omap_keys":
                     await be.ensure_active()
+                    await be.wait_readable(oid)
                     blob_out = json.dumps(
                         sorted(be.omap_get(oid))).encode()
                     outs.append({"op": "omap_keys",
@@ -650,9 +652,11 @@ class OSDDaemon(Dispatcher):
                     if not pieces:
                         outs.append({"op": "read", "dlen": 0})
                 elif name == "stat":
+                    await be.wait_readable(oid)
                     outs.append({"op": "stat", "size": be.object_size(oid),
                                  "dlen": 0})
                 elif name == "getxattr":
+                    await be.wait_readable(oid)
                     val = be.get_attr(oid, op["name"])
                     outs.append({"op": "getxattr", "dlen": len(val)})
                     out_bufs.append(bytes(val))

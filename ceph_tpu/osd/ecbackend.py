@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+from collections import deque
 from dataclasses import dataclass, field
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
                     Tuple)
@@ -270,6 +271,14 @@ class ECBackend:
         # primary's view of which objects each shard is missing
         # (reference peer_missing / pg_missing_t): shard -> oid -> version
         self.peer_missing: "Dict[int, Dict[str, Version]]" = {}
+        # objects still awaiting background recovery after activation
+        # (reference Active/Recovering substates): oid -> future resolved
+        # when the object is recovered (or given up on).  Writes to a
+        # degraded object wait on ITS future only; everything else flows.
+        self.degraded: "Dict[str, asyncio.Future]" = {}
+        # objects a client op is blocked on: the recovery workers pull
+        # these first (reference: recovery_requeue / prioritized recovery)
+        self._recovery_prio: "deque[str]" = deque()
         self._next_tid = 0
         self._lock = asyncio.Lock()
         self._not_peering = asyncio.Event()
@@ -496,6 +505,15 @@ class ECBackend:
         # drain and let it fan out mid-rewind.
         while True:
             await self._not_peering.wait()
+            fut = self.degraded.get(oid)
+            if fut is not None and not fut.done():
+                # write to a still-recovering object: wait for THAT
+                # object only and bump it to the recovery queue's front
+                # (reference wait_for_degraded_object + prioritized
+                # recovery); ops on clean objects flow past us.
+                self._recovery_prio.append(oid)
+                await fut
+                continue
             async with self._lock:
                 if self.peering:
                     continue
@@ -1581,11 +1599,27 @@ class ECBackend:
             return None          # absent at snap time
         return NO_GEN            # unchanged since the snap: head serves
 
+    async def wait_readable(self, oid: str) -> None:
+        """Block while THIS primary's own shard is missing ``oid``
+        (reference wait_for_unreadable_object / is_unreadable_object,
+        PrimaryLogPG): primary-local metadata — object_info size,
+        xattrs, omap, snap clones — is stale until the object is
+        recovered, so serving stat/read from it would return wrong
+        (empty) results.  Objects degraded only on OTHER shards serve
+        reads normally; recovery of a waited-on object is prioritized."""
+        while oid in self.local_missing:
+            fut = self.degraded.get(oid)
+            if fut is None or fut.done():
+                return  # no recovery in flight (unfound): legacy behavior
+            self._recovery_prio.append(oid)
+            await fut
+
     async def objects_read_at_snap(self, oid: str,
                                    extents: "List[Extent]",
                                    snapid: int,
                                    snapids: "Optional[List[int]]" = None
                                    ) -> "List[Tuple[int, bytes]]":
+        await self.wait_readable(oid)
         gen = self.snap_gen_for(oid, snapid, snapids)
         if gen is None:
             return []
@@ -1625,6 +1659,8 @@ class ECBackend:
         """Primary read entry (reference objects_read_and_reconstruct
         ECBackend.cc:2345): fetch min shards, decode, trim to the
         requested logical extents."""
+        for oid in reads:
+            await self.wait_readable(oid)
         sizes = {oid: self.object_size(oid) for oid in reads}
         clipped: "Dict[str, List[Extent]]" = {}
         for oid, extents in reads.items():
@@ -1675,6 +1711,20 @@ class ECBackend:
 
     async def recover_object(self, oid: str, missing_on: "Set[int]",
                              exclude: "Optional[Set[int]]" = None) -> None:
+        existing = self.recovery_ops.get(oid)
+        if existing is not None and existing.done is not None \
+                and not existing.done.done():
+            # a recovery of this object is already in flight: joining it
+            # instead of racing it keeps recovery_ops[oid] (which keys
+            # push replies) unambiguous — a second RecoveryOp would
+            # clobber it and strand the first on never-matched replies
+            covered = set(missing_on) <= set(existing.missing_on)
+            await existing.done
+            if covered:
+                return
+            # the joined op did not rebuild all our shards (e.g. scrub
+            # repairing a shard peering did not know about): fall
+            # through and recover the remainder now
         if self.scheduler is not None:
             # recovery work queues behind the QoS policy so client I/O
             # keeps its share (reference mClockScheduler background
@@ -2236,8 +2286,21 @@ class ECBackend:
             finally:
                 self.peering = False
                 self._not_peering.set()
+                # never leave a writer parked on a degraded future a
+                # dead recovery run will not resolve (e.g. _do_peer
+                # raised mid-recovery); waiters re-check state and
+                # proceed or fail cleanly
+                for fut in self.degraded.values():
+                    if not fut.done():
+                        fut.set_result(None)
+                self.degraded = {}
+                self._recovery_prio.clear()
 
     async def _do_peer(self) -> dict:
+        # (re)assert the admission gate: this run may follow an earlier
+        # _do_peer in the same peer() call that already activated
+        self.peering = True
+        self._not_peering.clear()
         async with self._lock:
             self._drain_in_flight()
             # interval change resets ALL pipeline caches (reference
@@ -2356,38 +2419,82 @@ class ECBackend:
                 self.peer_missing[s] = prior
 
         # recovery: reconstruct + push every missing object, bounded by
-        # osd_recovery_max_active concurrent ops (reference recovery
-        # reservations) with osd_recovery_sleep pacing between them
+        # osd_recovery_max_active concurrent workers (reference recovery
+        # reservations) with osd_recovery_sleep pacing between objects.
+        # Deletions are metadata pushes — propagated inline first.
         missing_union: "Dict[str, Set[int]]" = {}
         for s, mset in self.peer_missing.items():
             for oid in mset:
                 missing_union.setdefault(oid, set()).add(s)
-        sem = asyncio.Semaphore(
-            max(1, self.opt("osd_recovery_max_active", 3)))
-        sleep_s = self.opt("osd_recovery_sleep", 0.0)
-        counts = {"recovered": 0, "failed": 0}
-
-        async def recover_one(oid: str, shards: "Set[int]") -> None:
-            async with sem:
-                try:
-                    await self.recover_object(oid, shards,
-                                              exclude=set(shards))
-                    counts["recovered"] += 1
-                except ECError as e:
-                    dout("osd", 1, f"peer: recover {oid} failed: {e}")
-                    counts["failed"] += 1
-                if sleep_s:
-                    await asyncio.sleep(sleep_s)
-
-        work = []
+        to_recover: "Dict[str, Set[int]]" = {}
         for oid in sorted(missing_union):
             shards = missing_union[oid]
             if oid in deleted or oid not in all_objects:
                 await self._push_delete(oid, shards, up)
-                continue
-            work.append(recover_one(oid, shards))
-        if work:
-            await asyncio.gather(*work)
+            else:
+                to_recover[oid] = shards
+        loop = asyncio.get_event_loop()
+        self.degraded = {oid: loop.create_future() for oid in to_recover}
+
+        # ---- ACTIVATE before data recovery (reference PeeringState
+        # Active/{Activating,Recovering} + recovery_reservation.rst):
+        # the metadata work — log adoption, rewinds, missing sets — is
+        # done, so client I/O resumes NOW.  Reads exclude the missing
+        # shards per object; writes to a still-degraded object wait on
+        # its per-object future (enqueue_transaction).
+        self.active_acting = list(self.get_acting())
+        self.peering = False
+        self._not_peering.set()
+
+        sleep_s = self.opt("osd_recovery_sleep", 0.0)
+        counts = {"recovered": 0, "failed": 0}
+        pending = deque(sorted(to_recover))
+        # an oid bumped via _recovery_prio is NOT removed from pending:
+        # without a claim marker two workers would recover the same
+        # object concurrently, the second RecoveryOp would clobber
+        # recovery_ops[oid], and the first would wait forever on push
+        # replies that get discarded against the wrong op (deadlock
+        # found by the thrasher)
+        claimed: "Set[str]" = set()
+
+        async def worker() -> None:
+            while pending or self._recovery_prio:
+                # client-blocked objects jump the queue (reference
+                # prioritized recovery of degraded objects under I/O)
+                oid = None
+                while self._recovery_prio:
+                    cand = self._recovery_prio.popleft()
+                    if cand in to_recover and cand not in claimed:
+                        oid = cand
+                        break
+                if oid is None:
+                    if not pending:
+                        return
+                    oid = pending.popleft()
+                if oid in claimed:
+                    continue
+                claimed.add(oid)
+                fut = self.degraded.get(oid)
+                if fut is None or fut.done():
+                    continue
+                try:
+                    await self.recover_object(oid, to_recover[oid],
+                                              exclude=set(to_recover[oid]))
+                    counts["recovered"] += 1
+                except ECError as e:
+                    dout("osd", 1, f"peer: recover {oid} failed: {e}")
+                    counts["failed"] += 1
+                finally:
+                    if not fut.done():
+                        fut.set_result(None)
+                    self.degraded.pop(oid, None)
+                if sleep_s:
+                    await asyncio.sleep(sleep_s)
+
+        if to_recover:
+            n_workers = min(len(to_recover),
+                            max(1, self.opt("osd_recovery_max_active", 3)))
+            await asyncio.gather(*(worker() for _ in range(n_workers)))
         recovered, failed = counts["recovered"], counts["failed"]
         return {"status": "ok", "auth_head": list(auth_head),
                 "auth_shard": auth_shard, "recovered": recovered,

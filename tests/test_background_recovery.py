@@ -1,0 +1,94 @@
+"""Background recovery with per-object blocking (reference PeeringState
+Active/{Activating,Recovering} substates, PeeringState.h:654-1240, and
+recovery_reservation.rst): the PG activates as soon as peering's
+metadata work (log adoption, rewinds, missing sets) settles; data
+recovery proceeds in the background under the mClock recovery class.
+Client ops flow immediately — only writes touching a still-degraded
+object wait, and for THAT object only (wait_for_degraded_object), which
+the recovery workers then prioritize.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.qa.cluster import MiniCluster
+
+PROFILE = {"plugin": "jax_rs", "k": "3", "m": "2"}
+N_OBJECTS = 50
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_client_io_flows_during_recovery(loop):
+    async def go():
+        cfg = Config()
+        # slow the recovery down so the test can observe I/O mid-recovery
+        cfg.set("osd_recovery_sleep", 0.03)
+        cfg.set("osd_recovery_max_active", 1)
+        async with MiniCluster(n_osds=5, config=cfg) as c:
+            c.create_ec_pool("p", PROFILE, pg_num=1, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("p")
+            rng = np.random.default_rng(11)
+            pool = c.osdmap.pool_by_name("p")
+            _up, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)
+            victim = acting[2]
+            payloads = {}
+            for i in range(N_OBJECTS):
+                payloads[f"o{i:03d}"] = rng.integers(
+                    0, 256, 700, dtype=np.uint8).tobytes()
+                await io.write_full(f"o{i:03d}", payloads[f"o{i:03d}"])
+            # objects written while the victim is down go missing on it
+            await c.kill_osd(victim)
+            await c.peer_all()
+            for i in range(N_OBJECTS):
+                payloads[f"o{i:03d}"] = rng.integers(
+                    0, 256, 700, dtype=np.uint8).tobytes()
+                await io.write_full(f"o{i:03d}", payloads[f"o{i:03d}"])
+            await c.revive_osd(victim)
+            # recovery of N_OBJECTS at >=30ms each runs in background
+            ptask = asyncio.ensure_future(c.peer_all())
+            await asyncio.sleep(0.15)  # let peering activate
+            assert not ptask.done(), "recovery finished too fast to test"
+            # 1) a write to a CLEAN (new) object completes mid-recovery
+            fresh = rng.integers(0, 256, 700, dtype=np.uint8).tobytes()
+            await io.write_full("fresh", fresh)
+            assert not ptask.done(), \
+                "clean-object write did not complete before recovery"
+            # 2) reads work mid-recovery (degraded-aware shard choice)
+            assert await io.read("o000") == payloads["o000"]
+            assert not ptask.done()
+            # 3) a write to a DEGRADED object completes (prioritized)
+            #    well before the whole missing set is recovered
+            primary = c.osdmap.primary_of(
+                c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)[1])
+            be = c.osds[primary]._get_backend((pool.pool_id, 0))
+            # pick an object still awaiting recovery
+            deg = sorted(be.degraded)
+            if deg:  # recovery may be quick; only assert when observable
+                oid = deg[-1]
+                upd = rng.integers(0, 256, 900, dtype=np.uint8).tobytes()
+                await io.write_full(oid, upd)
+                payloads[oid] = upd
+                assert await io.read(oid) == upd
+                if not ptask.done():
+                    assert len(be.degraded) > 0, \
+                        "degraded write waited for the ENTIRE missing set"
+            stats = await ptask
+            recovered = sum(st.get("recovered", 0)
+                            for st in stats.values())
+            assert recovered >= N_OBJECTS - 2, stats
+            # final integrity sweep
+            for oid, want in payloads.items():
+                assert await io.read(oid) == want, oid
+            assert await io.read("fresh") == fresh
+    loop.run_until_complete(go())
