@@ -93,6 +93,10 @@ class Objecter(Dispatcher):
             self._inflight[tid] = fut
             fields = {"tid": tid, "pool": pool_id, "pg": pg,
                       "oid": oid, "ops": ops, "reqid": reqid,
+                      # root span: born at the client op and threaded
+                      # through every sub-op it causes (reference
+                      # ZTracer spans, ECBackend.cc:2063-2068)
+                      "trace_id": reqid,
                       "map_epoch": self.osdmap.epoch}
             if self.ticket:
                 fields["ticket"] = self.ticket
@@ -120,9 +124,11 @@ class Objecter(Dispatcher):
                 errs = [o.get("error") for o in outs if "error" in o]
                 if (result == -13 and not renewed
                         and self.ticket_renewer is not None
-                        and "ticket" in str(errs)):
-                    # expired/stale service ticket: renew at the mon
-                    # once, then retry the op with the fresh one
+                        and bool(reply.get("retry_auth"))):
+                    # the OSD says a FRESH ticket may fix this
+                    # (expired/stale generation) — structured field, not
+                    # substring matching: a caps denial mentioning
+                    # 'ticket' must not burn a renew+retry
                     self.ticket = await self.ticket_renewer()
                     renewed = True
                     continue

@@ -534,8 +534,22 @@ class MonDaemon(Dispatcher):
             entity = (peer if banner_auth and peer
                       else str(cmd.get("entity", peer)))
             ent = self.auth_entities.get(entity)
-            if ent is None and entity == "client.admin":
+            if ent is None and entity == "client.admin" \
+                    and (banner_auth or not self.auth_entities):
+                # bootstrap admin: allowed over an AUTHENTICATED banner
+                # channel, or on a virgin cluster with no entity db yet.
+                # With banner auth OFF on a populated cluster this
+                # fallback would let ANY client name client.admin and
+                # mint itself a full-caps ticket, bypassing every osd
+                # cap check — create client.admin explicitly instead.
+                # The bootstrap PERSISTS the admin entity so later
+                # renewals (after the db is populated) keep working.
+                from ..auth import Keyring
                 ent = {"caps": "mon allow *, osd allow *, mgr allow *"}
+                await self._propose_auth_ops([{
+                    "op": "entity_set", "entity": "client.admin",
+                    "key": Keyring.generate_key(),
+                    "caps": ent["caps"]}])
             if ent is None:
                 return -13, {"error": f"no entity {entity!r}"}
             auth = await self._ticket_authority(svc)

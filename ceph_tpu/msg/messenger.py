@@ -524,6 +524,14 @@ class _LocalConnection:
                                 fut.set_result(None)
             finally:
                 self._delaying = False
+                # cancellation (op timeout, daemon shutdown) can abort
+                # the drain above: fail any still-parked senders instead
+                # of leaving them awaiting futures nobody will resolve
+                while self._backlog:
+                    _nxt, fut = self._backlog.pop(0)
+                    if not fut.done():
+                        fut.set_exception(ConnectionError(
+                            f"delivery to {self.peer_addr} interrupted"))
             return
         await self._deliver_msg(msg)
 
@@ -543,6 +551,11 @@ class _LocalConnection:
 
     def mark_down(self) -> None:
         self.closed = True
+        while self._backlog:
+            _nxt, fut = self._backlog.pop(0)
+            if not fut.done():
+                fut.set_exception(ConnectionError(
+                    f"connection to {self.peer_addr} closed"))
 
 
 class Messenger:

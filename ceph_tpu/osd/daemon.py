@@ -384,6 +384,19 @@ class OSDDaemon(Dispatcher):
 
     # --- dispatch (reference ms_fast_dispatch OSD.cc:6990) -------------------
 
+    def _sub_span(self, msg: Message, what: str):
+        """Child span for a sub-op that crossed the messenger (reference
+        ZTracer child spans per EC sub-op, ECBackend.cc:2063-2068):
+        joins the originating client op's trace_id so
+        dump_historic_ops on every daemon can be correlated."""
+        tr = msg.get("trace")
+        if not tr:
+            return None
+        return self.op_tracker.create(
+            f"{what}[{tr.get('span', '')}](pg={msg.get('pgid')} "
+            f"tid={msg.get('tid')} from=osd.{msg.get('from_osd')})",
+            trace_id=str(tr.get("id", "")))
+
     async def ms_dispatch(self, conn, msg: Message) -> bool:
         t = msg.TYPE
         if t == "osd_op":
@@ -391,6 +404,7 @@ class OSDDaemon(Dispatcher):
         elif t == "ec_sub_write":
             be = self._get_backend(tuple(msg["pgid"]))
             self.perf.inc("subop_w")
+            span = self._sub_span(msg, "ec_sub_write")
             try:
                 reply = be.handle_sub_write(msg)
             except Exception as e:  # noqa: BLE001 — failed apply: this
@@ -407,6 +421,9 @@ class OSDDaemon(Dispatcher):
                     "from_osd": self.whoami, "tid": msg["tid"],
                     "committed": False, "applied": False,
                     "error": f"apply failed: {type(e).__name__}"})
+            if span:
+                span.finish("committed" if reply.get("committed")
+                            else "rejected")
             await conn.send_message(reply)
         elif t == "ec_sub_write_reply":
             be = self._get_backend(tuple(msg["pgid"]))
@@ -414,14 +431,20 @@ class OSDDaemon(Dispatcher):
         elif t == "ec_sub_read":
             be = self._get_backend(tuple(msg["pgid"]))
             self.perf.inc("subop_r")
+            span = self._sub_span(msg, "ec_sub_read")
             reply = be.handle_sub_read(msg)
+            if span:
+                span.finish("served")
             await conn.send_message(reply)
         elif t == "ec_sub_read_reply":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_sub_read_reply(msg)
         elif t == "pg_push":
             be = self._get_backend(tuple(msg["pgid"]))
+            span = self._sub_span(msg, "pg_push")
             reply = be.handle_push(msg)
+            if span:
+                span.finish("applied")
             await conn.send_message(reply)
         elif t == "pg_push_reply":
             be = self._get_backend(tuple(msg["pgid"]))
@@ -479,22 +502,26 @@ class OSDDaemon(Dispatcher):
                         "delete", "setxattr", "omap_set", "omap_rm"))
     _X_OPS = frozenset(("call",))
 
-    def _check_osd_caps(self, msg: MOSDOp) -> "Optional[str]":
+    def _check_osd_caps(self, msg: MOSDOp) \
+            -> "Optional[Tuple[str, bool]]":
         """cephx enforcement at dispatch: every op must carry a valid
         mon-issued ticket whose caps cover the op class on this pool.
-        Returns an error string (EACCES) or None.  Enforced on EVERY
-        transport, including in-process (the ticket rides the message,
-        not the socket)."""
+        Returns (error, retry_auth) or None.  ``retry_auth`` tells the
+        client a FRESH ticket may fix it (missing/expired/stale
+        generation) — a caps denial never does, and the client must not
+        waste a renew+retry on it.  Enforced on EVERY transport,
+        including in-process (the ticket rides the message, not the
+        socket)."""
         if str(self.config.get("auth_client_required")) != "cephx":
             return None
         from ..auth.cephx import TicketError
         blob = msg.get("ticket")
         if not blob:
-            return "no service ticket"
+            return "no service ticket", True
         try:
             entity, caps = self.ticket_verifier.verify(str(blob))
         except TicketError as e:
-            return f"ticket rejected: {e}"
+            return f"ticket rejected: {e}", True
         need = set()
         for op in msg.get("ops", []):
             name = op.get("op", "")
@@ -508,7 +535,8 @@ class OSDDaemon(Dispatcher):
         pool_name = pool.name if pool else None
         if not caps.allows("osd", "".join(sorted(need)), pool=pool_name):
             return (f"{entity}: osd caps {caps.spec!r} do not allow "
-                    f"{''.join(sorted(need))!r} on pool {pool_name!r}")
+                    f"{''.join(sorted(need))!r} on pool {pool_name!r}",
+                    False)
         return None
 
     async def _refresh_service_keys(self) -> None:
@@ -527,7 +555,7 @@ class OSDDaemon(Dispatcher):
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
         deny = self._check_osd_caps(msg)
-        if deny is not None and "generation" in deny \
+        if deny is not None and "generation" in deny[0] \
                 and self.monc is not None:
             # ticket sealed under a newer rotation than we hold:
             # refresh the rotating secrets once and re-check
@@ -536,7 +564,8 @@ class OSDDaemon(Dispatcher):
         if deny is not None:
             await conn.send_message(MOSDOpReply({
                 "tid": msg["tid"], "result": -EACCES,
-                "outs": [{"error": deny}]}))
+                "retry_auth": deny[1],
+                "outs": [{"error": deny[0]}]}))
             return
         be = self._get_backend(pgid)
         be.last_epoch = self.osdmap.epoch
@@ -644,7 +673,8 @@ class OSDDaemon(Dispatcher):
                             snapids=list(range(1, pool.snap_seq + 1)))
                     else:
                         res = await be.objects_read_and_reconstruct(
-                            {oid: ext})
+                            {oid: ext},
+                            trace_id=top.trace_id if top else "")
                         pieces = res[oid]
                     for _off, data in pieces:
                         outs.append({"op": "read", "dlen": len(data)})
@@ -667,7 +697,8 @@ class OSDDaemon(Dispatcher):
                 if top:
                     top.mark("started_write")
                 version = await be.submit_transaction(
-                    oid, mutations, reqid=str(msg.get("reqid", "")))
+                    oid, mutations, reqid=str(msg.get("reqid", "")),
+                    trace_id=top.trace_id if top else "")
                 if top:
                     top.mark("commit_sent")
                 outs.append({"op": "commit", "version": list(version),

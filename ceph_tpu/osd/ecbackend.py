@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
@@ -130,6 +131,9 @@ class Op:
     # pinned would decrement ANOTHER in-flight op's pin and trim its
     # post-image early, corrupting that op's successors)
     pinned: "List[Extent]" = field(default_factory=list)
+    # distributed trace id (reference ZTracer span threaded through EC
+    # sub-writes, ECBackend.cc:2063-2068); "" = untraced
+    trace_id: str = ""
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
 
 
@@ -160,6 +164,12 @@ class ReadOp:
     # fast_read failures are per (object, shard): a shard erroring on
     # one object may still have served valid chunks of the others
     obj_bad: "Dict[str, Set[int]]" = field(default_factory=dict)
+    trace_id: str = ""
+    span: str = "read"          # sub-span name carried on the wire
+    # shard -> monotonic time its (latest) sub-read was issued: the
+    # watchdog synthesizes EIO only for shards silent for the FULL
+    # timeout, not merely in flight at a tick boundary
+    issued_at: "Dict[int, float]" = field(default_factory=dict)
     complete: "Dict[str, Dict[int, Dict[int, bytes]]]" = field(
         default_factory=dict)                   # oid -> shard -> off -> bytes
     sizes: "Dict[str, Dict[int, int]]" = field(
@@ -181,6 +191,7 @@ class RecoveryOp:
     attrs: "Dict[str, bytes]" = field(default_factory=dict)
     omap: "Dict[str, bytes]" = field(default_factory=dict)
     waiting_on_pushes: "Set[int]" = field(default_factory=set)
+    trace_id: str = ""
     done: "asyncio.Future" = None               # type: ignore[assignment]
 
 
@@ -279,6 +290,10 @@ class ECBackend:
         # objects a client op is blocked on: the recovery workers pull
         # these first (reference: recovery_requeue / prioritized recovery)
         self._recovery_prio: "deque[str]" = deque()
+        # oid -> trace id of the client op blocked on its recovery, so
+        # the recovery's sub-reads/pushes join the client op's trace
+        # (reference: ZTracer child spans)
+        self._recovery_trace: "Dict[str, str]" = {}
         self._next_tid = 0
         self._lock = asyncio.Lock()
         self._not_peering = asyncio.Event()
@@ -467,19 +482,26 @@ class ECBackend:
 
     async def submit_transaction(self, oid: str,
                                  ops: "Sequence[ClientOp]",
-                                 reqid: str = "") -> Version:
+                                 reqid: str = "",
+                                 trace_id: str = "") -> Version:
         """Primary entry (reference ECBackend::submit_transaction
         ECBackend.cc:1483 -> start_rmw :1839).  Returns the committed
         version once every up shard acked.  ``reqid`` dedups client
         retries of a mutation that already committed."""
         if reqid and reqid in self.completed_reqids:
             return self.completed_reqids[reqid]
+        # degraded-object wait happens BEFORE taking cls_lock: parking
+        # under the lock would serialize every write to the PG behind
+        # one object's recovery (enqueue re-checks under the admission
+        # loop for the rare re-degrade race)
+        await self._wait_degraded(oid, trace_id)
         # brief cls_lock hold for the ENQUEUE only: object-class
         # executions hold it across their reads + enqueue, so a plain
         # write can never slip between a cls method's read and its
         # buffered-write admission (lost-update window)
         async with self.cls_lock:
-            op = await self.enqueue_transaction(oid, ops)
+            op = await self.enqueue_transaction(oid, ops,
+                                                trace_id=trace_id)
         version = await op.on_commit
         if reqid:
             self.completed_reqids[reqid] = version
@@ -489,14 +511,16 @@ class ECBackend:
         return version
 
     async def enqueue_transaction(self, oid: str,
-                                  ops: "Sequence[ClientOp]") -> Op:
+                                  ops: "Sequence[ClientOp]",
+                                  trace_id: str = "") -> Op:
         """Admit a mutation into the pipeline and return its Op without
         waiting for commit.  The pipeline commits strictly in admission
         order, so once op A is enqueued, no later op can commit before
         it — the ordering handle object-class executions need for
         read-modify-write atomicity (exec holds cls_lock across its
         reads AND this enqueue)."""
-        op = Op(tid=self.new_tid(), oid=oid, ops=list(ops))
+        op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
+                trace_id=trace_id)
         op.on_commit = asyncio.get_event_loop().create_future()
         # peering drains + blocks the pipeline (reference: client ops are
         # requeued until the PG is Active again).  The peering check must
@@ -505,14 +529,8 @@ class ECBackend:
         # drain and let it fan out mid-rewind.
         while True:
             await self._not_peering.wait()
-            fut = self.degraded.get(oid)
-            if fut is not None and not fut.done():
-                # write to a still-recovering object: wait for THAT
-                # object only and bump it to the recovery queue's front
-                # (reference wait_for_degraded_object + prioritized
-                # recovery); ops on clean objects flow past us.
-                self._recovery_prio.append(oid)
-                await fut
+            if oid in self.degraded:
+                await self._wait_degraded(oid, trace_id)
                 continue
             async with self._lock:
                 if self.peering:
@@ -523,6 +541,20 @@ class ECBackend:
                 await self._check_ops()
                 break
         return op
+
+    async def _wait_degraded(self, oid: str, trace_id: str = "") -> None:
+        """Write to a still-recovering object: wait for THAT object
+        only and bump it to the recovery queue's front (reference
+        wait_for_degraded_object + prioritized recovery); ops on clean
+        objects flow past."""
+        while True:
+            fut = self.degraded.get(oid)
+            if fut is None or fut.done():
+                return
+            if trace_id:
+                self._recovery_trace[oid] = trace_id
+            self._recovery_prio.append(oid)
+            await fut
 
     def _projected_oi(self, oid: str) -> ObjectInfo:
         """Object info as seen *through* in-flight pipelined ops, so an
@@ -936,7 +968,7 @@ class ECBackend:
             wire_txn = dict(txn)
             wire_txn["writes"] = [[o, len(d)]
                                   for o, d in txn.get("writes", [])]
-            msg = MECSubOpWrite({
+            fields = {
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": op.tid,
                 "epoch": self.last_epoch,
@@ -944,7 +976,12 @@ class ECBackend:
                 "trim_to": list(trim_to),
                 "roll_forward_to": list(self.pg_log.can_rollback_to),
                 "log_entries": [entry.to_dict()],
-                "txn": wire_txn, "lens": lens}, blob)
+                "txn": wire_txn, "lens": lens}
+            if op.trace_id:
+                # child span per EC sub-write crossing the messenger
+                # (reference ECBackend.cc:2063-2068 ZTracer child)
+                fields["trace"] = {"id": op.trace_id, "span": "sub_write"}
+            msg = MECSubOpWrite(fields, blob)
             if acting[shard] == self.whoami:
                 local_msgs.append((shard, msg))
             else:
@@ -1325,7 +1362,7 @@ class ECBackend:
                           for_recovery: bool, want_attrs: bool = False,
                           want_to_read: "Optional[List[int]]" = None,
                           exclude: "Optional[Set[int]]" = None,
-                          gen: int = NO_GEN) -> ReadOp:
+                          gen: int = NO_GEN, trace_id: str = "") -> ReadOp:
         """Build + launch a ReadOp (reference start_read_op
         ECBackend.cc:1679 -> do_read_op :1707).  ``exclude`` drops shards
         known stale/missing for these objects from the source set."""
@@ -1357,7 +1394,8 @@ class ECBackend:
             need = {s: [[0, sub_count]] for s in avail}
         rop = ReadOp(tid=self.new_tid(), requests={},
                      for_recovery=for_recovery, want_to_read=want,
-                     fast_read=fast)
+                     fast_read=fast, trace_id=trace_id,
+                     span="recovery_read" if for_recovery else "sub_read")
         rop.done = asyncio.get_event_loop().create_future()
         for oid, extents in reads.items():
             chunk_extents: "List[Extent]" = []
@@ -1389,12 +1427,17 @@ class ECBackend:
         (get_remaining_shards, ECBackend.cc:1633) widens around them."""
         timeout = self.opt("osd_ec_sub_read_timeout", 5.0)
         while not rop.done.done():
-            await asyncio.sleep(timeout)
+            await asyncio.sleep(timeout / 2)
             if rop.done.done():
                 return
-            stuck = set(rop.in_progress)
+            now = time.monotonic()
+            # per-shard issue timestamps: a read issued by a re-plan
+            # just before this tick keeps its own full window instead
+            # of being synthesized EIO almost immediately
+            stuck = {s for s in rop.in_progress
+                     if now - rop.issued_at.get(s, now) >= timeout}
             if not stuck:
-                continue  # retries in flight; give them their own window
+                continue  # nothing silent for a full window yet
             dout("osd", 1, f"read tid {rop.tid}: shards {sorted(stuck)} "
                            f"silent for {timeout}s, treating as EIO")
             for shard in stuck:
@@ -1423,14 +1466,20 @@ class ECBackend:
             self._maybe_complete_read(rop)
             return
         rop.in_progress |= set(per_shard)
+        now = time.monotonic()
+        for shard in per_shard:
+            rop.issued_at[shard] = now
         local = []
         for shard, to_read in per_shard.items():
-            msg = MECSubOpRead({
+            fields = {
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": rop.tid,
                 "to_read": to_read,
                 "attrs_to_read": [r["oid"] for r in to_read
-                                  if rop.requests[r["oid"]].want_attrs]})
+                                  if rop.requests[r["oid"]].want_attrs]}
+            if rop.trace_id:
+                fields["trace"] = {"id": rop.trace_id, "span": rop.span}
+            msg = MECSubOpRead(fields)
             if avail[shard] == self.whoami:
                 local.append(msg)
             else:
@@ -1654,12 +1703,15 @@ class ECBackend:
                 for off, length in clipped]
 
     async def objects_read_and_reconstruct(
-            self, reads: "Dict[str, List[Extent]]"
+            self, reads: "Dict[str, List[Extent]]",
+            trace_id: str = ""
     ) -> "Dict[str, List[Tuple[int, bytes]]]":
         """Primary read entry (reference objects_read_and_reconstruct
         ECBackend.cc:2345): fetch min shards, decode, trim to the
         requested logical extents."""
         for oid in reads:
+            if trace_id and oid in self.local_missing:
+                self._recovery_trace[oid] = trace_id
             await self.wait_readable(oid)
         sizes = {oid: self.object_size(oid) for oid in reads}
         clipped: "Dict[str, List[Extent]]" = {}
@@ -1677,7 +1729,8 @@ class ECBackend:
             o: [] for o in clipped}
         if not todo:
             return results
-        rop = await self._start_read(todo, for_recovery=False)
+        rop = await self._start_read(todo, for_recovery=False,
+                                     trace_id=trace_id)
         await rop.done
         for oid, extents in todo.items():
             if oid in rop.errors:
@@ -1710,7 +1763,8 @@ class ECBackend:
     # ============================================================== RECOVERY
 
     async def recover_object(self, oid: str, missing_on: "Set[int]",
-                             exclude: "Optional[Set[int]]" = None) -> None:
+                             exclude: "Optional[Set[int]]" = None,
+                             trace_id: str = "") -> None:
         existing = self.recovery_ops.get(oid)
         if existing is not None and existing.done is not None \
                 and not existing.done.done():
@@ -1731,11 +1785,13 @@ class ECBackend:
             # recovery class)
             async with self.scheduler.queued("recovery"):
                 return await self._recover_object(oid, missing_on,
-                                                  exclude)
-        return await self._recover_object(oid, missing_on, exclude)
+                                                  exclude, trace_id)
+        return await self._recover_object(oid, missing_on, exclude,
+                                          trace_id)
 
     async def _recover_object(self, oid: str, missing_on: "Set[int]",
-                              exclude: "Optional[Set[int]]" = None) -> None:
+                              exclude: "Optional[Set[int]]" = None,
+                              trace_id: str = "") -> None:
         """Rebuild ``oid``'s shards on ``missing_on`` (reference
         recover_object ECBackend.cc:738 + continue_recovery_op :570:
         IDLE -> READING -> WRITING -> COMPLETE).  ``exclude`` keeps
@@ -1743,18 +1799,37 @@ class ECBackend:
         non-acting shards but never ones missing this object).  Reads are
         whole-shard: sources clamp to their extent, so recovery never
         trusts the (possibly stale) local object_info for sizing."""
-        rop = RecoveryOp(oid=oid, missing_on=set(missing_on))
+        rop = RecoveryOp(oid=oid, missing_on=set(missing_on),
+                         trace_id=trace_id)
         rop.done = asyncio.get_event_loop().create_future()
+        # joiners (recover_object's in-flight dedup) await rop.done:
+        # EVERY exit path must resolve it or they hang forever.  The
+        # callback pre-retrieves the exception so a joinerless failure
+        # doesn't warn at GC.
+        rop.done.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None)
         self.recovery_ops[oid] = rop
+        try:
+            await self._run_recovery(rop, oid, exclude, trace_id)
+        except BaseException as e:
+            self.recovery_ops.pop(oid, None)
+            if not rop.done.done():
+                rop.done.set_exception(
+                    e if isinstance(e, Exception) else ECError(str(e)))
+            raise
+
+    async def _run_recovery(self, rop: RecoveryOp, oid: str,
+                            exclude: "Optional[Set[int]]",
+                            trace_id: str) -> None:
         # READING: fetch enough surviving shards to rebuild the missing
         rop.state = RecoveryOp.READING
         read = await self._start_read({oid: [(0, -1)]},
                                       for_recovery=True, want_attrs=True,
                                       want_to_read=sorted(rop.missing_on),
-                                      exclude=exclude or set(missing_on))
+                                      exclude=exclude or set(rop.missing_on),
+                                      trace_id=trace_id)
         await read.done
         if oid in read.errors:
-            self.recovery_ops.pop(oid, None)
             raise ECError(f"recovery read failed for {oid}")
         shard_bufs = read.complete.get(oid, {})
         csize = max((sum(len(b) for b in by_off.values())
@@ -1805,8 +1880,8 @@ class ECBackend:
         # backstops any miss)
         for gen in self._local_snap_gens(oid):
             try:
-                await self._recover_clone(oid, gen, set(missing_on),
-                                          exclude or set(missing_on))
+                await self._recover_clone(oid, gen, set(rop.missing_on),
+                                          exclude or set(rop.missing_on))
             except ECError as e:
                 dout("osd", 1,
                      f"clone {oid}@{gen} recovery failed: {e}")
@@ -1895,13 +1970,15 @@ class ECBackend:
         attrs = {k: v.hex() for k, v in rop.attrs.items()}
         local = []
         for shard in sorted(rop.waiting_on_pushes):
-            msg = MOSDPGPush({
+            fields = {
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": self.new_tid(),
                 "oid": rop.oid, "version": list(self.pg_log.head),
                 "whole": True, "off": 0, "attrs": attrs,
-                "omap": {k: v.hex() for k, v in rop.omap.items()}},
-                rop.recovered[shard])
+                "omap": {k: v.hex() for k, v in rop.omap.items()}}
+            if rop.trace_id:
+                fields["trace"] = {"id": rop.trace_id, "span": "push"}
+            msg = MOSDPGPush(fields, rop.recovered[shard])
             if acting[shard] == self.whoami:
                 local.append(msg)
             else:
@@ -2295,6 +2372,7 @@ class ECBackend:
                         fut.set_result(None)
                 self.degraded = {}
                 self._recovery_prio.clear()
+                self._recovery_trace.clear()
 
     async def _do_peer(self) -> dict:
         # (re)assert the admission gate: this run may follow an earlier
@@ -2478,8 +2556,10 @@ class ECBackend:
                 if fut is None or fut.done():
                     continue
                 try:
-                    await self.recover_object(oid, to_recover[oid],
-                                              exclude=set(to_recover[oid]))
+                    await self.recover_object(
+                        oid, to_recover[oid],
+                        exclude=set(to_recover[oid]),
+                        trace_id=self._recovery_trace.pop(oid, ""))
                     counts["recovered"] += 1
                 except ECError as e:
                     dout("osd", 1, f"peer: recover {oid} failed: {e}")

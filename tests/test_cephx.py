@@ -192,6 +192,13 @@ class TestMonManagedCephx:
                     "data", {"plugin": "jax_rs", "k": "2", "m": "1"},
                     pg_num=4)
                 admin = await cluster._admin_client()
+                # once any entity exists, the implicit client.admin
+                # full-caps ticket fallback is refused (banner auth is
+                # off here) — the admin must exist in the entity db
+                await admin.mon_command({
+                    "prefix": "auth get-or-create",
+                    "entity": "client.admin",
+                    "caps": "mon allow *, osd allow *, mgr allow *"})
                 out = await admin.mon_command({
                     "prefix": "auth get-or-create",
                     "entity": "client.app",
@@ -220,4 +227,50 @@ class TestMonManagedCephx:
 
                 listing = await admin.mon_command({"prefix": "auth list"})
                 assert "client.app" in listing["entities"]
+        loop.run_until_complete(go())
+
+    def test_admin_ticket_bypass_closed(self, loop):
+        """ADVICE r3 (medium): with banner auth off and a POPULATED
+        entity db, a client naming client.admin must not be handed an
+        implicit full-caps ticket — that would bypass every osd cap
+        check.  The fallback remains only for virgin-cluster bootstrap
+        (or over an authenticated banner channel)."""
+        async def go():
+            from tests.test_mon import fast_config
+            from ceph_tpu.mon.client import MonClientError
+            cfg = fast_config()
+            cfg.set("auth_client_required", "cephx")
+            async with MiniCluster(4, n_mons=1, config=cfg) as cluster:
+                admin = await cluster._admin_client()
+                # populate the entity db WITHOUT ever bootstrapping an
+                # admin ticket: client.admin does not exist
+                await admin.mon_command({
+                    "prefix": "auth get-or-create",
+                    "entity": "client.app", "caps": "mon allow r"})
+                rogue = await cluster.client()
+                with pytest.raises(MonClientError) as ei:
+                    await rogue.fetch_ticket(entity="client.admin")
+                assert "client.admin" in str(ei.value)
+        loop.run_until_complete(go())
+
+    def test_admin_bootstrap_persists_entity(self, loop):
+        """The virgin-cluster bootstrap ticket PERSISTS client.admin,
+        so renewals keep working after the entity db is populated
+        (otherwise the admin would be locked out the moment its first
+        ticket expired)."""
+        async def go():
+            from tests.test_mon import fast_config
+            cfg = fast_config()
+            cfg.set("auth_client_required", "cephx")
+            async with MiniCluster(4, n_mons=1, config=cfg) as cluster:
+                admin = await cluster._admin_client()
+                await admin.fetch_ticket(entity="client.admin")
+                await admin.mon_command({
+                    "prefix": "auth get-or-create",
+                    "entity": "client.app", "caps": "mon allow r"})
+                # renewal after population still works: the bootstrap
+                # wrote client.admin into the entity db
+                await admin.fetch_ticket(entity="client.admin")
+                listing = await admin.mon_command({"prefix": "auth list"})
+                assert "client.admin" in listing["entities"]
         loop.run_until_complete(go())
