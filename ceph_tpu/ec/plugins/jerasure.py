@@ -1,18 +1,26 @@
-"""jerasure — profile-compatibility plugin mapping jerasure profiles onto JaxRS.
+"""jerasure — the reference jerasure plugin's 7-technique surface.
 
-Accepts the reference jerasure plugin's profile surface (7 techniques,
-``packetsize`` knob, k=2 m=1 defaults — src/erasure-code/jerasure/
-ErasureCodeJerasure.h:81-240) so existing ec-profiles run unchanged on the
-TPU backend.  ``packetsize`` only shaped the CPU bit-matrix schedules; it
-is parsed and recorded but has no TPU meaning.
+Technique dispatch (reference src/erasure-code/jerasure/
+ErasureCodeJerasure.h:81-240):
+
+- ``reed_sol_van`` / ``reed_sol_r6_op`` / ``cauchy_orig`` /
+  ``cauchy_good``: GF(2^8) matrix codes served by JaxRS (TPU path).
+- ``liberation`` / ``blaum_roth`` / ``liber8tion``: REAL bit-matrix
+  RAID-6 codes over w packets per chunk (plugins/bitmatrix.py) — the
+  published minimal-density constructions, verified MDS at init, not
+  aliases onto a GF(2^8) matrix.
 """
 
 from __future__ import annotations
 
 from ..interface import Profile
+from .bitmatrix import BlaumRoth, Liber8tion, Liberation
 from .jax_rs import JaxRS
 
 __erasure_code_version__ = "1"
+
+_BITMATRIX = {"liberation": Liberation, "blaum_roth": BlaumRoth,
+              "liber8tion": Liber8tion}
 
 
 class ErasureCodeJerasureCompat(JaxRS):
@@ -28,8 +36,9 @@ class ErasureCodeJerasureCompat(JaxRS):
 
 
 def __erasure_code_init__(registry, name: str) -> None:
-    def factory(profile: Profile) -> ErasureCodeJerasureCompat:
-        codec = ErasureCodeJerasureCompat()
+    def factory(profile: Profile):
+        cls = _BITMATRIX.get(str(profile.get("technique", "")))
+        codec = cls() if cls is not None else ErasureCodeJerasureCompat()
         codec.init(profile)
         return codec
 

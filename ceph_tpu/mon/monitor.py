@@ -463,8 +463,16 @@ class MonDaemon(Dispatcher):
             # auth requests precede session caps)
             return None
         ent = self.auth_entities.get(peer)
-        if ent is None and peer == "client.admin":
-            return None   # bootstrap admin (reference initial keyring)
+        if ent is None and peer == "client.admin" \
+                and (str(self.config.get("auth_cluster_required")) != "none"
+                     or not self.auth_entities):
+            # bootstrap admin (reference initial keyring): honored only
+            # over an authenticated banner channel or on a virgin
+            # entity db — same gate as the implicit admin ticket.  With
+            # banner auth off the peer name is self-declared; on a
+            # populated db an uncreated 'client.admin' could otherwise
+            # mint itself arbitrary entities/caps via mon commands.
+            return None
         if ent is None:
             return -13, {"error": f"entity {peer!r} not authorized"}
         from ..auth.caps import Caps

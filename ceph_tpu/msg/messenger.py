@@ -514,11 +514,20 @@ class _LocalConnection:
                         nxt, fut = self._backlog.pop(0)
                         try:
                             await self._deliver_msg(nxt)
-                        except Exception as e:  # noqa: BLE001 — route to
-                            # the enqueuing sender (incl. dispatch errors
-                            # that inline delivery would have raised)
+                        except BaseException as e:  # noqa: BLE001 — route
+                            # to the enqueuing sender (incl. dispatch
+                            # errors inline delivery would have raised);
+                            # CancelledError mid-drain must still resolve
+                            # the ALREADY-POPPED future before it
+                            # propagates, or its sender hangs forever
                             if not fut.done():
-                                fut.set_exception(e)
+                                fut.set_exception(
+                                    e if isinstance(e, Exception)
+                                    else ConnectionError(
+                                        f"delivery to {self.peer_addr} "
+                                        f"interrupted"))
+                            if not isinstance(e, Exception):
+                                raise
                         else:
                             if not fut.done():
                                 fut.set_result(None)

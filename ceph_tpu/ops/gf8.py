@@ -282,8 +282,13 @@ def xor_min_matrix(k: int, m: int, limit: int = 32) -> np.ndarray:
 
 def generator_matrix(k: int, m: int, technique: str = "reed_sol_van") -> np.ndarray:
     """Full systematic generator [I_k; C], shape (k+m, k)."""
-    if technique in ("reed_sol_van", "vandermonde", "reed_sol_r6_op", "liberation",
-                     "blaum_roth", "liber8tion"):
+    if technique in ("liberation", "blaum_roth", "liber8tion"):
+        # bit-matrix codes (ec/plugins/bitmatrix.py) have no GF(2^8)
+        # generator — never silently alias them to Vandermonde
+        raise ValueError(
+            f"{technique} is a GF(2) bit-matrix code with no GF(2^8) "
+            f"generator matrix (plugin=jerasure serves it)")
+    if technique in ("reed_sol_van", "vandermonde", "reed_sol_r6_op"):
         C = vandermonde_matrix(k, m)
     elif technique in ("cauchy_good", "cauchy_orig", "cauchy"):
         C = cauchy_matrix(k, m)

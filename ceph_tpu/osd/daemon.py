@@ -432,7 +432,12 @@ class OSDDaemon(Dispatcher):
             be = self._get_backend(tuple(msg["pgid"]))
             self.perf.inc("subop_r")
             span = self._sub_span(msg, "ec_sub_read")
-            reply = be.handle_sub_read(msg)
+            try:
+                reply = be.handle_sub_read(msg)
+            except BaseException:
+                if span:
+                    span.finish("error")
+                raise
             if span:
                 span.finish("served")
             await conn.send_message(reply)
@@ -442,7 +447,12 @@ class OSDDaemon(Dispatcher):
         elif t == "pg_push":
             be = self._get_backend(tuple(msg["pgid"]))
             span = self._sub_span(msg, "pg_push")
-            reply = be.handle_push(msg)
+            try:
+                reply = be.handle_push(msg)
+            except BaseException:
+                if span:
+                    span.finish("error")
+                raise
             if span:
                 span.finish("applied")
             await conn.send_message(reply)

@@ -251,6 +251,15 @@ class TestMonManagedCephx:
                 with pytest.raises(MonClientError) as ei:
                     await rogue.fetch_ticket(entity="client.admin")
                 assert "client.admin" in str(ei.value)
+                # the MON-COMMAND path is gated the same way: a
+                # self-declared 'client.admin' peer on a populated db
+                # must not mint itself entities/caps
+                evil = await cluster.client(name="client.admin")
+                with pytest.raises(MonClientError):
+                    await evil.mon_command({
+                        "prefix": "auth get-or-create",
+                        "entity": "client.evil",
+                        "caps": "mon allow *, osd allow *"})
         loop.run_until_complete(go())
 
     def test_admin_bootstrap_persists_entity(self, loop):

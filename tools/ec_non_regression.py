@@ -51,6 +51,12 @@ PROFILES = [
     {"plugin": "jax_rs", "k": "4", "m": "2", "technique": "reed_sol_r6_op"},
     {"plugin": "jerasure", "k": "3", "m": "2"},
     {"plugin": "jerasure", "k": "4", "m": "2", "technique": "cauchy_good"},
+    {"plugin": "jerasure", "k": "5", "m": "2", "technique": "liberation",
+     "w": "7"},
+    {"plugin": "jerasure", "k": "5", "m": "2", "technique": "blaum_roth",
+     "w": "6"},
+    {"plugin": "jerasure", "k": "6", "m": "2", "technique": "liber8tion",
+     "w": "8"},
     {"plugin": "isa", "k": "4", "m": "2"},
     {"plugin": "xor", "k": "3", "m": "1"},
     {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
