@@ -15,16 +15,33 @@ HTTP API (S3-ish paths; asyncio server):
   PUT /bucket            create bucket     GET /            list buckets
   GET /bucket            list keys         PUT /bucket/key  upload
   GET /bucket/key        download          DELETE /...      remove
+
+Multipart (reference src/rgw multipart over manifest objects; parts are
+separate striped blobs, complete writes a manifest — no data copy):
+  POST   /bucket/key?uploads                     -> {"upload_id": ...}
+  PUT    /bucket/key?uploadId=U&partNumber=N     upload one part
+  POST   /bucket/key?uploadId=U  (JSON [[n, etag], ...])  complete
+  DELETE /bucket/key?uploadId=U                  abort
+S3 semantics kept: parts may arrive in any order and concurrently, a
+re-uploaded part number replaces the old one, the completed etag is
+``md5(md5(part1)||...)-N``.
+
+Auth (optional, S3 SigV4-shaped): register users with ``add_user``;
+requests then must carry ``x-rgw-date`` and ``Authorization:
+RGW1 <access>:<hex hmac-sha256(secret, method\npath\ndate\nsha256(body))>``.
+No users registered = open access (dev mode).
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
+import hmac as hmac_mod
 import json
+import os
 import time
-from typing import List, Optional
-from urllib.parse import unquote
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from ..client.striper import RadosStriper
 
@@ -45,6 +62,18 @@ def _data_oid(bucket: str, key: str) -> str:
     return f"data.{bucket}.{hashlib.sha256(key.encode()).hexdigest()}"
 
 
+def _upload_oid(bucket: str, upload_id: str) -> str:
+    return f".upload.{bucket}.{upload_id}"
+
+
+def _uploads_reg_oid(bucket: str) -> str:
+    return f".uploads.{bucket}"
+
+
+def _part_oid(bucket: str, upload_id: str, part: int) -> str:
+    return f"part.{bucket}.{upload_id}.{part:05d}"
+
+
 class Gateway:
     """Bucket/object operations + optional HTTP front end.
 
@@ -61,6 +90,52 @@ class Gateway:
             stripe_count=stripe_count, object_size=object_size)
         self._server: "Optional[asyncio.AbstractServer]" = None
         self.port = 0
+        # access_key -> secret; empty = open access (dev mode)
+        self._users: "Dict[str, str]" = {}
+
+    # --- auth -----------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret: str) -> None:
+        """Register an S3-style credential pair; once any user exists,
+        every HTTP request must be signed (reference rgw user keys)."""
+        self._users[access_key] = secret
+
+    @staticmethod
+    def sign(secret: str, method: str, path: str, date: str,
+             body: bytes) -> str:
+        msg = "\n".join([method, path, date,
+                         hashlib.sha256(body).hexdigest()])
+        return hmac_mod.new(secret.encode(), msg.encode(),
+                            hashlib.sha256).hexdigest()
+
+    # signed requests older/newer than this are refused (replay window;
+    # S3 SigV4 uses 15 minutes)
+    AUTH_MAX_SKEW = 900.0
+
+    def _check_auth(self, method: str, rawpath: str,
+                    headers: "Dict[str, str]", body: bytes) -> None:
+        if not self._users:
+            return
+        auth = headers.get("authorization", "")
+        date = headers.get("x-rgw-date", "")
+        if not auth.startswith("RGW1 ") or ":" not in auth:
+            raise RGWError("missing/malformed authorization", 403)
+        try:
+            import calendar
+            ts = calendar.timegm(time.strptime(date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise RGWError("bad x-rgw-date", 403)
+        if abs(time.time() - ts) > self.AUTH_MAX_SKEW:
+            # the date is part of the signed string, so bounding its
+            # skew bounds replay of captured requests
+            raise RGWError("request time too skewed (replay?)", 403)
+        access, _, sig = auth[5:].partition(":")
+        secret = self._users.get(access.strip())
+        if secret is None:
+            raise RGWError(f"unknown access key {access!r}", 403)
+        want = self.sign(secret, method, rawpath, date, body)
+        if not hmac_mod.compare_digest(want, sig.strip()):
+            raise RGWError("signature mismatch", 403)
 
     # --- buckets --------------------------------------------------------------
 
@@ -81,8 +156,19 @@ class Gateway:
         await self._require_bucket(bucket)
         if await self.list_objects(bucket):
             raise RGWError(f"bucket {bucket!r} not empty", 409)
+        if await self.list_multipart_uploads(bucket):
+            raise RGWError(
+                f"bucket {bucket!r} has in-progress multipart uploads",
+                409)
         await self.meta.omap_rm(BUCKETS_OID, [bucket])
         await self.meta.remove(_index_oid(bucket))
+
+    async def list_multipart_uploads(self, bucket: str) -> "List[str]":
+        try:
+            return sorted(await self.meta.omap_keys(
+                _uploads_reg_oid(bucket)))
+        except Exception:  # noqa: BLE001 — registry object absent
+            return []
 
     async def _require_bucket(self, bucket: str) -> None:
         if not await self.meta.omap_get(BUCKETS_OID, [bucket]):
@@ -93,15 +179,28 @@ class Gateway:
     async def put_object(self, bucket: str, key: str,
                          data: bytes) -> dict:
         await self._require_bucket(bucket)
+        old = await self.meta.omap_get(_index_oid(bucket), [key])
         await self.striper.write_full(_data_oid(bucket, key), data)
         etag = hashlib.md5(data).hexdigest()
         meta = {"size": len(data), "etag": etag, "mtime": time.time()}
         await self.meta.omap_set(_index_oid(bucket),
                                  {key: json.dumps(meta).encode()})
+        if old:
+            # overwriting a multipart object reaps its part blobs
+            old_meta = json.loads(old[key].decode())
+            for p in old_meta.get("parts", []):
+                await self.striper.remove(p["oid"])
         return meta
 
     async def get_object(self, bucket: str, key: str) -> bytes:
         meta = await self.head_object(bucket, key)
+        if "parts" in meta:
+            # manifest object (multipart): concatenate part blobs
+            out = []
+            for p in meta["parts"]:
+                blob = await self.striper.read(p["oid"])
+                out.append(blob[: p["size"]])
+            return b"".join(out)
         data = await self.striper.read(_data_oid(bucket, key))
         return data[:meta["size"]]
 
@@ -113,9 +212,122 @@ class Gateway:
         return json.loads(entry[key].decode())
 
     async def delete_object(self, bucket: str, key: str) -> None:
-        await self.head_object(bucket, key)
-        await self.striper.remove(_data_oid(bucket, key))
+        meta = await self.head_object(bucket, key)
+        if "parts" in meta:
+            for p in meta["parts"]:
+                await self.striper.remove(p["oid"])
+        else:
+            await self.striper.remove(_data_oid(bucket, key))
         await self.meta.omap_rm(_index_oid(bucket), [key])
+
+    # --- multipart (reference rgw multipart: parts as separate blobs,
+    # --- complete writes a manifest, no data copy) ----------------------------
+
+    async def create_multipart(self, bucket: str, key: str) -> str:
+        await self._require_bucket(bucket)
+        upload_id = os.urandom(8).hex()
+        await self.meta.omap_set(_upload_oid(bucket, upload_id), {
+            ".meta": json.dumps({"key": key,
+                                 "started": time.time()}).encode()})
+        await self.meta.omap_set(_uploads_reg_oid(bucket),
+                                 {upload_id: key.encode()})
+        return upload_id
+
+    async def _upload_rec(self, bucket: str, upload_id: str) -> dict:
+        rec = await self.meta.omap_get(_upload_oid(bucket, upload_id),
+                                       [".meta"])
+        if not rec:
+            raise RGWError(f"no such upload {upload_id!r}", 404)
+        return json.loads(rec[".meta"].decode())
+
+    async def upload_part(self, bucket: str, key: str, upload_id: str,
+                          part_number: int, data: bytes) -> str:
+        await self._require_bucket(bucket)
+        rec = await self._upload_rec(bucket, upload_id)
+        if rec["key"] != key:
+            raise RGWError(f"upload {upload_id!r} is for {rec['key']!r}")
+        if not 1 <= part_number <= 10000:
+            raise RGWError(f"part number {part_number} out of [1,10000]")
+        oid = _part_oid(bucket, upload_id, part_number)
+        await self.striper.write_full(oid, data)
+        etag = hashlib.md5(data).hexdigest()
+        await self.meta.omap_set(_upload_oid(bucket, upload_id), {
+            f"part.{part_number:05d}": json.dumps({
+                "oid": oid, "size": len(data),
+                "etag": etag}).encode()})
+        return etag
+
+    async def list_parts(self, bucket: str,
+                         upload_id: str) -> "List[dict]":
+        await self._upload_rec(bucket, upload_id)
+        kv = await self.meta.omap_get(_upload_oid(bucket, upload_id))
+        out = []
+        for k in sorted(kv):
+            if k.startswith("part."):
+                rec = json.loads(kv[k].decode())
+                rec["part_number"] = int(k.split(".", 1)[1])
+                out.append(rec)
+        return out
+
+    async def complete_multipart(self, bucket: str, key: str,
+                                 upload_id: str,
+                                 parts: "List[Tuple[int, str]]") -> dict:
+        """``parts``: the client's ordered (part_number, etag) list —
+        validated against what was uploaded, exactly like S3
+        CompleteMultipartUpload."""
+        rec = await self._upload_rec(bucket, upload_id)
+        if rec["key"] != key:
+            raise RGWError(f"upload {upload_id!r} is for {rec['key']!r}")
+        if not parts:
+            raise RGWError("empty part list")
+        have = {p["part_number"]: p
+                for p in await self.list_parts(bucket, upload_id)}
+        manifest = []
+        md5s = b""
+        last = 0
+        for num, etag in parts:
+            num = int(num)
+            if num <= last:
+                raise RGWError("parts must be in ascending order")
+            last = num
+            p = have.get(num)
+            if p is None:
+                raise RGWError(f"part {num} was never uploaded", 400)
+            if etag and etag != p["etag"]:
+                raise RGWError(f"part {num} etag mismatch", 400)
+            manifest.append({"oid": p["oid"], "size": p["size"]})
+            md5s += bytes.fromhex(p["etag"])
+        etag = f"{hashlib.md5(md5s).hexdigest()}-{len(manifest)}"
+        meta = {"size": sum(p["size"] for p in manifest), "etag": etag,
+                "mtime": time.time(), "parts": manifest,
+                "upload_id": upload_id}
+        old = await self.meta.omap_get(_index_oid(bucket), [key])
+        await self.meta.omap_set(_index_oid(bucket),
+                                 {key: json.dumps(meta).encode()})
+        # reap (a) the overwritten object's blobs, (b) abandoned parts
+        # (uploaded but not in the final list)
+        kept = {m["oid"] for m in manifest}
+        if old:
+            old_meta = json.loads(old[key].decode())
+            if "parts" in old_meta:
+                for p in old_meta["parts"]:
+                    if p["oid"] not in kept:
+                        await self.striper.remove(p["oid"])
+            else:
+                await self.striper.remove(_data_oid(bucket, key))
+        for p in have.values():
+            if p["oid"] not in kept:
+                await self.striper.remove(p["oid"])
+        await self.meta.remove(_upload_oid(bucket, upload_id))
+        await self.meta.omap_rm(_uploads_reg_oid(bucket), [upload_id])
+        return meta
+
+    async def abort_multipart(self, bucket: str, upload_id: str) -> None:
+        await self._upload_rec(bucket, upload_id)
+        for p in await self.list_parts(bucket, upload_id):
+            await self.striper.remove(p["oid"])
+        await self.meta.remove(_upload_oid(bucket, upload_id))
+        await self.meta.omap_rm(_uploads_reg_oid(bucket), [upload_id])
 
     async def list_objects(self, bucket: str,
                            prefix: str = "") -> "List[str]":
@@ -142,16 +354,21 @@ class Gateway:
             if len(req) < 2:
                 return
             method, rawpath = req[0], req[1]
-            clen = 0
+            headers: "Dict[str, str]" = {}
             while True:
                 line = (await reader.readline()).decode().strip()
                 if not line:
                     break
-                if line.lower().startswith("content-length:"):
-                    clen = int(line.split(":", 1)[1])
+                name, _, val = line.partition(":")
+                headers[name.strip().lower()] = val.strip()
+            clen = int(headers.get("content-length", 0))
             body = await reader.readexactly(clen) if clen else b""
+            self._check_auth(method, rawpath, headers, body)
+            split = urlsplit(rawpath)
+            query = {k: v[0] for k, v in
+                     parse_qs(split.query, keep_blank_values=True).items()}
             status, payload, ctype = await self._route(
-                method, unquote(rawpath), body)
+                method, unquote(split.path), body, query)
         except RGWError as e:
             status, payload, ctype = e.status, json.dumps(
                 {"error": str(e)}).encode(), "application/json"
@@ -160,8 +377,8 @@ class Gateway:
                 {"error": str(e)}).encode(), "application/json"
         try:
             reason = {200: "OK", 201: "Created", 204: "No Content",
-                      404: "Not Found", 409: "Conflict"}.get(status,
-                                                             "Error")
+                      403: "Forbidden", 404: "Not Found",
+                      409: "Conflict"}.get(status, "Error")
             writer.write(
                 f"HTTP/1.1 {status} {reason}\r\n"
                 f"Content-Type: {ctype}\r\n"
@@ -171,7 +388,9 @@ class Gateway:
         finally:
             writer.close()
 
-    async def _route(self, method: str, path: str, body: bytes):
+    async def _route(self, method: str, path: str, body: bytes,
+                     query: "Optional[Dict[str, str]]" = None):
+        query = query or {}
         parts = [p for p in path.split("/") if p]
         if not parts:
             if method == "GET":
@@ -193,6 +412,39 @@ class Gateway:
                 return 204, b"", "text/plain"
             raise RGWError("bad method")
         bucket, key = parts[0], "/".join(parts[1:])
+        if "uploads" in query and method == "POST":
+            uid = await self.create_multipart(bucket, key)
+            return 200, json.dumps({"upload_id": uid}).encode(), \
+                "application/json"
+        if "uploadId" in query:
+            uid = query["uploadId"]
+            if method == "PUT" and "partNumber" in query:
+                try:
+                    num = int(query["partNumber"])
+                except ValueError:
+                    raise RGWError(
+                        f"bad partNumber {query['partNumber']!r}")
+                etag = await self.upload_part(bucket, key, uid, num,
+                                              body)
+                return 200, json.dumps({"etag": etag}).encode(), \
+                    "application/json"
+            if method == "POST":
+                try:
+                    parts_list = [(int(n), str(e))
+                                  for n, e in json.loads(body.decode())]
+                except (ValueError, TypeError):
+                    raise RGWError("bad complete-multipart body")
+                meta = await self.complete_multipart(bucket, key, uid,
+                                                     parts_list)
+                return 200, json.dumps(meta).encode(), "application/json"
+            if method == "GET":
+                return 200, json.dumps(
+                    await self.list_parts(bucket, uid)).encode(), \
+                    "application/json"
+            if method == "DELETE":
+                await self.abort_multipart(bucket, uid)
+                return 204, b"", "text/plain"
+            raise RGWError("bad multipart method")
         if method == "PUT":
             meta = await self.put_object(bucket, key, body)
             return 201, json.dumps(meta).encode(), "application/json"

@@ -120,9 +120,11 @@ class TestRGW:
         loop.run_until_complete(go())
 
 
-async def http(port, method, path, body=b"", want_status=False):
+async def http(port, method, path, body=b"", want_status=False,
+               headers=None):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n{extra}"
            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
     writer.write(req)
     await writer.drain()
@@ -168,4 +170,158 @@ class TestFS:
                 with pytest.raises(FSError):
                     await fs.read_file("/home/user/blob.bin")
                 assert await fs.listdir("/home") == ["n2.txt"]
+        loop.run_until_complete(go())
+
+
+class TestRGWMultipart:
+    def test_multipart_round_trip_survives_osd_kill(self, loop):
+        """VERDICT r3 #9's bar: an S3 multipart round trip with a
+        >1-part object that survives an OSD kill between upload and
+        read-back (parts live on an EC pool)."""
+        async def go():
+            c = MiniCluster(n_osds=7)
+            c.create_ec_pool("data", {"plugin": "jax_rs", "k": "3",
+                                      "m": "2"}, pg_num=8,
+                             stripe_unit=4096)
+            c.create_replicated_pool("meta", size=3, pg_num=4,
+                                     stripe_unit=4096)
+            async with c:
+                client = await c.client()
+                gw = Gateway(client.io_ctx("meta"),
+                             client.io_ctx("data"))
+                await gw.create_bucket("vids")
+                port = await gw.serve(0)
+                chunks = [payload(2 << 20, 20), payload(1 << 20, 21),
+                          payload(700_000, 22)]
+                body = await http(port, "POST", "/vids/movie?uploads")
+                uid = json.loads(body)["upload_id"]
+                etags = []
+                for i, blob in enumerate(chunks, start=1):
+                    out = await http(
+                        port, "PUT",
+                        f"/vids/movie?uploadId={uid}&partNumber={i}",
+                        blob)
+                    etags.append(json.loads(out)["etag"])
+                # kill an OSD while the upload is open
+                await c.kill_osd(5)
+                await c.peer_all()
+                done = await http(
+                    port, "POST", f"/vids/movie?uploadId={uid}",
+                    json.dumps([[i + 1, e]
+                                for i, e in enumerate(etags)]).encode())
+                meta = json.loads(done)
+                want = b"".join(chunks)
+                assert meta["size"] == len(want)
+                assert meta["etag"].endswith("-3")
+                got = await http(port, "GET", "/vids/movie")
+                assert got == want
+                # abort path reaps parts; wrong etag rejected
+                b2 = await http(port, "POST", "/vids/x?uploads")
+                uid2 = json.loads(b2)["upload_id"]
+                await http(port, "PUT",
+                           f"/vids/x?uploadId={uid2}&partNumber=1",
+                           b"abc")
+                st, _ = await http(
+                    port, "POST", f"/vids/x?uploadId={uid2}",
+                    json.dumps([[1, "deadbeef"]]).encode(),
+                    want_status=True)
+                assert st == 400
+                await http(port, "DELETE", f"/vids/x?uploadId={uid2}")
+                st, _ = await http(port, "GET",
+                                   f"/vids/x?uploadId={uid2}",
+                                   want_status=True)
+                assert st == 404
+                # degraded read after killing a SECOND OSD (k=3 of the
+                # remaining shards still decode; no more writes now —
+                # some PG may be below min_size)
+                await c.kill_osd(4)
+                await c.peer_all()
+                assert await http(port, "GET", "/vids/movie") == want
+                gw.shutdown()
+        loop.run_until_complete(go())
+
+    def test_signed_requests(self, loop):
+        """rgw auth: registered users force HMAC-signed requests;
+        bad/absent signatures get 403."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                gw = Gateway(client.io_ctx("meta"),
+                             client.io_ctx("data"))
+                gw.add_user("AKID", "s3cr3t")
+                await gw.create_bucket("b")   # library path: no auth
+                port = await gw.serve(0)
+                st, _ = await http(port, "GET", "/", want_status=True)
+                assert st == 403   # unsigned
+                import time as _time
+                date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+
+                def hdrs(method, path, body=b"", key="s3cr3t",
+                         akid="AKID"):
+                    sig = Gateway.sign(key, method, path, date, body)
+                    return {"x-rgw-date": date,
+                            "authorization": f"RGW1 {akid}:{sig}"}
+
+                body = await http(port, "GET", "/",
+                                  headers=hdrs("GET", "/"))
+                assert json.loads(body) == ["b"]
+                blob = b"signed!" * 100
+                await http(port, "PUT", "/b/k", blob,
+                           headers=hdrs("PUT", "/b/k", blob))
+                assert await http(port, "GET", "/b/k",
+                                  headers=hdrs("GET", "/b/k")) == blob
+                st, _ = await http(
+                    port, "GET", "/b/k", want_status=True,
+                    headers=hdrs("GET", "/b/k", key="wrong"))
+                assert st == 403
+                st, _ = await http(
+                    port, "GET", "/b/k", want_status=True,
+                    headers=hdrs("GET", "/b/k", akid="NOPE"))
+                assert st == 403
+                gw.shutdown()
+        loop.run_until_complete(go())
+
+    def test_auth_replay_window_and_reaping(self, loop):
+        """A stale-dated signature is refused (replay window); completing
+        a second multipart for the same key reaps the first upload's
+        blobs; a bucket with an open upload refuses deletion."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                gw = Gateway(client.io_ctx("meta"),
+                             client.io_ctx("data"))
+                gw.add_user("AK", "SK")
+                await gw.create_bucket("b")
+                port = await gw.serve(0)
+                stale = "20200101T000000Z"
+                sig = Gateway.sign("SK", "GET", "/", stale, b"")
+                st, _ = await http(
+                    port, "GET", "/", want_status=True,
+                    headers={"x-rgw-date": stale,
+                             "authorization": f"RGW1 AK:{sig}"})
+                assert st == 403   # outside the replay window
+                gw._users.clear()  # open mode for the rest
+
+                # overwrite-by-multipart reaps the previous upload's parts
+                u1 = await gw.create_multipart("b", "k")
+                e1 = await gw.upload_part("b", "k", u1, 1, b"one" * 100)
+                await gw.complete_multipart("b", "k", u1, [(1, e1)])
+                first_oid = (await gw.head_object("b", "k"))["parts"][0]["oid"]
+                u2 = await gw.create_multipart("b", "k")
+                e2 = await gw.upload_part("b", "k", u2, 1, b"two" * 100)
+                await gw.complete_multipart("b", "k", u2, [(1, e2)])
+                assert await gw.get_object("b", "k") == b"two" * 100
+                try:                               # reaped blob gone
+                    leftover = await gw.striper.read(first_oid)
+                except Exception:  # noqa: BLE001 — absent is also fine
+                    leftover = b""
+                assert leftover == b""
+                # open upload blocks bucket deletion
+                await gw.delete_object("b", "k")
+                u3 = await gw.create_multipart("b", "x")
+                with pytest.raises(RGWError, match="in-progress"):
+                    await gw.delete_bucket("b")
+                await gw.abort_multipart("b", u3)
+                await gw.delete_bucket("b")
+                gw.shutdown()
         loop.run_until_complete(go())
