@@ -46,7 +46,8 @@ def make_encode_step(k: int, m: int, technique: str = "reed_sol_van",
         W = (data_u32.shape[-2] * data_u32.shape[-1]
              if data_u32.ndim == 4 else data_u32.shape[-1])
         fused_ok = fused_pallas.supported(k, m, W) and (
-            data_u32.ndim != 4 or data_u32.shape[-1] == fused_pallas.SEG_W)
+            data_u32.ndim != 4 or data_u32.shape[-1] in (
+                fused_pallas.SEG_W, fused_pallas.MAX_SEG_W))
         if fused_ok:
             return fused_pallas.fused_encode_crc(data_u32, k, m,
                                                  technique=technique)
@@ -58,21 +59,33 @@ def make_encode_step(k: int, m: int, technique: str = "reed_sol_van",
 
     @jax.jit
     def _split_step(data_u32: jax.Array):
-        parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(data_u32)
-        B, _, W = data_u32.shape
-        # non-dividing widths: crc32c_words_jax picks a sane
-        # segmentation itself (seg=1 would explode trace-time constants)
-        seg = crc_seg_words if W % crc_seg_words == 0 else 256
-        # crc data and parity separately: a concatenate would
-        # materialize an extra (k+m)/k copy of the batch in HBM
-        dcrc = crc_ops.crc32c_words_jax(
-            data_u32.reshape(B * k, W), seg_words=seg)
-        pcrc = crc_ops.crc32c_words_jax(
-            parity.reshape(B * m, W), seg_words=seg)
-        return parity, jnp.concatenate(
-            [dcrc.reshape(B, k), pcrc.reshape(B, m)], axis=1)
+        return split_encode_crc_matrix(C, data_u32,
+                                       crc_seg_words=crc_seg_words)
 
     return step
+
+
+def split_encode_crc_matrix(C: np.ndarray, data_u32,
+                            crc_seg_words: int = 512):
+    """The canonical SPLIT encode+crc composition: vmapped SWAR GF
+    matmul + segmented crc over data and parity separately (a
+    concatenate would materialize an extra (k+m)/k copy of the batch in
+    HBM).  Shared by make_encode_step's fallback and the sharded mesh
+    step (parallel/distributed.py) so the two can never diverge.
+
+    data_u32: (B, k, W) -> (parity (B, m, W), crcs (B, k+m))."""
+    m, k = C.shape
+    parity = jax.vmap(lambda x: gf_jax.gf_mat_encode_u32(C, x))(data_u32)
+    B, _, W = data_u32.shape
+    # non-dividing widths: crc32c_words_jax picks a sane segmentation
+    # itself (seg=1 would explode trace-time constants)
+    seg = crc_seg_words if W % crc_seg_words == 0 else 256
+    dcrc = crc_ops.crc32c_words_jax(
+        data_u32.reshape(B * k, W), seg_words=seg)
+    pcrc = crc_ops.crc32c_words_jax(
+        parity.reshape(B * m, W), seg_words=seg)
+    return parity, jnp.concatenate(
+        [dcrc.reshape(B, k), pcrc.reshape(B, m)], axis=1)
 
 
 @functools.lru_cache(maxsize=64)
@@ -96,7 +109,8 @@ def make_decode_step(k: int, m: int, rows: "tuple[int, ...]",
 
 
 def example_batch(B: int = 8, k: int = 8, chunk_bytes: int = 128 * 1024,
-                  seed: int = 0, segmented: bool = False) -> np.ndarray:
+                  seed: int = 0, segmented: bool = False,
+                  m: int = 3) -> np.ndarray:
     """Deterministic example input for compile checks and benchmarks.
 
     ``segmented=True`` returns the (B, k, S, 512) device-native layout
@@ -107,5 +121,7 @@ def example_batch(B: int = 8, k: int = 8, chunk_bytes: int = 128 * 1024,
     out = rng.integers(0, 2 ** 32, size=(B, k, chunk_bytes // 4),
                        dtype=np.uint32)
     if segmented:
-        return out.reshape(B, k, chunk_bytes // 4 // 512, 512)
+        from ..ops import fused_pallas
+        sw = fused_pallas.seg_w_for(chunk_bytes // 4, k, m)
+        return out.reshape(B, k, chunk_bytes // 4 // sw, sw)
     return out

@@ -59,16 +59,43 @@ import numpy as np
 from . import crc32c as crc_ops
 from . import gf8
 
-SEG_W = 512          # words per crc segment (2 KiB)
-MAX_BLK_SEGS = 64    # segments per kernel block (<= 128 KiB block width)
+SEG_W = 512          # BASE crc segment (2 KiB): the external layout unit
+MAX_SEG_W = 1024     # kernel-internal segment cap: M1 doubles to 8 MiB
+                     # VMEM at 1024 (2048 fails to compile); the larger
+                     # segment HALVES the per-segment register planes the
+                     # combine matmul reads back from HBM — measured
+                     # 128.9 -> 151.3 GiB/s on the flagship (v5e)
+BLK_WORDS = 32 * 1024   # words per kernel block (128 KiB block width)
 
 
 from .crc32c import _on_tpu
 
 
-def _blk_segs(n_words: int) -> int:
-    segs = n_words // SEG_W
-    b = min(MAX_BLK_SEGS, segs)
+# M1 (the per-segment crc operator constant, (k, 8, seg_w, L) int8) is
+# loaded whole into VMEM: 8 MiB measured-good, 16 MiB measured-fail on
+# v5e.  The wide segment is only worth taking when it fits.
+_M1_VMEM_BUDGET = 8 << 20
+_M1_VMEM_LIMIT = 12 << 20   # 10 MiB (k=10, L=256, seg 512) compiles
+
+
+def _m1_bytes(k: int, seg_w: int, L: int) -> int:
+    return k * 8 * seg_w * L
+
+
+def seg_w_for(n_words: int, k: int = 8, m: int = 3) -> int:
+    """Kernel segment width for a chunk of n_words: the widest segment
+    that divides the chunk AND keeps the M1 VMEM constant within the
+    measured budget (wider segment halves the combine readback)."""
+    L = 128 * _lane_groups(m)
+    if (n_words % MAX_SEG_W == 0 and n_words >= MAX_SEG_W
+            and _m1_bytes(k, MAX_SEG_W, L) <= _M1_VMEM_BUDGET):
+        return MAX_SEG_W
+    return SEG_W
+
+
+def _blk_segs(n_words: int, seg_w: int) -> int:
+    segs = n_words // seg_w
+    b = min(BLK_WORDS // seg_w, segs)
     while segs % b:
         b -= 1
     return b
@@ -104,9 +131,18 @@ def _regs_for_bytes(op_cols: np.ndarray) -> np.ndarray:
     return ((regs[:, None] >> np.arange(32)[None, :]) & 1).astype(np.uint8)
 
 
+def _lane_groups(m: int) -> int:
+    """MXU lane width per crc matmul: 32*(1+m) map lanes rounded up to
+    whole 128-lane tiles.  m <= 3 fits ONE tile (the 1024 MAC/B floor);
+    m in 4..7 takes two tiles (2048 MAC/B) and m in 8..11 three
+    (3072 MAC/B) — the floor scales with the tile count but stays 2-5x
+    better than the unfused path for those geometries."""
+    return ((1 + m) * 32 + 127) // 128
+
+
 @functools.lru_cache(maxsize=16)
 def _m1_matrix(c_bytes: bytes, m: int, k: int, seg_w: int) -> np.ndarray:
-    """Level-1 MXU matrices: (k, 8, seg_w, 128) int8.
+    """Level-1 MXU matrices: (k, 8, seg_w, 128*G) int8.
 
     M1[j, i, p, 32*g + n] = bit n of S_p(E8(T_g(2^i))) where
     S_p = advance-by-(4*(seg_w-1-p)+1)-bytes, T_0 = id and
@@ -114,8 +150,9 @@ def _m1_matrix(c_bytes: bytes, m: int, k: int, seg_w: int) -> np.ndarray:
     (A^(3-c)) is deferred to the combine matmul (_m2_matrix).
     """
     C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
+    L = 128 * _lane_groups(m)
     ops = _op_chain(1, 4, seg_w)[::-1]                 # ops[p] for word p
-    M1 = np.zeros((k, 8, seg_w, 128), dtype=np.int8)
+    M1 = np.zeros((k, 8, seg_w, L), dtype=np.int8)
     for p in range(seg_w):
         regs = _regs_for_bytes(ops[p])                 # (256, 32) bits
         for j in range(k):
@@ -129,15 +166,16 @@ def _m1_matrix(c_bytes: bytes, m: int, k: int, seg_w: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=16)
 def _m2_matrix(n_blk: int, blk_segs: int, seg_w: int,
-               chunk_bytes: int) -> np.ndarray:
-    """Combine matmul constants: (n_blk*blk_segs*4*128, 128) int8.
+               chunk_bytes: int, n_groups: int = 4,
+               lanes: int = 128) -> np.ndarray:
+    """Combine matmul constants: (n_blk*blk_segs*4*lanes, lanes) int8.
 
     Contraction rows are (block, segment r, byte-slot c, lane bit); the
     entry applies the shift operator for (bytes after this segment's
-    end) + (3 - c), block-diagonal over the 4 map groups.
+    end) + (3 - c), block-diagonal over the ``n_groups`` map groups.
     """
     blk_w = blk_segs * seg_w
-    M2 = np.zeros((n_blk, blk_segs, 4, 128, 128), dtype=np.int8)
+    M2 = np.zeros((n_blk, blk_segs, 4, lanes, lanes), dtype=np.int8)
     for wb in range(n_blk):
         for r in range(blk_segs):
             seg_end = 4 * (wb * blk_w + (r + 1) * seg_w)
@@ -145,10 +183,10 @@ def _m2_matrix(n_blk: int, blk_segs: int, seg_w: int,
                 op = crc_ops.shift_operator(chunk_bytes - seg_end + 3 - c)
                 colbits = ((op[:, None] >> np.arange(32)[None, :]) & 1
                            ).astype(np.int8)           # (bit b, bit n)
-                for g in range(4):
+                for g in range(n_groups):
                     M2[wb, r, c, 32 * g:32 * g + 32,
                        32 * g:32 * g + 32] = colbits
-    return M2.reshape(n_blk * blk_segs * 4 * 128, 128)
+    return M2.reshape(n_blk * blk_segs * 4 * lanes, lanes)
 
 
 # ---------------------------------------------------------------------------
@@ -170,14 +208,16 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int):
     from jax.experimental.pallas import tpu as pltpu
 
     C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
-    seg_w = SEG_W
-    blk_segs = _blk_segs(n_words)
+    seg_w = seg_w_for(n_words, k, m)
+    blk_segs = _blk_segs(n_words, seg_w)
     blk_w = seg_w * blk_segs
     n_wb = n_words // blk_w
     chunk_bytes = 4 * n_words
+    L = 128 * _lane_groups(m)            # crc matmul lane width
 
     M1 = _m1_matrix(c_bytes, m, k, seg_w)
-    M2_np = _m2_matrix(n_wb, blk_segs, seg_w, chunk_bytes)
+    M2_np = _m2_matrix(n_wb, blk_segs, seg_w, chunk_bytes,
+                       n_groups=1 + m, lanes=L)
     init_term = np.uint32(crc_ops._matvec(
         crc_ops.shift_operator(chunk_bytes), 0xFFFFFFFF))
     lane_w = (np.uint32(1) << np.arange(32, dtype=np.uint32))
@@ -204,7 +244,13 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int):
             out1_ref[0, j, 0] = (x & 1).astype(jnp.int8)
 
     @jax.jit
-    def run(data4):  # (B, k, n_wb*blk_segs, seg_w) uint32
+    def run(data4):  # (B, k, n_words//seg_w, seg_w) uint32
+        if data4.shape[-1] != seg_w:
+            # caller fed the base (…, S, 512) layout while the kernel
+            # runs wider segments: minor-dims merge (contiguous); free
+            # on host numpy, a (cheap) reshape when traced
+            data4 = data4.reshape(data4.shape[0], k,
+                                  n_words // seg_w, seg_w)
         B = data4.shape[0]
         parity4, out1 = pl.pallas_call(
             kernel,
@@ -212,30 +258,30 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int):
             in_specs=[
                 pl.BlockSpec((1, k, blk_segs, seg_w),
                              lambda b, w: (b, 0, w, 0)),
-                pl.BlockSpec((k, 8, seg_w, 128), lambda b, w: (0, 0, 0, 0)),
+                pl.BlockSpec((k, 8, seg_w, L), lambda b, w: (0, 0, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, m, blk_segs, seg_w),
                              lambda b, w: (b, 0, w, 0)),
-                pl.BlockSpec((1, k, 1, 4 * blk_segs, 128),
+                pl.BlockSpec((1, k, 1, 4 * blk_segs, L),
                              lambda b, w: (b, 0, w, 0, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, m, n_wb * blk_segs, seg_w),
                                      jnp.uint32),
-                jax.ShapeDtypeStruct((B, k, n_wb, 4 * blk_segs, 128),
+                jax.ShapeDtypeStruct((B, k, n_wb, 4 * blk_segs, L),
                                      jnp.int8),
             ],
         )(data4, jnp.asarray(M1))
 
         # ---- combine (negligible MACs: ~33/byte vs 1024 above).
-        # Multi-dim contraction avoids flattening the int8 (rows, 128)
+        # Multi-dim contraction avoids flattening the int8 (rows, L)
         # tile layout into one lane axis (a measurable relayout).
-        M2r = jnp.asarray(M2_np.reshape(n_wb, 4 * blk_segs, 128, 128))
+        M2r = jnp.asarray(M2_np.reshape(n_wb, 4 * blk_segs, L, L))
         r1 = jax.lax.dot_general(
             out1, M2r, (((2, 3, 4), (0, 1, 2)), ((), ())),
             preferred_element_type=jnp.int32) & 1
-        r1 = r1.reshape(B, k, 4, 32)
+        r1 = r1.reshape(B, k, L // 32, 32)
         data_bits = r1[:, :, 0, :]                             # (B, k, 32)
         par_bits = jnp.sum(r1[:, :, 1:1 + m, :], axis=1) & 1   # (B, m, 32)
         bits = jnp.concatenate([data_bits, par_bits], axis=1)
@@ -267,9 +313,10 @@ def fused_encode_crc_matrix(C: np.ndarray, data_u32):
     seg4 = data_u32.ndim == 4
     if seg4:
         B, k_, S, sw = data_u32.shape
-        if sw != SEG_W:
+        if sw not in (SEG_W, MAX_SEG_W):
             raise ValueError(
-                f"segmented layout requires last dim {SEG_W}, got {sw}")
+                f"segmented layout requires last dim {SEG_W} or "
+                f"{MAX_SEG_W}, got {sw}")
         W = S * sw
         d4 = data_u32
     else:
@@ -278,7 +325,11 @@ def fused_encode_crc_matrix(C: np.ndarray, data_u32):
     assert k_ == k
     run = _build_fused(C.tobytes(), m, k, W)
     parity4, crcs = run(d4)
-    return (parity4 if seg4 else parity4.reshape(B, m, W)), crcs
+    if seg4:
+        if parity4.shape[-1] != sw:
+            parity4 = parity4.reshape(B, m, W // sw, sw)
+        return parity4, crcs
+    return parity4.reshape(B, m, W), crcs
 
 
 def fused_encode_crc(data_u32, k: int, m: int,
@@ -288,10 +339,21 @@ def fused_encode_crc(data_u32, k: int, m: int,
     return fused_encode_crc_matrix(C, data_u32)
 
 
-def supported_matrix(m: int, W: int) -> bool:
-    """m <= 3 (4-map trick needs 32*(1+m) <= 128 lanes), whole segments."""
-    return (_on_tpu() and 1 <= m <= 3 and W % SEG_W == 0 and W >= SEG_W)
+def supported_matrix(m: int, W: int, k: "int | None" = None) -> bool:
+    """m <= 3 runs at the 1024 MAC/B floor (one 128-lane tile); m in
+    4..7 takes two lane tiles (2048 MAC/B), m in 8..11 three — each
+    still well ahead of the unfused path.  Whole 2 KiB segments
+    required; when ``k`` is given the M1 VMEM constant must also fit
+    the measured compile limit."""
+    if not (_on_tpu() and 1 <= m <= 11 and W % SEG_W == 0
+            and W >= SEG_W):
+        return False
+    if k is not None:
+        L = 128 * _lane_groups(m)
+        if _m1_bytes(k, SEG_W, L) > _M1_VMEM_LIMIT:
+            return False
+    return True
 
 
 def supported(k: int, m: int, W: int) -> bool:
-    return supported_matrix(m, W)
+    return supported_matrix(m, W, k)

@@ -1,4 +1,5 @@
 """Device-mesh parallelism: sharded EC pipelines over (pg, shard) meshes."""
 
-from .distributed import DistributedEC, default_geometry, make_mesh  # noqa: F401
+from .distributed import (DistributedEC, default_geometry,  # noqa: F401
+                          make_mesh, sharded_fused_encode_step)
 from .plane import MeshDataPlane  # noqa: F401

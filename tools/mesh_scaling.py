@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""mesh_scaling — measure the sharded fused encode+crc step across mesh
+sizes and write MESH_SCALING.json.
+
+The multi-chip perf story (ROOFLINE.md: per-chip 8x is unreachable on
+v5e; the path to the north star is sharding the batch over pg axes):
+this tool runs parallel.sharded_fused_encode_step — the SAME program a
+TPU pod would run — over 1/2/4/8-device meshes and reports weak-scaling
+efficiency.  On the virtual CPU mesh (default here) the numbers prove
+the program structure (no collectives, linear by construction) and
+measure real multi-core speedup; on a real multi-chip slice the same
+tool measures real ICI-domain scaling.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python tools/mesh_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+import jax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from ceph_tpu.ops import gf8  # noqa: E402
+from ceph_tpu.parallel import sharded_fused_encode_step  # noqa: E402
+
+K, M = 8, 3
+SEGS = 16                 # 32 KiB chunks: fits virtual-CPU compile times
+PER_DEV_B = 8             # weak scaling: batch per device held constant
+
+
+def measure(n_dev: int) -> dict:
+    C = gf8.xor_min_matrix(K, M)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(n_dev, 1),
+                ("pg", "shard"))
+    step = sharded_fused_encode_step(mesh, C)
+    B = PER_DEV_B * n_dev
+    rng = np.random.default_rng(0)
+    d4 = rng.integers(0, 2 ** 32, size=(B, K, SEGS, 512), dtype=np.uint32)
+    arr = jax.device_put(d4, NamedSharding(
+        mesh, P("pg", None, None, None)))
+    # warmup/compile
+    par, crcs = step(arr)
+    par.block_until_ready()
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        par, crcs = step(arr)
+    par.block_until_ready()
+    crcs.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    in_bytes = B * K * SEGS * 512 * 4
+    return {"devices": n_dev, "batch": B,
+            "input_MiB": round(in_bytes / 2**20, 1),
+            "step_ms": round(dt * 1e3, 2),
+            "gibs": round(in_bytes / dt / 2**30, 2)}
+
+
+def main() -> None:
+    n = len(jax.devices())
+    sizes = [s for s in (1, 2, 4, 8) if s <= n]
+    rows = [measure(s) for s in sizes]
+    base = rows[0]["gibs"]
+    for r in rows:
+        r["weak_scaling_eff"] = round(
+            r["gibs"] / (base * r["devices"]), 2) if base else 0.0
+    out = {"platform": jax.devices()[0].platform,
+           "k": K, "m": M, "chunk_bytes": SEGS * 512 * 4,
+           "per_device_batch": PER_DEV_B, "rows": rows,
+           "note": ("sharded_fused_encode_step has no cross-device "
+                    "collectives; on a virtual CPU mesh this measures "
+                    "host-core parallelism and proves the sharded "
+                    "program, on a real slice it measures the pod")}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MESH_SCALING.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["rows"]))
+
+
+if __name__ == "__main__":
+    main()
