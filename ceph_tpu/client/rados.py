@@ -106,6 +106,16 @@ class IoCtx:
     async def remove(self, oid: str) -> None:
         await self._submit(oid, [{"op": "delete"}])
 
+    async def copy_from(self, dst_oid: str, src_oid: str) -> int:
+        """Server-side object copy (reference rados copy /
+        CEPH_OSD_OP_COPY_FROM): the DST primary reads src wherever it
+        lives and commits the bytes — the payload never touches the
+        client.  Returns the copied size."""
+        outs, _ = await self._submit(
+            dst_oid, [{"op": "copy_from", "src": src_oid}])
+        return next((int(o["size"]) for o in outs
+                     if o.get("op") == "copy_from"), 0)
+
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         await self._submit(oid, [{"op": "setxattr", "name": name,
                                   "dlen": len(value)}], bytes(value))

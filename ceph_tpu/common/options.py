@@ -187,6 +187,12 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("mgr_prometheus_port", int, 9283, LEVEL_ADVANCED, min=0,
            desc="prometheus exporter port (0 = ephemeral)",
            services=("mgr",)),
+    Option("mgr_dashboard_port", int, 0, LEVEL_ADVANCED, min=0,
+           desc="dashboard http port (0 = ephemeral)",
+           services=("mgr",)),
+    Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED, min=1,
+           desc="pg_autoscaler aims for this many PG placements per "
+                "OSD across all pools", services=("mgr", "mon")),
     Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="extra directory for mgr modules", services=("mgr",)),
     # --- tracing / op tracking ---------------------------------------------

@@ -56,47 +56,66 @@ class StatusModule(MgrModule):
         return {"num_daemons": len(daemons), "daemons": daemons}
 
 
-class PrometheusModule(MgrModule):
-    """Text-format exporter (reference src/pybind/mgr/prometheus)."""
+class HttpModule(MgrModule):
+    """Shared HTTP plumbing for modules that serve a port (prometheus,
+    dashboard): bind-with-ephemeral-port, one-shot request handling,
+    shutdown.  Subclasses implement ``respond(path) -> (body, ctype)``."""
 
-    name = "prometheus"
+    port_option = ""
 
     def __init__(self, mgr: "MgrDaemon") -> None:
         super().__init__(mgr)
-        self.port = int(mgr.config.get("mgr_prometheus_port"))
+        self.port = int(mgr.config.get(self.port_option)) \
+            if self.port_option else 0
         self._server: "Optional[asyncio.AbstractServer]" = None
 
     async def serve(self) -> None:
         # awaited at init: port is final before init() returns (a
-        # fire-and-forget task would let prometheus_port() race the bind)
+        # fire-and-forget task would let port readers race the bind)
         self._server = await asyncio.start_server(
             self._handle, "127.0.0.1", self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        dout("mgr", 1, f"prometheus exporter on 127.0.0.1:{self.port}")
+        dout("mgr", 1, f"{self.name} on 127.0.0.1:{self.port}")
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    def respond(self, path: str) -> "tuple[bytes, str]":
+        raise NotImplementedError
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
-            await reader.readline()          # request line; drain headers
+            req = (await reader.readline()).decode().split()
             while (await reader.readline()).strip():
-                pass
-            body = self.render().encode()
-            writer.write(b"HTTP/1.1 200 OK\r\n"
-                         b"Content-Type: text/plain; version=0.0.4\r\n"
-                         b"Content-Length: " + str(len(body)).encode()
+                pass                         # drain headers
+            path = req[1] if len(req) > 1 else "/"
+            body, ctype = self.respond(path)
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: "
+                         + ctype.encode() + b"\r\nContent-Length: "
+                         + str(len(body)).encode()
                          + b"\r\nConnection: close\r\n\r\n" + body)
             await writer.drain()
         finally:
             writer.close()
 
+
+class PrometheusModule(HttpModule):
+    """Text-format exporter (reference src/pybind/mgr/prometheus)."""
+
+    name = "prometheus"
+    port_option = "mgr_prometheus_port"
+
+    def respond(self, path: str) -> "tuple[bytes, str]":
+        return self.render().encode(), "text/plain; version=0.0.4"
+
     def render(self) -> str:
         """Aggregate reports into prometheus exposition text."""
         lines = ["# HELP ceph_daemon_up 1 if the daemon reported recently",
                  "# TYPE ceph_daemon_up gauge"]
-        now = time.monotonic()
-        stale = float(self.mgr.config.get("mgr_stats_period")) * 3
         for name, rep in sorted(self.mgr.reports.items()):
-            up = 1 if now - rep["ts"] < stale else 0
+            up = 1 if self.mgr.is_fresh(rep) else 0
             lines.append(f'ceph_daemon_up{{ceph_daemon="{name}"}} {up}')
         seen: "set[str]" = set()
         for name, rep in sorted(self.mgr.reports.items()):
@@ -112,10 +131,6 @@ class PrometheusModule(MgrModule):
                         f'{metric}{{ceph_daemon="{name}"}} {val}')
         return "\n".join(lines) + "\n"
 
-    def shutdown(self) -> None:
-        if self._server is not None:
-            self._server.close()
-
 
 class MgrDaemon(Dispatcher):
     def __init__(self, config: "Optional[Config]" = None,
@@ -130,6 +145,10 @@ class MgrDaemon(Dispatcher):
         self._tasks: "list[asyncio.Task]" = []
         self.register_module(StatusModule)
         self.register_module(PrometheusModule)
+        from .dashboard import DashboardModule
+        from .pg_autoscaler import PgAutoscalerModule
+        self.register_module(PgAutoscalerModule)
+        self.register_module(DashboardModule)
 
     def register_module(self, cls: "Callable[[MgrDaemon], MgrModule]"
                         ) -> MgrModule:
@@ -148,6 +167,12 @@ class MgrDaemon(Dispatcher):
             mod.shutdown()
         await self.ms.shutdown()
 
+    def is_fresh(self, rep: dict, mult: float = 3.0) -> bool:
+        """A report newer than mult * mgr_stats_period counts as live
+        (shared staleness rule for prometheus/dashboard/autoscaler)."""
+        period = float(self.config.get("mgr_stats_period"))
+        return time.monotonic() - rep["ts"] < mult * period
+
     async def ms_dispatch(self, conn, msg: Message) -> bool:
         if msg.TYPE != "mgr_report":
             return False
@@ -155,6 +180,14 @@ class MgrDaemon(Dispatcher):
             "ts": time.monotonic(), "perf": dict(msg.get("perf", {})),
             "status": dict(msg.get("status", {})),
             "epoch": int(msg.get("epoch", 0))}
+        # expire long-gone daemons: a decommissioned OSD must not pin
+        # health at WARN or inflate the autoscaler's PG budget forever
+        # (reports older than 60 periods are purged, not just stale)
+        horizon = 60.0 * float(self.config.get("mgr_stats_period"))
+        now = time.monotonic()
+        for name in [n for n, r in self.reports.items()
+                     if now - r["ts"] > horizon]:
+            del self.reports[name]
         return True
 
     # --- convenience ----------------------------------------------------------
@@ -179,7 +212,15 @@ async def report_loop(daemon, mgr_addr: str) -> None:
                 "perf": daemon.perf_coll.dump(),
                 "status": {"up": daemon.up,
                            "num_pgs": len(daemon.backends),
-                           "epoch": daemon.osdmap.epoch},
+                           "epoch": daemon.osdmap.epoch,
+                           # pool geometry for the dashboard +
+                           # pg_autoscaler (reference: mgr consumes the
+                           # osdmap directly; here it rides the report)
+                           "pools": {
+                               p.name: {"type": p.type,
+                                        "pg_num": p.pg_num,
+                                        "size": p.size}
+                               for p in daemon.osdmap.pools.values()}},
                 "epoch": daemon.osdmap.epoch}))
         except Exception as e:  # noqa: BLE001 — mgr down: keep trying
             dout("mgr", 10, f"{name}: mgr report failed: {e}")

@@ -1,0 +1,98 @@
+"""Dashboard mgr module — the operator's web view of the cluster.
+
+Lean rebuild of src/pybind/mgr/dashboard (the reference ships a full
+SPA; this serves the same load-bearing content — cluster health,
+daemons, pools, PG autoscaler advice, perf counters — as a
+self-contained HTML page plus a JSON API):
+
+  GET /            one-page HTML dashboard (auto-refreshing)
+  GET /api/status  the same data as JSON
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import json
+import time
+
+from .daemon import HttpModule
+
+
+def _esc(v) -> str:
+    """Names (daemons, pools) are operator/client-chosen strings headed
+    for an auto-refreshing browser page: escape EVERYTHING interpolated
+    into the HTML (a pool named <script>... is stored XSS otherwise)."""
+    return html_mod.escape(str(v), quote=True)
+
+
+class DashboardModule(HttpModule):
+    name = "dashboard"
+    port_option = "mgr_dashboard_port"
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        daemons = {}
+        pools: dict = {}
+        for name, rep in sorted(self.mgr.reports.items()):
+            st = rep.get("status", {})
+            daemons[name] = {
+                "up": bool(st.get("up", False))
+                and self.mgr.is_fresh(rep),
+                "age_s": round(now - rep["ts"], 1),
+                "num_pgs": st.get("num_pgs", 0),
+                "epoch": st.get("epoch", 0)}
+            for pname, pinfo in st.get("pools", {}).items():
+                pools.setdefault(pname, pinfo)
+        up = sum(1 for d in daemons.values() if d["up"])
+        health = "HEALTH_OK" if up == len(daemons) and daemons \
+            else ("HEALTH_WARN" if up else "HEALTH_ERR")
+        out = {"health": health,
+               "num_daemons": len(daemons), "num_up": up,
+               "daemons": daemons, "pools": pools}
+        auto = self.mgr.modules.get("pg_autoscaler")
+        if auto is not None:
+            out["pg_autoscaler"] = auto.recommendations()
+        return out
+
+    def respond(self, path: str) -> "tuple[bytes, str]":
+        if path.startswith("/api"):
+            return json.dumps(self.snapshot()).encode(), \
+                "application/json"
+        return self._html().encode(), "text/html"
+
+    def _html(self) -> str:
+        s = self.snapshot()
+        color = {"HEALTH_OK": "#2a2", "HEALTH_WARN": "#b80",
+                 "HEALTH_ERR": "#c22"}[s["health"]]
+        drows = "".join(
+            f"<tr><td>{_esc(n)}</td><td>{'up' if d['up'] else 'DOWN'}"
+            f"</td><td>{_esc(d['num_pgs'])}</td>"
+            f"<td>{_esc(d['age_s'])}s</td></tr>"
+            for n, d in s["daemons"].items())
+        prows = "".join(
+            f"<tr><td>{_esc(n)}</td><td>{_esc(p.get('type', '?'))}</td>"
+            f"<td>{_esc(p.get('pg_num', '?'))}</td>"
+            f"<td>{_esc(p.get('size', '?'))}</td></tr>"
+            for n, p in s["pools"].items())
+        arows = "".join(
+            f"<tr><td>{_esc(r['pool'])}</td><td>{_esc(r['pg_num'])}</td>"
+            f"<td>{_esc(r['recommended'])}</td>"
+            f"<td>{_esc(r['verdict'])}</td></tr>"
+            for r in s.get("pg_autoscaler", []))
+        return f"""<!doctype html><html><head><title>ceph_tpu</title>
+<meta http-equiv="refresh" content="5">
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:
+collapse}}td,th{{border:1px solid #999;padding:4px 10px}}</style>
+</head><body>
+<h1>ceph_tpu <span style="color:{color}">{s['health']}</span></h1>
+<p>{s['num_up']}/{s['num_daemons']} daemons up</p>
+<h2>Daemons</h2>
+<table><tr><th>name</th><th>state</th><th>pgs</th><th>last report</th>
+</tr>{drows}</table>
+<h2>Pools</h2>
+<table><tr><th>pool</th><th>type</th><th>pg_num</th><th>size</th></tr>
+{prows}</table>
+<h2>PG autoscaler</h2>
+<table><tr><th>pool</th><th>pg_num</th><th>recommended</th>
+<th>verdict</th></tr>{arows}</table>
+</body></html>"""

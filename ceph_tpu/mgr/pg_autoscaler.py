@@ -1,0 +1,63 @@
+"""pg_autoscaler mgr module — per-pool PG count recommendations.
+
+Lean rebuild of src/pybind/mgr/pg_autoscaler: the reference computes a
+target PG count per pool from its capacity share and utilization, aims
+for ~``mon_target_pg_per_osd`` PGs per OSD after replication, rounds to
+a power of two, and warns (or acts) when the actual count is more than
+a factor of 4 off.
+
+This framework has no PG split/merge machinery yet (osd pool set
+rejects pg_num for exactly that reason), so the module is ADVISORY:
+recommendations surface in the dashboard, the JSON API, and as
+health-style verdicts — the reference's `ceph osd pool autoscale-status`
+view.  Without per-pool utilization stats the capacity share is assumed
+uniform across pools (the reference's behavior for pools with no data
+yet).
+"""
+
+from __future__ import annotations
+
+from .daemon import MgrModule
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class PgAutoscalerModule(MgrModule):
+    name = "pg_autoscaler"
+
+    def recommendations(self) -> "list[dict]":
+        target_per_osd = int(self.mgr.config.get(
+            "mon_target_pg_per_osd"))
+        # FRESH reports only: a decommissioned OSD must not inflate the
+        # PG budget (stale entries also expire outright in ms_dispatch)
+        fresh = {n: r for n, r in self.mgr.reports.items()
+                 if self.mgr.is_fresh(r)}
+        osds = [n for n in fresh if n.startswith("osd.")]
+        pools: dict = {}
+        for rep in fresh.values():
+            for pname, pinfo in rep.get("status", {}).get(
+                    "pools", {}).items():
+                pools.setdefault(pname, pinfo)
+        if not osds or not pools:
+            return []
+        budget = len(osds) * target_per_osd
+        out = []
+        for pname, pinfo in sorted(pools.items()):
+            size = max(1, int(pinfo.get("size", 1)))
+            pg_num = int(pinfo.get("pg_num", 1))
+            # uniform capacity share; each PG costs `size` placements
+            rec = _next_pow2(max(1, budget // max(1, len(pools)) // size))
+            if pg_num * 4 <= rec:
+                verdict = "TOO_FEW_PGS"
+            elif pg_num >= rec * 4:
+                verdict = "TOO_MANY_PGS"
+            else:
+                verdict = "ok"
+            out.append({"pool": pname, "pg_num": pg_num, "size": size,
+                        "recommended": rec, "verdict": verdict})
+        return out

@@ -456,6 +456,9 @@ class ECBackend:
     def object_size(self, oid: str) -> int:
         return self._get_object_info(oid).size
 
+    def object_exists(self, oid: str) -> bool:
+        return self._get_object_info(oid).version != ZERO
+
     def get_attr(self, oid: str, name: str) -> bytes:
         shard = self.my_shard
         return self.store.get_attr(self.coll(shard), ObjectId(oid, shard),

@@ -78,3 +78,49 @@ def test_custom_module_registration(loop):
         assert mod.evaluate() == {"active": True}
         await mgr.shutdown()
     loop.run_until_complete(go())
+
+
+def test_dashboard_and_pg_autoscaler(loop):
+    """Dashboard HTTP view + advisory pg_autoscaler (reference
+    src/pybind/mgr/{dashboard,pg_autoscaler}, lean rebuilds)."""
+    async def go():
+        import json as _json
+        from ceph_tpu.common.config import Config
+        cfg = Config()
+        cfg.set("mgr_stats_period", 0.2)
+        async with MiniCluster(n_osds=4, config=cfg, mgr=True) as c:
+            c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "2",
+                                    "m": "1"}, pg_num=2, stripe_unit=64)
+            client = await c.client()
+            await client.io_ctx("ec").write_full("o", b"x" * 500)
+            for _ in range(60):
+                await asyncio.sleep(0.1)
+                snap = c.mgr.modules["dashboard"].snapshot()
+                if snap["pools"] and snap["num_up"] >= 4:
+                    break
+            assert snap["health"] == "HEALTH_OK", snap
+            assert "ec" in snap["pools"]
+            # autoscaler: 2 PGs for a 3-wide pool on 4 osds with a
+            # 100/osd budget -> recommends far more -> TOO_FEW_PGS
+            recs = {r["pool"]: r for r in snap["pg_autoscaler"]}
+            assert recs["ec"]["verdict"] == "TOO_FEW_PGS", recs
+            assert recs["ec"]["recommended"] >= recs["ec"]["pg_num"] * 4
+            # HTTP surfaces
+            port = c.mgr.modules["dashboard"].port
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"GET /api/status HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            body = raw.partition(b"\r\n\r\n")[2]
+            api = _json.loads(body)
+            assert api["health"] == "HEALTH_OK"
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"GET / HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            html = (await reader.read()).decode()
+            writer.close()
+            assert "HEALTH_OK" in html and "pg_num" in html
+    loop.run_until_complete(go())
