@@ -193,6 +193,18 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED, min=1,
            desc="pg_autoscaler aims for this many PG placements per "
                 "OSD across all pools", services=("mgr", "mon")),
+    # --- hit sets (reference HitSet.h / hit_set_* pool params) --------------
+    Option("osd_hit_set_period", float, 0.0, LEVEL_ADVANCED, min=0,
+           desc="seconds per object-access hit set (0 = tracking off)",
+           services=("osd",)),
+    Option("osd_hit_set_count", int, 4, LEVEL_ADVANCED, min=1,
+           desc="archived hit sets kept per PG", services=("osd",)),
+    Option("osd_hit_set_target_size", int, 1024, LEVEL_ADVANCED, min=8,
+           desc="expected object accesses per hit-set period (sizes "
+                "the bloom)", services=("osd",)),
+    Option("osd_hit_set_fpp", float, 0.05, LEVEL_ADVANCED, min=0.0001,
+           max=0.5, desc="hit-set bloom false positive rate",
+           services=("osd",)),
     Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="extra directory for mgr modules", services=("mgr",)),
     # --- tracing / op tracking ---------------------------------------------

@@ -87,7 +87,10 @@ class HttpModule(MgrModule):
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
-            req = (await reader.readline()).decode().split()
+            # errors="replace": a port scanner's binary junk must get a
+            # clean close, not an unhandled UnicodeDecodeError
+            req = (await reader.readline()).decode(
+                errors="replace").split()
             while (await reader.readline()).strip():
                 pass                         # drain headers
             path = req[1] if len(req) > 1 else "/"

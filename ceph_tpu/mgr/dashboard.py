@@ -44,8 +44,14 @@ class DashboardModule(HttpModule):
             for pname, pinfo in st.get("pools", {}).items():
                 pools.setdefault(pname, pinfo)
         up = sum(1 for d in daemons.values() if d["up"])
-        health = "HEALTH_OK" if up == len(daemons) and daemons \
-            else ("HEALTH_WARN" if up else "HEALTH_ERR")
+        if not daemons:
+            # a mgr with no reports yet (fresh start, or the purge
+            # horizon emptied it) is UNKNOWN, not an outage
+            health = "HEALTH_WARN"
+        elif up == len(daemons):
+            health = "HEALTH_OK"
+        else:
+            health = "HEALTH_WARN" if up else "HEALTH_ERR"
         out = {"health": health,
                "num_daemons": len(daemons), "num_up": up,
                "daemons": daemons, "pools": pools}

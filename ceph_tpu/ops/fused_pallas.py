@@ -345,8 +345,12 @@ def supported_matrix(m: int, W: int, k: "int | None" = None) -> bool:
     still well ahead of the unfused path.  Whole 2 KiB segments
     required; when ``k`` is given the M1 VMEM constant must also fit
     the measured compile limit."""
+    # W >= 4096 words (16 KiB chunks): below that the kernel's launch +
+    # combine overhead loses to the split path at the OSD's operating
+    # batch (measured: 8 KiB chunks @ batch 128 = 32.8 fused vs 40.5
+    # split GiB/s; the split path serves small chunks)
     if not (_on_tpu() and 1 <= m <= 11 and W % SEG_W == 0
-            and W >= SEG_W):
+            and W >= 4096):
         return False
     if k is not None:
         L = 128 * _lane_groups(m)

@@ -209,6 +209,26 @@ class OSDDaemon(Dispatcher):
             await self.monc.send_beacon(self.whoami)
             await asyncio.sleep(interval)
 
+    def _profile_ctl(self, start: bool, trace_dir: str) -> dict:
+        """Device-kernel tracing (the §5 tracing gap: jax.profiler is
+        the TPU analog of the reference's LTTng tracepoints — the
+        resulting trace shows the fused encode/crc kernels on the
+        device timeline; view with tensorboard or xprof)."""
+        import jax
+        if start:
+            if getattr(self, "_profiling_dir", None):
+                return {"error": "already profiling",
+                        "dir": self._profiling_dir}
+            trace_dir = trace_dir or f"/tmp/ceph_tpu_trace_osd{self.whoami}"
+            jax.profiler.start_trace(trace_dir)
+            self._profiling_dir = trace_dir
+            return {"profiling": True, "dir": trace_dir}
+        if not getattr(self, "_profiling_dir", None):
+            return {"error": "not profiling"}
+        jax.profiler.stop_trace()
+        out, self._profiling_dir = self._profiling_dir, None
+        return {"profiling": False, "dir": out}
+
     async def _cluster_read_full(self, pool_id: int, oid: str) -> bytes:
         """Primary-side whole-object read of ANY object in the cluster
         (reference PrimaryLogPG::do_copy_from drives an Objecter read
@@ -222,7 +242,8 @@ class OSDDaemon(Dispatcher):
             await be.ensure_active()
             await be.wait_readable(oid)
             if not be.object_exists(oid):
-                raise ECError(f"copy_from: no such object {oid!r}")
+                from ..objectstore.store import NotFound
+                raise NotFound(f"copy_from: no such object {oid!r}")
             res = await be.objects_read_and_reconstruct(
                 {oid: [(0, 0)]})
             return b"".join(data for _off, data in res[oid])
@@ -232,7 +253,7 @@ class OSDDaemon(Dispatcher):
         self._copy_inflight[tid] = fut
         fields = {
             "tid": -tid,  # negative: never collides with client tids
-            "pool": pool_id, "pg": pg, "oid": oid,
+            "pool": pool_id, "pg": pg, "oid": oid, "internal": True,
             "ops": [{"op": "stat"},
                     {"op": "read", "off": 0, "len": 0}],
             "map_epoch": self.osdmap.epoch}
@@ -266,7 +287,10 @@ class OSDDaemon(Dispatcher):
         st = next((o for o in reply.get("outs", [])
                    if o.get("op") == "stat"), {})
         if not st.get("exists", True):
-            raise ECError(f"copy_from: no such object {oid!r}")
+            # ENOENT, not EIO: clients must distinguish "src absent"
+            # from a real I/O failure (same mapping as plain ops)
+            from ..objectstore.store import NotFound
+            raise NotFound(f"copy_from: no such object {oid!r}")
         return bytes(reply.data)
 
     def perf_dump(self) -> dict:
@@ -308,6 +332,17 @@ class OSDDaemon(Dispatcher):
                    lambda c: (self.config.set(c["key"], c["value"]),
                               {"success": True})[1],
                    "set a config value at runtime")
+        a.register("hit_set ls",
+                   lambda c: {"hit_sets": self._get_backend(
+                       (int(c["pool"]), int(c["pg"]))).hit_set_ls()},
+                   "archived + open object-access hit sets for a pg")
+        a.register("profile start",
+                   lambda c: self._profile_ctl(True, c.get("dir", "")),
+                   "start a jax.profiler device trace (kernel timeline "
+                   "for the encode/crc/decode steps)")
+        a.register("profile stop",
+                   lambda c: self._profile_ctl(False, ""),
+                   "stop the jax.profiler trace and flush it to disk")
         a.register("status",
                    lambda _c: {"whoami": self.whoami, "up": self.up,
                                "epoch": self.osdmap.epoch,
@@ -584,6 +619,16 @@ class OSDDaemon(Dispatcher):
             f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
             trace_id=str(msg.get("trace_id", "")))
         with top:
+            if bool(msg.get("internal")):
+                # cluster-internal op (a copy_from read another primary
+                # issued): must NOT queue behind the CLIENT class — the
+                # issuer holds a client slot while awaiting us, so two
+                # OSDs cross-copying at full slot occupancy would
+                # deadlock until the op timeout.  (The flag only skips
+                # QoS queueing; cap checks still apply.)
+                top.mark("reached_pg")
+                await self._do_client_op(conn, msg, top)
+                return
             top.mark("queued_for_pg")
             async with self.op_scheduler.queued(CLIENT):
                 top.mark("reached_pg")
@@ -596,7 +641,7 @@ class OSDDaemon(Dispatcher):
                         "copy_from"))
     _X_OPS = frozenset(("call",))
 
-    def _check_osd_caps(self, msg: MOSDOp, conn=None) \
+    def _check_osd_caps(self, msg: MOSDOp) \
             -> "Optional[Tuple[str, bool]]":
         """cephx enforcement at dispatch: every op must carry a valid
         mon-issued ticket whose caps cover the op class on this pool.
@@ -648,13 +693,13 @@ class OSDDaemon(Dispatcher):
         self.perf.inc("op")
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
-        deny = self._check_osd_caps(msg, conn)
+        deny = self._check_osd_caps(msg)
         if deny is not None and "generation" in deny[0] \
                 and self.monc is not None:
             # ticket sealed under a newer rotation than we hold:
             # refresh the rotating secrets once and re-check
             await self._refresh_service_keys()
-            deny = self._check_osd_caps(msg, conn)
+            deny = self._check_osd_caps(msg)
         if deny is not None:
             await conn.send_message(MOSDOpReply({
                 "tid": msg["tid"], "result": -EACCES,

@@ -248,6 +248,10 @@ class ECBackend:
         # newest pool snapid (daemon refreshes per op): a mutation of an
         # object whose oi.snap_seq is older clones it first (COW)
         self.pool_snap_seq = 0
+        # current period's access bloom (reference HitSet); None until
+        # the first tracked access with osd_hit_set_period > 0
+        self.hit_set = None
+        self._hit_set_cache = None   # decoded archive (rotation clears)
         # serializes object-class read-modify-write executions against
         # each other AND against plain write admissions (reference: cls
         # methods run under the PG lock in do_op)
@@ -396,6 +400,88 @@ class ECBackend:
         self._pg_meta_txn(t, cid)
         self.store.apply_transaction(t)
 
+    # ------------------------------------------------------------- hit sets
+
+    def _hit_set_track(self, oid: str) -> None:
+        """Record an object access in the current period's bloom
+        (reference PrimaryLogPG::hit_set_create + maybe_persist;
+        tracking only — no cache-tier consumer yet).  Disabled unless
+        osd_hit_set_period > 0."""
+        period = self.opt("osd_hit_set_period", 0.0)
+        if period <= 0:
+            return
+        from .hitset import BloomHitSet
+        now = time.time()
+        if self.hit_set is not None \
+                and now - self.hit_set.start >= period:
+            self._hit_set_rotate()
+        if self.hit_set is None:
+            self.hit_set = BloomHitSet(
+                target_size=self.opt("osd_hit_set_target_size", 1024),
+                fpp=self.opt("osd_hit_set_fpp", 0.05), start=now)
+        self.hit_set.insert(oid)
+
+    def _hit_set_rotate(self) -> None:
+        """Seal + persist the period's set to the PG meta omap, bounded
+        by osd_hit_set_count (reference hit_set_persist/trim)."""
+        hs, self.hit_set = self.hit_set, None
+        if hs is None or self.my_shard < 0:
+            return
+        hs.seal()
+        cid = self.coll(self.my_shard)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        t.omap_setkeys(cid, ObjectId(PGMETA_OID),
+                       {f"hitset.{int(hs.start * 1000):015d}":
+                        hs.encode()})
+        keep = self.opt("osd_hit_set_count", 4)
+        existing = sorted(k for k in self._hit_set_keys())
+        for k in existing[: max(0, len(existing) + 1 - keep)]:
+            t.omap_rmkeys(cid, ObjectId(PGMETA_OID), [k])
+        self.store.apply_transaction(t)
+        self._hit_set_cache = None
+
+    def _hit_set_keys(self) -> "List[str]":
+        cid = self.coll(self.my_shard)
+        try:
+            kv = self.store.omap_get(cid, ObjectId(PGMETA_OID))
+        except NotFound:
+            return []
+        return [k for k in kv if k.startswith("hitset.")]
+
+    def _hit_set_archive(self) -> "List":
+        """Decoded archived sets, cached: they are immutable once
+        sealed; the cache invalidates on rotation.  Per-probe omap +
+        JSON decode would make the per-promotion temperature query
+        O(archive) deserializations."""
+        if self._hit_set_cache is None:
+            from .hitset import BloomHitSet
+            cid = self.coll(self.my_shard)
+            try:
+                kv = self.store.omap_get(cid, ObjectId(PGMETA_OID))
+            except NotFound:
+                kv = {}
+            self._hit_set_cache = [
+                BloomHitSet.decode(kv[k]) for k in sorted(kv)
+                if k.startswith("hitset.")]
+        return self._hit_set_cache
+
+    def hit_set_ls(self) -> "List[dict]":
+        """Archived hit-set summaries plus the open period (admin
+        surface; reference 'hit set' queries)."""
+        out = [hs.summary() for hs in self._hit_set_archive()]
+        if self.hit_set is not None:
+            out.append({**self.hit_set.summary(), "open": True})
+        return out
+
+    def hit_set_contains(self, oid: str) -> bool:
+        """Temperature probe: was oid accessed in any tracked period?
+        (What the reference's tiering agent asks per promotion.)"""
+        if self.hit_set is not None and self.hit_set.contains(oid):
+            return True
+        return any(hs.contains(oid) for hs in self._hit_set_archive())
+
     def _complete_to(self) -> Version:
         """Newest version our log is known contiguous through — the head,
         unless we detected a gap (missed sub-writes)."""
@@ -525,6 +611,7 @@ class ECBackend:
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
                 trace_id=trace_id)
         op.on_commit = asyncio.get_event_loop().create_future()
+        self._hit_set_track(oid)
         # peering drains + blocks the pipeline (reference: client ops are
         # requeued until the PG is Active again).  The peering check must
         # be re-taken UNDER the lock: a peer() starting between the event
@@ -1716,6 +1803,7 @@ class ECBackend:
             if trace_id and oid in self.local_missing:
                 self._recovery_trace[oid] = trace_id
             await self.wait_readable(oid)
+            self._hit_set_track(oid)
         sizes = {oid: self.object_size(oid) for oid in reads}
         clipped: "Dict[str, List[Extent]]" = {}
         for oid, extents in reads.items():

@@ -1,0 +1,88 @@
+"""HitSet — per-PG object-access tracking (reference src/osd/HitSet.h
++ PrimaryLogPG::hit_set_create/persist/trim, PrimaryLogPG.cc).
+
+The reference records which objects a PG touched during each time
+period as a bloom filter, persisted as hidden hit-set objects; cache
+tiering's promotion logic reads them for temperature.  This rebuild
+keeps the same shape — a bloom per period, rotated on a timer, a
+bounded archive persisted with the PG metadata — minus the tiering
+consumer (no cache pools yet): the data is served to operators via the
+admin socket and to object classes for temperature queries.
+
+Bloom math: k = ln(2) * bits/n hashes; bits sized for the target false
+positive rate at ``target_size`` insertions (HitSet.h's
+BloomHitSet::Params seed/fpp semantics, rebuilt on numpy bit arrays).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class BloomHitSet:
+    def __init__(self, target_size: int = 1024, fpp: float = 0.01,
+                 start: "Optional[float]" = None) -> None:
+        bits = max(64, int(-target_size * math.log(fpp)
+                           / (math.log(2) ** 2)))
+        self.n_bits = (bits + 63) // 64 * 64
+        self.n_hash = max(1, round(math.log(2) * self.n_bits
+                                   / max(1, target_size)))
+        self.n_hash = min(self.n_hash, 8)
+        self.bits = np.zeros(self.n_bits // 64, dtype=np.uint64)
+        self.inserts = 0
+        self.start = start if start is not None else time.time()
+        self.end: "Optional[float]" = None
+
+    def _idx(self, oid: str) -> "List[int]":
+        # 8 x 4-byte words from one sha256: supports all n_hash <= 8
+        # (8-byte slices would run off the 32-byte digest after the 4th
+        # hash, silently degenerating them all to bit 0)
+        h = hashlib.sha256(oid.encode()).digest()
+        return [int.from_bytes(h[4 * i: 4 * i + 4], "little")
+                % self.n_bits for i in range(self.n_hash)]
+
+    def insert(self, oid: str) -> None:
+        for i in self._idx(oid):
+            self.bits[i // 64] |= np.uint64(1 << (i % 64))
+        self.inserts += 1
+
+    def contains(self, oid: str) -> bool:
+        return all(bool(self.bits[i // 64]
+                        & np.uint64(1 << (i % 64)))
+                   for i in self._idx(oid))
+
+    def seal(self) -> None:
+        self.end = time.time()
+
+    # --- persistence (rides the PG meta omap) -----------------------------
+
+    def encode(self) -> bytes:
+        return json.dumps({
+            "n_bits": self.n_bits, "n_hash": self.n_hash,
+            "inserts": self.inserts, "start": self.start,
+            "end": self.end,
+            "bits": self.bits.tobytes().hex()}).encode()
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "BloomHitSet":
+        d = json.loads(blob.decode())
+        hs = cls.__new__(cls)
+        hs.n_bits = int(d["n_bits"])
+        hs.n_hash = int(d["n_hash"])
+        hs.inserts = int(d["inserts"])
+        hs.start = float(d["start"])
+        hs.end = d["end"]
+        hs.bits = np.frombuffer(bytes.fromhex(d["bits"]),
+                                dtype=np.uint64).copy()
+        return hs
+
+    def summary(self) -> dict:
+        return {"start": self.start, "end": self.end,
+                "inserts": self.inserts, "bits": self.n_bits,
+                "hashes": self.n_hash}

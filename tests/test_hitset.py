@@ -1,0 +1,89 @@
+"""HitSet object-access tracking (reference src/osd/HitSet.h +
+PrimaryLogPG::hit_set_create/persist/trim): per-PG bloom per period,
+rotated and persisted with the PG metadata, bounded archive.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.osd.hitset import BloomHitSet
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+class TestBloom:
+    def test_insert_contains_no_false_negatives(self):
+        hs = BloomHitSet(target_size=500, fpp=0.01)
+        names = [f"obj-{i}" for i in range(500)]
+        for n in names:
+            hs.insert(n)
+        assert all(hs.contains(n) for n in names)
+
+    def test_false_positive_rate_reasonable(self):
+        hs = BloomHitSet(target_size=1000, fpp=0.01)
+        for i in range(1000):
+            hs.insert(f"in-{i}")
+        fp = sum(hs.contains(f"out-{i}") for i in range(5000)) / 5000
+        assert fp < 0.05, fp
+
+    def test_encode_decode_round_trip(self):
+        hs = BloomHitSet(target_size=100, fpp=0.02)
+        for i in range(50):
+            hs.insert(f"x{i}")
+        hs.seal()
+        back = BloomHitSet.decode(hs.encode())
+        assert back.inserts == 50 and back.end == hs.end
+        assert all(back.contains(f"x{i}") for i in range(50))
+
+
+class TestPgHitSets:
+    def test_tracking_rotation_and_persistence(self, loop):
+        async def go():
+            cfg = Config()
+            cfg.set("osd_hit_set_period", 0.2)
+            cfg.set("osd_hit_set_count", 3)
+            async with MiniCluster(n_osds=4, config=cfg) as c:
+                c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("p")
+                pool = c.osdmap.pool_by_name("p")
+                _u, acting = c.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, 0)
+                primary = c.osdmap.primary_of(acting)
+                be = c.osds[primary]._get_backend((pool.pool_id, 0))
+                await io.write_full("hot", b"h" * 500)
+                assert be.hit_set_contains("hot")
+                assert not be.hit_set_contains("never-touched")
+                # force several period rotations
+                for r in range(4):
+                    await asyncio.sleep(0.25)
+                    await io.write_full(f"era-{r}", bytes([r]) * 100)
+                sets = be.hit_set_ls()
+                archived = [s for s in sets if not s.get("open")]
+                assert archived, sets
+                assert len(archived) <= 3          # trim bound
+                # 'hot' was written in the FIRST era; if its set was
+                # trimmed that's fine — era-3 must be tracked
+                assert be.hit_set_contains("era-3")
+                # persistence: a fresh backend instance reloads the
+                # ARCHIVED sets (the open period dies with the daemon,
+                # as in the reference — persist happens on rotation)
+                del c.osds[primary].backends[(pool.pool_id, 0)]
+                be2 = c.osds[primary]._get_backend((pool.pool_id, 0))
+                assert [s for s in be2.hit_set_ls()
+                        if not s.get("open")]
+                assert be2.hit_set_contains("era-2")
+        loop.run_until_complete(go())
