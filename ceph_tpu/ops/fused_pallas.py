@@ -93,12 +93,18 @@ def seg_w_for(n_words: int, k: int = 8, m: int = 3) -> int:
     return SEG_W
 
 
-def _blk_segs(n_words: int, seg_w: int) -> int:
+def _blk_segs(n_words: int, seg_w: int) -> "int | None":
+    """Largest Mosaic-VALID block depth: the kernel's second-to-last
+    block dim must be divisible by 8 or equal the whole array dim
+    (found live: an 82-segment journal append compiled a block depth
+    of 2 and Mosaic rejected it).  None = no valid blocking — the
+    caller must take the split path."""
     segs = n_words // seg_w
-    b = min(BLK_WORDS // seg_w, segs)
-    while segs % b:
-        b -= 1
-    return b
+    cap = BLK_WORDS // seg_w
+    for b in range(min(cap, segs), 0, -1):
+        if segs % b == 0 and (b % 8 == 0 or b == segs):
+            return b
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +216,10 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int):
     C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
     seg_w = seg_w_for(n_words, k, m)
     blk_segs = _blk_segs(n_words, seg_w)
+    if blk_segs is None:
+        raise ValueError(
+            f"no Mosaic-valid blocking for W={n_words} seg_w={seg_w}; "
+            f"callers must gate on supported_matrix")
     blk_w = seg_w * blk_segs
     n_wb = n_words // blk_w
     chunk_bytes = 4 * n_words
@@ -352,6 +362,18 @@ def supported_matrix(m: int, W: int, k: "int | None" = None) -> bool:
     if not (_on_tpu() and 1 <= m <= 11 and W % SEG_W == 0
             and W >= 4096):
         return False
+    if k is not None:
+        if _blk_segs(W, seg_w_for(W, k, m)) is None:
+            return False   # no Mosaic-valid blocking for this shape
+    else:
+        # without k the seg choice is unknown (it depends on the M1
+        # VMEM budget): require a valid blocking for EVERY candidate
+        # so the gate can never pass a shape _build_fused rejects
+        cands = {SEG_W}
+        if W % MAX_SEG_W == 0 and W >= MAX_SEG_W:
+            cands.add(MAX_SEG_W)
+        if any(_blk_segs(W, s) is None for s in cands):
+            return False
     if k is not None:
         L = 128 * _lane_groups(m)
         if _m1_bytes(k, SEG_W, L) > _M1_VMEM_LIMIT:

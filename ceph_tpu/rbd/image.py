@@ -143,6 +143,7 @@ class Image:
         self.hdr: dict = {}
         self._present: "set[int]" = set()   # known-existing data objects
         self._parent_img: "Optional[Image]" = None  # cached parent handle
+        self._journal = None                # lazy Journal when enabled
 
     async def _load(self) -> None:
         try:
@@ -246,9 +247,40 @@ class Image:
             await self.io.write_full(self._data(idx), base)
         self._present.add(idx)
 
+    async def _jr(self, force_open: bool = False):
+        """The image's Journal handle (lazily opened); None when
+        journaling is off and ``force_open`` is False."""
+        if not force_open and not self.hdr.get("journaling"):
+            return None
+        if self._journal is None:
+            from .journal import Journal
+            self._journal = await Journal(self.io, self.name).open()
+        return self._journal
+
+    async def enable_journaling(self) -> None:
+        """Turn on write-ahead journaling (reference 'rbd feature
+        enable <img> journaling'): every mutation commits a journal
+        entry BEFORE it applies, feeding rbd-mirror replay
+        (rbd/journal.py).  NOTE: pre-existing data is handled by the
+        mirror's bootstrap full-image sync, not the journal."""
+        self.hdr["journaling"] = True
+        await self._save()
+        await self._jr()
+
+    async def disable_journaling(self, purge: bool = True) -> None:
+        jr = await self._jr(force_open=True)
+        self.hdr["journaling"] = False
+        await self._save()
+        if purge:
+            await jr.destroy()
+        self._journal = None
+
     async def write(self, off: int, data: bytes) -> None:
         if off + len(data) > self.size:
             raise RBDError("write beyond image size")
+        jr = await self._jr()
+        if jr is not None:
+            await jr.append("write", {"off": off}, bytes(data))
 
         async def one(idx, ooff, n, lpos):
             if self.parent is not None and not await self._exists(idx):
@@ -290,6 +322,9 @@ class Image:
         """Zero a range (punch holes at object granularity).  A cloned
         child must WRITE zeros — removing its object would re-expose the
         parent's bytes through the fall-through read."""
+        jr = await self._jr()
+        if jr is not None:
+            await jr.append("discard", {"off": off, "len": length})
         for idx, ooff, n, _ in self._extents(off, length):
             if (ooff == 0 and n == self.obj_bytes
                     and self.parent is None):
@@ -304,6 +339,9 @@ class Image:
                 await self.io.write(self._data(idx), b"\0" * n, ooff)
 
     async def resize(self, new_size: int) -> None:
+        jr = await self._jr()
+        if jr is not None:
+            await jr.append("resize", {"size": new_size})
         old_size = self.size
         old_objects = self._objects()
         self.hdr["size"] = int(new_size)
@@ -345,6 +383,9 @@ class Image:
         """O(metadata): take a pool snapshot; NO data is copied — the
         first write after the snap COWs only the touched object (the
         OSD-side generation clone, osd/ecbackend.py snap_clone path)."""
+        jr = await self._jr()
+        if jr is not None:
+            await jr.append("snap_create", {"snap": snap})
         if snap in self.hdr["snaps"]:
             raise RBDError(f"snap {snap!r} exists")
         snapid = await self.io.pool_mksnap(self._pool_snap(snap))
