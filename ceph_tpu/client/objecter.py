@@ -63,12 +63,20 @@ class Objecter(Dispatcher):
 
     # --- placement (reference _calc_target Objecter.cc:882) ------------------
 
-    def calc_target(self, pool_id: int, oid: str) -> "Tuple[int, int]":
-        """(pg, primary osd) for an object."""
+    def calc_target(self, pool_id: int, oid: str) -> "Tuple[int, int, int]":
+        """(target pool, pg, primary osd) for an object.  A base pool
+        with a cache tier redirects ALL client I/O to the overlay pool
+        (reference pg_pool_t read_tier/write_tier + Objecter
+        _calc_target's tier hop); the cache OSD promotes misses from
+        the base itself."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is not None and getattr(pool, "cache_tier", None) \
+                is not None:
+            pool_id = int(pool.cache_tier)
         pg = self.osdmap.object_to_pg(pool_id, oid)
         _up, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
         primary = next((o for o in acting if o != NONE_OSD), NONE_OSD)
-        return pg, primary
+        return pool_id, pg, primary
 
     # --- submit (reference op_submit Objecter.cc:2256) -----------------------
 
@@ -84,14 +92,14 @@ class Objecter(Dispatcher):
         reqid = f"{self.ms.name}:{tid}"
         renewed = False
         for attempt in range(self.max_retries):
-            pg, primary = self.calc_target(pool_id, oid)
+            tgt_pool, pg, primary = self.calc_target(pool_id, oid)
             if primary == NONE_OSD:
-                last_err = ObjecterError(f"pg {pool_id}.{pg} has no primary")
+                last_err = ObjecterError(f"pg {tgt_pool}.{pg} has no primary")
                 await asyncio.sleep(self.backoff * (attempt + 1))
                 continue
             fut = asyncio.get_event_loop().create_future()
             self._inflight[tid] = fut
-            fields = {"tid": tid, "pool": pool_id, "pg": pg,
+            fields = {"tid": tid, "pool": tgt_pool, "pg": pg,
                       "oid": oid, "ops": ops, "reqid": reqid,
                       # root span: born at the client op and threaded
                       # through every sub-op it causes (reference

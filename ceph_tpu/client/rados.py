@@ -106,6 +106,18 @@ class IoCtx:
     async def remove(self, oid: str) -> None:
         await self._submit(oid, [{"op": "delete"}])
 
+    async def cache_flush(self, oid: str) -> int:
+        """CEPH_OSD_OP_CACHE_FLUSH: push a dirty cached object to the
+        base pool (no-op when clean).  Returns 1 if a flush happened."""
+        outs, _ = await self._submit(oid, [{"op": "cache_flush"}])
+        return next((int(o.get("flushed", 0)) for o in outs
+                     if o.get("op") == "cache_flush"), 0)
+
+    async def cache_evict(self, oid: str) -> None:
+        """CEPH_OSD_OP_CACHE_EVICT: drop a CLEAN object from the cache
+        tier (errors if dirty — flush first)."""
+        await self._submit(oid, [{"op": "cache_evict"}])
+
     async def copy_from(self, dst_oid: str, src_oid: str) -> int:
         """Server-side object copy (reference rados copy /
         CEPH_OSD_OP_COPY_FROM): the DST primary reads src wherever it

@@ -108,6 +108,20 @@ async def cas_swap(ctx, data: bytes) -> bytes:
     return b""
 
 
+# --- cache (tiering flush CAS; reference cls_rgw-style helper) --------------
+
+async def cache_clear_dirty_if(ctx, data: bytes) -> bytes:
+    """Atomically clear cache.dirty IFF it still equals the given
+    token: a client write that raced the flush replaced the token, and
+    its dirtiness must survive (clearing unconditionally would let a
+    later evict drop the only copy of the new data)."""
+    cur = ctx.getxattr("cache.dirty")
+    if cur == bytes(data):
+        ctx.setxattr("cache.dirty", b"0")
+        return b"1"
+    return b"0"
+
+
 def register_all(reg) -> None:
     reg.register("hello", "say_hello", RD, hello_say)
     reg.register("hello", "record_hello", WR, hello_record)
@@ -118,3 +132,5 @@ def register_all(reg) -> None:
     reg.register("lock", "unlock", RD | WR, lock_unlock)
     reg.register("lock", "get_info", RD, lock_info)
     reg.register("cas", "swap", RD | WR, cas_swap)
+    reg.register("cache", "clear_dirty_if", RD | WR,
+                 cache_clear_dirty_if)

@@ -205,6 +205,10 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_hit_set_fpp", float, 0.05, LEVEL_ADVANCED, min=0.0001,
            max=0.5, desc="hit-set bloom false positive rate",
            services=("osd",)),
+    Option("osd_agent_interval", float, 5.0, LEVEL_ADVANCED, min=0,
+           desc="seconds between cache-tier agent flush passes "
+                "(0 = agent off; per-object cache_flush ops still "
+                "work)", services=("osd",)),
     Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="extra directory for mgr modules", services=("mgr",)),
     # --- tracing / op tracking ---------------------------------------------

@@ -222,6 +222,20 @@ class MonDaemon(Dispatcher):
             pool.snaps[str(op["snap"])] = pool.snap_seq
         elif kind == "pool_rmsnap":
             m.get_pool(int(op["pool"])).snaps.pop(str(op["snap"]), None)
+        elif kind == "tier_add":
+            base = m.get_pool(int(op["base"]))
+            cache = m.get_pool(int(op["cache"]))
+            base.cache_tier = cache.pool_id
+            cache.tier_of = base.pool_id
+            cache.cache_mode = str(op.get("mode", "writeback"))
+        elif kind == "tier_remove":
+            base = m.get_pool(int(op["base"]))
+            if base.cache_tier is not None:
+                cache = m.pools.get(base.cache_tier)
+                if cache is not None:
+                    cache.tier_of = None
+                    cache.cache_mode = ""
+                base.cache_tier = None
         elif kind == "pg_upmap":
             # balancer override: pin a PG's acting set (reference
             # pg-upmap-items / pg_temp)
@@ -445,8 +459,8 @@ class MonDaemon(Dispatcher):
     _MON_WRITE_PREFIXES = (
         "osd pool", "osd erasure-code-profile", "osd pg-upmap",
         "osd set", "osd unset", "osd out", "osd in", "osd down",
-        "config set", "config rm", "auth get-or-create", "auth caps",
-        "auth rm", "auth rotate")
+        "osd tier", "config set", "config rm", "auth get-or-create",
+        "auth caps", "auth rm", "auth rotate")
 
     def _check_mon_caps(self, conn, cmd: dict):
         """Per-entity mon caps at command dispatch (reference MonCap
@@ -663,6 +677,37 @@ class MonDaemon(Dispatcher):
             v = await self._propose_osd_ops([{
                 "op": "pool_set", "pool": pool.pool_id,
                 "key": key, "value": value}])
+            return 0, {"epoch": v}
+        if prefix in ("osd tier add", "osd tier remove"):
+            # reference OSDMonitor 'osd tier add <base> <cache>':
+            # writeback overlay; the cache must be replicated (dirty
+            # tracking + flush read the authoritative primary copy)
+            base = self.osdmap.pool_by_name(cmd["base"])
+            if base is None:
+                return -2, {"error": f"no pool {cmd['base']!r}"}
+            if prefix == "osd tier remove":
+                v = await self._propose_osd_ops([{
+                    "op": "tier_remove", "base": base.pool_id}])
+                return 0, {"epoch": v}
+            cache = self.osdmap.pool_by_name(cmd["cache"])
+            if cache is None:
+                return -2, {"error": f"no pool {cmd['cache']!r}"}
+            if cache.is_erasure():
+                return -22, {"error": "cache tier must be a "
+                                      "replicated pool"}
+            if base.pool_id == cache.pool_id:
+                return -22, {"error": "a pool cannot cache itself"}
+            if base.cache_tier is not None or cache.tier_of is not None \
+                    or base.tier_of is not None \
+                    or cache.cache_tier is not None:
+                # no chains: a pool that is already someone's cache or
+                # base cannot join another overlay (clients of the
+                # middle pool would see diverging views)
+                return -22, {"error": "pool already tiered"}
+            v = await self._propose_osd_ops([{
+                "op": "tier_add", "base": base.pool_id,
+                "cache": cache.pool_id,
+                "mode": str(cmd.get("mode", "writeback"))}])
             return 0, {"epoch": v}
         if prefix == "osd pool ls":
             return 0, {"pools": [p.name for p in

@@ -28,7 +28,7 @@ from ..ec.registry import factory_from_profile
 from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..objectstore.memstore import MemStore
-from ..objectstore.store import ObjectStore
+from ..objectstore.store import NotFound, ObjectStore
 from .messages import EACCES
 from .ecbackend import (EIO, ENOENT, ESTALE, ClientOp, ECBackend, ECError,
                         NONE_OSD, NotActive)
@@ -52,6 +52,9 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("op_r", "client reads")
           .add_u64_counter("subop_w", "ec sub writes served")
           .add_u64_counter("subop_r", "ec sub reads served")
+          .add_u64_counter("tier_promote", "cache-tier promotions")
+          .add_u64_counter("tier_flush", "cache-tier flushes to base")
+          .add_u64_counter("tier_evict", "cache-tier evictions")
           .add_time_avg("op_latency", "client op latency")
           .create_perf_counters())
     coll.add(pc)
@@ -119,6 +122,7 @@ class OSDDaemon(Dispatcher):
         # notify_id -> (pending watch_ids, done future)
         self._notifies: "Dict[int, Tuple[set, asyncio.Future]]" = {}
         self._mgr_task = None
+        self._agent_task = None
         self._beacon_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
         if self.monc is not None:
@@ -160,6 +164,8 @@ class OSDDaemon(Dispatcher):
             self._mgr_task = asyncio.ensure_future(
                 report_loop(self, self.mgr_addr))
         self.up = True
+        # writeback tiering agent (no-ops unless cache pools exist)
+        self._agent_task = asyncio.ensure_future(self._cache_agent_loop())
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
 
     # --- peering on map change (reference: new interval -> PG peers) ---------
@@ -209,6 +215,220 @@ class OSDDaemon(Dispatcher):
             await self.monc.send_beacon(self.whoami)
             await asyncio.sleep(interval)
 
+    # --- cache tiering (reference PrimaryLogPG promote/flush/evict +
+    # --- the tiering agent; lean writeback mode) ------------------------------
+
+    # ops that never justify pulling the object up from base first
+    _NO_PROMOTE_OPS = frozenset(("write_full", "delete", "cache_flush",
+                                 "cache_evict", "watch", "unwatch",
+                                 "notify"))
+
+    async def _cache_maybe_promote(self, be, pool, oid: str,
+                                   ops: "List[dict]") -> None:
+        """Writeback overlay: a cache miss pulls the object up from the
+        base pool before the op runs (reference promote_object).  Full
+        rewrites/deletes/flush/evict skip the pointless promotion."""
+        if be.object_exists(oid):
+            return
+        names = {o.get("op", "") for o in ops}
+        if names <= self._NO_PROMOTE_OPS:
+            return
+        try:
+            data, attrs = await self._cluster_read_with_attrs(
+                int(pool.tier_of), oid)
+        except NotFound:
+            return                      # absent in base too
+        muts = [ClientOp("write_full", off=0, data=data)]
+        for name, val in attrs.items():
+            muts.append(ClientOp("setxattr", name=name, value=val))
+        await be.submit_transaction(oid, muts)
+        self.perf.inc("tier_promote")
+
+    async def _cache_flush_object(self, be, pool, oid: str) -> int:
+        """Push a dirty object (data + user xattrs + omap when the base
+        supports it) down to the base pool, then clear the dirty mark
+        ONLY if no write raced the flush (CAS via the cache object
+        class).  Returns 1 when a flush happened."""
+        try:
+            token = bytes(be.get_attr(oid, "cache.dirty"))
+        except (NotFound, KeyError):
+            return 0
+        if not token.startswith(b"1"):
+            return 0
+        res = await be.objects_read_and_reconstruct({oid: [(0, 0)]})
+        data = b"".join(d for _o, d in res[oid])
+        attrs = {n: v for n, v in be.get_attrs(oid).items()
+                 if not n.startswith("cache.") and not n.startswith("_")}
+        base = self.osdmap.get_pool(int(pool.tier_of))
+        omap = be.omap_get(oid) if not base.is_erasure() else {}
+        await self._cluster_write_full(int(pool.tier_of), oid, data,
+                                       attrs=attrs, omap=omap)
+        cleared = await self._exec_cls(be, oid, "cache",
+                                       "clear_dirty_if", token)
+        if cleared != b"1":
+            dout("osd", 5, f"flush of {oid}: write raced, staying dirty")
+        self.perf.inc("tier_flush")
+        return 1
+
+    async def _cache_evict_object(self, be, pool, oid: str) -> None:
+        if not be.object_exists(oid):
+            return
+        try:
+            dirty = bytes(be.get_attr(oid, "cache.dirty")).startswith(
+                b"1")
+        except (NotFound, KeyError):
+            dirty = False
+        if dirty:
+            raise ECError(f"cannot evict dirty object {oid!r}: "
+                          f"flush first")
+        await be.submit_transaction(oid, [ClientOp("delete")])
+        self.perf.inc("tier_evict")
+
+    async def _cluster_read_with_attrs(self, pool_id: int, oid: str
+                                       ) -> "Tuple[bytes, dict]":
+        """_cluster_read_full + the object's user xattrs (promotion
+        must carry metadata, not just bytes)."""
+        data = await self._cluster_read_full(pool_id, oid)
+        pg = self.osdmap.object_to_pg(pool_id, oid)
+        _up, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        primary = self.osdmap.primary_of(acting)
+        attrs: dict = {}
+        if primary == self.whoami:
+            be = self._get_backend((pool_id, pg))
+            attrs = {n: v for n, v in be.get_attrs(oid).items()
+                     if not n.startswith("_")
+                     and not n.startswith("cache.")}
+        # remote: xattrs ride promotion only for locally-primaried
+        # bases for now (the read op surface has no attr listing);
+        # flush still carries them downstream
+        return data, attrs
+
+    async def _cluster_write_full(self, pool_id: int, oid: str,
+                                  data: bytes, attrs: "dict" = None,
+                                  omap: "dict" = None) -> None:
+        """Primary-side write to ANY pool (the flush path's downstream
+        write; same mini-objecter as _cluster_read_full).  ``attrs`` /
+        ``omap`` ride the same mutation batch atomically."""
+        import json as _json
+        attrs = attrs or {}
+        omap = omap or {}
+        pg = self.osdmap.object_to_pg(pool_id, oid)
+        _up, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        primary = self.osdmap.primary_of(acting)
+        if primary == self.whoami:
+            be = self._get_backend((pool_id, pg))
+            await be.ensure_active()
+            muts = [ClientOp("write_full", off=0, data=data)]
+            for n, v in attrs.items():
+                muts.append(ClientOp("setxattr", name=n, value=v))
+            if omap:
+                muts.append(ClientOp("omap_set", kv=dict(omap)))
+            await be.submit_transaction(oid, muts)
+            return
+        self._copy_tid += 1
+        tid = self._copy_tid
+        fut = asyncio.get_event_loop().create_future()
+        self._copy_inflight[tid] = fut
+        ops = [{"op": "write_full", "dlen": len(data)}]
+        blob = bytes(data)
+        for n, v in attrs.items():
+            ops.append({"op": "setxattr", "name": n, "dlen": len(v)})
+            blob += bytes(v)
+        if omap:
+            kv = _json.dumps({k: v.hex()
+                              for k, v in omap.items()}).encode()
+            ops.append({"op": "omap_set", "dlen": len(kv)})
+            blob += kv
+        fields = {"tid": -tid, "pool": pool_id, "pg": pg, "oid": oid,
+                  "internal": True, "ops": ops,
+                  "map_epoch": self.osdmap.epoch}
+        if str(self.config.get("auth_client_required")) == "cephx" \
+                and self.ticket_verifier.secrets:
+            from ..auth.cephx import TicketAuthority
+            fields["ticket"] = TicketAuthority(
+                "osd", secrets=dict(self.ticket_verifier.secrets)).issue(
+                f"osd.{self.whoami}", "osd allow *")
+        try:
+            conn = self.ms.get_connection(self.osdmap.get_addr(primary))
+            await conn.send_message(MOSDOp(fields, blob))
+            reply = await asyncio.wait_for(fut, float(
+                self.config.get("rados_osd_op_timeout")))
+        finally:
+            self._copy_inflight.pop(tid, None)
+        res = int(reply.get("result", 0))
+        if res == -ESTALE:
+            raise NotActive(f"flush target for {oid!r} stale")
+        if res != 0:
+            raise ECError(f"flush write of {oid} failed: "
+                          f"{reply.get('outs')}")
+
+    async def _cluster_delete(self, pool_id: int, oid: str) -> None:
+        """Propagate a cache-pool delete to the base (write-through
+        deletes: a writeback whiteout would be complex and a stale base
+        copy RESURRECTS on the next promotion)."""
+        pg = self.osdmap.object_to_pg(pool_id, oid)
+        _up, acting = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        primary = self.osdmap.primary_of(acting)
+        if primary == self.whoami:
+            be = self._get_backend((pool_id, pg))
+            await be.ensure_active()
+            if be.object_exists(oid):
+                await be.submit_transaction(oid, [ClientOp("delete")])
+            return
+        self._copy_tid += 1
+        tid = self._copy_tid
+        fut = asyncio.get_event_loop().create_future()
+        self._copy_inflight[tid] = fut
+        fields = {"tid": -tid, "pool": pool_id, "pg": pg, "oid": oid,
+                  "internal": True, "ops": [{"op": "delete"}],
+                  "map_epoch": self.osdmap.epoch}
+        if str(self.config.get("auth_client_required")) == "cephx" \
+                and self.ticket_verifier.secrets:
+            from ..auth.cephx import TicketAuthority
+            fields["ticket"] = TicketAuthority(
+                "osd", secrets=dict(self.ticket_verifier.secrets)).issue(
+                f"osd.{self.whoami}", "osd allow *")
+        try:
+            conn = self.ms.get_connection(self.osdmap.get_addr(primary))
+            await conn.send_message(MOSDOp(fields))
+            await asyncio.wait_for(fut, float(
+                self.config.get("rados_osd_op_timeout")))
+        finally:
+            self._copy_inflight.pop(tid, None)
+
+    async def _cache_agent_loop(self) -> None:
+        """Background writeback agent (reference tiering agent): every
+        osd_agent_interval, flush dirty objects of cache-pool PGs this
+        OSD is primary for."""
+        while self.up:
+            interval = float(self.config.get("osd_agent_interval"))
+            await asyncio.sleep(interval if interval > 0 else 5.0)
+            if interval <= 0:
+                continue
+            for pool in list(self.osdmap.pools.values()):
+                try:
+                    if getattr(pool, "tier_of", None) is None:
+                        continue
+                    for pg in range(pool.pg_num):
+                        _u, acting = self.osdmap.pg_to_up_acting_osds(
+                            pool.pool_id, pg)
+                        if self.osdmap.primary_of(acting) != self.whoami:
+                            continue
+                        be = self._get_backend((pool.pool_id, pg))
+                        for oid in be._list_objects(max(0, be.my_shard)):
+                            try:
+                                await self._cache_flush_object(
+                                    be, pool, oid)
+                            except Exception as e:  # noqa: BLE001 —
+                                # retry next pass (base mid-peering)
+                                dout("osd", 5,
+                                     f"agent flush {oid} failed: {e}")
+                except Exception as e:  # noqa: BLE001 — a deleted pool
+                    # or transient map error must not kill the agent
+                    # for the daemon's lifetime
+                    dout("osd", 1, f"cache agent pass failed on pool "
+                                   f"{getattr(pool, 'name', '?')}: {e}")
+
     def _profile_ctl(self, start: bool, trace_dir: str) -> dict:
         """Device-kernel tracing (the §5 tracing gap: jax.profiler is
         the TPU analog of the reference's LTTng tracepoints — the
@@ -241,8 +461,14 @@ class OSDDaemon(Dispatcher):
             be = self._get_backend((pool_id, pg))
             await be.ensure_active()
             await be.wait_readable(oid)
+            lpool = self.osdmap.get_pool(pool_id)
+            if getattr(lpool, "tier_of", None) is not None:
+                # the local fast path must promote like the remote one
+                # would, or the same read ENOENTs depending on which
+                # OSD happens to be primary
+                await self._cache_maybe_promote(be, lpool, oid,
+                                                [{"op": "read"}])
             if not be.object_exists(oid):
-                from ..objectstore.store import NotFound
                 raise NotFound(f"copy_from: no such object {oid!r}")
             res = await be.objects_read_and_reconstruct(
                 {oid: [(0, 0)]})
@@ -289,7 +515,6 @@ class OSDDaemon(Dispatcher):
         if not st.get("exists", True):
             # ENOENT, not EIO: clients must distinguish "src absent"
             # from a real I/O failure (same mapping as plain ops)
-            from ..objectstore.store import NotFound
             raise NotFound(f"copy_from: no such object {oid!r}")
         return bytes(reply.data)
 
@@ -355,6 +580,8 @@ class OSDDaemon(Dispatcher):
         self.up = False
         if self._beacon_task:
             self._beacon_task.cancel()
+        if self._agent_task:
+            self._agent_task.cancel()
         if self._mgr_task:
             self._mgr_task.cancel()
         if self.admin_socket is not None:
@@ -638,7 +865,7 @@ class OSDDaemon(Dispatcher):
     # everything else 'r' (reference OSDCap check in do_op)
     _W_OPS = frozenset(("write", "append", "write_full", "truncate",
                         "delete", "setxattr", "omap_set", "omap_rm",
-                        "copy_from"))
+                        "copy_from", "cache_flush", "cache_evict"))
     _X_OPS = frozenset(("call",))
 
     def _check_osd_caps(self, msg: MOSDOp) \
@@ -716,6 +943,10 @@ class OSDDaemon(Dispatcher):
             # serve only once the PG is peered for the current acting set
             # (reference: ops wait for PeeringState Active)
             await be.ensure_active()
+            pool = self.osdmap.get_pool(pgid[0])
+            if getattr(pool, "tier_of", None) is not None:
+                await self._cache_maybe_promote(be, pool, oid,
+                                                msg.get("ops", []))
             mutations: "List[ClientOp]" = []
             doff = 0
             for op in msg["ops"]:
@@ -728,6 +959,17 @@ class OSDDaemon(Dispatcher):
                                               data=payload))
                 elif name in ("truncate", "delete"):
                     mutations.append(ClientOp(name, off=int(op.get("off", 0))))
+                elif name == "cache_flush":
+                    # CEPH_OSD_OP_CACHE_FLUSH: push a dirty cached
+                    # object down to the base pool, mark it clean
+                    n = await self._cache_flush_object(be, pool, oid)
+                    outs.append({"op": "cache_flush", "flushed": n,
+                                 "dlen": 0})
+                elif name == "cache_evict":
+                    # CEPH_OSD_OP_CACHE_EVICT: drop a CLEAN cached
+                    # object (dirty objects must flush first)
+                    await self._cache_evict_object(be, pool, oid)
+                    outs.append({"op": "cache_evict", "dlen": 0})
                 elif name == "copy_from":
                     # server-side object copy (reference PrimaryLogPG
                     # do_copy_from, PrimaryLogPG.cc: the dst primary
@@ -745,6 +987,16 @@ class OSDDaemon(Dispatcher):
                     doff += dlen
                     mutations.append(ClientOp(name, name=op["name"],
                                               value=payload))
+                elif name == "omap_set" \
+                        and getattr(pool, "tier_of", None) is not None \
+                        and self.osdmap.get_pool(
+                            int(pool.tier_of)).is_erasure():
+                    # omap cannot be flushed to an EC base (EC pools
+                    # store no omap): refuse loudly instead of losing
+                    # the keys on evict
+                    raise ECError(
+                        "omap on a cache tier over an erasure-coded "
+                        "base cannot be flushed; use a replicated base")
                 elif name == "omap_set":
                     dlen = int(op.get("dlen", 0))
                     payload = msg.data[doff:doff + dlen]
@@ -844,12 +1096,29 @@ class OSDDaemon(Dispatcher):
                 else:
                     raise ECError(f"unknown op {name!r}")
             if mutations:
+                if getattr(pool, "tier_of", None) is not None and any(
+                        m.op in ("write", "append", "write_full",
+                                 "truncate", "setxattr", "omap_set",
+                                 "omap_rm") for m in mutations):
+                    # writeback cache: mutations mark the object dirty
+                    # with a UNIQUE token; the flush clears it only if
+                    # the token is unchanged (CAS via the cache object
+                    # class), so a racing write stays dirty
+                    import os as _os
+                    mutations.append(ClientOp(
+                        "setxattr", name="cache.dirty",
+                        value=b"1:" + _os.urandom(8).hex().encode()))
                 self.perf.inc("op_w")
                 if top:
                     top.mark("started_write")
                 version = await be.submit_transaction(
                     oid, mutations, reqid=str(msg.get("reqid", "")),
                     trace_id=top.trace_id if top else "")
+                if getattr(pool, "tier_of", None) is not None and any(
+                        m.op == "delete" for m in mutations):
+                    # write-through deletes: a surviving base copy
+                    # would RESURRECT on the next promotion
+                    await self._cluster_delete(int(pool.tier_of), oid)
                 if top:
                     top.mark("commit_sent")
                 outs.append({"op": "commit", "version": list(version),
@@ -861,7 +1130,6 @@ class OSDDaemon(Dispatcher):
             outs.append({"error": str(e)})
         except Exception as e:  # noqa: BLE001 — op errors become errno
             from ..cls import ClsError
-            from ..objectstore.store import NotFound
             if not isinstance(e, (ECError, KeyError, NotFound, ClsError)):
                 dout("osd", 0, f"op error: {type(e).__name__}: {e}")
             # absent objects map to ENOENT so clients (striper hole

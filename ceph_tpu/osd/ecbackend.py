@@ -550,6 +550,14 @@ class ECBackend:
         return self.store.get_attr(self.coll(shard), ObjectId(oid, shard),
                                    name)
 
+    def get_attrs(self, oid: str) -> "Dict[str, bytes]":
+        shard = self.my_shard
+        try:
+            return dict(self.store.get_attrs(self.coll(shard),
+                                             ObjectId(oid, shard)))
+        except NotFound:
+            return {}
+
     def omap_get(self, oid: str,
                  keys: "Optional[List[str]]" = None) -> "Dict[str, bytes]":
         """Primary-local omap read (replicated pools only: every shard

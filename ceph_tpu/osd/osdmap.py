@@ -43,6 +43,12 @@ class Pool:
     # collective plane when the shard ring fits the attached devices
     # (parallel/plane.py); host messenger still carries metadata
     device_mesh: bool = False
+    # cache tiering (reference OSDMap pg_pool_t tier fields): on a BASE
+    # pool, cache_tier points at the overlay pool clients are
+    # redirected to; on the CACHE pool, tier_of points back at base
+    cache_tier: "int | None" = None
+    tier_of: "int | None" = None
+    cache_mode: str = ""          # "writeback" on cache pools
     snap_seq: int = 0             # newest pool snapid (0 = no snaps)
     snaps: "dict" = None          # snap name -> snapid
 

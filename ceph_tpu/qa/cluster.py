@@ -161,6 +161,35 @@ class MiniCluster:
         self.osdmap.bump()
         return pool
 
+    def tier_add(self, base: str, cache: str,
+                 mode: str = "writeback") -> None:
+        """Static-mode cache-tier overlay (reference 'osd tier add'):
+        clients of ``base`` are redirected to ``cache``; the cache OSDs
+        promote misses and the agent/flush ops write back."""
+        assert not self.mon_addrs, "mon mode: use 'osd tier add'"
+        b = self.osdmap.pool_by_name(base)
+        ca = self.osdmap.pool_by_name(cache)
+        assert not ca.is_erasure(), "cache tier must be replicated"
+        assert b.pool_id != ca.pool_id, "a pool cannot cache itself"
+        assert (b.cache_tier is None and ca.tier_of is None
+                and b.tier_of is None and ca.cache_tier is None), \
+            "pool already tiered (no chains)"
+        b.cache_tier = ca.pool_id
+        ca.tier_of = b.pool_id
+        ca.cache_mode = mode
+        self.osdmap.bump()
+
+    def tier_remove(self, base: str) -> None:
+        assert not self.mon_addrs
+        b = self.osdmap.pool_by_name(base)
+        if b.cache_tier is not None:
+            ca = self.osdmap.pools.get(b.cache_tier)
+            if ca is not None:
+                ca.tier_of = None
+                ca.cache_mode = ""
+            b.cache_tier = None
+        self.osdmap.bump()
+
     def create_replicated_pool(self, name: str, size: int = 3,
                                min_size: "Optional[int]" = None,
                                pg_num: int = 8, stripe_unit: int = 4096):

@@ -1,0 +1,204 @@
+"""Cache tiering — writeback overlay (reference PrimaryLogPG promote /
+cache_flush / cache_evict + the tiering agent, src/osd/Tier*,
+OSDMonitor 'osd tier add').
+
+Clients of the BASE pool are transparently redirected to the CACHE
+pool (replicated); misses promote from base, data mutations mark the
+cached object dirty, flush pushes it down, evict drops clean copies.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.objecter import ObjecterError
+from ceph_tpu.common.config import Config
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_tiered(agent_interval=0.0):
+    cfg = Config()
+    cfg.set("osd_agent_interval", agent_interval)
+    c = MiniCluster(n_osds=6, config=cfg)
+    c.create_ec_pool("base", {"plugin": "jax_rs", "k": "3", "m": "2"},
+                     pg_num=4, stripe_unit=256)
+    c.create_replicated_pool("hot", size=3, pg_num=4, stripe_unit=256)
+    c.tier_add("base", "hot")
+    return c
+
+
+def _cache_backend(c, oid):
+    pool = c.osdmap.pool_by_name("hot")
+    pg = c.osdmap.object_to_pg(pool.pool_id, oid)
+    _u, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+    return c.osds[c.osdmap.primary_of(acting)]._get_backend(
+        (pool.pool_id, pg))
+
+
+def test_writeback_flush_evict_cycle(loop):
+    async def go():
+        async with make_tiered() as c:
+            client = await c.client()
+            io = client.io_ctx("base")      # clients speak to BASE
+            rng = np.random.default_rng(17)
+            data = rng.integers(0, 256, 30000, np.uint8).tobytes()
+            await io.write_full("obj", data)
+            # the write landed in the CACHE pool (redirect), dirty
+            be = _cache_backend(c, "obj")
+            assert be.object_exists("obj")
+            assert bytes(be.get_attr("obj", "cache.dirty")).startswith(b"1")
+            assert await io.read("obj") == data
+            # base does NOT have it yet (writeback, not writethrough):
+            # a direct base read sees an absent object (empty)
+            c.tier_remove("base")
+            assert await io.read("obj") == b""
+            c.tier_add("base", "hot")
+            # flush pushes to base and marks clean
+            assert await io.cache_flush("obj") == 1
+            assert bytes(be.get_attr("obj", "cache.dirty")) == b"0"
+            assert await io.cache_flush("obj") == 0   # idempotent
+            c.tier_remove("base")
+            assert await io.read("obj") == data       # base copy real
+            c.tier_add("base", "hot")
+            # evict drops the clean cached copy; read re-promotes
+            await io.cache_evict("obj")
+            assert not be.object_exists("obj")
+            assert await io.read("obj") == data       # promoted back
+            assert be.object_exists("obj")
+            # promoted copy is CLEAN until written again
+            await io.write("obj", b"XYZ", off=5)
+            assert bytes(be.get_attr("obj", "cache.dirty")).startswith(b"1")
+            with pytest.raises(ObjecterError):
+                await io.cache_evict("obj")           # dirty: refuse
+    loop.run_until_complete(go())
+
+
+def test_partial_write_promotes_base_content(loop):
+    """A partial overwrite of an uncached object must read the base
+    copy first (promotion), or the untouched bytes would be lost."""
+    async def go():
+        async with make_tiered() as c:
+            client = await c.client()
+            io = client.io_ctx("base")
+            rng = np.random.default_rng(18)
+            data = bytearray(rng.integers(0, 256, 20000,
+                                          np.uint8).tobytes())
+            await io.write_full("obj", bytes(data))
+            await io.cache_flush("obj")
+            await io.cache_evict("obj")
+            # partial write to the evicted object: promote + merge
+            await io.write("obj", b"P" * 100, off=7000)
+            data[7000:7100] = b"P" * 100
+            assert await io.read("obj") == bytes(data)
+    loop.run_until_complete(go())
+
+
+def test_background_agent_flushes(loop):
+    async def go():
+        async with make_tiered(agent_interval=0.3) as c:
+            client = await c.client()
+            io = client.io_ctx("base")
+            data = b"agent" * 1000
+            await io.write_full("obj", data)
+            be = _cache_backend(c, "obj")
+            for _ in range(40):
+                await asyncio.sleep(0.2)
+                try:
+                    if bytes(be.get_attr("obj", "cache.dirty")) == b"0":
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+            assert bytes(be.get_attr("obj", "cache.dirty")) == b"0"
+            c.tier_remove("base")
+            assert await io.read("obj") == data   # base copy written
+    loop.run_until_complete(go())
+
+
+def test_mon_tier_commands(loop):
+    async def go():
+        from tests.test_mon import fast_config
+        async with MiniCluster(5, n_mons=1,
+                               config=fast_config()) as c:
+            await c.create_ec_pool_cmd(
+                "b", {"plugin": "jax_rs", "k": "2", "m": "1"}, pg_num=2)
+            admin = await c._admin_client()
+            await admin.mon_command({
+                "prefix": "osd pool create", "name": "h",
+                "kwargs": {"type": "replicated", "size": 3,
+                           "pg_num": 2}})
+            # EC pool as cache refused
+            from ceph_tpu.mon.client import MonClientError
+            await c.create_ec_pool_cmd(
+                "b2", {"plugin": "jax_rs", "k": "2", "m": "1"}, pg_num=2)
+            with pytest.raises(MonClientError, match="replicated"):
+                await admin.mon_command({"prefix": "osd tier add",
+                                         "base": "b", "cache": "b2"})
+            await admin.mon_command({"prefix": "osd tier add",
+                                     "base": "b", "cache": "h"})
+            # maps propagate the overlay; clients redirect
+            io = admin.io_ctx("b")
+            await io.write_full("o", b"tiered!")
+            assert await io.read("o") == b"tiered!"
+            hot = c.osds[0].osdmap.pool_by_name("h")
+            assert hot.tier_of is not None
+            await admin.mon_command({"prefix": "osd tier remove",
+                                     "base": "b"})
+    loop.run_until_complete(go())
+
+
+def test_delete_propagates_and_no_resurrection(loop):
+    """A delete through the cache must reach the base pool — a
+    surviving base copy would resurrect on the next promotion."""
+    async def go():
+        async with make_tiered() as c:
+            client = await c.client()
+            io = client.io_ctx("base")
+            await io.write_full("obj", b"alive" * 100)
+            await io.cache_flush("obj")       # base has a copy now
+            await io.remove("obj")
+            assert await io.read("obj") == b""   # gone from cache
+            # and gone from base: an evicted/missed read must NOT
+            # promote the old content back
+            assert await io.read("obj") == b""
+            c.tier_remove("base")
+            assert await io.read("obj") == b""   # base really empty
+    loop.run_until_complete(go())
+
+
+def test_omap_refused_over_ec_base(loop):
+    """omap keys cannot be flushed to an EC base — refuse loudly
+    instead of losing them on evict."""
+    async def go():
+        async with make_tiered() as c:
+            client = await c.client()
+            io = client.io_ctx("base")
+            await io.write_full("obj", b"x")
+            with pytest.raises(ObjecterError, match="omap"):
+                await io.omap_set("obj", {"k": b"v"})
+    loop.run_until_complete(go())
+
+
+def test_tier_validation(loop):
+    async def go():
+        async with MiniCluster(n_osds=4) as c:
+            c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=2, stripe_unit=64)
+            c.create_replicated_pool("h", size=3, pg_num=2)
+            c.create_replicated_pool("h2", size=3, pg_num=2)
+            with pytest.raises(AssertionError):
+                c.tier_add("h", "h")          # self-tier
+            c.tier_add("b", "h")
+            with pytest.raises(AssertionError):
+                c.tier_add("h", "h2")         # chain via cache
+            with pytest.raises(AssertionError):
+                c.tier_add("b", "h2")         # base already tiered
+    loop.run_until_complete(go())
