@@ -122,6 +122,21 @@ async def cache_clear_dirty_if(ctx, data: bytes) -> bytes:
     return b"0"
 
 
+async def cache_evict_if_clean(ctx, data: bytes) -> bytes:
+    """Atomic evict: delete the object UNLESS its dirty mark is set.
+    Check and delete run under the cls lock — which also gates plain
+    write ADMISSION — so no client write can slip between them (the
+    TOCTOU that would delete an acked-but-unflushed write)."""
+    try:
+        dirty = ctx.getxattr("cache.dirty").startswith(b"1")
+    except Exception:  # noqa: BLE001 — no mark = clean
+        dirty = False
+    if dirty:
+        raise ClsError("object is dirty: flush first", 16)   # EBUSY
+    ctx.remove()
+    return b""
+
+
 def register_all(reg) -> None:
     reg.register("hello", "say_hello", RD, hello_say)
     reg.register("hello", "record_hello", WR, hello_record)
@@ -134,3 +149,5 @@ def register_all(reg) -> None:
     reg.register("cas", "swap", RD | WR, cas_swap)
     reg.register("cache", "clear_dirty_if", RD | WR,
                  cache_clear_dirty_if)
+    reg.register("cache", "evict_if_clean", RD | WR,
+                 cache_evict_if_clean)

@@ -361,7 +361,10 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="default per-subsystem debug level"),
     # --- objectstore --------------------------------------------------------
     Option("objectstore_type", str, "mem", LEVEL_ADVANCED, (FLAG_STARTUP,),
-           enum_values=("mem", "file"), desc="object store backend",
+           enum_values=("mem", "file", "kv", "kvstore", "block",
+                        "bluestore"),
+           desc="object store backend (block/bluestore = raw-block "
+                "allocator+WAL device, objectstore/blockstore.py)",
            services=("osd",)),
     Option("objectstore_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="data directory for the file objectstore", services=("osd",)),

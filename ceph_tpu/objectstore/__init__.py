@@ -26,8 +26,15 @@ def create_store(kind: str, path: str = "") -> ObjectStore:
         if not path:
             raise StoreError("file store needs objectstore_path")
         return FileStore(path)
-    if kind in ("kv", "kvstore", "bluestore"):
-        # the BlueStore-shaped backend: all state in a KeyValueDB
-        # (sqlite WAL when a path is given, memdb otherwise)
+    if kind in ("kv", "kvstore"):
+        # all state in a KeyValueDB (sqlite WAL when a path is given,
+        # memdb otherwise) — the reference's kstore layout
         return KVStore(path=path)
+    if kind in ("block", "bluestore"):
+        # the raw-block backend: allocator + WAL + no-overwrite data
+        # on one flat device file (objectstore/blockstore.py)
+        from .blockstore import BlockStore
+        if not path:
+            raise StoreError("block store needs objectstore_path")
+        return BlockStore(path)
     raise StoreError(f"unknown objectstore type {kind!r}")

@@ -1,0 +1,118 @@
+"""BlockStore-specific coverage (reference src/os/bluestore semantics):
+WAL crash recovery, torn-tail handling, COW clone refcounting, and
+allocator block reuse.  The generic ObjectStore contract runs in
+test_objectstore.py's backend matrix.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.objectstore import Collection, ObjectId, Transaction
+from ceph_tpu.objectstore import blockstore as bs_mod
+from ceph_tpu.objectstore.blockstore import AU, BlockStore
+
+CID = Collection(1, 0, 0)
+OID = ObjectId("obj", shard=0)
+
+
+def make(path) -> BlockStore:
+    s = BlockStore(str(path))
+    s.mkfs()
+    s.mount()
+    s.apply_transaction(Transaction().create_collection(CID))
+    return s
+
+
+def test_crash_recovery_replays_wal(tmp_path):
+    """Committed transactions survive WITHOUT a clean umount: a fresh
+    mount loads the checkpoint and replays the WAL (the umount-time
+    checkpoint never happens, as after a crash/kill -9)."""
+    p = tmp_path / "dev"
+    s = make(p)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 200_000, np.uint8)
+    s.apply_transaction(Transaction().write(CID, OID, 0, data))
+    s.apply_transaction(Transaction().setattr(CID, OID, "a", b"v"))
+    # crash: no umount — recover on a second handle
+    s2 = BlockStore(str(p))
+    s2.mount()
+    assert np.array_equal(s2.read(CID, OID), data)
+    assert s2.get_attr(CID, OID, "a") == b"v"
+    # and the recovered instance keeps working + re-recovers
+    s2.apply_transaction(Transaction().write(CID, OID, 0, b"post"))
+    s3 = BlockStore(str(p))
+    s3.mount()
+    assert bytes(s3.read(CID, OID, 0, 4)) == b"post"
+
+
+def test_torn_wal_tail_stops_replay(tmp_path):
+    """Garbage after the last durable record (a torn append) must not
+    be replayed — recovery keeps every committed txn and stays usable."""
+    p = tmp_path / "dev"
+    s = make(p)
+    s.apply_transaction(Transaction().write(CID, OID, 0, b"durable"))
+    head = s.wal_head
+    # simulate a torn in-flight record: plausible header, junk payload
+    import struct, zlib
+    junk = struct.pack("<QII", s.seq + 1, 100, 12345) + b"\xff" * 50
+    fd = os.open(str(p), os.O_RDWR)
+    os.pwrite(fd, junk, s._wal_off + head)
+    os.close(fd)
+    s2 = BlockStore(str(p))
+    s2.mount()
+    assert bytes(s2.read(CID, OID)) == b"durable"
+    s2.apply_transaction(Transaction().write(CID, OID, 0, b"again!!"))
+    s3 = BlockStore(str(p))
+    s3.mount()
+    assert bytes(s3.read(CID, OID)) == b"again!!"
+
+
+def test_clone_shares_blocks_cow(tmp_path):
+    p = tmp_path / "dev"
+    s = make(p)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, 6 * AU, np.uint8)
+    s.apply_transaction(Transaction().write(CID, OID, 0, data))
+    used_before = s.high_lba - len(s.free)
+    clone = OID.with_gen(7)
+    s.apply_transaction(Transaction().clone(CID, OID, clone))
+    # COW: the clone consumed ZERO new data blocks
+    assert s.high_lba - len(s.free) == used_before
+    # modifying the head leaves the clone intact (new blocks for head)
+    s.apply_transaction(Transaction().write(CID, OID, 0, b"X" * AU))
+    assert np.array_equal(s.read(CID, clone), data)
+    assert bytes(s.read(CID, OID, 0, 4)) == b"XXXX"
+    # removing the head keeps the clone's shared blocks alive
+    s.apply_transaction(Transaction().remove(CID, OID))
+    assert np.array_equal(s.read(CID, clone), data)
+
+
+def test_allocator_reuses_freed_blocks(tmp_path):
+    p = tmp_path / "dev"
+    s = make(p)
+    data = np.arange(4 * AU, dtype=np.uint32).view(np.uint8)[: 4 * AU]
+    for _ in range(8):          # repeated full overwrites
+        s.apply_transaction(Transaction().write(CID, OID, 0, data))
+    # no-overwrite allocation frees the replaced blocks each time: the
+    # high-water mark stays bounded (~2 generations, not 8)
+    assert s.high_lba <= 3 * (len(data) // AU), s.high_lba
+    s.apply_transaction(Transaction().remove(CID, OID))
+    assert len(s.free) == s.high_lba     # everything back in the pool
+
+
+def test_checkpoint_when_wal_fills(tmp_path, monkeypatch):
+    monkeypatch.setattr(bs_mod, "WAL_BYTES", 16 * 1024)
+    p = tmp_path / "dev"
+    s = make(p)
+    rng = np.random.default_rng(3)
+    blobs = {}
+    for i in range(60):          # far more records than a 16K WAL holds
+        blobs[f"o{i}"] = rng.integers(0, 256, 600, np.uint8).tobytes()
+        s.apply_transaction(Transaction().write(
+            CID, ObjectId(f"o{i}", 0), 0, blobs[f"o{i}"]))
+    s2 = BlockStore(str(p))
+    s2.mount()                    # crash-recover through checkpoints
+    for i in range(60):
+        assert bytes(s2.read(CID, ObjectId(f"o{i}", 0))) == blobs[f"o{i}"]

@@ -12,7 +12,7 @@ CID = Collection(1, 0, 2)
 OID = ObjectId("rbd_data.1", shard=2)
 
 
-@pytest.fixture(params=["mem", "file", "kv"])
+@pytest.fixture(params=["mem", "file", "kv", "block"])
 def store(request, tmp_path):
     s = create_store(request.param, str(tmp_path / "store"))
     s.mkfs()
