@@ -82,11 +82,27 @@ class FileSystem:
 
     # --- path walking ---------------------------------------------------------
 
-    async def _lookup(self, path: str) -> "Tuple[int, dict]":
+    async def _lookup(self, path: str, follow: bool = True,
+                      _depth: int = 0) -> "Tuple[int, dict]":
+        if _depth > 8:
+            raise FSError(f"{path}: too many symlink levels", 40)
         parts = [p for p in posixpath.normpath(path).split("/") if p]
         ino = ROOT_INO
         meta = await self._read_inode(ino)
-        for name in parts:
+        walked: "List[str]" = []     # path of the CURRENT inode
+        for i, name in enumerate(parts):
+            if meta["type"] == "symlink":
+                # intermediate symlinks always resolve (POSIX);
+                # relative targets resolve against the link's PARENT
+                # directory, not the fs root
+                tgt = str(meta["target"])
+                if not tgt.startswith("/"):
+                    tgt = posixpath.join(
+                        "/" + "/".join(walked[:-1]), tgt)
+                rest = "/".join(parts[i:])
+                return await self._lookup(posixpath.join(tgt, rest),
+                                          follow=follow,
+                                          _depth=_depth + 1)
             if meta["type"] != "dir":
                 raise FSError(f"{name}: not a directory", 20)
             entry = await self.meta.omap_get(_inode_oid(ino), [name])
@@ -95,6 +111,13 @@ class FileSystem:
             rec = json.loads(entry[name].decode())
             ino = int(rec["ino"])
             meta = await self._read_inode(ino)
+            walked.append(name)
+        if follow and meta["type"] == "symlink":
+            tgt = str(meta["target"])
+            if not tgt.startswith("/"):
+                tgt = posixpath.join("/" + "/".join(walked[:-1]), tgt)
+            return await self._lookup(tgt, follow=True,
+                                      _depth=_depth + 1)
         return ino, meta
 
     async def _parent_of(self, path: str) -> "Tuple[int, str]":
@@ -137,13 +160,17 @@ class FileSystem:
             if rec["type"] != "file":
                 raise FSError(f"{path}: is a directory", 21)
             ino = int(rec["ino"])
+            # preserve the inode's OTHER fields — rewriting it fresh
+            # dropped nlink, so an overwrite through one hardlink let a
+            # later unlink destroy data the other dirent still needs
+            meta = await self._read_inode(ino)
         else:
             ino = await self._alloc_ino()
             await self._link(dir_ino, name, ino, "file")
+            meta = {"type": "file", "mode": 0o644}
         await self.striper.write_full(f"filedata.{ino:x}", data)
-        await self._write_inode(ino, {"type": "file", "mode": 0o644,
-                                      "size": len(data),
-                                      "mtime": time.time()})
+        meta.update({"size": len(data), "mtime": time.time()})
+        await self._write_inode(ino, meta)
 
     async def read_file(self, path: str) -> bytes:
         ino, meta = await self._lookup(path)
@@ -164,17 +191,96 @@ class FileSystem:
         ino, meta = await self._lookup(path)
         return {"ino": ino, **meta}
 
+    async def lstat(self, path: str) -> dict:
+        """stat that does NOT follow a final symlink."""
+        ino, meta = await self._lookup(path, follow=False)
+        return {"ino": ino, **meta}
+
+    # --- symlinks + hardlinks (reference MDS CInode nlink / symlinks) ---------
+
+    async def symlink(self, target: str, path: str) -> None:
+        dir_ino, name = await self._parent_of(path)
+        if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
+            raise FSError(f"{path}: exists", 17)
+        ino = await self._alloc_ino()
+        await self._write_inode(ino, {"type": "symlink",
+                                      "target": target, "mode": 0o777,
+                                      "mtime": time.time()})
+        await self._link(dir_ino, name, ino, "symlink")
+
+    async def readlink(self, path: str) -> str:
+        _ino, meta = await self._lookup(path, follow=False)
+        if meta["type"] != "symlink":
+            raise FSError(f"{path}: not a symlink", 22)
+        return str(meta["target"])
+
+    async def link(self, existing: str, path: str) -> None:
+        """Hardlink: a second dirent to the same inode; data lives
+        until the last link drops (nlink refcount, like the MDS)."""
+        ino, meta = await self._lookup(existing, follow=False)
+        if meta["type"] == "dir":
+            raise FSError(f"{existing}: hardlink to directory", 31)
+        dir_ino, name = await self._parent_of(path)
+        if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
+            raise FSError(f"{path}: exists", 17)
+        meta["nlink"] = int(meta.get("nlink", 1)) + 1
+        await self._write_inode(ino, meta)
+        await self._link(dir_ino, name, ino, meta["type"])
+
+    # --- offset I/O + attrs ---------------------------------------------------
+
+    async def pwrite(self, path: str, data: bytes, off: int) -> None:
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "file":
+            raise FSError(f"{path}: is a directory", 21)
+        await self.striper.write(f"filedata.{ino:x}", data, off)
+        meta["size"] = max(int(meta.get("size", 0)), off + len(data))
+        meta["mtime"] = time.time()
+        await self._write_inode(ino, meta)
+
+    async def pread(self, path: str, length: int = 0,
+                    off: int = 0) -> bytes:
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "file":
+            raise FSError(f"{path}: is a directory", 21)
+        return await self.striper.read(f"filedata.{ino:x}", length, off)
+
+    async def truncate(self, path: str, size: int) -> None:
+        ino, meta = await self._lookup(path)
+        if meta["type"] != "file":
+            raise FSError(f"{path}: is a directory", 21)
+        # O(tail), not O(file): the striper trims only cleared object
+        # tails; growth is metadata-only (reads past data return zeros)
+        await self.striper.truncate(f"filedata.{ino:x}", size)
+        meta["size"] = size
+        meta["mtime"] = time.time()
+        await self._write_inode(ino, meta)
+
+    async def chmod(self, path: str, mode: int) -> None:
+        ino, meta = await self._lookup(path)
+        meta["mode"] = int(mode)
+        await self._write_inode(ino, meta)
+
     async def unlink(self, path: str) -> None:
         dir_ino, name = await self._parent_of(path)
         entry = await self.meta.omap_get(_inode_oid(dir_ino), [name])
         if not entry:
             raise FSError(f"{path}: no such file")
         rec = json.loads(entry[name].decode())
-        if rec["type"] != "file":
+        if rec["type"] == "dir":
             raise FSError(f"{path}: is a directory (use rmdir)", 21)
         ino = int(rec["ino"])
-        await self.striper.remove(f"filedata.{ino:x}", missing_ok=True)
-        await self.meta.remove(_inode_oid(ino))
+        meta = await self._read_inode(ino)
+        nlink = int(meta.get("nlink", 1)) - 1
+        if nlink > 0:
+            # other hardlinks remain: drop this dirent only
+            meta["nlink"] = nlink
+            await self._write_inode(ino, meta)
+        else:
+            if rec["type"] == "file":
+                await self.striper.remove(f"filedata.{ino:x}",
+                                          missing_ok=True)
+            await self.meta.remove(_inode_oid(ino))
         await self.meta.omap_rm(_inode_oid(dir_ino), [name])
 
     async def rmdir(self, path: str) -> None:

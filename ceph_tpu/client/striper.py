@@ -138,6 +138,29 @@ class RadosStriper:
         await asyncio.gather(*(read_ext(*e) for e in extents))
         return bytes(out)
 
+    async def truncate(self, soid: str, size: int) -> None:
+        """O(tail) truncate: trims each object's cleared tail (for a
+        contiguous file tail, every object's cleared region is
+        contiguous to its own end under RAID-0 striping) and updates
+        the size attr — no whole-file read/rewrite."""
+        old = await self._get_size(soid)
+        if size < old:
+            per_obj: "dict[int, int]" = {}
+            for idx, ooff, _n, _l in self.layout.file_to_extents(
+                    size, old - size):
+                per_obj[idx] = min(per_obj.get(idx, 1 << 62), ooff)
+
+            async def trim(idx: int, ooff: int) -> None:
+                name = self.layout.object_name(soid, idx)
+                try:
+                    await self.io.truncate(name, ooff)
+                except Exception:  # noqa: BLE001 — sparse hole object
+                    pass
+
+            await asyncio.gather(*(trim(i, o)
+                                   for i, o in per_obj.items()))
+        await self._set_size(soid, size)
+
     async def stat(self, soid: str) -> dict:
         size = await self._get_size(soid)
         n_objects = len({idx for idx, *_ in

@@ -363,8 +363,8 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("objectstore_type", str, "mem", LEVEL_ADVANCED, (FLAG_STARTUP,),
            enum_values=("mem", "file", "kv", "kvstore", "block",
                         "bluestore"),
-           desc="object store backend (block/bluestore = raw-block "
-                "allocator+WAL device, objectstore/blockstore.py)",
+           desc="object store backend (block = raw-block allocator+WAL "
+                "device; bluestore aliases the legacy kv layout)",
            services=("osd",)),
     Option("objectstore_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
            desc="data directory for the file objectstore", services=("osd",)),

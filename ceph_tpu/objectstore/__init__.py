@@ -26,11 +26,13 @@ def create_store(kind: str, path: str = "") -> ObjectStore:
         if not path:
             raise StoreError("file store needs objectstore_path")
         return FileStore(path)
-    if kind in ("kv", "kvstore"):
+    if kind in ("kv", "kvstore", "bluestore"):
         # all state in a KeyValueDB (sqlite WAL when a path is given,
-        # memdb otherwise) — the reference's kstore layout
+        # memdb otherwise) — the reference's kstore layout.  The
+        # historical "bluestore" alias stays here: existing stores
+        # formatted under that name must keep mounting.
         return KVStore(path=path)
-    if kind in ("block", "bluestore"):
+    if kind == "block":
         # the raw-block backend: allocator + WAL + no-overwrite data
         # on one flat device file (objectstore/blockstore.py)
         from .blockstore import BlockStore

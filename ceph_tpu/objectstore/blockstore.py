@@ -112,7 +112,6 @@ class BlockStore(ObjectStore):
         self._t_colls: "Dict[str, Optional[bool]]" = {}
         self._t_alloc: "List[int]" = []        # lbas allocated this txn
         self._t_ref: "Dict[int, int]" = {}     # lba -> ref delta
-        self._io_lock = threading.RLock()
 
     # --- layout helpers ------------------------------------------------------
 
@@ -279,6 +278,13 @@ class BlockStore(ObjectStore):
         if self.wal_head + len(frame) + 16 > WAL_BYTES:
             # WAL full: fold everything into a checkpoint instead
             self._checkpoint()
+            if len(frame) + 16 > WAL_BYTES:
+                # one record larger than the whole ring would overrun
+                # into the checkpoint slots — refuse loudly (split the
+                # transaction) rather than corrupt the store
+                raise StoreError(
+                    f"transaction record {len(frame)}B exceeds the "
+                    f"{WAL_BYTES}B WAL ring")
         os.pwrite(self.fd, frame, self._wal_off + self.wal_head)
         # pre-invalidate the NEXT frame slot so replay cannot run past
         # this record into stale bytes

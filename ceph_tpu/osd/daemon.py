@@ -482,10 +482,11 @@ class OSDDaemon(Dispatcher):
             self._copy_inflight.pop(tid, None)
         res = int(reply.get("result", 0))
         if res == -ESTALE:
-            # src PG mid-peering or map skew: surface as NotActive so
-            # the CLIENT's objecter retries the whole copy with a fresh
-            # map instead of seeing a hard EIO
-            raise NotActive(f"copy_from src {oid!r} primary stale")
+            # target PG mid-peering or map skew: surface as NotActive
+            # so the CLIENT's objecter retries the whole op with a
+            # fresh map instead of seeing a hard EIO
+            raise NotActive(f"internal op target for {oid!r} is stale "
+                            f"(mid-peering / map skew)")
         if res != 0:
             raise ECError(f"internal op on {oid} failed: "
                           f"{reply.get('outs')}")

@@ -325,3 +325,83 @@ class TestRGWMultipart:
                 await gw.delete_bucket("b")
                 gw.shutdown()
         loop.run_until_complete(go())
+
+
+class TestFSExtended:
+    def test_symlinks_hardlinks_offset_io(self, loop):
+        """Round-4 FS surface: symlinks (follow + readlink + loops),
+        hardlinks with nlink refcounting, offset I/O, truncate, chmod."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                fs = FileSystem(client.io_ctx("meta"),
+                                client.io_ctx("data"))
+                await fs.mkfs()
+                await fs.mkdir("/d")
+                await fs.write_file("/d/f", b"0123456789")
+                # symlink: follow on read/stat, lstat/readlink raw
+                await fs.symlink("/d/f", "/lnk")
+                assert await fs.read_file("/lnk") == b"0123456789"
+                assert (await fs.stat("/lnk"))["type"] == "file"
+                assert (await fs.lstat("/lnk"))["type"] == "symlink"
+                assert await fs.readlink("/lnk") == "/d/f"
+                # symlink through an intermediate dir component
+                await fs.symlink("/d", "/dl")
+                assert await fs.read_file("/dl/f") == b"0123456789"
+                # loops bounded
+                await fs.symlink("/loop2", "/loop1")
+                await fs.symlink("/loop1", "/loop2")
+                with pytest.raises(FSError):
+                    await fs.read_file("/loop1")
+                # hardlink: survives unlink of the original
+                await fs.link("/d/f", "/hard")
+                await fs.unlink("/d/f")
+                assert await fs.read_file("/hard") == b"0123456789"
+                assert (await fs.stat("/hard"))["nlink"] == 1
+                # offset I/O + truncate + chmod
+                await fs.pwrite("/hard", b"AB", 3)
+                assert await fs.pread("/hard", 6, 1) == b"12AB56"
+                await fs.truncate("/hard", 4)
+                assert await fs.read_file("/hard") == b"012A"
+                await fs.truncate("/hard", 8)
+                assert await fs.read_file("/hard") == b"012A\0\0\0\0"
+                await fs.chmod("/hard", 0o600)
+                assert (await fs.stat("/hard"))["mode"] == 0o600
+                await fs.unlink("/hard")
+                with pytest.raises(FSError):
+                    await fs.read_file("/hard")
+        loop.run_until_complete(go())
+
+    def test_relative_symlinks_and_hardlink_overwrite(self, loop):
+        """Review-found holes: relative symlink targets resolve against
+        the LINK's directory; overwriting through one hardlink must not
+        destroy the nlink refcount; truncate shrink-then-grow must not
+        resurrect stale bytes."""
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                fs = FileSystem(client.io_ctx("meta"),
+                                client.io_ctx("data"))
+                await fs.mkfs()
+                await fs.mkdir("/d")
+                await fs.write_file("/d/f", b"target-data")
+                await fs.symlink("f", "/d/rel")        # RELATIVE target
+                assert await fs.read_file("/d/rel") == b"target-data"
+                await fs.mkdir("/d/sub")
+                await fs.symlink("../f", "/d/sub/up")
+                assert await fs.read_file("/d/sub/up") == b"target-data"
+                # hardlink + overwrite through one name
+                await fs.link("/d/f", "/d/g")
+                await fs.write_file("/d/f", b"NEW")
+                assert (await fs.stat("/d/g"))["nlink"] == 2
+                await fs.unlink("/d/f")
+                assert await fs.read_file("/d/g") == b"NEW"
+                # truncate shrink then grow: no stale resurrection
+                data = payload(300_000, 33)
+                await fs.write_file("/d/big", data)
+                await fs.truncate("/d/big", 5000)
+                await fs.truncate("/d/big", 200_000)
+                got = await fs.read_file("/d/big")
+                assert got[:5000] == data[:5000]
+                assert got[5000:] == b"\0" * 195_000
+        loop.run_until_complete(go())
