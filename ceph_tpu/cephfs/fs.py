@@ -12,10 +12,12 @@ the MDS-less lean core exercising the same storage layout ideas:
   striper as ``filedata.<ino>``, the reference's file-layout analog.
 - the inode counter lives in the ``fs.meta`` object, incremented
   ATOMICALLY server-side via the numops object class.
-
-Multi-step namespace updates are not journaled (the reference gets
-atomicity from MDS journaling — an mdlog analog is future work), but
-each single omap/object update rides the PG pipeline atomically.
+- multi-step namespace updates (mkdir/link/unlink/rmdir/rename/…) are
+  JOURNALED through the MDLog (mdlog.py — reference src/mds/MDLog.h:61,
+  src/mds/journal.cc EUpdate): intent record first, then the
+  single-object applies; ``mount()`` replays surviving records so a
+  crash mid-op rolls forward instead of leaving orphans/dangling
+  dirents.  ``fsck()`` is the offline safety net on top.
 """
 
 from __future__ import annotations
@@ -26,9 +28,11 @@ import time
 from typing import List, Optional, Tuple
 
 from ..client.striper import RadosStriper
+from .mdlog import MDLog
 
 ROOT_INO = 1
 META_OID = "fs.meta"
+LOST_FOUND = "lost+found"
 
 
 class FSError(Exception):
@@ -49,18 +53,52 @@ class FileSystem:
         self.striper = RadosStriper(
             data_io, stripe_unit=object_size // stripe_count,
             stripe_count=stripe_count, object_size=object_size)
+        self.mdlog = MDLog(self.meta, self.striper)
 
-    async def mkfs(self) -> None:
-        """Initialize root + counter (idempotent)."""
+    async def mkfs(self) -> int:
+        """Initialize root + counter (idempotent), then recover the
+        journal: surviving mdlog records from a crashed client replay
+        before any new op runs (the MDS rejoin sequence).  Returns the
+        number of replayed records."""
         try:
             raw = await self.meta.read(META_OID)
         except Exception:  # noqa: BLE001 — absent
             raw = b""
-        if raw:
-            return
-        await self.meta.write_full(META_OID, str(ROOT_INO).encode())
-        await self._write_inode(ROOT_INO, {"type": "dir", "mode": 0o755,
-                                           "mtime": time.time()})
+        if not raw:
+            await self.meta.write_full(META_OID, str(ROOT_INO).encode())
+            await self._write_inode(ROOT_INO,
+                                    {"type": "dir", "mode": 0o755,
+                                     "mtime": time.time()})
+        return await self.mdlog.open()
+
+    async def mount(self) -> int:
+        """mkfs-if-needed + journal replay; returns replayed count."""
+        return await self.mkfs()
+
+    # --- journal step builders (absolute values only) -------------------------
+
+    @staticmethod
+    def _s_link(dir_ino: int, name: str, ino: int, kind: str) -> dict:
+        val = json.dumps({"ino": ino, "type": kind}).encode()
+        return {"t": "omap_set", "oid": _inode_oid(dir_ino),
+                "key": name, "val": val.hex()}
+
+    @staticmethod
+    def _s_unlink(dir_ino: int, name: str) -> dict:
+        return {"t": "omap_rm", "oid": _inode_oid(dir_ino), "key": name}
+
+    @staticmethod
+    def _s_inode(ino: int, meta: dict) -> dict:
+        return {"t": "write", "oid": _inode_oid(ino),
+                "val": json.dumps(meta).encode().hex()}
+
+    @staticmethod
+    def _s_rm_inode(ino: int) -> dict:
+        return {"t": "remove", "oid": _inode_oid(ino)}
+
+    @staticmethod
+    def _s_rm_data(ino: int) -> dict:
+        return {"t": "strip_rm", "base": f"filedata.{ino:x}"}
 
     async def _alloc_ino(self) -> int:
         """Atomic server-side increment via the numops object class —
@@ -142,9 +180,10 @@ class FileSystem:
         if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
             raise FSError(f"{path}: exists", 17)
         ino = await self._alloc_ino()
-        await self._write_inode(ino, {"type": "dir", "mode": mode,
-                                      "mtime": time.time()})
-        await self._link(dir_ino, name, ino, "dir")
+        await self.mdlog.transact("mkdir", [
+            self._s_inode(ino, {"type": "dir", "mode": mode,
+                                "mtime": time.time()}),
+            self._s_link(dir_ino, name, ino, "dir")])
 
     async def listdir(self, path: str = "/") -> "List[str]":
         ino, meta = await self._lookup(path)
@@ -166,8 +205,10 @@ class FileSystem:
             meta = await self._read_inode(ino)
         else:
             ino = await self._alloc_ino()
-            await self._link(dir_ino, name, ino, "file")
             meta = {"type": "file", "mode": 0o644}
+            await self.mdlog.transact("create", [
+                self._s_inode(ino, meta),
+                self._s_link(dir_ino, name, ino, "file")])
         await self.striper.write_full(f"filedata.{ino:x}", data)
         meta.update({"size": len(data), "mtime": time.time()})
         await self._write_inode(ino, meta)
@@ -203,10 +244,10 @@ class FileSystem:
         if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
             raise FSError(f"{path}: exists", 17)
         ino = await self._alloc_ino()
-        await self._write_inode(ino, {"type": "symlink",
-                                      "target": target, "mode": 0o777,
-                                      "mtime": time.time()})
-        await self._link(dir_ino, name, ino, "symlink")
+        await self.mdlog.transact("symlink", [
+            self._s_inode(ino, {"type": "symlink", "target": target,
+                                "mode": 0o777, "mtime": time.time()}),
+            self._s_link(dir_ino, name, ino, "symlink")])
 
     async def readlink(self, path: str) -> str:
         _ino, meta = await self._lookup(path, follow=False)
@@ -224,8 +265,9 @@ class FileSystem:
         if await self.meta.omap_get(_inode_oid(dir_ino), [name]):
             raise FSError(f"{path}: exists", 17)
         meta["nlink"] = int(meta.get("nlink", 1)) + 1
-        await self._write_inode(ino, meta)
-        await self._link(dir_ino, name, ino, meta["type"])
+        await self.mdlog.transact("link", [
+            self._s_inode(ino, meta),
+            self._s_link(dir_ino, name, ino, meta["type"])])
 
     # --- offset I/O + attrs ---------------------------------------------------
 
@@ -275,13 +317,16 @@ class FileSystem:
         if nlink > 0:
             # other hardlinks remain: drop this dirent only
             meta["nlink"] = nlink
-            await self._write_inode(ino, meta)
+            await self.mdlog.transact("unlink", [
+                self._s_inode(ino, meta),
+                self._s_unlink(dir_ino, name)])
         else:
+            steps = []
             if rec["type"] == "file":
-                await self.striper.remove(f"filedata.{ino:x}",
-                                          missing_ok=True)
-            await self.meta.remove(_inode_oid(ino))
-        await self.meta.omap_rm(_inode_oid(dir_ino), [name])
+                steps.append(self._s_rm_data(ino))
+            steps += [self._s_rm_inode(ino),
+                      self._s_unlink(dir_ino, name)]
+            await self.mdlog.transact("unlink", steps)
 
     async def rmdir(self, path: str) -> None:
         dir_ino, name = await self._parent_of(path)
@@ -290,8 +335,9 @@ class FileSystem:
             raise FSError(f"{path}: not a directory", 20)
         if await self.meta.omap_keys(_inode_oid(ino)):
             raise FSError(f"{path}: directory not empty", 39)
-        await self.meta.remove(_inode_oid(ino))
-        await self.meta.omap_rm(_inode_oid(dir_ino), [name])
+        await self.mdlog.transact("rmdir", [
+            self._s_rm_inode(ino),
+            self._s_unlink(dir_ino, name)])
 
     async def rename(self, src: str, dst: str) -> None:
         sdir, sname = await self._parent_of(src)
@@ -301,6 +347,101 @@ class FileSystem:
             raise FSError(f"{src}: no such file or directory")
         if await self.meta.omap_get(_inode_oid(ddir), [dname]):
             raise FSError(f"{dst}: exists", 17)
-        await self.meta.omap_set(_inode_oid(ddir),
-                                 {dname: entry[sname]})
-        await self.meta.omap_rm(_inode_oid(sdir), [sname])
+        await self.mdlog.transact("rename", [
+            {"t": "omap_set", "oid": _inode_oid(ddir), "key": dname,
+             "val": entry[sname].hex()},
+            self._s_unlink(sdir, sname)])
+
+    # --- fsck (reference cephfs-data-scan / MDS forward scrub) ----------------
+
+    async def fsck(self, repair: bool = False) -> dict:
+        """Full namespace check over the metadata pool (PGLS-listed):
+
+        - ``dangling``: dirents whose target inode object is missing
+          (repair: drop the dirent);
+        - ``orphans``: inodes no dirent references (repair: link into
+          ``/lost+found`` as ``ino.<hex>``);
+        - ``nlink``: file inodes whose nlink disagrees with the actual
+          dirent count (repair: rewrite with the true count).
+
+        Run after ``mount()`` (journal replay first): a healthy tree
+        reports all-empty.  Reference analog: cephfs-data-scan +
+        ScrubStack (src/mds/ScrubStack.cc) — rebuilt here as one
+        client-driven pass, sized to the lean MDS-less design."""
+        import asyncio
+
+        async def _read_inode_entry(oid: str):
+            ino = int(oid.split(".", 1)[1], 16)
+            try:
+                return ino, json.loads(
+                    (await self.meta.read(oid)).decode())
+            except Exception:  # noqa: BLE001 — unreadable inode
+                return ino, {"type": "?", "unreadable": True}
+
+        # the scan round trips are independent: batch them (bounded)
+        # instead of one awaited op per object
+        BATCH = 32
+        oids = [o for o in await self.meta.list_objects()
+                if o.startswith("inode.")]
+        inodes: "dict[int, dict]" = {}
+        for i in range(0, len(oids), BATCH):
+            for ino, meta in await asyncio.gather(
+                    *(_read_inode_entry(o) for o in oids[i:i + BATCH])):
+                inodes[ino] = meta
+        refcount: "dict[int, int]" = {}
+        dangling: "List[Tuple[int, str, int]]" = []
+        dirs = [ino for ino, meta in inodes.items()
+                if meta.get("type") == "dir"]
+        for i in range(0, len(dirs), BATCH):
+            batch = dirs[i:i + BATCH]
+            all_ents = await asyncio.gather(
+                *(self.meta.omap_get(_inode_oid(d)) for d in batch))
+            for ino, ents in zip(batch, all_ents):
+                for name, raw in ents.items():
+                    rec = json.loads(raw.decode())
+                    child = int(rec["ino"])
+                    if child not in inodes:
+                        dangling.append((ino, name, child))
+                    else:
+                        refcount[child] = refcount.get(child, 0) + 1
+        orphans = [ino for ino in inodes
+                   if ino != ROOT_INO and refcount.get(ino, 0) == 0]
+        nlink_bad = []
+        for ino, meta in inodes.items():
+            if meta.get("type") in ("file", "symlink"):
+                want = refcount.get(ino, 0)
+                have = int(meta.get("nlink", 1))
+                if want > 0 and have != want:
+                    nlink_bad.append((ino, have, want))
+        report = {"inodes": len(inodes), "dangling": dangling,
+                  "orphans": orphans, "nlink": nlink_bad,
+                  "repaired": False}
+        if not repair or not (dangling or orphans or nlink_bad):
+            return report
+        steps: "List[dict]" = []
+        for dir_ino, name, _child in dangling:
+            steps.append(self._s_unlink(dir_ino, name))
+        if orphans:
+            lf = await self.meta.omap_get(_inode_oid(ROOT_INO),
+                                          [LOST_FOUND])
+            if lf:
+                lf_ino = int(json.loads(
+                    lf[LOST_FOUND].decode())["ino"])
+            else:
+                lf_ino = await self._alloc_ino()
+                steps.append(self._s_inode(
+                    lf_ino, {"type": "dir", "mode": 0o700,
+                             "mtime": time.time()}))
+                steps.append(self._s_link(ROOT_INO, LOST_FOUND,
+                                          lf_ino, "dir"))
+            for ino in orphans:
+                kind = inodes[ino].get("type", "file")
+                steps.append(self._s_link(lf_ino, f"ino.{ino:x}",
+                                          ino, kind))
+        for ino, _have, want in nlink_bad:
+            fixed = dict(inodes[ino])
+            fixed["nlink"] = want
+            steps.append(self._s_inode(ino, fixed))
+        await self.mdlog.transact("fsck_repair", steps)
+        report["repaired"] = True
+        return report

@@ -1,0 +1,136 @@
+"""MDLog — metadata journal giving multi-step namespace ops crash
+atomicity (reference src/mds/MDLog.h:61 + src/mds/journal.cc EUpdate:
+the MDS appends an intent event to a journal in the metadata pool,
+applies the dirty state, and trims the journal once the apply is safe;
+a crashed MDS replays the journal on rejoin).
+
+Design here — a redo log of IDEMPOTENT absolute-value steps:
+
+1. ``transact(op, steps)`` appends ONE journal record (a single atomic
+   omap_set on the ``mdlog`` object) describing every mutation the op
+   will make, with absolute values (full inode bodies, final dirent
+   bytes) — never increments — so replay can re-apply blindly.
+2. The steps are then applied, each an atomic single-object RADOS op.
+3. The record trims (one omap_rm) as soon as the apply completes —
+   the journal holds IN-FLIGHT ops only.  Eager trim is a correctness
+   requirement, not tuning: later inode updates (file size/mtime) are
+   not journaled, so replaying an already-completed record after them
+   would resurrect the older inode body.  (The MDS avoids the same
+   hazard by journaling every dirty field until expire; this design
+   trades one extra round trip per namespace op for a journal that
+   never holds completed state.)
+
+Crash anywhere mid-apply leaves the record in the journal; ``open()``
+on mount re-applies every surviving record in sequence order, rolling
+the namespace FORWARD to each op's committed end state.  Record append
+is atomic, so an op either never happened (crash before append) or
+completes on next mount — the same guarantee MDS journaling provides.
+
+Step vocabulary (all idempotent):
+  {"t": "omap_set", "oid", "key", "val" (hex)}   — dirent link
+  {"t": "omap_rm",  "oid", "key"}                — dirent unlink
+  {"t": "write",    "oid", "val" (hex)}          — inode write_full
+  {"t": "remove",   "oid"}                       — inode delete
+  {"t": "strip_rm", "base"}                      — striped file data
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+from typing import List
+
+MDLOG_OID = "mdlog"
+
+
+class MDLogDamaged(Exception):
+    """A transact's apply failed mid-way: the journal holds a record
+    whose steps are partially on disk.  Further mutations through this
+    handle are refused until ``open()`` replays — the analog of the
+    reference MDS marking its rank damaged on journal errors
+    (src/mds/MDSRank.cc damaged()) rather than writing past them."""
+
+
+class MDLog:
+    """Single-active-writer journal, like one MDS rank: the reference
+    mon guarantees one active MDS per rank; here the caller must not
+    mount the same filesystem for writing from two live clients
+    (replay on mount would race a live writer's in-flight records).
+    Journal keys carry a per-mount nonce so even a misbehaving second
+    writer cannot silently overwrite another's record."""
+
+    def __init__(self, meta_io, striper) -> None:
+        self.meta = meta_io
+        self.striper = striper
+        self._seq = 0
+        self._nonce = secrets.token_hex(4)
+        self.damaged = False
+        # test hook: raise after applying N steps (crash injection)
+        self.fail_after_steps: "int | None" = None
+
+    # --- lifecycle ------------------------------------------------------------
+
+    async def open(self) -> int:
+        """Recover the append position and REPLAY surviving records.
+        Returns the number of records replayed."""
+        entries = await self.meta.omap_get(MDLOG_OID)
+        replayed = 0
+        for key in sorted(entries):     # seq-major: "seq.nonce"
+            rec = json.loads(entries[key].decode())
+            await self._apply(rec["steps"])
+            await self.meta.omap_rm(MDLOG_OID, [key])
+            self._seq = max(self._seq, int(key.split(".")[0], 16))
+            replayed += 1
+        self.damaged = False
+        return replayed
+
+    # --- the transaction ------------------------------------------------------
+
+    async def transact(self, op: str, steps: "List[dict]") -> None:
+        """Journal then apply.  The journal append is one atomic
+        omap_set; every step is itself one atomic RADOS op; the record
+        trims the moment the last step lands.  If an apply step FAILS
+        (exception, process alive) the handle goes damaged: the record
+        must replay via ``open()`` before further mutations, otherwise
+        a retry would build new state a later replay of the stale
+        record would clobber."""
+        if self.damaged:
+            raise MDLogDamaged(
+                "mdlog has a partially-applied record; re-open/mount "
+                "to replay before further namespace mutations")
+        self._seq += 1
+        key = f"{self._seq:016x}.{self._nonce}"
+        rec = json.dumps({"op": op, "steps": steps}).encode()
+        await self.meta.omap_set(MDLOG_OID, {key: rec})
+        try:
+            await self._apply(steps)
+        except Exception:
+            self.damaged = True
+            raise
+        await self.meta.omap_rm(MDLOG_OID, [key])
+
+    async def _apply(self, steps: "List[dict]") -> None:
+        for n, s in enumerate(steps):
+            if self.fail_after_steps is not None \
+                    and n >= self.fail_after_steps:
+                raise RuntimeError(
+                    f"mdlog crash injection after {n} steps")
+            t = s["t"]
+            if t == "omap_set":
+                await self.meta.omap_set(
+                    s["oid"], {s["key"]: bytes.fromhex(s["val"])})
+            elif t == "omap_rm":
+                await self.meta.omap_rm(s["oid"], [s["key"]])
+            elif t == "write":
+                await self.meta.write_full(
+                    s["oid"], bytes.fromhex(s["val"]))
+            elif t == "remove":
+                try:
+                    await self.meta.remove(s["oid"])
+                except Exception:  # noqa: BLE001 — replay idempotence
+                    pass
+            elif t == "strip_rm":
+                await self.striper.remove(s["base"], missing_ok=True)
+            else:
+                raise ValueError(f"unknown mdlog step {t!r}")
+

@@ -81,9 +81,16 @@ class Objecter(Dispatcher):
     # --- submit (reference op_submit Objecter.cc:2256) -----------------------
 
     async def op_submit(self, pool_id: int, oid: str, ops: "List[dict]",
-                        data: bytes = b"") -> "Tuple[List[dict], bytes]":
+                        data: bytes = b"",
+                        pg: "Optional[int]" = None
+                        ) -> "Tuple[List[dict], bytes]":
         """Send ops to the object's primary; retry on resets/down primary
-        (the reference requeues on every new map epoch)."""
+        (the reference requeues on every new map epoch).
+
+        ``pg`` pins the target PG instead of hashing ``oid`` — the PGLS
+        path (reference Objecter::_pg_read / CEPH_OSD_OP_PGNLS), which
+        enumerates a pool one PG at a time and never redirects through
+        a cache tier (it lists the pool it was asked about)."""
         last_err: "Optional[Exception]" = None
         # one tid per *logical* op: retries reuse it, and the server-side
         # reqid dedup (reference osd_reqid_t in the PG log) keeps a
@@ -92,14 +99,22 @@ class Objecter(Dispatcher):
         reqid = f"{self.ms.name}:{tid}"
         renewed = False
         for attempt in range(self.max_retries):
-            tgt_pool, pg, primary = self.calc_target(pool_id, oid)
+            if pg is not None:
+                tgt_pool, tgt_pg = pool_id, pg
+                _up, acting = self.osdmap.pg_to_up_acting_osds(
+                    pool_id, pg)
+                primary = next((o for o in acting if o != NONE_OSD),
+                               NONE_OSD)
+            else:
+                tgt_pool, tgt_pg, primary = self.calc_target(pool_id, oid)
             if primary == NONE_OSD:
-                last_err = ObjecterError(f"pg {tgt_pool}.{pg} has no primary")
+                last_err = ObjecterError(
+                    f"pg {tgt_pool}.{tgt_pg} has no primary")
                 await asyncio.sleep(self.backoff * (attempt + 1))
                 continue
             fut = asyncio.get_event_loop().create_future()
             self._inflight[tid] = fut
-            fields = {"tid": tid, "pool": tgt_pool, "pg": pg,
+            fields = {"tid": tid, "pool": tgt_pool, "pg": tgt_pg,
                       "oid": oid, "ops": ops, "reqid": reqid,
                       # root span: born at the client op and threaded
                       # through every sub-op it causes (reference

@@ -106,6 +106,30 @@ class IoCtx:
     async def remove(self, oid: str) -> None:
         await self._submit(oid, [{"op": "delete"}])
 
+    async def list_objects(self) -> "list[str]":
+        """Enumerate every object in the pool, one PGLS per PG
+        (reference rados_nobjects_list -> Objecter pg-indexed listing).
+        A pool fronted by a cache tier lists BOTH pools and unions the
+        names — dirty objects may exist only in the tier (normal reads
+        redirect there; the pg-pinned PGLS path does not).  Names are
+        merged and sorted; concurrent writers give the usual listing
+        semantics (no snapshot isolation)."""
+        names: "set[str]" = set()
+        pool_ids = [self.pool_id]
+        tier = getattr(self.client.osdmap.pools[self.pool_id],
+                       "cache_tier", None)
+        if tier is not None:
+            pool_ids.append(int(tier))
+        for pid in pool_ids:
+            pool = self.client.osdmap.pools[pid]
+            for pg in range(pool.pg_num):
+                outs, blob = await self.client.objecter.op_submit(
+                    pid, "", [{"op": "pgls"}], pg=pg)
+                lens = [o["dlen"] for o in outs if o.get("op") == "pgls"]
+                for buf in unpack_buffers(lens, blob):
+                    names.update(json.loads(buf.decode()))
+        return sorted(names)
+
     async def cache_flush(self, oid: str) -> int:
         """CEPH_OSD_OP_CACHE_FLUSH: push a dirty cached object to the
         base pool (no-op when clean).  Returns 1 if a flush happened."""

@@ -89,7 +89,7 @@ def _device_encode_step(c_bytes: bytes, m: int, k: int, with_crc: bool):
     def run(d):
         from ...ops import fused_pallas
         if (with_crc and d.ndim == 4 and fused_pallas.supported_matrix(
-                m, d.shape[-2] * d.shape[-1], k)):
+                m, d.shape[-2] * d.shape[-1], k, B=d.shape[0])):
             return fused_pallas.fused_encode_crc_matrix(C, d)
         if d.ndim == 4:            # segmented layout, fused unsupported
             B, k_, S, sw = d.shape

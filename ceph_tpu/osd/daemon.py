@@ -989,6 +989,16 @@ class OSDDaemon(Dispatcher):
                         {k: v.hex() for k, v in kv.items()}).encode()
                     outs.append({"op": "omap_get", "dlen": len(blob_out)})
                     out_bufs.append(blob_out)
+                elif name == "pgls":
+                    # CEPH_OSD_OP_PGNLS: enumerate this PG's objects at
+                    # the primary (reference PrimaryLogPG::do_pg_op).
+                    # Serves `rados ls`, cephfs fsck, and the
+                    # objectstore tool's online cross-check.
+                    await be.ensure_active()
+                    names = be._list_objects(max(0, be.my_shard))
+                    blob_out = json.dumps(names).encode()
+                    outs.append({"op": "pgls", "dlen": len(blob_out)})
+                    out_bufs.append(blob_out)
                 elif name == "omap_keys":
                     await be.ensure_active()
                     await be.wait_readable(oid)

@@ -164,12 +164,14 @@ class EncodeService:
         for i, r in enumerate(reqs):
             batch[i] = r.data
         with_crc = any(r.with_crc for r in reqs)
-        from ..ops.fused_pallas import SEG_W, seg_w_for
+        from ..ops.fused_pallas import seg_w_for
         u32 = batch.view(np.uint32).reshape(Bb, k, W // 4)
-        if (W // 4) % SEG_W == 0:
+        if (W // 4) % 128 == 0:
             # segmented device-native layout (free host-side view): the
             # fused Pallas step takes this rank directly; a traced 3-D
-            # reshape on TPU would cost a ~30% relayout (ROOFLINE.md)
+            # reshape on TPU would cost a ~30% relayout (ROOFLINE.md).
+            # Segments go down to 128 words so sub-2KiB chunks reach
+            # the packed small-chunk kernel.
             sw = seg_w_for(W // 4, k, m)
             u32 = u32.reshape(Bb, k, W // 4 // sw, sw)
 

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Probe the packed small-chunk fused kernel: correctness vs the host
+codec and a pack-factor sweep at the reference's small-object operating
+points (8 KiB chunks = 64 KiB stripe, and 512 B chunks = 4 KiB objects,
+qa/workunits/erasure-code/bench.sh).  TPU-only; writes one JSON line.
+
+Usage: python tools/packed_probe.py [--sweep]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.ops import fused_pallas, gf8  # noqa: E402
+from ceph_tpu.ops.crc32c import crc32c  # noqa: E402
+
+
+def host_check(C, data_u32, parity, crcs):
+    """Golden-check parity + crcs for a few stripes against host math."""
+    B, k, W = data_u32.shape
+    m = C.shape[0]
+    for b in (0, B // 2, B - 1):
+        d8 = data_u32[b].view(np.uint8).reshape(k, 4 * W)
+        p8 = np.asarray(parity[b]).view(np.uint8).reshape(m, 4 * W)
+        want = gf8.gf_mat_encode(C, d8)
+        assert np.array_equal(p8, want), f"parity mismatch stripe {b}"
+        for j in range(k):
+            assert crcs[b, j] == crc32c(d8[j].tobytes()), (b, j)
+        for i in range(m):
+            assert crcs[b, k + i] == crc32c(p8[i].tobytes()), (b, i)
+
+
+def bench_one(k, m, chunk_bytes, batch, pack):
+    """GiB/s via the tunnel-safe chained recipe (utils/devtime.py) plus
+    one eager call for the correctness outputs."""
+    W = chunk_bytes // 4
+    C = gf8.xor_min_matrix(k, m)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 2**32, (batch, k, W), dtype=np.uint32)
+    sw = fused_pallas.seg_w_for(W, k, m)
+    d4 = data.reshape(batch, k, W // sw, sw)
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.utils.devtime import chained_time
+    d4j = jax.device_put(d4)
+    parity, crcs = fused_pallas.fused_encode_crc_matrix(C, d4j, pack=pack)
+    jax.block_until_ready((parity, crcs))
+
+    run = fused_pallas._build_fused(C.tobytes(), m, k, W, pack)
+
+    def body(i, d):
+        par, cr = run(d)
+        s = jnp.sum(par, dtype=jnp.uint32) ^ jnp.sum(cr, dtype=jnp.uint32)
+        return d.at[:, 0, 0, 0].set(d[:, 0, 0, 0] ^ s)
+
+    dt = chained_time(body, d4j, iters_hi=64, min_signal_s=0.3)
+    gibs = batch * k * chunk_bytes / dt / 2**30
+    return gibs, parity, np.asarray(crcs), data
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sweep", action="store_true")
+    args = p.parse_args()
+    import jax
+    assert jax.devices()[0].platform != "cpu", "TPU required"
+
+    out = {"metric": "packed_probe", "rows": []}
+    # correctness first: 8 KiB and 512 B chunks, packed
+    for k, m, cb, batch in ((8, 3, 8192, 64), (8, 3, 512, 256),
+                            (4, 2, 2048, 128), (10, 4, 4096, 64)):
+        C = gf8.xor_min_matrix(k, m)
+        pack = fused_pallas.pick_pack(batch, cb // 4, k, m)
+        gibs, parity, crcs, data = bench_one(k, m, cb, batch, pack)
+        par3 = np.asarray(parity).reshape(batch, m, cb // 4)
+        host_check(C, data, par3, crcs)
+        out["rows"].append({"check": f"k{k}m{m}_chunk{cb}", "pack": pack,
+                            "ok": True, "gibs": round(gibs, 2)})
+    if args.sweep:
+        for cb in (8192, 2048, 512):
+            W = cb // 4
+            for pack in (1, 8, 16, 32):
+                try:
+                    gibs, *_ = bench_one(8, 3, cb, 128, pack)
+                except Exception as e:  # noqa: BLE001
+                    out["rows"].append({"cfg": f"chunk{cb}_pack{pack}",
+                                        "error": str(e)[:120]})
+                    continue
+                out["rows"].append({"cfg": f"chunk{cb}_pack{pack}",
+                                    "gibs": round(gibs, 2)})
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
