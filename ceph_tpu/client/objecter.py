@@ -103,8 +103,7 @@ class Objecter(Dispatcher):
                 tgt_pool, tgt_pg = pool_id, pg
                 _up, acting = self.osdmap.pg_to_up_acting_osds(
                     pool_id, pg)
-                primary = next((o for o in acting if o != NONE_OSD),
-                               NONE_OSD)
+                primary = self.osdmap.primary_of(acting)
             else:
                 tgt_pool, tgt_pg, primary = self.calc_target(pool_id, oid)
             if primary == NONE_OSD:

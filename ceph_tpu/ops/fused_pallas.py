@@ -352,13 +352,17 @@ def pick_pack(B: int, W: int, k: int, m: int) -> int:
     """Stripes per kernel block for the small-chunk path.
 
     Targets >=128 MXU rows per crc matmul (P*4*S rows) and caps the
-    per-block data VMEM at 2 MiB; P must divide the batch.  W >= 4096
-    words runs the measured-tuned unpacked kernel (P=1)."""
+    per-block data VMEM at 1 MiB — with the 8 MiB M1 constant resident
+    (seg_w=1024 geometries), a 2 MiB data block failed to compile on
+    v5e (packed_probe chunk8192_pack32).  P must divide the batch.
+    W >= 4096 words runs the measured-tuned unpacked kernel (P=1).
+    Measured (chained timing, v5e): 8 KiB chunks 33.5 -> 67.9 GiB/s
+    at P=16; 2 KiB 15.4 -> 39.8 at P=32; 512 B 9.3 -> 20.2 at P=32."""
     if W >= 4096 or B <= 1:
         return 1
     S = max(1, W // seg_w_for(W, k, m))
     t = max(1, 128 // (4 * S))
-    cap = max(1, (2 << 20) // (k * W * 4))
+    cap = max(1, (1 << 20) // (k * W * 4))
     t = min(t, cap, B, 64)
     while t > 1 and B % t:
         t -= 1

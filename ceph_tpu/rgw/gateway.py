@@ -26,10 +26,24 @@ S3 semantics kept: parts may arrive in any order and concurrently, a
 re-uploaded part number replaces the old one, the completed etag is
 ``md5(md5(part1)||...)-N``.
 
-Auth (optional, S3 SigV4-shaped): register users with ``add_user``;
-requests then must carry ``x-rgw-date`` and ``Authorization:
-RGW1 <access>:<hex hmac-sha256(secret, method\npath\ndate\nsha256(body))>``.
+Auth (optional): register users with ``add_user``; requests then must
+be signed.  TWO schemes are accepted:
+- **AWS SigV4** (``Authorization: AWS4-HMAC-SHA256 Credential=...``):
+  the real algorithm (sigv4.py, pinned to AWS's published test
+  vector), so stock S3 clients' signatures verify unmodified —
+  reference rgw_auth_s3.h:419.
+- legacy ``RGW1 <access>:<hmac>`` (kept for old callers).
 No users registered = open access (dev mode).
+
+Versioning (S3 bucket versioning, reference rgw versioned buckets):
+  PUT  /bucket?versioning  {"Status": "Enabled"|"Suspended"}
+  GET  /bucket?versioning
+  GET  /bucket?versions[&prefix=]         list all versions
+  GET/HEAD/DELETE /bucket/key?versionId=V
+With versioning enabled each PUT allocates a version id and archives
+the previous current entry; DELETE inserts a delete marker (the key
+404s but old versions stay readable); DELETE with versionId removes
+that version permanently.
 """
 
 from __future__ import annotations
@@ -44,6 +58,7 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
 from ..client.striper import RadosStriper
+from . import sigv4
 
 BUCKETS_OID = ".buckets"
 
@@ -58,8 +73,17 @@ def _index_oid(bucket: str) -> str:
     return f".bucket.index.{bucket}"
 
 
-def _data_oid(bucket: str, key: str) -> str:
-    return f"data.{bucket}.{hashlib.sha256(key.encode()).hexdigest()}"
+def _data_oid(bucket: str, key: str, vid: "Optional[str]" = None) -> str:
+    base = f"data.{bucket}.{hashlib.sha256(key.encode()).hexdigest()}"
+    return f"{base}.{vid}" if vid else base
+
+
+def _versions_oid(bucket: str) -> str:
+    return f".versions.{bucket}"
+
+
+def _ver_key(key: str, vid: str) -> str:
+    return f"{key}\x00{vid}"
 
 
 def _upload_oid(bucket: str, upload_id: str) -> str:
@@ -117,6 +141,8 @@ class Gateway:
         if not self._users:
             return
         auth = headers.get("authorization", "")
+        if auth.startswith(sigv4.ALGORITHM):
+            return self._check_sigv4(method, rawpath, headers, body)
         date = headers.get("x-rgw-date", "")
         if not auth.startswith("RGW1 ") or ":" not in auth:
             raise RGWError("missing/malformed authorization", 403)
@@ -136,6 +162,33 @@ class Gateway:
         want = self.sign(secret, method, rawpath, date, body)
         if not hmac_mod.compare_digest(want, sig.strip()):
             raise RGWError("signature mismatch", 403)
+
+    def _check_sigv4(self, method: str, rawpath: str,
+                     headers: "Dict[str, str]", body: bytes) -> None:
+        """Real AWS SigV4 (sigv4.py): the scheme stock S3 clients
+        emit.  Skew-bounded via x-amz-date like S3's 15-minute
+        window."""
+        try:
+            access, _scope, _signed, _sig = sigv4.parse_authorization(
+                headers.get("authorization", ""))
+        except sigv4.SigV4Error as e:
+            raise RGWError(f"bad sigv4 authorization: {e}", 403)
+        secret = self._users.get(access)
+        if secret is None:
+            raise RGWError(f"unknown access key {access!r}", 403)
+        amz_date = headers.get("x-amz-date", "")
+        try:
+            import calendar
+            ts = calendar.timegm(
+                time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise RGWError("bad x-amz-date", 403)
+        if abs(time.time() - ts) > self.AUTH_MAX_SKEW:
+            raise RGWError("request time too skewed (replay?)", 403)
+        try:
+            sigv4.verify(secret, method, rawpath, headers, body)
+        except sigv4.SigV4Error as e:
+            raise RGWError(f"sigv4 verification failed: {e}", 403)
 
     # --- buckets --------------------------------------------------------------
 
@@ -160,8 +213,16 @@ class Gateway:
             raise RGWError(
                 f"bucket {bucket!r} has in-progress multipart uploads",
                 409)
+        vers = await self.list_object_versions(bucket)
+        if any(not v.get("delete_marker") for v in vers):
+            raise RGWError(
+                f"bucket {bucket!r} still holds object versions", 409)
         await self.meta.omap_rm(BUCKETS_OID, [bucket])
         await self.meta.remove(_index_oid(bucket))
+        try:
+            await self.meta.remove(_versions_oid(bucket))
+        except Exception:  # noqa: BLE001 — never versioned
+            pass
 
     async def list_multipart_uploads(self, bucket: str) -> "List[str]":
         try:
@@ -170,30 +231,113 @@ class Gateway:
         except Exception:  # noqa: BLE001 — registry object absent
             return []
 
-    async def _require_bucket(self, bucket: str) -> None:
-        if not await self.meta.omap_get(BUCKETS_OID, [bucket]):
+    async def _require_bucket(self, bucket: str) -> dict:
+        rec = await self.meta.omap_get(BUCKETS_OID, [bucket])
+        if not rec:
             raise RGWError(f"no bucket {bucket!r}", 404)
+        return json.loads(rec[bucket].decode())
+
+    # --- versioning (S3 bucket versioning; reference rgw versioned
+    # --- buckets: rgw_op.cc RGWSetBucketVersioning + versioned index) --------
+
+    async def set_versioning(self, bucket: str, status: str) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise RGWError(f"bad versioning status {status!r}")
+        rec = await self._require_bucket(bucket)
+        rec["versioning"] = status
+        await self.meta.omap_set(BUCKETS_OID,
+                                 {bucket: json.dumps(rec).encode()})
+
+    async def get_versioning(self, bucket: str) -> str:
+        rec = await self._require_bucket(bucket)
+        return rec.get("versioning", "Off")
+
+    async def _archive_current(self, bucket: str, key: str,
+                               meta: dict) -> None:
+        """Move the current index entry into the version archive.  A
+        pre-versioning entry (no version_id) archives as 'null', the
+        S3 null-version convention."""
+        vid = meta.get("version_id", "null")
+        await self.meta.omap_set(_versions_oid(bucket), {
+            _ver_key(key, vid): json.dumps(meta).encode()})
+
+    async def list_object_versions(self, bucket: str,
+                                   prefix: str = "") -> "List[dict]":
+        """All versions, current first per key, then newest-first."""
+        await self._require_bucket(bucket)
+        out: "List[dict]" = []
+        idx = await self.meta.omap_get(_index_oid(bucket))
+        for key, raw in idx.items():
+            if not key.startswith(prefix):
+                continue
+            meta = json.loads(raw.decode())
+            out.append({"key": key, "is_latest": True, **meta})
+        try:
+            vers = await self.meta.omap_get(_versions_oid(bucket))
+        except Exception:  # noqa: BLE001 — no archive object yet
+            vers = {}
+        for vk, raw in vers.items():
+            key, _, _vid = vk.partition("\x00")
+            if not key.startswith(prefix):
+                continue
+            meta = json.loads(raw.decode())
+            out.append({"key": key, "is_latest": False, **meta})
+        out.sort(key=lambda m: (m["key"], -float(m.get("mtime", 0))))
+        return out
 
     # --- objects --------------------------------------------------------------
 
+    def _retain_policy(self, brec: dict, cur: "Optional[dict]"
+                       ) -> "Tuple[bool, bool]":
+        """(archive_cur, reap_cur) for an overwrite of ``cur`` under
+        the bucket's versioning state.  Enabled: every previous
+        current is retained (a pre-versioning entry archives as the
+        'null' version).  Suspended (S3 semantics): versions with real
+        ids are retained, the null version is overwritten.  Off:
+        nothing is retained."""
+        if cur is None:
+            return False, False
+        status = brec.get("versioning", "Off")
+        if status == "Enabled":
+            return True, False
+        if status == "Suspended" and cur.get("version_id"):
+            return True, False
+        return False, True
+
     async def put_object(self, bucket: str, key: str,
                          data: bytes) -> dict:
-        await self._require_bucket(bucket)
+        brec = await self._require_bucket(bucket)
+        enabled = brec.get("versioning") == "Enabled"
         old = await self.meta.omap_get(_index_oid(bucket), [key])
-        await self.striper.write_full(_data_oid(bucket, key), data)
+        cur = json.loads(old[key].decode()) if old else None
+        archive, reap = self._retain_policy(brec, cur)
+        if archive:
+            # BEFORE touching the index: a crash between archive and
+            # index write must never lose the previous version (the
+            # same torn-state class cephfs closes with the mdlog); a
+            # retried put re-archives the same record idempotently
+            await self._archive_current(bucket, key, cur)
+        vid = os.urandom(8).hex() if enabled else None
+        oid = _data_oid(bucket, key, vid)
+        await self.striper.write_full(oid, data)
         etag = hashlib.md5(data).hexdigest()
-        meta = {"size": len(data), "etag": etag, "mtime": time.time()}
+        meta = {"size": len(data), "etag": etag, "mtime": time.time(),
+                "oid": oid}
+        if vid:
+            meta["version_id"] = vid
         await self.meta.omap_set(_index_oid(bucket),
                                  {key: json.dumps(meta).encode()})
-        if old:
-            # overwriting a multipart object reaps its part blobs
-            old_meta = json.loads(old[key].decode())
-            for p in old_meta.get("parts", []):
+        if reap:
+            for p in cur.get("parts", []):
                 await self.striper.remove(p["oid"])
+            ooid = cur.get("oid", _data_oid(bucket, key))
+            if ooid != oid and "parts" not in cur \
+                    and not cur.get("delete_marker"):
+                await self.striper.remove(ooid, missing_ok=True)
         return meta
 
-    async def get_object(self, bucket: str, key: str) -> bytes:
-        meta = await self.head_object(bucket, key)
+    async def _read_meta_blob(self, bucket: str, key: str,
+                              meta: dict) -> bytes:
         if "parts" in meta:
             # manifest object (multipart): concatenate part blobs
             out = []
@@ -201,24 +345,119 @@ class Gateway:
                 blob = await self.striper.read(p["oid"])
                 out.append(blob[: p["size"]])
             return b"".join(out)
-        data = await self.striper.read(_data_oid(bucket, key))
+        data = await self.striper.read(
+            meta.get("oid", _data_oid(bucket, key)))
         return data[:meta["size"]]
 
-    async def head_object(self, bucket: str, key: str) -> dict:
+    async def get_object(self, bucket: str, key: str,
+                         version_id: "Optional[str]" = None) -> bytes:
+        meta = await self.head_object(bucket, key, version_id)
+        return await self._read_meta_blob(bucket, key, meta)
+
+    async def head_object(self, bucket: str, key: str,
+                          version_id: "Optional[str]" = None) -> dict:
         await self._require_bucket(bucket)
         entry = await self.meta.omap_get(_index_oid(bucket), [key])
-        if not entry:
-            raise RGWError(f"no key {key!r}", 404)
-        return json.loads(entry[key].decode())
+        cur = json.loads(entry[key].decode()) if entry else None
+        if version_id is None:
+            if cur is None or cur.get("delete_marker"):
+                raise RGWError(f"no key {key!r}", 404)
+            return cur
+        if cur is not None and \
+                cur.get("version_id", "null") == version_id:
+            if cur.get("delete_marker"):
+                raise RGWError(f"{key!r} version {version_id} is a "
+                               f"delete marker", 404)
+            return cur
+        vk = _ver_key(key, version_id)
+        rec = await self.meta.omap_get(_versions_oid(bucket), [vk])
+        if not rec:
+            raise RGWError(f"no key {key!r} version {version_id}", 404)
+        meta = json.loads(rec[vk].decode())
+        if meta.get("delete_marker"):
+            raise RGWError(f"{key!r} version {version_id} is a "
+                           f"delete marker", 404)
+        return meta
 
-    async def delete_object(self, bucket: str, key: str) -> None:
-        meta = await self.head_object(bucket, key)
+    async def _reap_version_blobs(self, bucket: str, key: str,
+                                  meta: dict) -> None:
+        if meta.get("delete_marker"):
+            return
         if "parts" in meta:
             for p in meta["parts"]:
                 await self.striper.remove(p["oid"])
         else:
-            await self.striper.remove(_data_oid(bucket, key))
-        await self.meta.omap_rm(_index_oid(bucket), [key])
+            await self.striper.remove(
+                meta.get("oid", _data_oid(bucket, key)),
+                missing_ok=True)
+
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: "Optional[str]" = None
+                            ) -> "Optional[dict]":
+        brec = await self._require_bucket(bucket)
+        status = brec.get("versioning", "Off")
+        entry = await self.meta.omap_get(_index_oid(bucket), [key])
+        cur = json.loads(entry[key].decode()) if entry else None
+        if version_id is None:
+            if status in ("Enabled", "Suspended"):
+                # S3 semantics: insert a delete marker.  Enabled gives
+                # the marker a real id and retains the current;
+                # Suspended inserts the null marker, retaining only
+                # real-id currents (the null version is destroyed).
+                archive, reap = self._retain_policy(brec, cur)
+                if archive:
+                    await self._archive_current(bucket, key, cur)
+                marker = {"delete_marker": True,
+                          "version_id": (os.urandom(8).hex()
+                                         if status == "Enabled"
+                                         else "null"),
+                          "mtime": time.time()}
+                await self.meta.omap_set(_index_oid(bucket), {
+                    key: json.dumps(marker).encode()})
+                if reap:
+                    await self._reap_version_blobs(bucket, key, cur)
+                return marker
+            if cur is None:
+                raise RGWError(f"no key {key!r}", 404)
+            await self._reap_version_blobs(bucket, key, cur)
+            await self.meta.omap_rm(_index_oid(bucket), [key])
+            return None
+        # permanent delete of one version
+        if cur is not None and \
+                cur.get("version_id", "null") == version_id:
+            await self._reap_version_blobs(bucket, key, cur)
+            await self.meta.omap_rm(_index_oid(bucket), [key])
+            await self._promote_latest(bucket, key)
+            return None
+        vk = _ver_key(key, version_id)
+        rec = await self.meta.omap_get(_versions_oid(bucket), [vk])
+        if not rec:
+            raise RGWError(f"no key {key!r} version {version_id}", 404)
+        await self._reap_version_blobs(
+            bucket, key, json.loads(rec[vk].decode()))
+        await self.meta.omap_rm(_versions_oid(bucket), [vk])
+        return None
+
+    async def _promote_latest(self, bucket: str, key: str) -> None:
+        """After deleting the current version by id, the newest
+        archived version becomes current again (S3 behavior)."""
+        try:
+            vers = await self.meta.omap_get(_versions_oid(bucket))
+        except Exception:  # noqa: BLE001 — no archive
+            return
+        best_vk, best = None, None
+        for vk, raw in vers.items():
+            k, _, _vid = vk.partition("\x00")
+            if k != key:
+                continue
+            meta = json.loads(raw.decode())
+            if best is None or float(meta.get("mtime", 0)) > \
+                    float(best.get("mtime", 0)):
+                best_vk, best = vk, meta
+        if best_vk is not None:
+            await self.meta.omap_set(_index_oid(bucket), {
+                key: json.dumps(best).encode()})
+            await self.meta.omap_rm(_versions_oid(bucket), [best_vk])
 
     # --- multipart (reference rgw multipart: parts as separate blobs,
     # --- complete writes a manifest, no data copy) ----------------------------
@@ -301,20 +540,30 @@ class Gateway:
         meta = {"size": sum(p["size"] for p in manifest), "etag": etag,
                 "mtime": time.time(), "parts": manifest,
                 "upload_id": upload_id}
+        brec = await self._require_bucket(bucket)
+        if brec.get("versioning") == "Enabled":
+            meta["version_id"] = os.urandom(8).hex()
         old = await self.meta.omap_get(_index_oid(bucket), [key])
+        cur = json.loads(old[key].decode()) if old else None
+        archive, reap = self._retain_policy(brec, cur)
+        if archive:
+            # a multipart completion is a write like any other: the
+            # previous current version is retained, not destroyed
+            await self._archive_current(bucket, key, cur)
         await self.meta.omap_set(_index_oid(bucket),
                                  {key: json.dumps(meta).encode()})
-        # reap (a) the overwritten object's blobs, (b) abandoned parts
-        # (uploaded but not in the final list)
+        # reap (a) the overwritten object's blobs (unless retained as
+        # a version), (b) abandoned parts (uploaded, not in the list)
         kept = {m["oid"] for m in manifest}
-        if old:
-            old_meta = json.loads(old[key].decode())
-            if "parts" in old_meta:
-                for p in old_meta["parts"]:
+        if reap:
+            if "parts" in cur:
+                for p in cur["parts"]:
                     if p["oid"] not in kept:
                         await self.striper.remove(p["oid"])
-            else:
-                await self.striper.remove(_data_oid(bucket, key))
+            elif not cur.get("delete_marker"):
+                await self.striper.remove(
+                    cur.get("oid", _data_oid(bucket, key)),
+                    missing_ok=True)
         for p in have.values():
             if p["oid"] not in kept:
                 await self.striper.remove(p["oid"])
@@ -331,9 +580,14 @@ class Gateway:
 
     async def list_objects(self, bucket: str,
                            prefix: str = "") -> "List[str]":
+        """Current keys only; keys whose latest version is a delete
+        marker are hidden (S3 ListObjects semantics)."""
         await self._require_bucket(bucket)
-        keys = await self.meta.omap_keys(_index_oid(bucket))
-        return [k for k in keys if k.startswith(prefix)]
+        idx = await self.meta.omap_get(_index_oid(bucket))
+        return sorted(
+            k for k, raw in idx.items()
+            if k.startswith(prefix)
+            and not json.loads(raw.decode()).get("delete_marker"))
 
     # --- HTTP front end -------------------------------------------------------
 
@@ -400,6 +654,24 @@ class Gateway:
             raise RGWError("bad request")
         if len(parts) == 1:
             bucket = parts[0]
+            if "versioning" in query:
+                if method == "PUT":
+                    try:
+                        status = str(json.loads(
+                            body.decode())["Status"])
+                    except (ValueError, KeyError, TypeError):
+                        raise RGWError("bad versioning body")
+                    await self.set_versioning(bucket, status)
+                    return 200, b"", "text/plain"
+                if method == "GET":
+                    return 200, json.dumps({
+                        "Status": await self.get_versioning(bucket)
+                    }).encode(), "application/json"
+                raise RGWError("bad versioning method")
+            if "versions" in query and method == "GET":
+                return 200, json.dumps(await self.list_object_versions(
+                    bucket, query.get("prefix", ""))).encode(), \
+                    "application/json"
             if method == "PUT":
                 await self.create_bucket(bucket)
                 return 201, b"", "text/plain"
@@ -445,16 +717,20 @@ class Gateway:
                 await self.abort_multipart(bucket, uid)
                 return 204, b"", "text/plain"
             raise RGWError("bad multipart method")
+        vid = query.get("versionId")
         if method == "PUT":
             meta = await self.put_object(bucket, key, body)
             return 201, json.dumps(meta).encode(), "application/json"
         if method == "GET":
-            return 200, await self.get_object(bucket, key), \
+            return 200, await self.get_object(bucket, key, vid), \
                 "application/octet-stream"
         if method == "HEAD":
-            await self.head_object(bucket, key)   # 404 when absent
+            await self.head_object(bucket, key, vid)  # 404 when absent
             return 200, b"", "text/plain"
         if method == "DELETE":
-            await self.delete_object(bucket, key)
+            marker = await self.delete_object(bucket, key, vid)
+            if marker is not None:
+                return 200, json.dumps(marker).encode(), \
+                    "application/json"
             return 204, b"", "text/plain"
         raise RGWError("bad method")

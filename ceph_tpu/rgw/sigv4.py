@@ -1,0 +1,172 @@
+"""AWS Signature Version 4 — spec-exact signing and verification.
+
+Reference: src/rgw/rgw_auth_s3.h:419 (rgw_create_s3_canonical_header)
+and rgw_auth_s3.cc — the reference implements the same algorithm AWS
+documents ("Authenticating Requests: AWS Signature Version 4"), so any
+stock S3 client (boto3, s3cmd, awscli) can talk to RGW.  This module
+is that algorithm, both directions:
+
+- ``sign_headers(...)`` — client side: produce the Authorization and
+  x-amz-* headers for a request (what botocore's SigV4Auth does).
+- ``verify(...)`` — gateway side: rebuild the canonical request from
+  the received wire data and compare signatures constant-time.
+
+Algorithm (AWS "Signature Calculation" docs; no deviations):
+
+  CanonicalRequest = Method \n URI \n Query \n CanonicalHeaders \n
+                     SignedHeaders \n HexSHA256(payload)
+  StringToSign     = "AWS4-HMAC-SHA256" \n amzdate \n scope \n
+                     HexSHA256(CanonicalRequest)
+  SigningKey       = HMAC(HMAC(HMAC(HMAC("AWS4"+secret, date),
+                     region), service), "aws4_request")
+  Signature        = HexHMAC(SigningKey, StringToSign)
+
+Correctness is pinned by the published AWS test vector (the documented
+IAM ListUsers example) in tests/test_sigv4.py — the implementation
+reproduces its canonical-request hash and final signature bit-exactly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Dict, Iterable, List, Tuple
+from urllib.parse import parse_qsl, quote, urlsplit
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED = "UNSIGNED-PAYLOAD"
+
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    """AWS canonical URI-encoding: unreserved chars [A-Za-z0-9-._~]
+    stay, everything else %XX uppercase.  Path encoding keeps '/'."""
+    safe = "-._~" if encode_slash else "-._~/"
+    return quote(s, safe=safe)
+
+
+def canonical_uri(path: str) -> str:
+    if not path:
+        return "/"
+    return _uri_encode(path, encode_slash=False) or "/"
+
+
+def canonical_query(raw_query: str) -> str:
+    """Sorted by (name, value), strict URI-encoding of both."""
+    pairs = parse_qsl(raw_query, keep_blank_values=True)
+    enc = sorted((_uri_encode(k), _uri_encode(v)) for k, v in pairs)
+    return "&".join(f"{k}={v}" for k, v in enc)
+
+
+def canonical_headers(headers: "Dict[str, str]",
+                      signed: "Iterable[str]") -> "Tuple[str, str]":
+    """(CanonicalHeaders, SignedHeaders) for the given header subset.
+    Names lowercase + sorted; values trimmed with inner whitespace
+    runs collapsed (the AWS 'trimall' rule)."""
+    names = sorted(h.lower() for h in signed)
+    lines = []
+    for n in names:
+        v = headers.get(n, "")
+        lines.append(f"{n}:{' '.join(v.split())}\n")
+    return "".join(lines), ";".join(names)
+
+
+def canonical_request(method: str, rawpath: str,
+                      headers: "Dict[str, str]",
+                      signed: "Iterable[str]",
+                      payload_hash: str) -> "Tuple[str, str]":
+    """Returns (canonical_request, signed_headers_str)."""
+    split = urlsplit(rawpath)
+    ch, sh = canonical_headers(headers, signed)
+    creq = "\n".join([
+        method.upper(), canonical_uri(split.path),
+        canonical_query(split.query), ch, sh, payload_hash])
+    return creq, sh
+
+
+def string_to_sign(amz_date: str, scope: str, creq: str) -> str:
+    return "\n".join([ALGORITHM, amz_date, scope,
+                      hashlib.sha256(creq.encode()).hexdigest()])
+
+
+def signing_key(secret: str, date: str, region: str,
+                service: str) -> bytes:
+    k = hmac.new(("AWS4" + secret).encode(), date.encode(),
+                 hashlib.sha256).digest()
+    for part in (region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
+def sign_headers(access: str, secret: str, method: str, rawpath: str,
+                 headers: "Dict[str, str]", body: bytes,
+                 amz_date: str, region: str = "us-east-1",
+                 service: str = "s3") -> "Dict[str, str]":
+    """Client side: return the extra headers (Authorization,
+    x-amz-date, x-amz-content-sha256) that make the request verify."""
+    payload_hash = hashlib.sha256(body).hexdigest()
+    hdrs = {k.lower(): v for k, v in headers.items()}
+    hdrs["x-amz-date"] = amz_date
+    hdrs["x-amz-content-sha256"] = payload_hash
+    signed = sorted(set(hdrs) | {"x-amz-date", "x-amz-content-sha256"})
+    creq, sh = canonical_request(method, rawpath, hdrs, signed,
+                                 payload_hash)
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
+    sts = string_to_sign(amz_date, scope, creq)
+    key = signing_key(secret, amz_date[:8], region, service)
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "authorization": (
+            f"{ALGORITHM} Credential={access}/{scope}, "
+            f"SignedHeaders={sh}, Signature={sig}"),
+    }
+
+
+class SigV4Error(Exception):
+    pass
+
+
+def parse_authorization(auth: str) -> "Tuple[str, List[str], List[str], str]":
+    """-> (access_key, scope_parts, signed_header_names, signature)."""
+    if not auth.startswith(ALGORITHM + " "):
+        raise SigV4Error("not AWS4-HMAC-SHA256")
+    fields: "Dict[str, str]" = {}
+    for item in auth[len(ALGORITHM):].split(","):
+        name, _, val = item.strip().partition("=")
+        fields[name] = val
+    try:
+        cred = fields["Credential"].split("/")
+        signed = fields["SignedHeaders"].split(";")
+        sig = fields["Signature"]
+    except KeyError as e:
+        raise SigV4Error(f"missing {e} in Authorization")
+    if len(cred) != 5 or cred[4] != "aws4_request":
+        raise SigV4Error(f"malformed credential scope {cred!r}")
+    return cred[0], cred[1:], signed, sig
+
+
+def verify(secret: str, method: str, rawpath: str,
+           headers: "Dict[str, str]", body: bytes) -> None:
+    """Gateway side: recompute the signature from the wire request and
+    compare.  Raises SigV4Error on any mismatch."""
+    _access, scope_parts, signed, want_sig = parse_authorization(
+        headers.get("authorization", ""))
+    date, region, service = scope_parts[0], scope_parts[1], scope_parts[2]
+    amz_date = headers.get("x-amz-date", "")
+    if not amz_date.startswith(date):
+        raise SigV4Error("x-amz-date does not match credential scope")
+    payload_hash = headers.get("x-amz-content-sha256", "")
+    if not payload_hash:
+        payload_hash = hashlib.sha256(body).hexdigest()
+    elif payload_hash != UNSIGNED and payload_hash != hashlib.sha256(
+            body).hexdigest():
+        raise SigV4Error("x-amz-content-sha256 does not match body")
+    creq, _sh = canonical_request(method, rawpath, headers, signed,
+                                  payload_hash)
+    scope = "/".join(scope_parts)
+    sts = string_to_sign(amz_date, scope, creq)
+    key = signing_key(secret, date, region, service)
+    got = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(got, want_sig):
+        raise SigV4Error("signature mismatch")

@@ -1,0 +1,80 @@
+"""AWS SigV4 correctness, pinned to the published AWS test vector.
+
+The vector is the documented IAM ListUsers example from AWS's
+"Signature Version 4 signing process" documentation (also embedded in
+the reference's rgw SigV4 tests): known secret, date, and request with
+published intermediate hashes and final signature.  Reproducing it
+bit-exactly is the proof an unmodified stock S3 client's signatures
+will verify.
+"""
+
+import hashlib
+
+import pytest
+
+from ceph_tpu.rgw import sigv4
+
+ACCESS = "AKIDEXAMPLE"
+SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+AMZ_DATE = "20150830T123600Z"
+HEADERS = {
+    "content-type": "application/x-www-form-urlencoded; charset=utf-8",
+    "host": "iam.amazonaws.com",
+    "x-amz-date": AMZ_DATE,
+}
+SIGNED = ["content-type", "host", "x-amz-date"]
+RAWPATH = "/?Action=ListUsers&Version=2010-05-08"
+
+# published intermediates + signature (AWS docs)
+CREQ_SHA = "f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59"
+SIGNATURE = "5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+
+
+class TestAwsVector:
+    def test_canonical_request_hash(self):
+        creq, sh = sigv4.canonical_request(
+            "GET", RAWPATH, HEADERS, SIGNED,
+            hashlib.sha256(b"").hexdigest())
+        assert sh == "content-type;host;x-amz-date"
+        assert hashlib.sha256(creq.encode()).hexdigest() == CREQ_SHA
+
+    def test_final_signature(self):
+        creq, sh = sigv4.canonical_request(
+            "GET", RAWPATH, HEADERS, SIGNED,
+            hashlib.sha256(b"").hexdigest())
+        scope = "20150830/us-east-1/iam/aws4_request"
+        sts = sigv4.string_to_sign(AMZ_DATE, scope, creq)
+        import hmac
+        key = sigv4.signing_key(SECRET, "20150830", "us-east-1", "iam")
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        assert sig == SIGNATURE
+
+    def test_sign_then_verify_roundtrip(self):
+        body = b'{"hello": "world"}'
+        hdrs = {"host": "localhost:8000"}
+        extra = sigv4.sign_headers(
+            ACCESS, SECRET, "PUT", "/bucket/key?versionId=3",
+            hdrs, body, AMZ_DATE)
+        all_hdrs = {**hdrs, **extra}
+        sigv4.verify(SECRET, "PUT", "/bucket/key?versionId=3",
+                     all_hdrs, body)
+        # tampered body fails
+        with pytest.raises(sigv4.SigV4Error):
+            sigv4.verify(SECRET, "PUT", "/bucket/key?versionId=3",
+                         all_hdrs, body + b"x")
+        # tampered path fails
+        with pytest.raises(sigv4.SigV4Error):
+            sigv4.verify(SECRET, "PUT", "/bucket/other",
+                         all_hdrs, body)
+        # wrong secret fails
+        with pytest.raises(sigv4.SigV4Error):
+            sigv4.verify("not-it", "PUT", "/bucket/key?versionId=3",
+                         all_hdrs, body)
+
+    def test_query_and_path_encoding(self):
+        # unreserved chars stay; others %XX uppercase; query sorted
+        assert sigv4.canonical_uri("/a b/c~d") == "/a%20b/c~d"
+        assert sigv4.canonical_query("b=2&a=1&a=%20") in (
+            "a=1&a=%20&b=2", "a=%20&a=1&b=2")
+        # values sort AFTER keys pair-wise: (a,1) < (a,%20)? byte order
+        assert sigv4.canonical_query("x=&y=3") == "x=&y=3"

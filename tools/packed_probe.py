@@ -60,7 +60,13 @@ def bench_one(k, m, chunk_bytes, batch, pack):
         s = jnp.sum(par, dtype=jnp.uint32) ^ jnp.sum(cr, dtype=jnp.uint32)
         return d.at[:, 0, 0, 0].set(d[:, 0, 0, 0] ^ s)
 
-    dt = chained_time(body, d4j, iters_hi=64, min_signal_s=0.3)
+    # size the chain up front: every iters_hi doubling is a fresh
+    # remote compile (30-40 s through the tunnel), so aim directly at
+    # ~0.6 s of chained work assuming an optimistic 60 GiB/s
+    step_bytes = batch * k * chunk_bytes
+    hi = int(0.6 * 60 * 2**30 / max(step_bytes, 1))
+    hi = max(64, min(4096, hi))
+    dt = chained_time(body, d4j, iters_hi=hi, min_signal_s=0.25)
     gibs = batch * k * chunk_bytes / dt / 2**30
     return gibs, parity, np.asarray(crcs), data
 
