@@ -91,6 +91,24 @@ async def lock_unlock(ctx, data: bytes) -> bytes:
     return b""
 
 
+async def lock_break(ctx, data: bytes) -> bytes:
+    """Force-release a named holder's lock (reference cls_lock
+    break_lock): the caller asserts the holder is dead — librbd's
+    exclusive-lock checks header watchers (a live holder acks a
+    notify) before breaking.  Naming the expected holder makes the
+    break CAS-like: a lock re-acquired by someone else in the window
+    survives."""
+    args = jarg(data)
+    st = _lock_state(ctx)
+    if not st:
+        return b""
+    if st.get("owner") != args.get("owner"):
+        raise ClsError(f"locked by {st['owner']}, not "
+                       f"{args.get('owner')!r}", 16)
+    ctx.setxattr(LOCK_XATTR, jret({}))
+    return b""
+
+
 async def lock_info(ctx, data: bytes) -> bytes:
     return jret(_lock_state(ctx))
 
@@ -145,6 +163,7 @@ def register_all(reg) -> None:
     reg.register("numops", "mul", RD | WR, numops_mul)
     reg.register("lock", "lock", RD | WR, lock_lock)
     reg.register("lock", "unlock", RD | WR, lock_unlock)
+    reg.register("lock", "break_lock", RD | WR, lock_break)
     reg.register("lock", "get_info", RD, lock_info)
     reg.register("cas", "swap", RD | WR, cas_swap)
     reg.register("cache", "clear_dirty_if", RD | WR,

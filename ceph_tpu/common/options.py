@@ -193,6 +193,11 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED, min=1,
            desc="pg_autoscaler aims for this many PG placements per "
                 "OSD across all pools", services=("mgr", "mon")),
+    Option("mgr_pg_autoscaler_mode", str, "warn", LEVEL_ADVANCED,
+           enum_values=("off", "warn", "on"),
+           desc="pg_autoscaler: warn only, or 'on' to apply pg_num "
+                "increases via 'osd pool set' (PG split)",
+           services=("mgr",)),
     # --- hit sets (reference HitSet.h / hit_set_* pool params) --------------
     Option("osd_hit_set_period", float, 0.0, LEVEL_ADVANCED, min=0,
            desc="seconds per object-access hit set (0 = tracking off)",

@@ -146,6 +146,10 @@ class MgrDaemon(Dispatcher):
         self.reports: "Dict[str, dict]" = {}
         self.modules: "Dict[str, MgrModule]" = {}
         self._tasks: "list[asyncio.Task]" = []
+        # async callable sending a mon command (injected by the
+        # harness/deployer in mon-managed clusters); modules that ACT
+        # (pg_autoscaler mode=on) need it, advisory ones don't
+        self.mon_command = None
         self.register_module(StatusModule)
         self.register_module(PrometheusModule)
         from .dashboard import DashboardModule
@@ -164,8 +168,24 @@ class MgrDaemon(Dispatcher):
         self.addr = self.ms.listen_addr
         for mod in self.modules.values():
             await mod.serve()
+        self._tasks.append(asyncio.ensure_future(self._tick_loop()))
+
+    async def _tick_loop(self) -> None:
+        """Periodic module work (reference mgr tick): currently the
+        acting pg_autoscaler's apply pass."""
+        period = float(self.config.get("mgr_stats_period"))
+        auto = self.modules.get("pg_autoscaler")
+        while True:
+            await asyncio.sleep(period)
+            if auto is not None:
+                try:
+                    await auto.maybe_apply()
+                except Exception as e:  # noqa: BLE001 — keep ticking
+                    dout("mgr", 0, f"mgr tick: {e}")
 
     async def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()
         for mod in self.modules.values():
             mod.shutdown()
         await self.ms.shutdown()

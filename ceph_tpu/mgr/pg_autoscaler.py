@@ -6,17 +6,23 @@ for ~``mon_target_pg_per_osd`` PGs per OSD after replication, rounds to
 a power of two, and warns (or acts) when the actual count is more than
 a factor of 4 off.
 
-This framework has no PG split/merge machinery yet (osd pool set
-rejects pg_num for exactly that reason), so the module is ADVISORY:
-recommendations surface in the dashboard, the JSON API, and as
-health-style verdicts — the reference's `ceph osd pool autoscale-status`
-view.  Without per-pool utilization stats the capacity share is assumed
+Two modes (``mgr_pg_autoscaler_mode``):
+- ``warn`` (default): recommendations surface in the dashboard, the
+  JSON API, and as health-style verdicts — the reference's
+  `ceph osd pool autoscale-status` view.
+- ``on``: TOO_FEW_PGS pools get their pg_num raised through the mon
+  ('osd pool set pg_num'), which triggers the OSD-side PG split
+  (OSDDaemon.split_pool_pgs; reference OSD::split_pgs) — the acting
+  autoscaler.  Increase-only, like the machinery beneath it.
+
+Without per-pool utilization stats the capacity share is assumed
 uniform across pools (the reference's behavior for pools with no data
 yet).
 """
 
 from __future__ import annotations
 
+from ..common.log import dout
 from .daemon import MgrModule
 
 
@@ -29,6 +35,10 @@ def _next_pow2(n: int) -> int:
 
 class PgAutoscalerModule(MgrModule):
     name = "pg_autoscaler"
+
+    def __init__(self, mgr) -> None:
+        super().__init__(mgr)
+        self._asked: "set[tuple]" = set()
 
     def recommendations(self) -> "list[dict]":
         target_per_osd = int(self.mgr.config.get(
@@ -61,3 +71,31 @@ class PgAutoscalerModule(MgrModule):
             out.append({"pool": pname, "pg_num": pg_num, "size": size,
                         "recommended": rec, "verdict": verdict})
         return out
+
+    async def maybe_apply(self) -> "list[dict]":
+        """mode=on: apply TOO_FEW_PGS recommendations by raising
+        pg_num through the mon.  Returns the applied records.  Pools
+        already asked for (per recommended value) are not re-asked —
+        reports lag the map, and re-proposing the same increase every
+        tick until they catch up would spam the paxos log."""
+        mode = str(self.mgr.config.get("mgr_pg_autoscaler_mode"))
+        if mode != "on" or self.mgr.mon_command is None:
+            return []
+        applied = []
+        for rec in self.recommendations():
+            if rec["verdict"] != "TOO_FEW_PGS":
+                continue
+            key = (rec["pool"], rec["recommended"])
+            if key in self._asked:
+                continue
+            try:
+                await self.mgr.mon_command({
+                    "prefix": "osd pool set", "name": rec["pool"],
+                    "key": "pg_num", "value": rec["recommended"]})
+                self._asked.add(key)
+                applied.append(rec)
+                dout("mgr", 1, f"pg_autoscaler: {rec['pool']} pg_num "
+                               f"{rec['pg_num']} -> {rec['recommended']}")
+            except Exception as e:  # noqa: BLE001 — retried next tick
+                dout("mgr", 0, f"pg_autoscaler apply failed: {e}")
+        return applied

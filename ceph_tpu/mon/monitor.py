@@ -214,6 +214,17 @@ class MonDaemon(Dispatcher):
                     pool.fast_read = bool(op["value"])
                 elif key == "min_size":
                     pool.min_size = int(op["value"])
+                elif key == "pg_num":
+                    # increase-only (validated at command time): OSDs
+                    # split collections when they consume this epoch
+                    # (OSDDaemon._split_pool_pgs; reference
+                    # OSD::split_pgs, OSD.cc:8891)
+                    pool.pg_num = max(int(pool.pg_num),
+                                      int(op["value"]))
+                elif key == "compression_mode":
+                    pool.compression_mode = str(op["value"])
+                elif key == "compression_algorithm":
+                    pool.compression_algorithm = str(op["value"])
             except (KeyError, ValueError, TypeError) as e:
                 dout("mon", 0, f"pool_set apply skipped: {e}")
         elif kind == "pool_mksnap":
@@ -640,11 +651,12 @@ class MonDaemon(Dispatcher):
         if prefix == "osd pool set":
             # 'ceph osd pool set <pool> <key> <value>' (reference
             # OSDMonitor prepare_command_pool_set).  Only keys that are
-            # safe to change on a live pool are accepted: pg_num needs
-            # PG-split machinery, stripe_unit a re-stripe, size a
-            # backfill — none exist, so changing them would strand or
-            # corrupt existing data.  Values are validated HERE, before
-            # they can enter the paxos log.
+            # safe to change on a live pool are accepted: pg_num rides
+            # the PG-split machinery (increase-only); stripe_unit
+            # would need a re-stripe and size a backfill — those don't
+            # exist, so changing them would strand or corrupt existing
+            # data.  Values are validated HERE, before they can enter
+            # the paxos log.
             pool = self.osdmap.pool_by_name(cmd["name"])
             if pool is None:
                 return -2, {"error": f"no pool {cmd['name']!r}"}
@@ -672,6 +684,32 @@ class MonDaemon(Dispatcher):
                 if not lo <= value <= pool.size:
                     return -22, {"error": f"min_size {value} out of "
                                           f"[{lo}, {pool.size}]"}
+            elif key == "pg_num":
+                # PG split: increase-only (merge needs the reverse
+                # machinery); stable_mod placement means each existing
+                # PG sheds objects only to its own split children, and
+                # every OSD splits collections when it consumes the new
+                # epoch (reference OSDMonitor pg_num checks +
+                # OSD::split_pgs)
+                try:
+                    value = int(raw)
+                except (TypeError, ValueError):
+                    return -22, {"error": f"invalid int {raw!r}"}
+                if value <= pool.pg_num:
+                    return -22, {"error": f"pg_num can only increase "
+                                          f"({pool.pg_num} -> {value})"}
+                if value > 65536:
+                    return -22, {"error": "pg_num > 65536"}
+            elif key == "compression_mode":
+                value = str(raw).lower()
+                if value not in ("none", "force"):
+                    return -22, {"error": f"compression_mode {raw!r} "
+                                          f"not in (none, force)"}
+            elif key == "compression_algorithm":
+                value = str(raw).lower()
+                if value not in ("", "zlib", "zstd", "lz4", "snappy"):
+                    return -22, {"error":
+                                 f"unknown compressor {raw!r}"}
             else:
                 return -22, {"error": f"cannot set pool key {key!r}"}
             v = await self._propose_osd_ops([{

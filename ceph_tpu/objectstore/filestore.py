@@ -9,6 +9,17 @@ WAL/rocksdb machinery; thrasher QA relies on it).
 
 Block size 64 KiB: EC chunk writes (typically >= 4 KiB, chunk-aligned)
 touch few blocks; partial-block RMW reads one block.
+
+Data compression (reference bluestore_compression,
+src/common/options.cc:4198 + BlueStore blob compression): pools opted
+in via ``compression_mode`` run each 64 KiB data block through a
+compressor plugin before it hits sqlite, gated by the required ratio
+(``compressor_max_ratio``) — blocks that don't compress well enough
+stay raw.  Framing is self-describing per block (len == BLOCK -> raw;
+shorter -> 1-byte algorithm tag + compressed body), so reads never
+consult configuration and mixed raw/compressed objects are fine.
+(BlockStore deliberately does NOT compress data: its allocator is
+AU-granular, so sub-AU savings free no space there.)
 """
 
 from __future__ import annotations
@@ -24,13 +35,55 @@ from .types import Collection, ObjectId
 
 BLOCK = 64 * 1024
 
+# per-block framing tags (len == BLOCK means legacy/raw, no tag)
+_ALGO_TAGS = {"zlib": 1, "zstd": 2, "lz4": 3, "snappy": 4}
+_TAG_ALGOS = {v: k for k, v in _ALGO_TAGS.items()}
+
 
 class FileStore(ObjectStore):
-    def __init__(self, path: str, fsync: bool = False) -> None:
+    def __init__(self, path: str, fsync: bool = False,
+                 compression_ratio: float = 0.875) -> None:
         super().__init__()
         self.path = path
         self._fsync = fsync
         self._db: "Optional[sqlite3.Connection]" = None
+        # pool id -> compressor plugin name; maintained by the OSD from
+        # each pool's compression_mode/algorithm (empty = no pools
+        # compress).  Decompression never consults this — blocks are
+        # self-describing.
+        self.compression_pools: "Dict[int, str]" = {}
+        self.compression_ratio = compression_ratio
+        self._codecs: "Dict[str, object]" = {}
+
+    def _codec(self, algo: str):
+        c = self._codecs.get(algo)
+        if c is None:
+            from ..compressor import Compressor
+            c = self._codecs[algo] = Compressor.create(algo)
+        return c
+
+    def _frame(self, pool: int, data: bytes) -> bytes:
+        """Compress a full data block if its pool opted in AND it pays
+        (ratio gate); otherwise store raw (legacy framing)."""
+        algo = self.compression_pools.get(pool)
+        if not algo or algo == "none" or len(data) != BLOCK:
+            return bytes(data)
+        comp = self._codec(algo).compress(bytes(data))
+        if len(comp) + 1 > self.compression_ratio * BLOCK:
+            return bytes(data)
+        return bytes([_ALGO_TAGS[algo]]) + comp
+
+    @staticmethod
+    def _unframe_static(codec_get, row: bytes) -> bytes:
+        if len(row) >= BLOCK:
+            return bytes(row)
+        algo = _TAG_ALGOS.get(row[0])
+        if algo is None:
+            return bytes(row)      # short legacy tail block
+        return codec_get(algo).decompress(bytes(row[1:]))
+
+    def _unframe(self, row: bytes) -> bytes:
+        return self._unframe_static(self._codec, row)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -128,13 +181,21 @@ class FileStore(ObjectStore):
         row = self._conn().execute(
             "SELECT data FROM blocks WHERE cid=? AND oid=? AND blk=?",
             (cid, oid, blk)).fetchone()
-        return bytearray(row[0]) if row else bytearray(BLOCK)
+        if not row:
+            return bytearray(BLOCK)
+        buf = bytearray(self._unframe(row[0]))
+        if len(buf) < BLOCK:
+            buf.extend(b"\x00" * (BLOCK - len(buf)))
+        return buf
 
-    def _put_block(self, cid: str, oid: str, blk: int, data: bytes) -> None:
+    def _put_block(self, cid: str, oid: str, blk: int, data: bytes,
+                   pool: "Optional[int]" = None) -> None:
+        body = (self._frame(pool, bytes(data)) if pool is not None
+                else bytes(data))
         self._conn().execute(
             "INSERT INTO blocks (cid, oid, blk, data) VALUES (?, ?, ?, ?) "
             "ON CONFLICT (cid, oid, blk) DO UPDATE SET data=excluded.data",
-            (cid, oid, blk, sqlite3.Binary(bytes(data))))
+            (cid, oid, blk, sqlite3.Binary(body)))
 
     # --- primitives -----------------------------------------------------------
 
@@ -158,6 +219,7 @@ class FileStore(ObjectStore):
 
     def _write(self, cid, oid, off: int, data: bytes) -> None:
         c, o = cid.key(), oid.key()
+        pool = cid.pool
         size = self._ensure_obj(c, o)
         pos = off
         remaining = memoryview(data)
@@ -165,11 +227,11 @@ class FileStore(ObjectStore):
             blk, in_blk = divmod(pos, BLOCK)
             take = min(BLOCK - in_blk, len(remaining))
             if in_blk == 0 and take == BLOCK:
-                self._put_block(c, o, blk, remaining[:take])
+                self._put_block(c, o, blk, remaining[:take], pool)
             else:
                 buf = self._read_block(c, o, blk)
                 buf[in_blk:in_blk + take] = remaining[:take]
-                self._put_block(c, o, blk, buf)
+                self._put_block(c, o, blk, buf, pool)
             pos += take
             remaining = remaining[take:]
         if pos > size:
@@ -189,7 +251,7 @@ class FileStore(ObjectStore):
             blk = size // BLOCK
             buf = self._read_block(c, o, blk)
             buf[size % BLOCK:] = b"\x00" * (BLOCK - size % BLOCK)
-            self._put_block(c, o, blk, buf)
+            self._put_block(c, o, blk, buf, cid.pool)
         self._set_size(c, o, size)
 
     def _remove(self, cid, oid) -> None:
@@ -273,11 +335,15 @@ class FileStore(ObjectStore):
                     (c, o, blk)).fetchone()
                 if row is None:
                     continue
+                raw = (row[0] if len(row[0]) >= BLOCK
+                       else self._unframe(row[0]))
                 bstart = blk * BLOCK
                 lo = max(off, bstart)
                 hi = min(end, bstart + BLOCK)
-                out[lo - off:hi - off] = np.frombuffer(
-                    row[0], dtype=np.uint8, count=hi - lo, offset=lo - bstart)
+                n = min(hi, bstart + len(raw)) - lo
+                if n > 0:
+                    out[lo - off:lo - off + n] = np.frombuffer(
+                        raw, dtype=np.uint8, count=n, offset=lo - bstart)
             return out
 
     def stat(self, cid, oid) -> dict:

@@ -125,6 +125,16 @@ class OSDDaemon(Dispatcher):
         self._agent_task = None
         self._beacon_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
+        # last-consumed pg_num per pool: a map epoch raising it triggers
+        # the local collection split (reference OSD::split_pgs)
+        self._pool_pg_nums: "Dict[int, int]" = {}
+        self._split_task: "Optional[asyncio.Task]" = None
+        # pool -> pre-split pg_num while a split is pending: sub-ops
+        # for CHILD pgs (>= old) gate on the split; parent-pg sub-ops
+        # keep flowing so cross-OSD drains can't cycle
+        self._splitting_old: "Dict[int, int]" = {}
+        self._inflight_client_ops = 0
+        self.split_moved = 0          # lifetime objects moved by splits
         if self.monc is not None:
             self.monc.map_callbacks.append(self._on_map_change)
 
@@ -132,6 +142,7 @@ class OSDDaemon(Dispatcher):
 
     async def init(self) -> None:
         self.store.mount()
+        self._load_consumed_pg_nums()
         addr = self.osdmap.get_addr(self.whoami) if self.monc is None \
             else self.addr
         await self.ms.bind(addr or self.addr)
@@ -172,9 +183,63 @@ class OSDDaemon(Dispatcher):
 
     def _on_map_change(self, osdmap: OSDMap) -> None:
         """New epoch: every PG whose primary we now are re-peers
-        (reference OSD::consume_map -> PG advance_map -> peering)."""
+        (reference OSD::consume_map -> PG advance_map -> peering).
+        A pg_num increase first splits the local collections; peering
+        and client ops for the pool wait on the split."""
         if not self.up:
             return
+        splits = []
+        changed = False
+        for pool_id, pool in osdmap.pools.items():
+            old = self._pool_pg_nums.get(pool_id, pool.pg_num)
+            if self._pool_pg_nums.get(pool_id) != pool.pg_num:
+                changed = True
+            self._pool_pg_nums[pool_id] = pool.pg_num
+            if pool.pg_num > old:
+                splits.append((pool_id, old, pool.pg_num))
+        if changed:
+            # survive restarts: an OSD down across a pg_num raise must
+            # detect the delta on reboot (superblock, _load_consumed)
+            try:
+                self._persist_consumed_pg_nums()
+            except Exception as e:  # noqa: BLE001 — split still runs
+                dout("osd", 0, f"superblock persist failed: {e}")
+        self._sync_store_compression(osdmap)
+        if splits:
+            prev = self._split_task
+            for pool_id, old, _new in splits:
+                self._splitting_old.setdefault(pool_id, old)
+
+            async def run_splits():
+                if prev is not None and not prev.done():
+                    await prev
+                for pool_id, old, new in splits:
+                    # quiesce: wait for EVERY admitted client op and
+                    # this pool's write pipelines to drain before
+                    # moving objects (reference blocks ops across the
+                    # split interval).  Parent-pg sub-ops keep flowing
+                    # during this phase, so remote drains progress.
+                    for _ in range(3000):
+                        busy = self._inflight_client_ops > 0
+                        for pgid, be in list(self.backends.items()):
+                            if pgid[0] != pool_id:
+                                continue
+                            if (be.waiting_state or be.waiting_reads
+                                    or be.waiting_commit
+                                    or be.in_flight_reads):
+                                busy = True
+                        if not busy:
+                            break
+                        await asyncio.sleep(0.01)
+                    else:
+                        dout("osd", 0, f"osd.{self.whoami} split "
+                                       f"quiesce timed out; proceeding")
+                    # the move itself is fully synchronous: no other
+                    # coroutine interleaves with it
+                    self.split_moved += self.split_pool_pgs(
+                        pool_id, old, new)
+                    self._splitting_old.pop(pool_id, None)
+            self._split_task = asyncio.ensure_future(run_splits())
         for pool_id, pool in osdmap.pools.items():
             for pg in range(pool.pg_num):
                 _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
@@ -187,8 +252,183 @@ class OSDDaemon(Dispatcher):
                 self._peer_tasks[pgid] = asyncio.ensure_future(
                     self._peer_pg(pgid))
 
+    # superblock collection holding per-OSD metadata that must survive
+    # restarts (consumed pg_nums; reference OSDSuperblock)
+    _SUPER_CID = (-1, 0, 0)
+
+    def _load_consumed_pg_nums(self) -> None:
+        """Restart path for splits: without the persisted last-consumed
+        pg_num, an OSD that was DOWN while the mon raised pg_num would
+        seed the delta detector with the already-raised value and never
+        split its on-disk collections — objects stranded in parent
+        collections while reads consult children."""
+        from ..objectstore.types import Collection, ObjectId
+        cid = Collection(*self._SUPER_CID)
+        try:
+            kv = self.store.omap_get(cid, ObjectId("osd_superblock"))
+            self._pool_pg_nums = {
+                int(k): int(v) for k, v in
+                json.loads(kv.get("pg_nums", b"{}").decode()).items()}
+        except Exception:  # noqa: BLE001 — fresh store
+            self._pool_pg_nums = {}
+
+    def _persist_consumed_pg_nums(self) -> None:
+        from ..objectstore.transaction import Transaction
+        from ..objectstore.types import Collection, ObjectId
+        cid = Collection(*self._SUPER_CID)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        t.touch(cid, ObjectId("osd_superblock"))
+        t.omap_setkeys(cid, ObjectId("osd_superblock"), {
+            "pg_nums": json.dumps(
+                {str(k): v for k, v in
+                 self._pool_pg_nums.items()}).encode()})
+        self.store.apply_transaction(t)
+
+    def _sync_store_compression(self, osdmap: OSDMap) -> None:
+        """Push each pool's compression choice down to the store
+        (reference: BlueStore reads per-pool compression overrides).
+        Stores without block compression (mem/block) just ignore it."""
+        if not hasattr(self.store, "compression_pools"):
+            return
+        default = str(self.config.get("compressor_default"))
+        want = {}
+        for pid, pool in osdmap.pools.items():
+            if getattr(pool, "compression_mode", "") == "force":
+                want[pid] = pool.compression_algorithm or default
+        self.store.compression_pools = want
+        try:
+            self.store.compression_ratio = float(
+                self.config.get("compressor_max_ratio"))
+        except Exception:  # noqa: BLE001 — keep the store default
+            pass
+
+    def split_pool_pgs(self, pool_id: int, old_num: int,
+                       new_num: int) -> int:
+        """Split this OSD's local collections for a pg_num increase
+        (reference OSD::split_pgs, OSD.cc:8891 + PG::split_into).
+
+        stable_mod placement guarantees every object either stays in
+        its PG or moves to one of that PG's split children, so the
+        split is local per parent: re-hash each object, move the
+        children's objects into the child collections (data + attrs +
+        omap + rollback generations, one transaction per parent/shard),
+        and give parent and children a FRESH log trimmed at the
+        parent's head — all shards compute the identical result, so
+        peering converges with nothing missing.  In-memory backends
+        for the pool are evicted and reload from the store.  Returns
+        the number of objects moved."""
+        from ..objectstore.types import Collection, NO_GEN, ObjectId
+        from ..objectstore.transaction import Transaction
+        from ..ops import crc32c as crcmod
+        from .ecbackend import PGMETA_OID
+        from .osdmap import stable_mod
+        from .pglog import PGLog
+        moved_total = 0
+        for c in list(self.store.list_collections()):
+            if c.pool != pool_id or c.pg >= old_num:
+                continue
+            try:
+                kv = self.store.omap_get(c, ObjectId(PGMETA_OID))
+            except NotFound:
+                kv = {}
+            pg_log = (PGLog.from_dict(json.loads(kv["pglog"].decode()))
+                      if "pglog" in kv else PGLog())
+            try:
+                missing_raw = (json.loads(kv["missing"].decode())
+                               if "missing" in kv else {})
+            except ValueError:
+                missing_raw = {}
+            t = Transaction()
+            touched: "set" = set()
+            created: "set" = set()
+            for o in self.store.list_objects(c):
+                if o.name == PGMETA_OID:
+                    continue
+                npg = stable_mod(crcmod.crc32c(o.name.encode()),
+                                 new_num)
+                if npg == c.pg:
+                    continue
+                dst = Collection(pool_id, npg, c.shard)
+                if dst not in touched:
+                    touched.add(dst)
+                    if not self.store.collection_exists(dst):
+                        t.create_collection(dst)
+                        created.add(dst)
+                if dst not in created and self.store.exists(dst, o):
+                    # a post-split writer already landed a NEWER copy
+                    # in the child (mon mode: OSDs consume the epoch
+                    # at different times); the stale parent copy must
+                    # not clobber it
+                    t.remove(c, o)
+                    continue
+                data = self.store.read(c, o)
+                t.touch(dst, o)
+                if len(data):
+                    t.write(dst, o, 0, bytes(data))
+                for name, val in self.store.get_attrs(c, o).items():
+                    t.setattr(dst, o, name, bytes(val))
+                omap = self.store.omap_get(c, o)
+                if omap:
+                    t.omap_setkeys(dst, o, dict(omap))
+                t.remove(c, o)
+                if o.generation == NO_GEN:
+                    moved_total += 1
+            # fresh fully-trimmed logs at the parent's head: shards
+            # split deterministically, so logs stay identical across
+            # the acting set and peering finds nothing divergent.  The
+            # missing set survives, partitioned by each entry's new pg
+            # (a shard that rejected an in-flight sub-write as deposed
+            # recorded the object here; recovery still needs it).
+            fresh = PGLog()
+            fresh.tail = fresh.head = pg_log.head
+            fresh.can_rollback_to = pg_log.head
+            by_pg: "Dict[int, dict]" = {}
+            for moid, mver in missing_raw.items():
+                mpg = stable_mod(crcmod.crc32c(moid.encode()), new_num)
+                by_pg.setdefault(mpg, {})[moid] = mver
+
+            def meta_kv(pg: int) -> "Dict[str, bytes]":
+                return {
+                    "pglog": json.dumps(fresh.to_dict()).encode(),
+                    "missing": json.dumps(
+                        by_pg.get(pg, {})).encode(),
+                    "gap_from": json.dumps(None).encode(),
+                }
+            t.touch(c, ObjectId(PGMETA_OID))
+            t.omap_setkeys(c, ObjectId(PGMETA_OID), meta_kv(c.pg))
+            for dst in touched:
+                t.touch(dst, ObjectId(PGMETA_OID))
+                t.omap_setkeys(dst, ObjectId(PGMETA_OID),
+                               meta_kv(dst.pg))
+            self.store.apply_transaction(t)
+        # evict in-memory backends for the pool: state (logs, caches)
+        # reloads from the split store on next use
+        for pgid in [p for p in self.backends if p[0] == pool_id]:
+            self.backends.pop(pgid, None)
+        dout("osd", 1, f"osd.{self.whoami} split pool {pool_id} "
+                       f"{old_num}->{new_num}: moved {moved_total}")
+        return moved_total
+
+    def _maybe_repeer(self, pgid: "Tuple[int, int]") -> None:
+        """Schedule a peering pass for a PG we are primary of, unless
+        one is already running (reference: requeue_pg on interval
+        errors)."""
+        _u, acting = self.osdmap.pg_to_up_acting_osds(*pgid)
+        if self.osdmap.primary_of(acting) != self.whoami:
+            return
+        prev = self._peer_tasks.get(pgid)
+        if prev is not None and not prev.done():
+            return
+        self._peer_tasks[pgid] = asyncio.ensure_future(
+            self._peer_pg(pgid))
+
     async def _peer_pg(self, pgid: "Tuple[int, int]") -> None:
         try:
+            if self._split_task is not None \
+                    and not self._split_task.done():
+                await self._split_task
             be = self._get_backend(pgid)
             be.last_epoch = self.osdmap.epoch
             res = await be.peer()
@@ -713,10 +953,63 @@ class OSDDaemon(Dispatcher):
 
     async def ms_dispatch(self, conn, msg: Message) -> bool:
         t = msg.TYPE
+        if t in ("ec_sub_write", "ec_sub_read", "pg_query", "pg_push",
+                 "pg_rewind") and self._splitting_old:
+            pgid_m = msg.get("pgid")
+            if pgid_m is not None \
+                    and self._split_task is not None \
+                    and not self._split_task.done():
+                old = self._splitting_old.get(int(pgid_m[0]))
+                if old is not None and (
+                        int(pgid_m[1]) >= old
+                        or t in ("pg_query", "pg_push", "pg_rewind")):
+                    # CHILD-pg sub-ops: the collection doesn't exist
+                    # here until the move runs.  Peering traffic gates
+                    # for EVERY pg of a splitting pool — answering a
+                    # query mid-move reports a half-moved object list
+                    # and triggers bogus backfills/deletes.  Parent-pg
+                    # DATA sub-ops are NOT gated: they are what other
+                    # OSDs' quiesces are draining.  Gated messages PARK
+                    # in their own task — awaiting inline would
+                    # head-of-line block this connection's serialized
+                    # delivery loop and starve the sub-write REPLIES
+                    # the split quiesce itself is draining (TCP
+                    # transport delivers per-connection in order).
+                    split = self._split_task
+
+                    async def _deliver_after_split(c=conn, m=msg):
+                        try:
+                            await split
+                        except Exception:  # noqa: BLE001 — still serve
+                            pass
+                        await self.ms_dispatch(c, m)
+                    asyncio.ensure_future(_deliver_after_split())
+                    return True
         if t == "osd_op":
             asyncio.ensure_future(self._handle_client_op(conn, msg))
         elif t == "ec_sub_write":
-            be = self._get_backend(tuple(msg["pgid"]))
+            pgid_m = (int(msg["pgid"][0]), int(msg["pgid"][1]))
+            wrong = None
+            if pgid_m[0] in self.osdmap.pools:
+                for entry in msg.get("log_entries", []):
+                    if self.osdmap.object_to_pg(
+                            pgid_m[0], entry["oid"]) != pgid_m[1]:
+                        wrong = entry["oid"]
+                        break
+            if wrong is not None:
+                # shard-side wrong-pg gate (mirror of the client-op
+                # one): a straggler sub-write from a primary that
+                # planned before a pg_num split would land the object
+                # in a collection reads no longer consult.  Rejecting
+                # makes the primary fail the op; the client retries
+                # against the post-split placement.
+                await conn.send_message(MECSubOpWriteReply({
+                    "pgid": list(pgid_m), "shard": msg["shard"],
+                    "from_osd": self.whoami, "tid": msg["tid"],
+                    "committed": False, "applied": False,
+                    "error": f"wrong pg for {wrong} (pg_num split)"}))
+                return True
+            be = self._get_backend(pgid_m)
             self.perf.inc("subop_w")
             span = self._sub_span(msg, "ec_sub_write")
             try:
@@ -892,8 +1185,33 @@ class OSDDaemon(Dispatcher):
 
     async def _do_client_op(self, conn, msg: MOSDOp, top=None) -> None:
         self.perf.inc("op")
+        if self._split_task is not None and not self._split_task.done():
+            # a pg_num split is consuming the new map: ops wait so they
+            # never land in a collection mid-move
+            await self._split_task
+        self._inflight_client_ops += 1
+        try:
+            await self._do_client_op_inner(conn, msg, top)
+        finally:
+            self._inflight_client_ops -= 1
+
+    async def _do_client_op_inner(self, conn, msg: MOSDOp,
+                                  top=None) -> None:
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
+        if oid and pgid[0] in self.osdmap.pools:
+            # the objecter hashes against the pool it actually sends
+            # to (after any tier redirect), so the message's own pool
+            # is the right one to check
+            if self.osdmap.object_to_pg(pgid[0], oid) != pgid[1]:
+                # client targeted with a pre-split map: make it refresh
+                # and resend (reference: ops from an older interval are
+                # requeued/ESTALEd, never served on the wrong PG)
+                await conn.send_message(MOSDOpReply({
+                    "tid": msg["tid"], "result": -ESTALE,
+                    "outs": [{"error": "wrong pg for object "
+                                       "(map changed?)"}]}))
+                return
         deny = self._check_osd_caps(msg)
         if deny is not None and "generation" in deny[0] \
                 and self.monc is not None:
@@ -1109,9 +1427,14 @@ class OSDDaemon(Dispatcher):
                              "dlen": 0})
         except NotActive as e:
             # wrong primary / mid-peering: the client should wait for a
-            # newer map and resend (reference: requeue on map change)
+            # newer map and resend (reference: requeue on map change).
+            # A write can ALSO land here when a racing interval change
+            # (peering sweep, pg split) partially applied it — kick a
+            # re-peer so log election reconciles the divergent shards
+            # before the client's retry arrives.
             result = -ESTALE
             outs.append({"error": str(e)})
+            self._maybe_repeer(pgid)
         except Exception as e:  # noqa: BLE001 — op errors become errno
             from ..cls import ClsError
             if not isinstance(e, (ECError, KeyError, NotFound, ClsError)):

@@ -315,12 +315,20 @@ class ECBackend:
         # cumulative bytes this shard served to sub-reads (repair-I/O
         # accounting: clay repair must move less than full-chunk repair)
         self.sub_read_bytes = 0
-        # newest map epoch a primary has peered this shard at: sub-ops
-        # from primaries of OLDER epochs are rejected, so a deposed
-        # primary can never complete (and ack) a write behind the back
-        # of a successor that already peered — the reference's
-        # same-interval/last_epoch_started gate (PeeringState).
+        # newest INTERVAL-START epoch a primary has peered this shard
+        # at: sub-ops from primaries of OLDER intervals are rejected,
+        # so a deposed primary can never complete (and ack) a write
+        # behind the back of a successor that already peered — the
+        # reference's same-interval/last_epoch_started gate
+        # (PeeringState).  Keyed to the epoch the acting set last
+        # CHANGED, not the latest peering sweep: a re-peer with an
+        # unchanged acting set (recovery pass, pg split) must not
+        # reject the same primary's in-flight writes — that created
+        # partially-applied writes and gapped logs under load
+        # (reference same_interval_since).
         self.peered_epoch = 0
+        self.interval_epoch = 0
+        self._interval_acting: "tuple | None" = None
         self._load_pg_meta()
 
     # ------------------------------------------------------------------ utils
@@ -796,7 +804,12 @@ class ECBackend:
         await rop.done
         if op.oid in rop.errors:
             async with self._lock:
-                self._fail_op(op, ECError(
+                # NotActive (not a hard EIO): mixed shard state here
+                # usually means a partially-applied racing write (e.g.
+                # across a peering or pg split) — the client retries
+                # while re-peering reconciles via log election; genuine
+                # unrecoverable loss surfaces when retries exhaust
+                self._fail_op(op, NotActive(
                     f"RMW read failed for {op.oid}: errno "
                     f"{rop.errors[op.oid]}"))
             return
@@ -1204,9 +1217,11 @@ class ECBackend:
                     op.version
                 self._check_commit_queue()
                 return
-            # shard rejected us as a deposed primary: never ack this op;
-            # the client will retry against the current primary
-            self._fail_op(op, ECError(
+            # shard rejected us as a deposed primary (or as the wrong
+            # pg after a split): never ack this op.  NotActive -> the
+            # client sees ESTALE and retries against the current
+            # primary/placement instead of surfacing a hard error.
+            self._fail_op(op, NotActive(
                 f"write {op.oid} v{op.version}: shard {msg['shard']} "
                 f"rejected stale interval"))
             return
@@ -2345,7 +2360,10 @@ class ECBackend:
             await self.send(osd, MPGQuery({
                 "pgid": list(self.pgid), "shard": shard,
                 "from_osd": self.whoami, "tid": tid,
-                "epoch": self.last_epoch}))
+                # the INTERVAL start, not the current epoch: shards
+                # must keep accepting this same interval's in-flight
+                # sub-writes across recovery/split re-peers
+                "epoch": self.interval_epoch}))
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError, ECError, asyncio.TimeoutError):
             return None
@@ -2487,9 +2505,15 @@ class ECBackend:
             self.extent_cache = ExtentCache()
         up = self._avail_shards()
         infos: "Dict[int, dict]" = {}
-        # peering at this epoch deposes any older primary on our own
+        # interval tracking: the deposed-primary gate advances only
+        # when the acting set actually changes (see __init__ note)
+        acting_now = tuple(self.get_acting())
+        if acting_now != self._interval_acting:
+            self._interval_acting = acting_now
+            self.interval_epoch = self.last_epoch
+        # peering deposes primaries of OLDER INTERVALS on our own
         # shard too (remote shards record it via the query's epoch)
-        self.peered_epoch = max(self.peered_epoch, self.last_epoch)
+        self.peered_epoch = max(self.peered_epoch, self.interval_epoch)
         for s, osd in up.items():
             if osd == self.whoami:
                 infos[s] = {"log": self.pg_log.to_dict(),
@@ -2594,6 +2618,40 @@ class ECBackend:
                 self.peer_missing[s] = got
             elif prior:
                 self.peer_missing[s] = prior
+
+        # ---- object-list reconciliation (pg-split orphan handling).
+        # An object some complete shards hold that others lack, with no
+        # log entry or missing record explaining the difference, is the
+        # residue of a never-acked partially-applied write (a client op
+        # that died across an interval change or pg_num split; its log
+        # entry was trimmed with the split's fresh log).  Holders >= k:
+        # the data is decodable and might be wanted — recover it to the
+        # absent shards.  Holders < k: unreconstructable junk no client
+        # was ever acked — roll it back by deletion.
+        tracked = set(latest)
+        for _s, mset in self.peer_missing.items():
+            tracked.update(mset)
+        complete_shards = [s for s in infos if complete[s] >= auth_head]
+        presence: "Dict[str, Set[int]]" = {}
+        for s in complete_shards:
+            for oid in infos[s]["objects"]:
+                presence.setdefault(oid, set()).add(s)
+        for oid in sorted(presence):
+            if oid in tracked:
+                continue
+            holders = presence[oid]
+            absent = [s for s in complete_shards if s not in holders]
+            if not absent:
+                continue
+            if len(holders) >= self.k:
+                for s in absent:
+                    self.peer_missing.setdefault(s, {})[oid] = auth_head
+            else:
+                dout("osd", 1, f"peer {self.pgid}: deleting "
+                               f"unreconstructable orphan {oid} on "
+                               f"shards {sorted(holders)}")
+                await self._push_delete(oid, set(holders), up)
+                all_objects.discard(oid)
 
         # recovery: reconstruct + push every missing object, bounded by
         # osd_recovery_max_active concurrent workers (reference recovery

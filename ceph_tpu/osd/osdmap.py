@@ -27,6 +27,26 @@ POOL_ERASURE = "erasure"
 NONE_OSD = -1
 
 
+def stable_mod(x: int, b: int) -> int:
+    """The reference's ceph_stable_mod (src/include/ceph_hash.h):
+    hash -> pg with the SPLIT-STABLE property — growing pg_num from N
+    to 2N moves an object either nowhere or from pg i to pg i+N, so a
+    PG splits into exactly itself + one child instead of a full
+    reshuffle (what a plain modulus would cause)."""
+    bmask = (1 << max(0, (b - 1).bit_length())) - 1
+    return (x & bmask) if (x & bmask) < b else (x & (bmask >> 1))
+
+
+def pg_parent(pg: int, old_pg_num: int) -> int:
+    """The ancestor PG (under the old pg_num) a child split from:
+    strip high bits until the id is a pre-split pg (reference
+    pg_t.is_split/get_parent)."""
+    p = pg
+    while p >= old_pg_num:
+        p &= (1 << (p.bit_length() - 1)) - 1
+    return p
+
+
 @dataclass
 class Pool:
     pool_id: int
@@ -35,6 +55,14 @@ class Pool:
     size: int = 3                 # replicas, or k+m for EC
     min_size: int = 2
     pg_num: int = 32
+    # placement seeds (reference pg_pool_t pgp_num): pg_num can grow
+    # (PG split) while pgp_num stays — split children CO-LOCATE with
+    # their parent (same CRUSH seed, same acting set, same shard
+    # order), so the split is purely local to each OSD's store.
+    # Raising pgp_num would re-seed children and migrate data via
+    # backfill — that second phase is not built; pgp_num is pinned at
+    # the create-time pg_num.
+    pgp_num: int = 0
     crush_rule: str = "replicated_rule"
     ec_profile: str = ""          # name into OSDMap.ec_profiles
     stripe_unit: int = 4096       # EC chunk granularity
@@ -49,12 +77,19 @@ class Pool:
     cache_tier: "int | None" = None
     tier_of: "int | None" = None
     cache_mode: str = ""          # "writeback" on cache pools
+    # objectstore data compression (reference bluestore_compression
+    # pool overrides): mode "" / "none" = off, "force" = every data
+    # block; algorithm names a compressor plugin ("" = store default)
+    compression_mode: str = ""
+    compression_algorithm: str = ""
     snap_seq: int = 0             # newest pool snapid (0 = no snaps)
     snaps: "dict" = None          # snap name -> snapid
 
     def __post_init__(self):
         if self.snaps is None:
             self.snaps = {}
+        if not self.pgp_num:
+            self.pgp_num = self.pg_num
 
     def is_erasure(self) -> bool:
         return self.type == POOL_ERASURE
@@ -125,9 +160,16 @@ class OSDMap:
 
     def object_to_pg(self, pool_id: int, name: str) -> int:
         pool = self.get_pool(pool_id)
-        return crcmod.crc32c(name.encode()) % pool.pg_num
+        return stable_mod(crcmod.crc32c(name.encode()), pool.pg_num)
 
     def _pg_seed(self, pool_id: int, pg: int) -> int:
+        # placement collapses split children onto their parent's seed
+        # (pgp_num, reference raw_pg_to_pps): children share the
+        # parent's acting set + shard order, keeping pg_num splits
+        # local to each OSD's store
+        pool = self.pools.get(pool_id)
+        if pool is not None and pg >= pool.pgp_num:
+            pg = pg_parent(pg, pool.pgp_num)
         return (pool_id << 32) ^ pg
 
     def _weights(self) -> "Dict[int, float]":

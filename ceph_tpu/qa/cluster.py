@@ -90,6 +90,13 @@ class MiniCluster:
                     mesh_plane=self.mesh_plane)
             for osd in self.osds.values():
                 await osd.init()
+            if self.mgr is not None:
+                # acting modules (pg_autoscaler mode=on) speak to the
+                # mon through an admin client
+                async def _mgr_mon_command(cmd: dict) -> dict:
+                    admin = await self._admin_client()
+                    return await admin.mon_command(cmd)
+                self.mgr.mon_command = _mgr_mon_command
         else:
             for osd in self.osds.values():
                 await osd.init()
@@ -269,6 +276,38 @@ class MiniCluster:
         await osd.init()
         if not self.mon_addrs:
             self._publish_addrs()
+
+    async def set_pg_num(self, pool_name: str, new_pg_num: int) -> int:
+        """Static mode: raise pg_num, split every OSD's collections,
+        re-peer — the in-process analog of 'ceph osd pool set pg_num'
+        (mon mode does the same through map subscriptions).  Returns
+        objects moved across all OSDs."""
+        assert not self.mon_addrs, \
+            "mon mode: use 'osd pool set pg_num' via mon_command"
+        pool = self.osdmap.pool_by_name(pool_name)
+        old = pool.pg_num
+        if new_pg_num <= old:
+            raise ValueError(f"pg_num can only increase "
+                             f"({old} -> {new_pg_num})")
+        for osd in self.osds.values():
+            # static mode never ran _on_map_change for pool create, so
+            # record the pre-split pg_num the delta detector needs
+            osd._pool_pg_nums.setdefault(pool.pool_id, old)
+        pool.pg_num = new_pg_num
+        self.osdmap.bump()
+        # same path as mon mode: _on_map_change quiesces in-flight
+        # write pipelines before the store split, and client ops gate
+        # on the split task — calling split_pool_pgs directly would
+        # move objects out from under a running RMW
+        before = sum(o.split_moved for o in self.osds.values())
+        for osd in self.osds.values():
+            if osd.up:
+                osd._on_map_change(self.osdmap)
+        for osd in self.osds.values():
+            if osd._split_task is not None:
+                await osd._split_task
+        await self.peer_all()
+        return sum(o.split_moved for o in self.osds.values()) - before
 
     async def peer_all(self) -> dict:
         """Run a peering sweep on every up OSD (static-mode recovery

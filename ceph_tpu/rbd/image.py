@@ -39,7 +39,9 @@ DEFAULT_ORDER = 22          # 4 MiB objects, the reference default
 
 
 class RBDError(Exception):
-    pass
+    def __init__(self, msg: str, errno: int = 0) -> None:
+        super().__init__(msg)
+        self.errno = errno
 
 
 class RBD:
@@ -144,6 +146,12 @@ class Image:
         self._present: "set[int]" = set()   # known-existing data objects
         self._parent_img: "Optional[Image]" = None  # cached parent handle
         self._journal = None                # lazy Journal when enabled
+        # exclusive lock state (reference librbd::ExclusiveLock)
+        import os as _os
+        self._owner = f"client.{_os.urandom(6).hex()}"
+        self._locked = False
+        self._watch_id: "Optional[int]" = None
+        self._watch_renewed = 0.0
 
     async def _load(self) -> None:
         try:
@@ -275,9 +283,106 @@ class Image:
             await jr.destroy()
         self._journal = None
 
+    # --- exclusive lock (reference librbd/ExclusiveLock.h:15 +
+    # --- ManagedLock; lock state lives in the header object's cls_lock
+    # --- xattr, liveness in its watch table) ----------------------------------
+
+    async def enable_exclusive_lock(self) -> None:
+        """'rbd feature enable <img> exclusive-lock': mutations then
+        require the cooperative header lock; the first write
+        auto-acquires (librbd behavior)."""
+        self.hdr["exclusive_lock"] = True
+        await self._save()
+
+    async def acquire_lock(self) -> None:
+        """Take the header lock, breaking a DEAD holder's lock: a live
+        holder watches the header and acks a notify ping; silence
+        means the holder is gone and its lock can be broken
+        (reference ExclusiveLock::handle_peer_notification +
+        break_lock on dead watchers)."""
+        if self._locked:
+            return
+        hdr_oid = RBD._header(self.name)
+        args = json.dumps({"owner": self._owner}).encode()
+        from ..client.objecter import ObjecterError
+        try:
+            await self.io.exec(hdr_oid, "lock", "lock", args)
+        except ObjecterError as e:
+            if e.errno != 16:     # EBUSY = held by someone else
+                raise
+            res = await self.io.notify(hdr_oid, b"lock-ping",
+                                       timeout=1.0)
+            if res["acked"]:
+                raise RBDError(
+                    f"image {self.name!r} is locked by a live client",
+                    errno=16)
+            info = json.loads((await self.io.exec(
+                hdr_oid, "lock", "get_info", b"")).decode() or "{}")
+            if info.get("owner"):
+                await self.io.exec(hdr_oid, "lock", "break_lock",
+                                   json.dumps(
+                                       {"owner": info["owner"]}).encode())
+            await self.io.exec(hdr_oid, "lock", "lock", args)
+        # watch the header: our liveness signal for future breakers,
+        # and the channel lock-release requests would ride
+        self._watch_id = await self.io.watch(hdr_oid,
+                                             lambda oid, payload: None)
+        import time as _time
+        self._watch_renewed = _time.monotonic()
+        self._locked = True
+
+    # watches are volatile on the PG primary (dropped on failover): a
+    # holder whose watch silently died looks dead to a breaker's
+    # liveness ping.  Mutations renew the watch on this period so the
+    # vulnerable window is bounded (librbd closes it fully by
+    # blocklisting the broken owner; blocklisting is out of scope —
+    # documented residual: failover + break both inside one period).
+    WATCH_RENEW_S = 5.0
+
+    async def _renew_watch(self) -> None:
+        import time as _time
+        now = _time.monotonic()
+        if now - self._watch_renewed < self.WATCH_RENEW_S:
+            return
+        hdr_oid = RBD._header(self.name)
+        old = self._watch_id
+        self._watch_id = await self.io.watch(hdr_oid,
+                                             lambda oid, payload: None)
+        self._watch_renewed = now
+        if old is not None:
+            try:
+                await self.io.unwatch(hdr_oid, old)
+            except Exception:  # noqa: BLE001 — stale id after failover
+                pass
+
+    async def release_lock(self) -> None:
+        if not self._locked:
+            return
+        hdr_oid = RBD._header(self.name)
+        if self._watch_id is not None:
+            await self.io.unwatch(hdr_oid, self._watch_id)
+            self._watch_id = None
+        await self.io.exec(hdr_oid, "lock", "unlock",
+                           json.dumps({"owner": self._owner}).encode())
+        self._locked = False
+
+    async def _require_lock(self) -> None:
+        if not self.hdr.get("exclusive_lock"):
+            return
+        if not self._locked:
+            await self.acquire_lock()
+        else:
+            await self._renew_watch()
+
+    async def close(self) -> None:
+        """Release the exclusive lock (if held); further use re-opens
+        it via auto-acquire."""
+        await self.release_lock()
+
     async def write(self, off: int, data: bytes) -> None:
         if off + len(data) > self.size:
             raise RBDError("write beyond image size")
+        await self._require_lock()
         jr = await self._jr()
         if jr is not None:
             await jr.append("write", {"off": off}, bytes(data))
@@ -322,6 +427,7 @@ class Image:
         """Zero a range (punch holes at object granularity).  A cloned
         child must WRITE zeros — removing its object would re-expose the
         parent's bytes through the fall-through read."""
+        await self._require_lock()
         jr = await self._jr()
         if jr is not None:
             await jr.append("discard", {"off": off, "len": length})
@@ -339,6 +445,7 @@ class Image:
                 await self.io.write(self._data(idx), b"\0" * n, ooff)
 
     async def resize(self, new_size: int) -> None:
+        await self._require_lock()
         jr = await self._jr()
         if jr is not None:
             await jr.append("resize", {"size": new_size})
@@ -383,6 +490,7 @@ class Image:
         """O(metadata): take a pool snapshot; NO data is copied — the
         first write after the snap COWs only the touched object (the
         OSD-side generation clone, osd/ecbackend.py snap_clone path)."""
+        await self._require_lock()
         jr = await self._jr()
         if jr is not None:
             await jr.append("snap_create", {"snap": snap})

@@ -300,7 +300,11 @@ class Gateway:
         status = brec.get("versioning", "Off")
         if status == "Enabled":
             return True, False
-        if status == "Suspended" and cur.get("version_id"):
+        # Suspended: retain REAL ids only — "null" (a suspended-mode
+        # delete marker / null version) is overwritten, preserving
+        # S3's single-null-version invariant
+        if status == "Suspended" and \
+                cur.get("version_id") not in (None, "null"):
             return True, False
         return False, True
 

@@ -1,0 +1,189 @@
+"""PG split: pg_num increase on a live pool + the acting autoscaler.
+
+Reference: OSD::split_pgs (src/osd/OSD.cc:8891), pg_t split math
+(src/osd/osd_types.cc), OSDMonitor pg_num handling, and the
+pg_autoscaler mgr module in 'on' mode.  Placement uses ceph_stable_mod
+so a pool growing N -> 2N splits each PG into itself + one child
+instead of reshuffling every object.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.osdmap import pg_parent, stable_mod
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+class TestStableMod:
+    def test_split_stability(self):
+        """Doubling pg_num moves an object nowhere or to pg + N."""
+        rng = np.random.default_rng(0)
+        for x in rng.integers(0, 2**32, 2000, dtype=np.uint64):
+            x = int(x)
+            for n in (1, 2, 4, 8, 16):
+                a = stable_mod(x, n)
+                b = stable_mod(x, 2 * n)
+                assert b in (a, a + n), (x, n, a, b)
+                assert pg_parent(b, n) == a
+        # non-power-of-two pg_nums stay in range
+        for x in rng.integers(0, 2**32, 500, dtype=np.uint64):
+            for n in (3, 6, 12, 100):
+                assert 0 <= stable_mod(int(x), n) < n
+
+
+class TestSplitStatic:
+    def test_split_preserves_data_and_remaps(self, loop):
+        async def go():
+            c = MiniCluster(n_osds=6)
+            c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "2",
+                                    "m": "1"}, pg_num=4,
+                             stripe_unit=4096)
+            async with c:
+                client = await c.client()
+                io = client.io_ctx("ec")
+                blobs = {f"o-{i}": payload(8000, i) for i in range(60)}
+                for name, data in blobs.items():
+                    await io.write_full(name, data)
+                moved = await c.set_pg_num("ec", 8)
+                assert moved > 0
+                # every object readable; every object served from its
+                # NEW pg (the wrong-pg ESTALE gate would reject stale
+                # targeting, so a plain read proves placement)
+                for name, data in blobs.items():
+                    assert await io.read(name) == data
+                # at least one child PG actually holds objects
+                pool = c.osdmap.pool_by_name("ec")
+                assert pool.pg_num == 8
+                child_pgs = {c.osdmap.object_to_pg(pool.pool_id, n)
+                             for n in blobs}
+                assert any(pg >= 4 for pg in child_pgs)
+                # listing still covers everything (pgls over 8 PGs)
+                assert set(await io.list_objects()) >= set(blobs)
+                # writes after the split land fine
+                await io.write_full("post-split", b"x" * 5000)
+                assert await io.read("post-split") == b"x" * 5000
+        loop.run_until_complete(go())
+
+    def test_split_under_load(self, loop):
+        async def go():
+            c = MiniCluster(n_osds=6)
+            c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "2",
+                                    "m": "1"}, pg_num=2,
+                             stripe_unit=4096)
+            async with c:
+                client = await c.client()
+                io = client.io_ctx("ec")
+                acked = {}
+                stop = asyncio.Event()
+
+                async def writer(wid: int):
+                    i = 0
+                    while not stop.is_set():
+                        name = f"w{wid}-{i}"
+                        data = payload(4000, wid * 1000 + i)
+                        await io.write_full(name, data)
+                        acked[name] = data
+                        i += 1
+                        await asyncio.sleep(0)
+
+                writers = [asyncio.ensure_future(writer(w))
+                           for w in range(3)]
+                await asyncio.sleep(0.3)
+                await c.set_pg_num("ec", 4)
+                await asyncio.sleep(0.3)
+                await c.set_pg_num("ec", 8)
+                await asyncio.sleep(0.2)
+                stop.set()
+                await asyncio.gather(*writers)
+                assert len(acked) > 10
+                for name, data in acked.items():
+                    assert await io.read(name) == data, name
+        loop.run_until_complete(go())
+
+
+class TestSplitMonMode:
+    def test_pool_set_pg_num_via_mon(self, loop):
+        async def go():
+            c = MiniCluster(n_osds=5, n_mons=1)
+            async with c:
+                await c.create_ec_pool_cmd(
+                    "mp", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=2, stripe_unit=4096)
+                client = await c.client()
+                io = client.io_ctx("mp")
+                blobs = {f"m-{i}": payload(6000, i) for i in range(30)}
+                for name, data in blobs.items():
+                    await io.write_full(name, data)
+                admin = await c._admin_client()
+                res = await admin.mon_command({
+                    "prefix": "osd pool set", "name": "mp",
+                    "key": "pg_num", "value": 4})
+                assert "epoch" in res
+                # decrease refused
+                with pytest.raises(Exception):
+                    await admin.mon_command({
+                        "prefix": "osd pool set", "name": "mp",
+                        "key": "pg_num", "value": 2})
+                # wait for OSDs to consume the epoch + split + re-peer
+                for _ in range(100):
+                    pool = client.osdmap.pool_by_name("mp")
+                    if pool is not None and pool.pg_num == 4:
+                        break
+                    await asyncio.sleep(0.05)
+                await asyncio.sleep(0.3)
+                for name, data in blobs.items():
+                    assert await io.read(name) == data, name
+        loop.run_until_complete(go())
+
+
+class TestActingAutoscaler:
+    def test_mode_on_applies_pg_num(self, loop):
+        async def go():
+            from ceph_tpu.common.config import Config
+            cfg = Config()
+            cfg.set("mgr_pg_autoscaler_mode", "on")
+            cfg.set("mon_target_pg_per_osd", "4")
+            cfg.set("mgr_stats_period", "0.3")
+            c = MiniCluster(n_osds=5, n_mons=1, config=cfg, mgr=True)
+            async with c:
+                await c.create_ec_pool_cmd(
+                    "auto", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=1, stripe_unit=4096)
+                client = await c.client()
+                io = client.io_ctx("auto")
+                blobs = {f"a-{i}": payload(3000, i) for i in range(20)}
+                for name, data in blobs.items():
+                    await io.write_full(name, data)
+                # budget = 5 osds * 4 / 1 pool / size 3 -> rec 8;
+                # pg_num 1 * 4 <= 8 -> TOO_FEW_PGS -> mode=on applies
+                applied = None
+                for _ in range(200):
+                    pool = client.osdmap.pool_by_name("auto")
+                    if pool is not None and pool.pg_num > 1:
+                        applied = pool.pg_num
+                        break
+                    await asyncio.sleep(0.1)
+                assert applied and applied > 1, \
+                    "autoscaler never applied a pg_num increase"
+                status = c.mgr.modules["pg_autoscaler"].recommendations()
+                assert any(r["pool"] == "auto" for r in status)
+                await asyncio.sleep(0.3)
+                for name, data in blobs.items():
+                    assert await io.read(name) == data, name
+        loop.run_until_complete(go())

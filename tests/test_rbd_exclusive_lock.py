@@ -1,0 +1,92 @@
+"""RBD exclusive-lock: two writers serialize; a dead holder's lock is
+broken via the watch-liveness check.
+
+Reference: src/librbd/ExclusiveLock.h:15 + ManagedLock (cooperative
+cls_lock on the header object; breakers check header watchers for
+liveness before break_lock).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.rbd import RBD
+from ceph_tpu.rbd.image import RBDError
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("data", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                     pg_num=4, stripe_unit=4096)
+    return c
+
+
+class TestExclusiveLock:
+    def test_two_writers_serialize(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                ca = await c.client()
+                cb = await c.client()
+                rbd_a = RBD(ca.io_ctx("data"))
+                await rbd_a.create("disk", 1 << 20, order=16)
+                img_a = await rbd_a.open("disk")
+                await img_a.enable_exclusive_lock()
+
+                # A writes -> auto-acquires the lock
+                await img_a.write(0, b"A" * 4096)
+                assert img_a._locked
+
+                # B (live A) is refused with EBUSY
+                img_b = await RBD(cb.io_ctx("data")).open("disk")
+                with pytest.raises(RBDError) as ei:
+                    await img_b.write(4096, b"B" * 4096)
+                assert ei.value.errno == 16
+
+                # A releases cleanly -> B acquires and writes
+                await img_a.close()
+                await img_b.write(4096, b"B" * 4096)
+                assert img_b._locked
+                assert await img_b.read(0, 8192) == \
+                    b"A" * 4096 + b"B" * 4096
+                # ...and now A is the one refused
+                with pytest.raises(RBDError):
+                    await img_a.write(0, b"x")
+                await img_b.close()
+        loop.run_until_complete(go())
+
+    def test_dead_holder_lock_breaks(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                ca = await c.client()
+                cb = await c.client()
+                rbd_a = RBD(ca.io_ctx("data"))
+                await rbd_a.create("disk2", 1 << 20, order=16)
+                img_a = await rbd_a.open("disk2")
+                await img_a.enable_exclusive_lock()
+                await img_a.write(0, b"A" * 4096)
+                assert img_a._locked
+
+                # the holder's client dies WITHOUT unlocking: its
+                # header watch dies with the connection, so the next
+                # writer's liveness ping goes unacked and the lock
+                # breaks (ManagedLock break_lock on dead watcher)
+                await ca.shutdown()
+                img_b = await RBD(cb.io_ctx("data")).open("disk2")
+                await img_b.write(4096, b"B" * 4096)
+                assert img_b._locked
+                assert await img_b.read(0, 8192) == \
+                    b"A" * 4096 + b"B" * 4096
+                # journaling + exclusive lock compose: appends gated
+                await img_b.enable_journaling()
+                await img_b.write(0, b"C" * 100)
+                await img_b.close()
+        loop.run_until_complete(go())

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""objectstore_tool — offline surgery on a STOPPED OSD's object store.
+
+Rebuild of src/tools/ceph_objectstore_tool.cc (the disaster-recovery
+store surgeon): list PGs and objects, export a PG shard to a portable
+file, import it into another (fresh) OSD's store, and dump or repair
+per-shard HashInfo.  Works against any objectstore backend the OSD can
+run on (mem stores excepted — nothing survives the process).
+
+Usage:
+  objectstore_tool.py --store-path DIR [--store-type file|kv|block] CMD
+
+  list-pgs
+  list PGID                      (e.g. 1.3)
+  export PGID --file OUT
+  import --file IN               (refuses if the pg exists)
+  dump-hinfo PGID OID
+  repair-hinfo PGID OID          (recompute chunk crc chain from data)
+
+Export format: one JSON object; binary payloads hex-encoded (portable
+and diffable; these are recovery artifacts, not hot-path data).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.objectstore import Transaction, create_store  # noqa: E402
+from ceph_tpu.objectstore.types import Collection, ObjectId  # noqa: E402
+
+HINFO_XATTR = "hinfo_key"     # must match osd/ecutil.py
+
+
+def _parse_pgid(s: str):
+    pool, _, pg = s.partition(".")
+    return int(pool), int(pg)
+
+
+def open_store(args):
+    store = create_store(args.store_type, args.store_path)
+    store.mount()
+    return store
+
+
+def cmd_list_pgs(store, args) -> None:
+    pgs = {}
+    for c in store.list_collections():
+        if c.pool < 0:
+            continue          # OSD superblock collection, not a pg
+        pgs.setdefault(f"{c.pool}.{c.pg}", []).append(c.shard)
+    print(json.dumps({pg: sorted(sh) for pg, sh in
+                      sorted(pgs.items())}))
+
+
+def cmd_list(store, args) -> None:
+    pool, pg = _parse_pgid(args.pgid)
+    out = []
+    for c in store.list_collections():
+        if (c.pool, c.pg) != (pool, pg):
+            continue
+        for o in store.list_objects(c):
+            if o.name == "_pgmeta_":
+                continue         # pg metadata travels with export only
+            out.append({"oid": o.name, "shard": c.shard,
+                        "gen": o.generation,
+                        "size": store.stat(c, o)["size"]})
+    print(json.dumps(sorted(out, key=lambda r: (r["oid"], r["shard"],
+                                                r["gen"]))))
+
+
+def cmd_export(store, args) -> None:
+    pool, pg = _parse_pgid(args.pgid)
+    dump = {"version": 1, "pgid": [pool, pg], "collections": []}
+    found = False
+    for c in store.list_collections():
+        if (c.pool, c.pg) != (pool, pg):
+            continue
+        found = True
+        objs = []
+        for o in store.list_objects(c):
+            objs.append({
+                "name": o.name, "shard": o.shard, "gen": o.generation,
+                "data": bytes(store.read(c, o)).hex(),
+                "attrs": {k: v.hex() for k, v in
+                          store.get_attrs(c, o).items()},
+                "omap": {k: v.hex() for k, v in
+                         store.omap_get(c, o).items()},
+            })
+        dump["collections"].append({"shard": c.shard, "objects": objs})
+    if not found:
+        sys.exit(f"no collections for pg {args.pgid}")
+    with open(args.file, "w") as f:
+        json.dump(dump, f)
+    n = sum(len(c["objects"]) for c in dump["collections"])
+    print(json.dumps({"exported": args.pgid, "objects": n,
+                      "file": args.file}))
+
+
+def cmd_import(store, args) -> None:
+    with open(args.file) as f:
+        dump = json.load(f)
+    pool, pg = dump["pgid"]
+    for c in store.list_collections():
+        if (c.pool, c.pg) == (pool, pg):
+            sys.exit(f"pg {pool}.{pg} already present in this store: "
+                     f"remove it first (safety: import never merges)")
+    n = 0
+    for crec in dump["collections"]:
+        cid = Collection(pool, pg, int(crec["shard"]))
+        t = Transaction()
+        t.create_collection(cid)
+        for rec in crec["objects"]:
+            oid = ObjectId(rec["name"], int(rec["shard"]),
+                           int(rec["gen"]))
+            t.touch(cid, oid)
+            data = bytes.fromhex(rec["data"])
+            if data:
+                t.write(cid, oid, 0, data)
+            for k, v in rec["attrs"].items():
+                t.setattr(cid, oid, k, bytes.fromhex(v))
+            if rec["omap"]:
+                t.omap_setkeys(cid, oid, {
+                    k: bytes.fromhex(v) for k, v in rec["omap"].items()})
+            n += 1
+        store.apply_transaction(t)
+    print(json.dumps({"imported": f"{pool}.{pg}", "objects": n}))
+
+
+def _iter_object(store, pgid_s, oid_name):
+    pool, pg = _parse_pgid(pgid_s)
+    for c in store.list_collections():
+        if (c.pool, c.pg) != (pool, pg):
+            continue
+        for o in store.list_objects(c):
+            if o.name == oid_name:
+                yield c, o
+
+
+def cmd_dump_hinfo(store, args) -> None:
+    from ceph_tpu.osd.ecutil import HashInfo
+    out = []
+    for c, o in _iter_object(store, args.pgid, args.oid):
+        try:
+            raw = store.get_attr(c, o, HINFO_XATTR)
+            hi = HashInfo.decode(bytes(raw))
+            rec = {"shard": c.shard, "gen": o.generation,
+                   "total_chunk_size": hi.total_chunk_size,
+                   "crcs": [f"{x:08x}" for x in hi.cumulative_shard_hashes]}
+        except Exception as e:  # noqa: BLE001 — absent/corrupt
+            rec = {"shard": c.shard, "gen": o.generation,
+                   "error": str(e)}
+        out.append(rec)
+    if not out:
+        sys.exit(f"no object {args.oid!r} in pg {args.pgid}")
+    print(json.dumps(out))
+
+
+def cmd_repair_hinfo(store, args) -> None:
+    """Recompute THIS shard's cumulative crc from the on-disk chunk
+    bytes (reference ceph-objectstore-tool's fix-ec-hinfo surgery).
+    The hashes vector spans all k+m shards; entries for shards this
+    store doesn't hold are preserved from the existing xattr (or taken
+    from --shards for a rebuilt one) — each OSD verifies only its own
+    index on read."""
+    import numpy as np
+    from ceph_tpu.ops.crc32c import crc32c
+    from ceph_tpu.osd.ecutil import HashInfo
+    fixed = []
+    for c, o in _iter_object(store, args.pgid, args.oid):
+        data = bytes(store.read(c, o))
+        crc = crc32c(np.frombuffer(data, dtype=np.uint8), 0xFFFFFFFF) \
+            if data else 0xFFFFFFFF
+        try:
+            hi = HashInfo.decode(
+                bytes(store.get_attr(c, o, HINFO_XATTR)))
+        except Exception:  # noqa: BLE001 — absent/corrupt: rebuild
+            hi = HashInfo(args.shards)
+        if c.shard >= len(hi.cumulative_shard_hashes):
+            sys.exit(f"shard {c.shard} outside hinfo width "
+                     f"{len(hi.cumulative_shard_hashes)}; pass --shards")
+        hi.total_chunk_size = len(data)
+        hi.cumulative_shard_hashes[c.shard] = int(crc)
+        t = Transaction()
+        t.setattr(c, o, HINFO_XATTR, hi.encode())
+        store.apply_transaction(t)
+        fixed.append({"shard": c.shard, "crc": f"{crc:08x}",
+                      "size": len(data)})
+    if not fixed:
+        sys.exit(f"no object {args.oid!r} in pg {args.pgid}")
+    print(json.dumps(fixed))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--store-path", required=True)
+    p.add_argument("--store-type", default="file")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list-pgs")
+    sp = sub.add_parser("list")
+    sp.add_argument("pgid")
+    sp = sub.add_parser("export")
+    sp.add_argument("pgid")
+    sp.add_argument("--file", required=True)
+    sp = sub.add_parser("import")
+    sp.add_argument("--file", required=True)
+    sp = sub.add_parser("dump-hinfo")
+    sp.add_argument("pgid")
+    sp.add_argument("oid")
+    sp = sub.add_parser("repair-hinfo")
+    sp.add_argument("pgid")
+    sp.add_argument("oid")
+    sp.add_argument("--shards", type=int, default=3,
+                    help="k+m width when rebuilding an absent hinfo")
+    args = p.parse_args()
+    store = open_store(args)
+    try:
+        {"list-pgs": cmd_list_pgs, "list": cmd_list,
+         "export": cmd_export, "import": cmd_import,
+         "dump-hinfo": cmd_dump_hinfo,
+         "repair-hinfo": cmd_repair_hinfo}[args.cmd](store, args)
+    finally:
+        store.umount()
+
+
+if __name__ == "__main__":
+    main()
