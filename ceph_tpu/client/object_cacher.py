@@ -1,0 +1,161 @@
+"""ObjectCacher — client-side object caching for the service layers.
+
+Reference: src/osdc/ObjectCacher.h:52 (the extent cache librbd and the
+fuse client mount between themselves and RADOS).  The lean rebuild is
+a WRITE-THROUGH LRU over whole objects wrapped around an IoCtx:
+
+- reads fill the cache; repeat reads of hot objects (RBD headers,
+  CephFS inodes + dirents, small files) skip the OSD round trip;
+- every mutation goes straight to the OSDs (write-through — the
+  reference's safest cache mode) and updates/invalidates the local
+  copy, so a crashed client never holds acked-but-unsent data (the
+  reference's writeback mode buys latency at exactly that risk);
+- coherence across clients is the caller's contract, as in librbd:
+  single-writer use (e.g. under the RBD exclusive lock) is coherent;
+  multi-writer without locking must not cache (same caveat the
+  reference documents for rbd_cache).
+
+``CachedIoCtx`` is a drop-in IoCtx: pass it to ``RBD``, ``Image``,
+``FileSystem``, or ``Gateway`` in place of the raw context.  Ops it
+does not intercept (omap, watch/notify, exec, snapshots) pass through
+untouched — omap mutability makes caching it wrong for dirents, and
+the metadata round trips are not the hot path this exists for.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+
+class CachedIoCtx:
+    def __init__(self, io, max_bytes: int = 32 << 20,
+                 max_object_bytes: int = 4 << 20) -> None:
+        self.io = io
+        self.max_bytes = max_bytes
+        self.max_object_bytes = max_object_bytes
+        self._cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    # --- cache bookkeeping ----------------------------------------------------
+
+    def _insert(self, oid: str, data: bytes) -> None:
+        if len(data) > self.max_object_bytes:
+            return
+        self._drop(oid)
+        self._cache[oid] = data
+        self._bytes += len(data)
+        while self._bytes > self.max_bytes and self._cache:
+            _old, blob = self._cache.popitem(last=False)
+            self._bytes -= len(blob)
+
+    def _drop(self, oid: str) -> None:
+        blob = self._cache.pop(oid, None)
+        if blob is not None:
+            self._bytes -= len(blob)
+
+    def invalidate(self, oid: "Optional[str]" = None) -> None:
+        """Drop one object (or everything) — the hook for external
+        coherence signals (e.g. a watch callback on shared state)."""
+        if oid is None:
+            self._cache.clear()
+            self._bytes = 0
+        else:
+            self._drop(oid)
+
+    def stats(self) -> dict:
+        return {"bytes": self._bytes, "objects": len(self._cache),
+                "hits": self.hits, "misses": self.misses}
+
+    # --- intercepted reads ----------------------------------------------------
+
+    async def read(self, oid: str, length: int = 0, off: int = 0,
+                   snap: "Optional[str]" = None) -> bytes:
+        if snap is not None:
+            # snapshot reads bypass: one cache slot per oid holds HEAD
+            return await self.io.read(oid, length, off, snap=snap)
+        blob = self._cache.get(oid)
+        if blob is not None:
+            self._cache.move_to_end(oid)
+            self.hits += 1
+            end = off + length if length else len(blob)
+            return blob[off:end]
+        self.misses += 1
+        if off == 0 and not length:
+            data = await self.io.read(oid)
+            self._insert(oid, data)
+            return data
+        # partial miss: fetch the WHOLE object once (the reference
+        # caches per-extent; whole-object keeps correctness obvious
+        # and matches the striper's small fixed object sizes)
+        data = await self.io.read(oid)
+        self._insert(oid, data)
+        end = off + length if length else len(data)
+        return data[off:end]
+
+    # --- intercepted writes (write-through + local update) --------------------
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        await self.io.write_full(oid, data)
+        self._insert(oid, bytes(data))
+
+    async def write(self, oid: str, data: bytes, off: int) -> None:
+        await self.io.write(oid, data, off)
+        blob = self._cache.get(oid)
+        if blob is None:
+            return
+        end = off + len(data)
+        if off > len(blob):
+            # writing past a hole: drop instead of guessing zeros
+            self._drop(oid)
+            return
+        buf = bytearray(blob)
+        if end > len(buf):
+            buf.extend(b"\x00" * (end - len(buf)))
+        buf[off:end] = data
+        self._insert(oid, bytes(buf))
+
+    async def append(self, oid: str, data: bytes) -> None:
+        await self.io.append(oid, data)
+        blob = self._cache.pop(oid, None)
+        if blob is not None:
+            self._bytes -= len(blob)
+            self._insert(oid, blob + bytes(data))
+
+    async def truncate(self, oid: str, size: int) -> None:
+        await self.io.truncate(oid, size)
+        blob = self._cache.get(oid)
+        if blob is not None:
+            if size <= len(blob):
+                self._insert(oid, blob[:size])
+            else:
+                self._drop(oid)
+
+    async def remove(self, oid: str) -> None:
+        self._drop(oid)
+        await self.io.remove(oid)
+
+    # mutations that change object state through side doors drop the
+    # cached copy before passing through
+    async def exec(self, oid: str, cls: str, method: str,
+                   data: bytes = b"") -> bytes:
+        self._drop(oid)
+        return await self.io.exec(oid, cls, method, data)
+
+    async def copy_from(self, dst_oid: str, src_oid: str) -> int:
+        self._drop(dst_oid)
+        return await self.io.copy_from(dst_oid, src_oid)
+
+    async def cache_flush(self, oid: str) -> int:
+        return await self.io.cache_flush(oid)
+
+    async def cache_evict(self, oid: str) -> None:
+        self._drop(oid)
+        await self.io.cache_evict(oid)
+
+    # --- passthrough ----------------------------------------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.io, name)

@@ -73,17 +73,13 @@ class FileStore(ObjectStore):
             return bytes(data)
         return bytes([_ALGO_TAGS[algo]]) + comp
 
-    @staticmethod
-    def _unframe_static(codec_get, row: bytes) -> bytes:
+    def _unframe(self, row: bytes) -> bytes:
         if len(row) >= BLOCK:
             return bytes(row)
         algo = _TAG_ALGOS.get(row[0])
         if algo is None:
             return bytes(row)      # short legacy tail block
-        return codec_get(algo).decompress(bytes(row[1:]))
-
-    def _unframe(self, row: bytes) -> bytes:
-        return self._unframe_static(self._codec, row)
+        return self._codec(algo).decompress(bytes(row[1:]))
 
     # --- lifecycle -----------------------------------------------------------
 

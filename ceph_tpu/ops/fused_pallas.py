@@ -29,6 +29,12 @@ src/osd/ECUtil.cc:120 and the per-shard crc at src/osd/ECUtil.cc:172):
    group i.  This is the MXU floor for this problem: 8 bit-planes x 128
    lanes = 1024 int8 MACs per data byte covering ALL k+m crcs (the
    naive layout needs 1408 with 3/4 of lanes padded dead).
+   Geometries with m > 3 go HYBRID (r5): the first three parities ride
+   the data maps as above; each later parity is crc'd from its own
+   freshly-encoded bytes (still in registers) through a 1-map matmul —
+   1024*(1 + (m-3)/k) MAC per data byte instead of widening every data
+   matmul to a second, mostly-dead lane tile (2048 MAC/B): 1.8x less
+   MXU work for cauchy k=10 m=4, 1.33x for LRC m=7.
 
 4. Bit-plane "unpack" costs ONE VPU shift per plane per word: the
    operand for plane i is (word >> i) reinterpreted as int8 bytes via
@@ -146,13 +152,23 @@ def _regs_for_bytes(op_cols: np.ndarray) -> np.ndarray:
     return ((regs[:, None] >> np.arange(32)[None, :]) & 1).astype(np.uint8)
 
 
+def _in_map_parities(m: int) -> int:
+    """Parities whose crcs ride the data chunks' 4-map matmuls (the
+    lane-packing trick): at most 3 — (1+3)*32 = 128 lanes fills ONE
+    MXU tile exactly.  Parities beyond 3 are crc'd from their own
+    parity BYTES (extra VPU unpack + a 1-map matmul), which measures
+    cheaper than widening every data matmul to a second, mostly-dead
+    lane tile: the old 2-tile layout cost 2048 MAC per data byte at
+    m in 4..7; the hybrid costs 1024*(1 + (m-3)/k) — 1.8x less for
+    cauchy k=10 m=4, 1.33x for LRC k=8 m=7."""
+    return min(m, 3)
+
+
 def _lane_groups(m: int) -> int:
-    """MXU lane width per crc matmul: 32*(1+m) map lanes rounded up to
-    whole 128-lane tiles.  m <= 3 fits ONE tile (the 1024 MAC/B floor);
-    m in 4..7 takes two tiles (2048 MAC/B) and m in 8..11 three
-    (3072 MAC/B) — the floor scales with the tile count but stays 2-5x
-    better than the unfused path for those geometries."""
-    return ((1 + m) * 32 + 127) // 128
+    """MXU lane width per crc matmul: one 128-lane tile always — data
+    matmuls carry [crc(d), crc(c_1 d), crc(c_2 d), crc(c_3 d)]; see
+    _in_map_parities for where m > 3 parities get their crcs."""
+    return ((1 + _in_map_parities(m)) * 32 + 127) // 128
 
 
 @functools.lru_cache(maxsize=16)
@@ -163,20 +179,38 @@ def _m1_matrix(c_bytes: bytes, m: int, k: int, seg_w: int) -> np.ndarray:
     S_p = advance-by-(4*(seg_w-1-p)+1)-bytes, T_0 = id and
     T_g = multiply-by-C[g-1, j] in GF(2^8).  The byte-slot phase
     (A^(3-c)) is deferred to the combine matmul (_m2_matrix).
+    Carries maps for the data chunk + the first _in_map_parities(m)
+    parities only; later parities crc from their own bytes (_m1p).
     """
     C = np.frombuffer(c_bytes, dtype=np.uint8).reshape(m, k)
+    G = _in_map_parities(m)
     L = 128 * _lane_groups(m)
     ops = _op_chain(1, 4, seg_w)[::-1]                 # ops[p] for word p
     M1 = np.zeros((k, 8, seg_w, L), dtype=np.int8)
     for p in range(seg_w):
         regs = _regs_for_bytes(ops[p])                 # (256, 32) bits
         for j in range(k):
-            for g in range(1 + m):
+            for g in range(1 + G):
                 coeff = 1 if g == 0 else int(C[g - 1, j])
                 for i in range(8):
                     val = gf8.gf_mul(coeff, 1 << i)
                     M1[j, i, p, 32 * g:32 * g + 32] = regs[val]
     return M1
+
+
+@functools.lru_cache(maxsize=8)
+def _m1p_matrix(seg_w: int, lanes: int = 128) -> np.ndarray:
+    """Identity-map M1 for byte-side parity crcs: (8, seg_w, lanes)
+    int8, lanes 0..31 = the plain crc map of 2^i, rest zero.  Shared
+    by every parity beyond the in-map three (coefficient is identity:
+    the operand IS the parity chunk's own bytes)."""
+    ops = _op_chain(1, 4, seg_w)[::-1]
+    M1P = np.zeros((8, seg_w, lanes), dtype=np.int8)
+    for p in range(seg_w):
+        regs = _regs_for_bytes(ops[p])
+        for i in range(8):
+            M1P[i, p, 0:32] = regs[1 << i]
+    return M1P
 
 
 @functools.lru_cache(maxsize=16)
@@ -235,38 +269,37 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int,
     blk_w = seg_w * blk_segs
     n_wb = n_words // blk_w
     chunk_bytes = 4 * n_words
+    G = _in_map_parities(m)              # parities riding the data maps
+    E = m - G                            # parities crc'd from own bytes
     L = 128 * _lane_groups(m)            # crc matmul lane width
 
     M1 = _m1_matrix(c_bytes, m, k, seg_w)
     M2_np = _m2_matrix(n_wb, blk_segs, seg_w, chunk_bytes,
-                       n_groups=1 + m, lanes=L)
+                       n_groups=1 + G, lanes=L)
+    M1P = _m1p_matrix(seg_w, L) if E else None
     init_term = np.uint32(crc_ops._matvec(
         crc_ops.shift_operator(chunk_bytes), 0xFFFFFFFF))
     lane_w = (np.uint32(1) << np.arange(32, dtype=np.uint32))
 
-    def kernel(d_ref, m1_ref, par_ref, out1_ref):
-        d = d_ref[0]                                   # (k, blk_segs, seg_w)
-        # ---- encode (VPU SWAR) ----
-        par = _emit_encode(C, [d[j] for j in range(k)])
-        for i in range(m):
-            par_ref[0, i] = par[i]
-        # ---- crc bit-sums (MXU), 4 maps per data chunk ----
-        for j in range(k):
+    def _crc_dots(planes_of, m1_rows, out_write, n_rows, contract):
+        """Shared emission: 8 bit-plane dots + XOR fold per chunk."""
+        for r in range(n_rows):
             accs = []
             for i in range(8):
                 # operand: plane i as int8 bytes; bit 0 = bit i of the
                 # source byte, junk above only pollutes high sum bits
-                pb = pltpu.bitcast(d[j] >> np.uint32(i), jnp.int8)
+                pb = pltpu.bitcast(planes_of(r) >> np.uint32(i),
+                                   jnp.int8)
                 accs.append(jax.lax.dot_general(
-                    pb, m1_ref[j, i], (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32))  # (4*blk_segs, 128)
+                    pb, m1_rows(r, i), ((contract, (0,)), ((), ())),
+                    preferred_element_type=jnp.int32))
             x = accs[0]
             for i in range(1, 8):
                 x = x ^ accs[i]
-            out1_ref[0, j, 0] = (x & 1).astype(jnp.int8)
+            out_write(r, (x & 1).astype(jnp.int8))
 
-    def kernel_packed(d_ref, m1_ref, par_ref, out1_ref):
-        # Small-chunk variant: P whole stripes per block.  An unpacked
+    def _make_kernel(packed: bool):
+        # Packed variant: P whole stripes per block.  An unpacked
         # small chunk feeds the crc matmuls only 4*S rows (S = segments
         # per chunk, 4 byte-slots each) — e.g. 16 rows for an 8 KiB
         # chunk, an 8x under-fill of the 128-row MXU tile, which is why
@@ -275,24 +308,52 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int,
         # P*4*S without any data transpose (the batch is already
         # stripe-major in HBM) and without touching the combine path:
         # each stripe keeps its own rows, so out1 is identical to P=1.
-        d = d_ref[...]                          # (P, k, blk_segs, seg_w)
-        par = _emit_encode(C, [d[:, j] for j in range(k)])
-        for i in range(m):
-            par_ref[:, i] = par[i]
-        for j in range(k):
-            accs = []
-            for i in range(8):
-                # bitcast expands the sublane (second-to-last) dim x4:
-                # (P, S, seg_w) u32 -> (P, 4S, seg_w) i8, row 4r+c =
-                # byte c of word row r — same row order as unpacked
-                pb = pltpu.bitcast(d[:, j] >> np.uint32(i), jnp.int8)
-                accs.append(jax.lax.dot_general(
-                    pb, m1_ref[j, i], (((2,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32))  # (P, 4S, L)
-            x = accs[0]
-            for i in range(1, 8):
-                x = x ^ accs[i]
-            out1_ref[:, j, 0] = (x & 1).astype(jnp.int8)
+        # The bitcast expands the sublane (second-to-last) dim x4:
+        # (.., S, seg_w) u32 -> (.., 4S, seg_w) i8, row 4r+c = byte c
+        # of word row r.
+        cdim = (2,) if packed else (1,)
+
+        def body(d_ref, m1_ref, m1p_ref, par_ref, out1_ref, out1p_ref):
+            if packed:
+                d = d_ref[...]              # (P, k, blk_segs, seg_w)
+                data_row = lambda j: d[:, j]              # noqa: E731
+                w1 = lambda j, v: out1_ref.__setitem__(   # noqa: E731
+                    (slice(None), j, 0), v)
+                wp = lambda e, v: out1p_ref.__setitem__(  # noqa: E731
+                    (slice(None), e, 0), v)
+
+                def wpar(i, v):
+                    par_ref[:, i] = v
+            else:
+                d = d_ref[0]                # (k, blk_segs, seg_w)
+                data_row = lambda j: d[j]                 # noqa: E731
+                w1 = lambda j, v: out1_ref.__setitem__(   # noqa: E731
+                    (0, j, 0), v)
+                wp = lambda e, v: out1p_ref.__setitem__(  # noqa: E731
+                    (0, e, 0), v)
+
+                def wpar(i, v):
+                    par_ref[0, i] = v
+            # ---- encode (VPU SWAR) ----
+            par = _emit_encode(C, [data_row(j) for j in range(k)])
+            for i in range(m):
+                wpar(i, par[i])
+            # ---- crc bit-sums (MXU): 4 maps per data chunk ----
+            _crc_dots(data_row, lambda j, i: m1_ref[j, i], w1, k, cdim)
+            # ---- m>3: remaining parities crc'd from their OWN bytes
+            if E:
+                _crc_dots(lambda e: par[G + e],
+                          lambda e, i: m1p_ref[i], wp, E, cdim)
+
+        if E:
+            return body
+        # m <= 3: no parity-crc output — keep the original arity so
+        # the measured flagship path is untouched (an unused pallas
+        # output would still be DMA'd back from VMEM)
+
+        def body3(d_ref, m1_ref, par_ref, out1_ref):
+            return body(d_ref, m1_ref, None, par_ref, out1_ref, None)
+        return body3
 
     P = pack
 
@@ -307,27 +368,40 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int,
         B = data4.shape[0]
         if B % P:
             raise ValueError(f"batch {B} not divisible by pack {P}")
-        parity4, out1 = pl.pallas_call(
-            kernel_packed if P > 1 else kernel,
+        in_specs = [
+            pl.BlockSpec((P, k, blk_segs, seg_w),
+                         lambda b, w: (b, 0, w, 0)),
+            pl.BlockSpec((k, 8, seg_w, L), lambda b, w: (0, 0, 0, 0)),
+        ]
+        operands = [data4, jnp.asarray(M1)]
+        out_specs = [
+            pl.BlockSpec((P, m, blk_segs, seg_w),
+                         lambda b, w: (b, 0, w, 0)),
+            pl.BlockSpec((P, k, 1, 4 * blk_segs, L),
+                         lambda b, w: (b, 0, w, 0, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((B, m, n_wb * blk_segs, seg_w),
+                                 jnp.uint32),
+            jax.ShapeDtypeStruct((B, k, n_wb, 4 * blk_segs, L),
+                                 jnp.int8),
+        ]
+        if E:
+            in_specs.append(pl.BlockSpec((8, seg_w, L),
+                                         lambda b, w: (0, 0, 0)))
+            operands.append(jnp.asarray(M1P))
+            out_specs.append(pl.BlockSpec((P, E, 1, 4 * blk_segs, L),
+                                          lambda b, w: (b, 0, w, 0, 0)))
+            out_shape.append(jax.ShapeDtypeStruct(
+                (B, E, n_wb, 4 * blk_segs, L), jnp.int8))
+        outs = pl.pallas_call(
+            _make_kernel(P > 1),
             grid=(B // P, n_wb),
-            in_specs=[
-                pl.BlockSpec((P, k, blk_segs, seg_w),
-                             lambda b, w: (b, 0, w, 0)),
-                pl.BlockSpec((k, 8, seg_w, L), lambda b, w: (0, 0, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((P, m, blk_segs, seg_w),
-                             lambda b, w: (b, 0, w, 0)),
-                pl.BlockSpec((P, k, 1, 4 * blk_segs, L),
-                             lambda b, w: (b, 0, w, 0, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((B, m, n_wb * blk_segs, seg_w),
-                                     jnp.uint32),
-                jax.ShapeDtypeStruct((B, k, n_wb, 4 * blk_segs, L),
-                                     jnp.int8),
-            ],
-        )(data4, jnp.asarray(M1))
+            in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape,
+        )(*operands)
+        parity4, out1 = outs[0], outs[1]
+        out1p = outs[2] if E else None
 
         # ---- combine (negligible MACs: ~33/byte vs 1024 above).
         # Multi-dim contraction avoids flattening the int8 (rows, L)
@@ -338,8 +412,14 @@ def _build_fused(c_bytes: bytes, m: int, k: int, n_words: int,
             preferred_element_type=jnp.int32) & 1
         r1 = r1.reshape(B, k, L // 32, 32)
         data_bits = r1[:, :, 0, :]                             # (B, k, 32)
-        par_bits = jnp.sum(r1[:, :, 1:1 + m, :], axis=1) & 1   # (B, m, 32)
-        bits = jnp.concatenate([data_bits, par_bits], axis=1)
+        par_bits = jnp.sum(r1[:, :, 1:1 + G, :], axis=1) & 1   # (B, G, 32)
+        parts = [data_bits, par_bits]
+        if E:
+            r1p = jax.lax.dot_general(
+                out1p, M2r, (((2, 3, 4), (0, 1, 2)), ((), ())),
+                preferred_element_type=jnp.int32) & 1
+            parts.append(r1p.reshape(B, E, L // 32, 32)[:, :, 0, :])
+        bits = jnp.concatenate(parts, axis=1)          # (B, k+m, 32)
         regs = jnp.sum(bits.astype(jnp.uint32) * lane_w[None, None, :],
                        axis=-1, dtype=jnp.uint32)
         crcs = ~(regs ^ init_term)
@@ -425,11 +505,11 @@ def fused_encode_crc(data_u32, k: int, m: int,
 
 def supported_matrix(m: int, W: int, k: "int | None" = None,
                      B: "int | None" = None) -> bool:
-    """m <= 3 runs at the 1024 MAC/B floor (one 128-lane tile); m in
-    4..7 takes two lane tiles (2048 MAC/B), m in 8..11 three — each
-    still well ahead of the unfused path.  Whole segments (>=128
-    words) required; when ``k`` is given the M1 VMEM constant must
-    also fit the measured compile limit.
+    """m <= 3 runs at the 1024 MAC/B floor (one 128-lane tile); m > 3
+    runs the hybrid layout at 1024*(1+(m-3)/k) MAC/B (in-map parities
+    + byte-side parity crcs — see the module docstring).  Whole
+    segments (>=128 words) required; when ``k`` is given the M1 VMEM
+    constant must also fit the measured compile limit.
 
     Chunks below 16 KiB (W < 4096) are served by the PACKED kernel,
     which needs multiple stripes per block to fill the MXU row tiles —

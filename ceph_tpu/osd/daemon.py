@@ -133,6 +133,7 @@ class OSDDaemon(Dispatcher):
         # for CHILD pgs (>= old) gate on the split; parent-pg sub-ops
         # keep flowing so cross-OSD drains can't cycle
         self._splitting_old: "Dict[int, int]" = {}
+        self._split_pending: "Dict[int, int]" = {}
         self._inflight_client_ops = 0
         self.split_moved = 0          # lifetime objects moved by splits
         if self.monc is not None:
@@ -208,11 +209,22 @@ class OSDDaemon(Dispatcher):
         if splits:
             prev = self._split_task
             for pool_id, old, _new in splits:
+                # keep the EARLIEST pre-split pg_num while ANY split of
+                # the pool is pending (counted: back-to-back raises
+                # must not drop the gate when the first move finishes)
                 self._splitting_old.setdefault(pool_id, old)
+                self._split_pending[pool_id] = \
+                    self._split_pending.get(pool_id, 0) + 1
 
             async def run_splits():
                 if prev is not None and not prev.done():
-                    await prev
+                    try:
+                        await prev
+                    except Exception as e:  # noqa: BLE001 — this
+                        # split must still run: the map already raised
+                        # pg_num, and skipping the move would strand
+                        # objects in parent collections permanently
+                        dout("osd", 0, f"previous split failed: {e}")
                 for pool_id, old, new in splits:
                     # quiesce: wait for EVERY admitted client op and
                     # this pool's write pipelines to drain before
@@ -238,7 +250,12 @@ class OSDDaemon(Dispatcher):
                     # coroutine interleaves with it
                     self.split_moved += self.split_pool_pgs(
                         pool_id, old, new)
-                    self._splitting_old.pop(pool_id, None)
+                    left = self._split_pending.get(pool_id, 1) - 1
+                    if left <= 0:
+                        self._split_pending.pop(pool_id, None)
+                        self._splitting_old.pop(pool_id, None)
+                    else:
+                        self._split_pending[pool_id] = left
             self._split_task = asyncio.ensure_future(run_splits())
         for pool_id, pool in osdmap.pools.items():
             for pg in range(pool.pg_num):

@@ -305,30 +305,56 @@ class Image:
         hdr_oid = RBD._header(self.name)
         args = json.dumps({"owner": self._owner}).encode()
         from ..client.objecter import ObjecterError
-        try:
-            await self.io.exec(hdr_oid, "lock", "lock", args)
-        except ObjecterError as e:
-            if e.errno != 16:     # EBUSY = held by someone else
-                raise
-            res = await self.io.notify(hdr_oid, b"lock-ping",
-                                       timeout=1.0)
-            if res["acked"]:
-                raise RBDError(
-                    f"image {self.name!r} is locked by a live client",
-                    errno=16)
-            info = json.loads((await self.io.exec(
-                hdr_oid, "lock", "get_info", b"")).decode() or "{}")
-            if info.get("owner"):
-                await self.io.exec(hdr_oid, "lock", "break_lock",
-                                   json.dumps(
-                                       {"owner": info["owner"]}).encode())
-            await self.io.exec(hdr_oid, "lock", "lock", args)
-        # watch the header: our liveness signal for future breakers,
-        # and the channel lock-release requests would ride
+        # watch BEFORE locking (librbd order): the moment the lock is
+        # ours, our liveness signal is already in place — a competing
+        # acquirer probing in the lock/watch gap must not see zero
+        # watchers and break a freshly-taken lock
         self._watch_id = await self.io.watch(hdr_oid,
                                              lambda oid, payload: None)
         import time as _time
         self._watch_renewed = _time.monotonic()
+
+        async def _drop_watch():
+            if self._watch_id is not None:
+                try:
+                    await self.io.unwatch(hdr_oid, self._watch_id)
+                finally:
+                    self._watch_id = None
+
+        try:
+            await self.io.exec(hdr_oid, "lock", "lock", args)
+        except ObjecterError as e:
+            if e.errno != 16:     # EBUSY = held by someone else
+                await _drop_watch()
+                raise
+            try:
+                res = await self.io.notify(hdr_oid, b"lock-ping",
+                                           timeout=1.0)
+                # >1 ack = another live watcher besides US: the holder
+                # (or another waiter) is alive
+                if len(res["acked"]) > 1:
+                    raise RBDError(
+                        f"image {self.name!r} is locked by a live "
+                        f"client", errno=16)
+                info = json.loads((await self.io.exec(
+                    hdr_oid, "lock", "get_info", b"")).decode() or "{}")
+                if info.get("owner"):
+                    await self.io.exec(
+                        hdr_oid, "lock", "break_lock",
+                        json.dumps({"owner": info["owner"]}).encode())
+                await self.io.exec(hdr_oid, "lock", "lock", args)
+            except ObjecterError as e2:
+                # lost the break/re-lock race to another client: keep
+                # the RBDError(EBUSY) contract callers handle
+                await _drop_watch()
+                if e2.errno == 16:
+                    raise RBDError(
+                        f"image {self.name!r}: lost the lock race",
+                        errno=16)
+                raise
+            except RBDError:
+                await _drop_watch()
+                raise
         self._locked = True
 
     # watches are volatile on the PG primary (dropped on failover): a
