@@ -1,0 +1,262 @@
+"""MDS daemon — the metadata server owning a filesystem's namespace.
+
+Reference: src/mds (MDSDaemon.cc / MDSRank + Server.cc): one ACTIVE
+MDS per rank serializes all namespace mutations through its journal;
+clients send metadata ops over the wire and do file DATA I/O directly
+against the OSDs (the capability model's division of labor).
+
+The lean rebuild keeps that division exactly:
+
+- ``MDSDaemon`` hosts the journaled ``FileSystem`` (fs.py + mdlog.py)
+  and serves namespace ops over the messenger (MMDSOp/MMDSOpReply).
+  Being the only writer, it provides the single-active-writer model
+  the MDLog assumes — multiple clients get a coherent namespace with
+  no client-side locking.
+- ``MDSClient`` is the thin proxy: metadata calls go to the MDS; file
+  data flows client -> striper -> OSDs directly, never through the
+  MDS (``open``-style calls return the inode number, the data key).
+
+Ops served: mkdir, rmdir, listdir, rename, link, symlink, readlink,
+unlink, stat, lstat, chmod, truncate_meta, create (alloc ino + link),
+set_size (post-write size/mtime commit), fsck.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..common.config import Config
+from ..common.log import dout
+from ..msg.message import Message, register_message
+from ..msg.messenger import Dispatcher, Messenger
+from .fs import FileSystem, FSError
+
+
+@register_message
+class MMDSOp(Message):
+    """Client -> mds: fields: tid, op, args (json-able dict)."""
+    TYPE = "mds_op"
+
+
+@register_message
+class MMDSOpReply(Message):
+    """mds -> client: fields: tid, result (0 or -errno), value."""
+    TYPE = "mds_op_reply"
+
+
+class MDSDaemon(Dispatcher):
+    """Single active rank (the mon-enforced invariant in the
+    reference; here the deployer runs exactly one per filesystem)."""
+
+    # ops exposed 1:1 from FileSystem; each value = (needs_value,)
+    _OPS = ("mkdir", "rmdir", "listdir", "rename", "link", "symlink",
+            "readlink", "unlink", "stat", "lstat", "chmod", "fsck")
+
+    def __init__(self, meta_io, data_io,
+                 config: "Optional[Config]" = None,
+                 addr: str = "local:mds.0") -> None:
+        self.config = config or Config()
+        self.addr = addr
+        self.fs = FileSystem(meta_io, data_io)
+        self.ms = Messenger.create("mds.0", self.config)
+        self.ms.add_dispatcher(self)
+        # one mutation at a time: the single-active-writer model the
+        # MDLog assumes must hold across CONNECTIONS too — without
+        # this, two clients' create('/f') both miss the lookup and
+        # the second dirent silently orphans the first's data (the
+        # mon serializes its command surface the same way)
+        from ..common.lockdep import DepLock
+        self._op_lock = DepLock("mds.op")
+
+    async def init(self) -> None:
+        replayed = await self.fs.mount()
+        await self.ms.bind(self.addr)
+        self.addr = self.ms.listen_addr
+        if replayed:
+            dout("mds", 1, f"mds.0 replayed {replayed} journal records")
+
+    async def shutdown(self) -> None:
+        await self.ms.shutdown()
+
+    async def ms_dispatch(self, conn, msg) -> bool:
+        if msg.TYPE != "mds_op":
+            return False
+        op = str(msg.get("op", ""))
+        args = dict(msg.get("args", {}))
+        result, value = 0, None
+        try:
+            async with self._op_lock:
+                result, value = await self._serve(op, args)
+        except FSError as e:
+            result = -int(e.errno)
+            value = str(e)
+        except Exception as e:  # noqa: BLE001 — op error, keep serving
+            result = -5
+            value = f"{type(e).__name__}: {e}"
+        await conn.send_message(MMDSOpReply({
+            "tid": msg["tid"], "result": result, "value": value}))
+        return True
+
+    async def _serve(self, op: str, args: dict):
+        if op == "create":
+            # alloc ino + journal the dirent; the CLIENT writes the
+            # data objects itself afterwards
+            return 0, await self._create(str(args["path"]))
+        if op == "set_size":
+            return 0, await self._set_size(
+                int(args["ino"]), int(args["size"]),
+                bool(args.get("grow_only", False)))
+        if op == "truncate_meta":
+            return 0, await self._set_size(int(args["ino"]),
+                                           int(args["size"]), False)
+        if op in self._OPS:
+            return 0, await getattr(self.fs, op)(**args)
+        raise FSError(f"unknown mds op {op!r}", 22)
+
+    async def _create(self, path: str) -> dict:
+        """Lookup-or-create the file inode for ``path`` (the open-for-
+        write handshake); returns {ino, size}."""
+        from .fs import _inode_oid
+        import json as _json
+        dir_ino, name = await self.fs._parent_of(path)
+        entry = await self.fs.meta.omap_get(_inode_oid(dir_ino), [name])
+        if entry:
+            rec = _json.loads(entry[name].decode())
+            if rec["type"] != "file":
+                raise FSError(f"{path}: not a regular file", 21)
+            ino = int(rec["ino"])
+            meta = await self.fs._read_inode(ino)
+            return {"ino": ino, "size": int(meta.get("size", 0))}
+        ino = await self.fs._alloc_ino()
+        meta = {"type": "file", "mode": 0o644, "size": 0}
+        await self.fs.mdlog.transact("create", [
+            self.fs._s_inode(ino, meta),
+            self.fs._s_link(dir_ino, name, ino, "file")])
+        return {"ino": ino, "size": 0}
+
+    async def _set_size(self, ino: int, size: int,
+                        grow_only: bool) -> dict:
+        import time as _time
+        meta = await self.fs._read_inode(ino)
+        if meta.get("type") != "file":
+            raise FSError(f"inode {ino}: not a file", 21)
+        if grow_only:
+            size = max(size, int(meta.get("size", 0)))
+        meta["size"] = size
+        meta["mtime"] = _time.time()
+        await self.fs._write_inode(ino, meta)
+        return {"ino": ino, "size": size}
+
+
+class MDSClient:
+    """Thin metadata proxy + direct data I/O (reference Client.cc's
+    split: caps/metadata to the MDS, file extents to the OSDs)."""
+
+    def __init__(self, ms: Messenger, mds_addr: str, data_io,
+                 stripe_count: int = 4,
+                 object_size: int = 1 << 20) -> None:
+        from ..client.striper import RadosStriper
+        self.ms = ms
+        self.mds_addr = mds_addr
+        self.striper = RadosStriper(
+            data_io, stripe_unit=object_size // stripe_count,
+            stripe_count=stripe_count, object_size=object_size)
+        # random tid base: several MDSClients may share one messenger
+        # (the reply dispatcher routes by tid ownership)
+        import os as _os
+        self._tid = int.from_bytes(_os.urandom(4), "big") << 16
+        self._inflight: "Dict[int, asyncio.Future]" = {}
+        ms.add_dispatcher(self)
+
+    async def ms_dispatch(self, conn, msg) -> bool:
+        if msg.TYPE != "mds_op_reply":
+            return False
+        fut = self._inflight.pop(int(msg["tid"]), None)
+        if fut is None:
+            # not ours (several MDSClients can share one messenger):
+            # let the next dispatcher see it
+            return False
+        if not fut.done():
+            fut.set_result(msg)
+        return True
+
+    async def _call(self, op: str, **args):
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_event_loop().create_future()
+        self._inflight[tid] = fut
+        try:
+            conn = self.ms.get_connection(self.mds_addr)
+            await conn.send_message(MMDSOp({"tid": tid, "op": op,
+                                            "args": args}))
+            reply = await asyncio.wait_for(fut, 30.0)
+        finally:
+            self._inflight.pop(tid, None)   # timeout must not leak
+        if int(reply["result"]) != 0:
+            raise FSError(str(reply.get("value")),
+                          -int(reply["result"]))
+        return reply.get("value")
+
+    # --- namespace (proxied) --------------------------------------------------
+
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        await self._call("mkdir", path=path, mode=mode)
+
+    async def rmdir(self, path: str) -> None:
+        await self._call("rmdir", path=path)
+
+    async def listdir(self, path: str = "/") -> list:
+        return list(await self._call("listdir", path=path))
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._call("rename", src=src, dst=dst)
+
+    async def link(self, existing: str, path: str) -> None:
+        await self._call("link", existing=existing, path=path)
+
+    async def symlink(self, target: str, path: str) -> None:
+        await self._call("symlink", target=target, path=path)
+
+    async def readlink(self, path: str) -> str:
+        return str(await self._call("readlink", path=path))
+
+    async def unlink(self, path: str) -> None:
+        await self._call("unlink", path=path)
+
+    async def stat(self, path: str) -> dict:
+        return dict(await self._call("stat", path=path))
+
+    async def chmod(self, path: str, mode: int) -> None:
+        await self._call("chmod", path=path, mode=mode)
+
+    async def fsck(self, repair: bool = False) -> dict:
+        return dict(await self._call("fsck", repair=repair))
+
+    # --- file data (direct to OSDs) -------------------------------------------
+
+    async def write_file(self, path: str, data: bytes) -> None:
+        rec = await self._call("create", path=path)
+        ino = int(rec["ino"])
+        await self.striper.write_full(f"filedata.{ino:x}", data)
+        await self._call("set_size", ino=ino, size=len(data))
+
+    async def read_file(self, path: str) -> bytes:
+        st = await self.stat(path)
+        if st["type"] != "file":
+            raise FSError(f"{path}: not a file", 21)
+        data = await self.striper.read(f"filedata.{st['ino']:x}")
+        return data[: int(st.get("size", len(data)))]
+
+    async def pwrite(self, path: str, data: bytes, off: int) -> None:
+        rec = await self._call("create", path=path)
+        ino = int(rec["ino"])
+        await self.striper.write(f"filedata.{ino:x}", data, off)
+        await self._call("set_size", ino=ino, size=off + len(data),
+                         grow_only=True)
+
+    async def pread(self, path: str, length: int = 0,
+                    off: int = 0) -> bytes:
+        st = await self.stat(path)
+        return await self.striper.read(f"filedata.{st['ino']:x}",
+                                       length, off)

@@ -1,0 +1,178 @@
+"""lockdep — lock-ordering cycle detection + stalled-await watchdog.
+
+Reference: src/common/lockdep.cc (the mutex-order cycle detector every
+debug build of the reference links in) and its backtrace dumps.  The
+asyncio rebuild covers the two failure classes this codebase actually
+has:
+
+- **Ordering cycles**: coroutines that acquire named asyncio.Locks in
+  inconsistent orders (A->B in one task, B->A in another) deadlock
+  under the right interleaving.  ``DepLock`` wraps asyncio.Lock; a
+  process-wide order graph records every (held -> acquiring) edge the
+  first time it appears and raises ``LockOrderError`` the moment an
+  edge would close a cycle — deterministically, on the FIRST run of
+  the colliding order, not only on the unlucky interleaving (exactly
+  lockdep.cc's value proposition).
+- **Stalled awaits**: a task stuck >N seconds acquiring a DepLock is
+  reported with both the waiting task and the holder's acquisition
+  site (the asyncio analog of the reference's lockdep backtraces).
+
+Instrumentation is ALWAYS-ON but O(1) per acquire on the hot path
+(edge-set membership check); the graph only grows when a brand-new
+edge appears.  The OSD's admin socket exposes ``lockdep dump``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderError(RuntimeError):
+    pass
+
+
+class _OrderGraph:
+    """Process-wide (class -> class) acquisition-order edges."""
+
+    def __init__(self) -> None:
+        self.edges: "Set[Tuple[str, str]]" = set()
+        self.succ: "Dict[str, Set[str]]" = {}
+        # edge -> where it was first taken (for reports)
+        self.sites: "Dict[Tuple[str, str], str]" = {}
+
+    def _reaches(self, src: str, dst: str) -> "Optional[List[str]]":
+        """DFS path src -> dst through recorded edges, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self.succ.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def add(self, held: str, acquiring: str) -> None:
+        edge = (held, acquiring)
+        if edge in self.edges or held == acquiring:
+            return
+        back = self._reaches(acquiring, held)
+        if back is not None:
+            first = self.sites.get((back[0], back[1]), "?")
+            raise LockOrderError(
+                f"lock order cycle: acquiring {acquiring!r} while "
+                f"holding {held!r}, but the reverse order "
+                f"{' -> '.join(back)} was established at:\n{first}")
+        self.edges.add(edge)
+        self.succ.setdefault(held, set()).add(acquiring)
+        self.sites[edge] = "".join(traceback.format_stack(limit=6)[:-1])
+
+    def dump(self) -> dict:
+        return {"edges": sorted(list(e) for e in self.edges)}
+
+
+_graph = _OrderGraph()
+# task -> stack of lock classes it currently holds
+_held: "Dict[int, List[str]]" = {}
+# lock INSTANCE id -> (name, acquire site, monotonic time): keyed per
+# instance because several same-class locks are held concurrently
+# (one messenger.send per connection) and must not clobber each other
+_holder_site: "Dict[int, Tuple[str, str, float]]" = {}
+
+
+def graph_dump() -> dict:
+    out = _graph.dump()
+    now = time.monotonic()
+    out["held"] = [{"class": name, "site": site,
+                    "for_s": round(now - t, 3)}
+                   for name, site, t in _holder_site.values()]
+    return out
+
+
+def reset() -> None:
+    """Test hook: forget all recorded edges."""
+    _graph.edges.clear()
+    _graph.succ.clear()
+    _graph.sites.clear()
+    _held.clear()
+    _holder_site.clear()
+
+
+def _cheap_site() -> str:
+    """First caller frame OUTSIDE this module, as file:line, without
+    traceback formatting — this runs on EVERY acquire (messenger.send
+    per message), so no linecache/format_stack on the hot path; full
+    stacks are captured only for brand-new order-graph edges (rare)."""
+    import sys
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    return f"{f.f_code.co_filename}:{f.f_lineno}" if f else "?"
+
+
+class DepLock:
+    """asyncio.Lock with lockdep ordering checks.
+
+    ``name`` is the lock CLASS (e.g. "ecbackend.pipeline"); every
+    instance of a class shares ordering rules, like the reference's
+    lockdep registered names.  ``stall_warn_s`` > 0 reports an acquire
+    that waits longer than the threshold (returns the report through
+    ``stall_reports`` and dout)."""
+
+    stall_reports: "List[str]" = []        # class attr: test/admin view
+
+    def __init__(self, name: str, stall_warn_s: float = 30.0) -> None:
+        self.name = name
+        self.stall_warn_s = stall_warn_s
+        self._lock = asyncio.Lock()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    async def acquire(self) -> bool:
+        task = id(asyncio.current_task())
+        held = _held.get(task, [])
+        for h in held:
+            _graph.add(h, self.name)       # raises on a cycle
+        if self.stall_warn_s > 0 and self._lock.locked():
+            try:
+                await asyncio.wait_for(self._lock.acquire(),
+                                       self.stall_warn_s)
+            except asyncio.TimeoutError:
+                holder = _holder_site.get(id(self))
+                report = (
+                    f"lockdep: task waited >{self.stall_warn_s}s for "
+                    f"{self.name!r}; holder acquired at "
+                    f"{holder[1] if holder else '?'}")
+                DepLock.stall_reports.append(report)
+                from .log import dout
+                dout("lockdep", 0, report)
+                await self._lock.acquire()   # keep waiting (report only)
+        else:
+            await self._lock.acquire()
+        _held.setdefault(task, []).append(self.name)
+        _holder_site[id(self)] = (self.name, _cheap_site(),
+                                  time.monotonic())
+        return True
+
+    def release(self) -> None:
+        task = id(asyncio.current_task())
+        stack = _held.get(task, [])
+        if self.name in stack:
+            stack.remove(self.name)
+            if not stack:
+                _held.pop(task, None)
+        _holder_site.pop(id(self), None)
+        self._lock.release()
+
+    async def __aenter__(self) -> "DepLock":
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()

@@ -82,7 +82,8 @@ class MonDaemon(Dispatcher):
         self.last_beacon: "Dict[int, float]" = {}
         self.failure_reports: "Dict[int, Set[int]]" = {}
         self._tick_task: "Optional[asyncio.Task]" = None
-        self._cmd_lock = asyncio.Lock()
+        from ..common.lockdep import DepLock
+        self._cmd_lock = DepLock("mon.command")
         self._last_lease = time.monotonic()
         self.running = False
 

@@ -61,7 +61,8 @@ class Paxos:
         self.uncommitted_v = 0
         self.uncommitted_pn = 0
         self.uncommitted_value: "Optional[bytes]" = None
-        self._propose_lock = asyncio.Lock()
+        from ..common.lockdep import DepLock
+        self._propose_lock = DepLock("paxos.propose")
         # pulsed on every applied commit; _finish_collect waits on it
         # instead of polling while catch-up commits stream in
         self._commit_applied = asyncio.Event()

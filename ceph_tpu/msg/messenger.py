@@ -104,7 +104,8 @@ class Connection:
         self.unacked: "List[Tuple[int, bytes]]" = []  # (seq, frame)
         self.in_seq = 0
         self._writer: "Optional[asyncio.StreamWriter]" = None
-        self._send_lock = asyncio.Lock()
+        from ..common.lockdep import DepLock
+        self._send_lock = DepLock("messenger.send")
         self._connected = asyncio.Event()
         self.closed = False
         self._salt = os.urandom(4)

@@ -792,6 +792,13 @@ class OSDDaemon(Dispatcher):
                    lambda c: {"hit_sets": self._get_backend(
                        (int(c["pool"]), int(c["pg"]))).hit_set_ls()},
                    "archived + open object-access hit sets for a pg")
+        from ..common import lockdep as _lockdep
+        a.register("lockdep dump",
+                   lambda _c: {**_lockdep.graph_dump(),
+                               "stalls":
+                               _lockdep.DepLock.stall_reports[-20:]},
+                   "recorded lock-order edges, currently-held locks, "
+                   "and stalled-await reports (reference lockdep.cc)")
         a.register("profile start",
                    lambda c: self._profile_ctl(True, c.get("dir", "")),
                    "start a jax.profiler device trace (kernel timeline "

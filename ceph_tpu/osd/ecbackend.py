@@ -254,8 +254,11 @@ class ECBackend:
         self._hit_set_cache = None   # decoded archive (rotation clears)
         # serializes object-class read-modify-write executions against
         # each other AND against plain write admissions (reference: cls
-        # methods run under the PG lock in do_op)
-        self.cls_lock = asyncio.Lock()
+        # methods run under the PG lock in do_op).  DepLock = the
+        # always-on lockdep analog (common/lockdep.py): named lock
+        # classes, order-cycle detection, stalled-await reports.
+        from ..common.lockdep import DepLock
+        self.cls_lock = DepLock("ecbackend.cls")
         # reqid -> result bytes for replayed object-class calls (a
         # retried numops.add must not double-apply)
         self.completed_cls: "Dict[str, bytes]" = {}
@@ -277,7 +280,7 @@ class ECBackend:
         # peering request/reply correlation (MPGInfo / MPGRewindAck / ...)
         self.pending_queries: "Dict[int, asyncio.Future]" = {}
         self.peering = False
-        self._peer_lock = asyncio.Lock()
+        self._peer_lock = DepLock("ecbackend.peer")
         # the acting set this PG last successfully peered+activated for;
         # client ops are gated on it matching the current acting set
         # (reference: a PG serves I/O only in Active, and every interval
@@ -299,7 +302,7 @@ class ECBackend:
         # (reference: ZTracer child spans)
         self._recovery_trace: "Dict[str, str]" = {}
         self._next_tid = 0
-        self._lock = asyncio.Lock()
+        self._lock = DepLock("ecbackend.pipeline")
         self._not_peering = asyncio.Event()
         self._not_peering.set()
         # shard-local state

@@ -35,6 +35,19 @@ be signed.  TWO schemes are accepted:
 - legacy ``RGW1 <access>:<hmac>`` (kept for old callers).
 No users registered = open access (dev mode).
 
+Swift surface (reference src/rgw/rgw_rest_swift.h:345 — the second
+protocol personality over the SAME buckets/objects):
+  GET  /auth/v1.0        X-Auth-User/X-Auth-Key -> X-Auth-Token +
+                         X-Storage-Url (TempAuth handshake)
+  GET  /v1/AUTH_<acct>                list containers
+  PUT  /v1/AUTH_<acct>/<cont>         create container
+  GET  /v1/AUTH_<acct>/<cont>         list objects
+  PUT/GET/HEAD/DELETE /v1/AUTH_<acct>/<cont>/<obj>
+Tokens ride X-Auth-Token; Swift requests bypass the S3 signature
+check (each personality authenticates its own way, as in the
+reference).  Containers ARE buckets — objects written through one
+API read back through the other.
+
 Versioning (S3 bucket versioning, reference rgw versioned buckets):
   PUT  /bucket?versioning  {"Status": "Enabled"|"Suspended"}
   GET  /bucket?versioning
@@ -116,6 +129,8 @@ class Gateway:
         self.port = 0
         # access_key -> secret; empty = open access (dev mode)
         self._users: "Dict[str, str]" = {}
+        # swift TempAuth tokens: token -> access_key
+        self._swift_tokens: "Dict[str, str]" = {}
 
     # --- auth -----------------------------------------------------------------
 
@@ -621,30 +636,142 @@ class Gateway:
                 headers[name.strip().lower()] = val.strip()
             clen = int(headers.get("content-length", 0))
             body = await reader.readexactly(clen) if clen else b""
-            self._check_auth(method, rawpath, headers, body)
             split = urlsplit(rawpath)
-            query = {k: v[0] for k, v in
-                     parse_qs(split.query, keep_blank_values=True).items()}
-            status, payload, ctype = await self._route(
-                method, unquote(split.path), body, query)
+            extra_hdrs: "Dict[str, str]" = {}
+            # Swift personality detection must not hijack the S3
+            # namespace (an S3 bucket named 'v1' or 'auth' stays
+            # reachable): the handshake needs X-Auth-User, and /v1
+            # paths are swift only with an AUTH_<acct> segment
+            seg = [p for p in split.path.split("/") if p]
+            is_swift = (
+                (split.path == "/auth/v1.0"
+                 and "x-auth-user" in headers)
+                or (len(seg) >= 2 and seg[0] == "v1"
+                    and seg[1].startswith("AUTH_")))
+            if is_swift:
+                # Swift personality: its own auth (TempAuth tokens),
+                # same backend (reference rgw_rest_swift.h:345)
+                status, payload, ctype, extra_hdrs = \
+                    await self._swift_route(method, unquote(split.path),
+                                            headers, body)
+            else:
+                self._check_auth(method, rawpath, headers, body)
+                query = {k: v[0] for k, v in
+                         parse_qs(split.query,
+                                  keep_blank_values=True).items()}
+                status, payload, ctype = await self._route(
+                    method, unquote(split.path), body, query)
         except RGWError as e:
-            status, payload, ctype = e.status, json.dumps(
-                {"error": str(e)}).encode(), "application/json"
+            status, payload, ctype, extra_hdrs = e.status, json.dumps(
+                {"error": str(e)}).encode(), "application/json", {}
         except Exception as e:  # noqa: BLE001 — 500, keep serving
-            status, payload, ctype = 500, json.dumps(
-                {"error": str(e)}).encode(), "application/json"
+            status, payload, ctype, extra_hdrs = 500, json.dumps(
+                {"error": str(e)}).encode(), "application/json", {}
         try:
             reason = {200: "OK", 201: "Created", 204: "No Content",
+                      401: "Unauthorized",
                       403: "Forbidden", 404: "Not Found",
                       409: "Conflict"}.get(status, "Error")
+            extras = "".join(f"{k}: {v}\r\n"
+                             for k, v in extra_hdrs.items())
             writer.write(
                 f"HTTP/1.1 {status} {reason}\r\n"
-                f"Content-Type: {ctype}\r\n"
+                f"Content-Type: {ctype}\r\n{extras}"
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + payload)
             await writer.drain()
         finally:
             writer.close()
+
+    # --- Swift personality (reference rgw_rest_swift.h:345) -------------------
+
+    _SWIFT_TOKEN_CAP = 1024
+
+    def _swift_user(self, headers: "Dict[str, str]") -> str:
+        """Validate X-Auth-Token; returns the access key (or raises).
+        No registered users = open access, matching the S3 side.
+        Tokens minted during open-access mode carry no user and become
+        INVALID the moment credentials are registered — enabling auth
+        must cut off every unauthenticated session."""
+        if not self._users:
+            return ""
+        tok = headers.get("x-auth-token", "")
+        user = self._swift_tokens.get(tok)
+        if not user or user not in self._users:
+            raise RGWError("invalid or missing X-Auth-Token", 401)
+        return user
+
+    async def _swift_route(self, method: str, path: str,
+                           headers: "Dict[str, str]", body: bytes):
+        if path == "/auth/v1.0":
+            # TempAuth: X-Auth-User "<acct>:<access>", X-Auth-Key =
+            # the S3 secret — one credential db, two personalities
+            user = headers.get("x-auth-user", "")
+            key = headers.get("x-auth-key", "")
+            access = user.split(":")[-1]
+            if self._users:
+                if self._users.get(access) != key or not key:
+                    raise RGWError("swift auth failed", 401)
+                tok = "AUTH_tk" + os.urandom(12).hex()
+                self._swift_tokens[tok] = access
+            else:
+                # open access: a fresh no-user token per handshake;
+                # all of them die the moment credentials register
+                tok = "AUTH_tk" + os.urandom(12).hex()
+                self._swift_tokens[tok] = ""
+            while len(self._swift_tokens) > self._SWIFT_TOKEN_CAP:
+                self._swift_tokens.pop(next(iter(self._swift_tokens)))
+            return 204, b"", "text/plain", {
+                "X-Auth-Token": tok,
+                "X-Storage-Url":
+                    f"http://127.0.0.1:{self.port}/v1/AUTH_{access}"}
+        self._swift_user(headers)
+        parts = [p for p in path.split("/") if p]     # v1, AUTH_x, ...
+        if len(parts) < 2 or not parts[1].startswith("AUTH_"):
+            raise RGWError("bad swift path", 404)
+        if len(parts) == 2:
+            if method in ("GET", "HEAD"):
+                names = await self.list_buckets()
+                body_out = b"" if method == "HEAD" else \
+                    "\n".join(names).encode() + (b"\n" if names else b"")
+                return 200, body_out, "text/plain", {
+                    "X-Account-Container-Count": str(len(names))}
+            raise RGWError("bad swift account method")
+        cont = parts[2]
+        if len(parts) == 3:
+            if method == "PUT":
+                try:
+                    await self.create_bucket(cont)
+                except RGWError as e:
+                    if e.status != 409:   # swift PUT is idempotent
+                        raise
+                return 201, b"", "text/plain", {}
+            if method in ("GET", "HEAD"):
+                keys = await self.list_objects(cont)
+                body_out = b"" if method == "HEAD" else \
+                    "\n".join(keys).encode() + (b"\n" if keys else b"")
+                return 200, body_out, "text/plain", {
+                    "X-Container-Object-Count": str(len(keys))}
+            if method == "DELETE":
+                await self.delete_bucket(cont)
+                return 204, b"", "text/plain", {}
+            raise RGWError("bad swift container method")
+        key = "/".join(parts[3:])
+        if method == "PUT":
+            meta = await self.put_object(cont, key, body)
+            return 201, b"", "text/plain", {"Etag": meta["etag"]}
+        if method == "GET":
+            data = await self.get_object(cont, key)
+            return 200, data, "application/octet-stream", {}
+        if method == "HEAD":
+            meta = await self.head_object(cont, key)
+            return 200, b"", "application/octet-stream", {
+                "Content-Length-Hint": str(meta["size"]),
+                "Etag": meta["etag"]}
+        if method == "DELETE":
+            await self.delete_object(cont, key)
+            return 204, b"", "text/plain", {}
+        raise RGWError("bad swift object method")
 
     async def _route(self, method: str, path: str, body: bytes,
                      query: "Optional[Dict[str, str]]" = None):

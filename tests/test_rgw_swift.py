@@ -1,0 +1,153 @@
+"""RGW Swift personality (reference src/rgw/rgw_rest_swift.h:345):
+TempAuth handshake + /v1 account/container/object surface over the
+same buckets the S3 personality serves.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.rgw import Gateway
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_cluster():
+    c = MiniCluster(n_osds=6)
+    c.create_ec_pool("data", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                     pg_num=4, stripe_unit=4096)
+    c.create_replicated_pool("meta", size=3, pg_num=4, stripe_unit=4096)
+    return c
+
+
+async def http(port, method, path, body=b"", headers=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n{extra}"
+           f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+    writer.write(req)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split()[1])
+    hdrs = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    return status, hdrs, payload
+
+
+class TestSwift:
+    def test_tempauth_and_object_lifecycle(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                gw = Gateway(client.io_ctx("meta"),
+                             client.io_ctx("data"))
+                gw.add_user("swifty", "s3cr3t")
+                port = await gw.serve(0)
+
+                # unauthenticated /v1 access refused
+                st, _, _ = await http(port, "GET", "/v1/AUTH_swifty")
+                assert st == 401
+                # bad key refused
+                st, _, _ = await http(port, "GET", "/auth/v1.0",
+                                      headers={"X-Auth-User":
+                                               "acct:swifty",
+                                               "X-Auth-Key": "wrong"})
+                assert st == 401
+                # TempAuth handshake
+                st, h, _ = await http(port, "GET", "/auth/v1.0",
+                                      headers={"X-Auth-User":
+                                               "acct:swifty",
+                                               "X-Auth-Key": "s3cr3t"})
+                assert st == 204 and "x-auth-token" in h
+                tok = {"X-Auth-Token": h["x-auth-token"]}
+                assert "/v1/AUTH_swifty" in h["x-storage-url"]
+
+                # container + object lifecycle
+                st, _, _ = await http(port, "PUT", "/v1/AUTH_s/c1",
+                                      headers=tok)
+                assert st == 201
+                st, _, _ = await http(port, "PUT", "/v1/AUTH_s/c1",
+                                      headers=tok)   # idempotent
+                assert st == 201
+                body = b"swift object body" * 100
+                st, h, _ = await http(port, "PUT",
+                                      "/v1/AUTH_s/c1/path/obj",
+                                      body, headers=tok)
+                assert st == 201 and h.get("etag")
+                st, _, got = await http(port, "GET",
+                                        "/v1/AUTH_s/c1/path/obj",
+                                        headers=tok)
+                assert st == 200 and got == body
+                st, _, listing = await http(port, "GET",
+                                            "/v1/AUTH_s/c1",
+                                            headers=tok)
+                assert b"path/obj" in listing
+                st, _, accts = await http(port, "GET", "/v1/AUTH_s",
+                                          headers=tok)
+                assert b"c1" in accts
+                st, _, _ = await http(port, "DELETE",
+                                      "/v1/AUTH_s/c1/path/obj",
+                                      headers=tok)
+                assert st == 204
+                st, _, _ = await http(port, "DELETE", "/v1/AUTH_s/c1",
+                                      headers=tok)
+                assert st == 204
+                gw.shutdown()
+        loop.run_until_complete(go())
+
+    def test_swift_and_s3_share_objects(self, loop):
+        async def go():
+            async with make_cluster() as c:
+                client = await c.client()
+                gw = Gateway(client.io_ctx("meta"),
+                             client.io_ctx("data"))
+                port = await gw.serve(0)   # open access (no users)
+                # write via the S3 personality
+                await gw.create_bucket("shared")
+                await gw.put_object("shared", "k", b"one body")
+                # read via swift (open token; real swift clients
+                # always send X-Auth-User — it is also the router's
+                # disambiguator vs an S3 bucket named 'auth')
+                st, h, _ = await http(port, "GET", "/auth/v1.0",
+                                      headers={"X-Auth-User":
+                                               "acct:any"})
+                tok = {"X-Auth-Token": h["x-auth-token"]}
+                st, _, got = await http(port, "GET",
+                                        "/v1/AUTH_x/shared/k",
+                                        headers=tok)
+                assert st == 200 and got == b"one body"
+                # write via swift, read via S3
+                st, _, _ = await http(port, "PUT",
+                                      "/v1/AUTH_x/shared/k2",
+                                      b"two", headers=tok)
+                assert st == 201
+                assert await gw.get_object("shared", "k2") == b"two"
+
+                # an S3 bucket named 'v1' is NOT hijacked by the
+                # swift router (no AUTH_ segment)
+                await gw.create_bucket("v1")
+                st, _, _ = await http(port, "PUT", "/v1/key",
+                                      b"s3 body")
+                assert st == 201
+                assert await gw.get_object("v1", "key") == b"s3 body"
+
+                # registering credentials kills open-mode tokens
+                gw.add_user("AK", "SK")
+                st, _, _ = await http(port, "GET",
+                                      "/v1/AUTH_x/shared/k",
+                                      headers=tok)
+                assert st == 401
+                gw.shutdown()
+        loop.run_until_complete(go())
