@@ -45,6 +45,12 @@ def _inode_oid(ino: int) -> str:
     return f"inode.{ino:x}"
 
 
+def _filedata_oid(ino: int) -> str:
+    """Striper base name for a file inode's data — the ONE place the
+    layout convention lives (fs + MDS + client must agree)."""
+    return f"filedata.{ino:x}"
+
+
 class FileSystem:
     def __init__(self, meta_io, data_io,
                  stripe_count: int = 4,
@@ -98,7 +104,7 @@ class FileSystem:
 
     @staticmethod
     def _s_rm_data(ino: int) -> dict:
-        return {"t": "strip_rm", "base": f"filedata.{ino:x}"}
+        return {"t": "strip_rm", "base": _filedata_oid(ino)}
 
     async def _alloc_ino(self) -> int:
         """Atomic server-side increment via the numops object class —
@@ -209,7 +215,7 @@ class FileSystem:
             await self.mdlog.transact("create", [
                 self._s_inode(ino, meta),
                 self._s_link(dir_ino, name, ino, "file")])
-        await self.striper.write_full(f"filedata.{ino:x}", data)
+        await self.striper.write_full(_filedata_oid(ino), data)
         meta.update({"size": len(data), "mtime": time.time()})
         await self._write_inode(ino, meta)
 
@@ -217,13 +223,13 @@ class FileSystem:
         ino, meta = await self._lookup(path)
         if meta["type"] != "file":
             raise FSError(f"{path}: is a directory", 21)
-        return await self.striper.read(f"filedata.{ino:x}")
+        return await self.striper.read(_filedata_oid(ino))
 
     async def append_file(self, path: str, data: bytes) -> None:
         ino, meta = await self._lookup(path)
         if meta["type"] != "file":
             raise FSError(f"{path}: is a directory", 21)
-        await self.striper.append(f"filedata.{ino:x}", data)
+        await self.striper.append(_filedata_oid(ino), data)
         meta["size"] = int(meta.get("size", 0)) + len(data)
         meta["mtime"] = time.time()
         await self._write_inode(ino, meta)
@@ -275,7 +281,7 @@ class FileSystem:
         ino, meta = await self._lookup(path)
         if meta["type"] != "file":
             raise FSError(f"{path}: is a directory", 21)
-        await self.striper.write(f"filedata.{ino:x}", data, off)
+        await self.striper.write(_filedata_oid(ino), data, off)
         meta["size"] = max(int(meta.get("size", 0)), off + len(data))
         meta["mtime"] = time.time()
         await self._write_inode(ino, meta)
@@ -285,7 +291,7 @@ class FileSystem:
         ino, meta = await self._lookup(path)
         if meta["type"] != "file":
             raise FSError(f"{path}: is a directory", 21)
-        return await self.striper.read(f"filedata.{ino:x}", length, off)
+        return await self.striper.read(_filedata_oid(ino), length, off)
 
     async def truncate(self, path: str, size: int) -> None:
         ino, meta = await self._lookup(path)
@@ -293,7 +299,7 @@ class FileSystem:
             raise FSError(f"{path}: is a directory", 21)
         # O(tail), not O(file): the striper trims only cleared object
         # tails; growth is metadata-only (reads past data return zeros)
-        await self.striper.truncate(f"filedata.{ino:x}", size)
+        await self.striper.truncate(_filedata_oid(ino), size)
         meta["size"] = size
         meta["mtime"] = time.time()
         await self._write_inode(ino, meta)

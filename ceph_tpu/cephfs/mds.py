@@ -17,8 +17,9 @@ The lean rebuild keeps that division exactly:
   MDS (``open``-style calls return the inode number, the data key).
 
 Ops served: mkdir, rmdir, listdir, rename, link, symlink, readlink,
-unlink, stat, lstat, chmod, truncate_meta, create (alloc ino + link),
-set_size (post-write size/mtime commit), fsck.
+unlink, stat, lstat, chmod, truncate (full: metadata + striper trim),
+create (alloc ino + link), set_size (post-write size/mtime commit),
+fsck.
 """
 
 from __future__ import annotations
@@ -30,7 +31,7 @@ from ..common.config import Config
 from ..common.log import dout
 from ..msg.message import Message, register_message
 from ..msg.messenger import Dispatcher, Messenger
-from .fs import FileSystem, FSError
+from .fs import FileSystem, FSError, _filedata_oid
 
 
 @register_message
@@ -49,9 +50,10 @@ class MDSDaemon(Dispatcher):
     """Single active rank (the mon-enforced invariant in the
     reference; here the deployer runs exactly one per filesystem)."""
 
-    # ops exposed 1:1 from FileSystem; each value = (needs_value,)
+    # ops exposed 1:1 from FileSystem
     _OPS = ("mkdir", "rmdir", "listdir", "rename", "link", "symlink",
-            "readlink", "unlink", "stat", "lstat", "chmod", "fsck")
+            "readlink", "unlink", "stat", "lstat", "chmod", "truncate",
+            "fsck")
 
     def __init__(self, meta_io, data_io,
                  config: "Optional[Config]" = None,
@@ -82,6 +84,7 @@ class MDSDaemon(Dispatcher):
     async def ms_dispatch(self, conn, msg) -> bool:
         if msg.TYPE != "mds_op":
             return False
+        tid = msg.get("tid", 0)
         op = str(msg.get("op", ""))
         args = dict(msg.get("args", {}))
         result, value = 0, None
@@ -95,7 +98,7 @@ class MDSDaemon(Dispatcher):
             result = -5
             value = f"{type(e).__name__}: {e}"
         await conn.send_message(MMDSOpReply({
-            "tid": msg["tid"], "result": result, "value": value}))
+            "tid": tid, "result": result, "value": value}))
         return True
 
     async def _serve(self, op: str, args: dict):
@@ -107,9 +110,6 @@ class MDSDaemon(Dispatcher):
             return 0, await self._set_size(
                 int(args["ino"]), int(args["size"]),
                 bool(args.get("grow_only", False)))
-        if op == "truncate_meta":
-            return 0, await self._set_size(int(args["ino"]),
-                                           int(args["size"]), False)
         if op in self._OPS:
             return 0, await getattr(self.fs, op)(**args)
         raise FSError(f"unknown mds op {op!r}", 22)
@@ -230,6 +230,11 @@ class MDSClient:
     async def chmod(self, path: str, mode: int) -> None:
         await self._call("chmod", path=path, mode=mode)
 
+    async def truncate(self, path: str, size: int) -> None:
+        """Full truncate at the MDS: metadata AND the striper trim run
+        server-side (the MDS holds the data striper too)."""
+        await self._call("truncate", path=path, size=size)
+
     async def fsck(self, repair: bool = False) -> dict:
         return dict(await self._call("fsck", repair=repair))
 
@@ -238,25 +243,25 @@ class MDSClient:
     async def write_file(self, path: str, data: bytes) -> None:
         rec = await self._call("create", path=path)
         ino = int(rec["ino"])
-        await self.striper.write_full(f"filedata.{ino:x}", data)
+        await self.striper.write_full(_filedata_oid(ino), data)
         await self._call("set_size", ino=ino, size=len(data))
 
     async def read_file(self, path: str) -> bytes:
         st = await self.stat(path)
         if st["type"] != "file":
             raise FSError(f"{path}: not a file", 21)
-        data = await self.striper.read(f"filedata.{st['ino']:x}")
+        data = await self.striper.read(_filedata_oid(int(st['ino'])))
         return data[: int(st.get("size", len(data)))]
 
     async def pwrite(self, path: str, data: bytes, off: int) -> None:
         rec = await self._call("create", path=path)
         ino = int(rec["ino"])
-        await self.striper.write(f"filedata.{ino:x}", data, off)
+        await self.striper.write(_filedata_oid(ino), data, off)
         await self._call("set_size", ino=ino, size=off + len(data),
                          grow_only=True)
 
     async def pread(self, path: str, length: int = 0,
                     off: int = 0) -> bytes:
         st = await self.stat(path)
-        return await self.striper.read(f"filedata.{st['ino']:x}",
+        return await self.striper.read(_filedata_oid(int(st['ino'])),
                                        length, off)

@@ -150,6 +150,7 @@ class DepLock:
                     f"{self.name!r}; holder acquired at "
                     f"{holder[1] if holder else '?'}")
                 DepLock.stall_reports.append(report)
+                del DepLock.stall_reports[:-100]   # bounded history
                 from .log import dout
                 dout("lockdep", 0, report)
                 await self._lock.acquire()   # keep waiting (report only)

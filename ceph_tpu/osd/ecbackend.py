@@ -2183,6 +2183,27 @@ class ECBackend:
         return sorted({o.name for o in self.store.list_objects(cid)
                        if o.name != PGMETA_OID and o.generation == NO_GEN})
 
+    def _list_object_versions(self, shard: int) -> "Dict[str, list]":
+        """oid -> per-shard ObjectInfo version (list form for the
+        wire).  Peering compares these across shards to catch VERSION
+        divergence that log comparison cannot see once a pg_num split
+        trimmed the logs — a shard revived with a stale copy must be
+        detected by its object metadata, not only its log."""
+        cid = self.coll(shard)
+        out: "Dict[str, list]" = {}
+        if not self.store.collection_exists(cid):
+            return out
+        for o in self.store.list_objects(cid):
+            if o.name == PGMETA_OID or o.generation != NO_GEN:
+                continue
+            try:
+                oi = ObjectInfo.decode(bytes(
+                    self.store.get_attr(cid, o, OI_KEY)))
+                out[o.name] = list(oi.version)
+            except (NotFound, KeyError, ValueError):
+                out[o.name] = list(ZERO)
+        return out
+
     def handle_pg_query(self, msg: MPGQuery) -> MPGInfo:
         """Shard side: report our log, how far it is contiguous, our
         missing set, and our object list (reference MOSDPGQuery ->
@@ -2195,6 +2216,7 @@ class ECBackend:
         if q_epoch > self.peered_epoch:
             self.peered_epoch = q_epoch
             self._persist_pg_meta(shard)
+        overs = self._list_object_versions(shard)
         return MPGInfo({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -2202,7 +2224,10 @@ class ECBackend:
             "complete_to": list(self._complete_to()),
             "missing": {o: list(v)
                         for o, v in self.local_missing.items()},
-            "objects": self._list_objects(shard)})
+            # the plain name list IS the version map's keys — one
+            # collection pass, no duplicated payload
+            "objects": sorted(overs),
+            "object_versions": overs})
 
     def _stale_interval(self, msg) -> bool:
         """True if this peering message is from a primary of an older
@@ -2519,11 +2544,13 @@ class ECBackend:
         self.peered_epoch = max(self.peered_epoch, self.interval_epoch)
         for s, osd in up.items():
             if osd == self.whoami:
+                overs_self = self._list_object_versions(s)
                 infos[s] = {"log": self.pg_log.to_dict(),
                             "complete_to": list(self._complete_to()),
                             "missing": {o: list(v) for o, v in
                                         self.local_missing.items()},
-                            "objects": self._list_objects(s)}
+                            "objects": sorted(overs_self),
+                            "overs": overs_self}
             else:
                 reply = await self._query_shard(s, osd)
                 if reply is not None:
@@ -2532,7 +2559,9 @@ class ECBackend:
                                     reply.get("complete_to",
                                               reply["log"]["head"])),
                                 "missing": dict(reply.get("missing", {})),
-                                "objects": list(reply["objects"])}
+                                "objects": list(reply["objects"]),
+                                "overs": dict(
+                                    reply.get("object_versions", {}))}
         if len(infos) < self.k:
             # not enough shards to even decide what the data is: stay
             # inactive (reference marks the PG incomplete/down and
@@ -2622,39 +2651,76 @@ class ECBackend:
             elif prior:
                 self.peer_missing[s] = prior
 
-        # ---- object-list reconciliation (pg-split orphan handling).
-        # An object some complete shards hold that others lack, with no
-        # log entry or missing record explaining the difference, is the
-        # residue of a never-acked partially-applied write (a client op
-        # that died across an interval change or pg_num split; its log
-        # entry was trimmed with the split's fresh log).  Holders >= k:
-        # the data is decodable and might be wanted — recover it to the
-        # absent shards.  Holders < k: unreconstructable junk no client
-        # was ever acked — roll it back by deletion.
+        # ---- object-VERSION reconciliation (pg-split divergence
+        # handling).  Log comparison cannot see divergence among
+        # objects whose entries a pg_num split trimmed away: a shard
+        # that was down across the split revives with stale copies
+        # (older version, maybe different size) and identical fresh
+        # logs — undetectable by log election, poisonous to decode
+        # (the thrasher found it: "chunk size 1536 != 2048"; a
+        # same-size stale copy would corrupt silently).  For every
+        # log-UNTRACKED object, compare per-shard ObjectInfo versions:
+        # - >= k shards at the newest version: recover everyone else
+        #   (absent OR stale) to it;
+        # - else the newest version was never acked (acks need
+        #   min_size >= k durable shards): fall back to the newest
+        #   version >= k shards still hold — the committed state —
+        #   and roll the minority forward/back to it;
+        # - no version decodable at all: never-acked junk, delete.
         tracked = set(latest)
         for _s, mset in self.peer_missing.items():
             tracked.update(mset)
         complete_shards = [s for s in infos if complete[s] >= auth_head]
-        presence: "Dict[str, Set[int]]" = {}
+        byobj: "Dict[str, Dict[int, tuple]]" = {}
         for s in complete_shards:
-            for oid in infos[s]["objects"]:
-                presence.setdefault(oid, set()).add(s)
-        for oid in sorted(presence):
+            for oid, v in infos[s].get("overs", {}).items():
+                byobj.setdefault(oid, {})[s] = ver(v)
+        # potential unseen holders = every acting position NOT in
+        # complete_shards: down shards AND behind/backfilling shards
+        # (their object versions are not in byobj, but their stores
+        # may hold acked copies — counting only non-responders let the
+        # delete branch destroy an acked object whose other holders
+        # were merely backfill-classified; thrasher seed 11 found it)
+        absent_n = (self.k + self.m) - len(complete_shards)
+        for oid in sorted(byobj):
             if oid in tracked:
                 continue
-            holders = presence[oid]
-            absent = [s for s in complete_shards if s not in holders]
-            if not absent:
-                continue
-            if len(holders) >= self.k:
-                for s in absent:
-                    self.peer_missing.setdefault(s, {})[oid] = auth_head
+            byshard = byobj[oid]
+            versions = sorted(set(byshard.values()), reverse=True)
+            vmax = versions[0]
+            n_vmax = sum(1 for x in byshard.values() if x == vmax)
+            if n_vmax >= self.k:
+                pick = vmax              # decodable: heal everyone up
+            elif n_vmax + absent_n >= self.min_size:
+                # vmax MAY have been acked (commit gate needs
+                # min_size durable shards; the rest could be among
+                # the absent) — rolling back would destroy acked
+                # data.  Quarantine the stale shards instead: marked
+                # missing, they are excluded from reads; recovery
+                # stays short of k sources and defers until absent
+                # shards return (per-object unfound, clean EIO).
+                pick = vmax
             else:
-                dout("osd", 1, f"peer {self.pgid}: deleting "
-                               f"unreconstructable orphan {oid} on "
-                               f"shards {sorted(holders)}")
-                await self._push_delete(oid, set(holders), up)
-                all_objects.discard(oid)
+                # vmax provably never acked: fall back to the newest
+                # version k shards still hold — the committed state
+                pick = next(
+                    (v for v in versions[1:]
+                     if sum(1 for x in byshard.values() if x == v)
+                     >= self.k), None)
+                if pick is None:
+                    dout("osd", 1, f"peer {self.pgid}: deleting "
+                                   f"unreconstructable orphan {oid} "
+                                   f"(versions {versions})")
+                    await self._push_delete(oid, set(byshard), up)
+                    all_objects.discard(oid)
+                    continue
+            stale = [s for s in complete_shards
+                     if byshard.get(s, ZERO) != pick]
+            if stale:
+                dout("osd", 2, f"peer {self.pgid}: {oid} -> "
+                               f"v{list(pick)} on shards {stale}")
+            for s in stale:
+                self.peer_missing.setdefault(s, {})[oid] = pick
 
         # recovery: reconstruct + push every missing object, bounded by
         # osd_recovery_max_active concurrent workers (reference recovery
@@ -2721,7 +2787,11 @@ class ECBackend:
                         exclude=set(to_recover[oid]),
                         trace_id=self._recovery_trace.pop(oid, ""))
                     counts["recovered"] += 1
-                except ECError as e:
+                except (ECError, ErasureCodeError) as e:
+                    # ErasureCodeError too: a codec-level failure
+                    # (mixed-size sources from undetected divergence)
+                    # must degrade to a failed-object count, not kill
+                    # the whole peering pass
                     dout("osd", 1, f"peer: recover {oid} failed: {e}")
                     counts["failed"] += 1
                 finally:

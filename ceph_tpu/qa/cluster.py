@@ -48,6 +48,7 @@ class MiniCluster:
         self.mons: "Dict[int, object]" = {}
         self.osds: "Dict[int, OSDDaemon]" = {}
         self.clients: "List[RadosClient]" = []
+        self._killed_pg_nums: "Dict[int, Dict[int, int]]" = {}
         self._admin: "Optional[RadosClient]" = None
         self._tcp = self.config.get("ms_type") == "async+tcp"
         if not self.mon_addrs:
@@ -247,6 +248,15 @@ class MiniCluster:
 
     async def kill_osd(self, osd_id: int) -> None:
         """qa/tasks/ceph_manager.py Thrasher.kill_osd analog."""
+        # static mode: remember the pg_nums this OSD had consumed so a
+        # revival spanning a pg_num raise still detects + runs the
+        # split (mon mode persists this in the store superblock)
+        self._killed_pg_nums[osd_id] = dict(
+            self.osds[osd_id]._pool_pg_nums)
+        if not self.mon_addrs:
+            for pid, pool in self.osdmap.pools.items():
+                self._killed_pg_nums[osd_id].setdefault(pid,
+                                                        pool.pg_num)
         await self.osds[osd_id].shutdown()
         if not self.mon_addrs:
             self.osdmap.mark_down(osd_id)
@@ -273,9 +283,20 @@ class MiniCluster:
             self.osdmap.mark_up(osd_id, self._initial_addr(osd_id))
             self.osdmap.bump()
         self.osds[osd_id] = osd
+        saved = self._killed_pg_nums.pop(osd_id, None)
         await osd.init()
+        if saved is not None and not self.mon_addrs:
+            # seed the consumed pg_nums from before the kill — AFTER
+            # init(), whose _load_consumed_pg_nums reassigns the dict
+            # (an unpersisted static-mode store loads {}).  Superblock
+            # entries, when present, are at least as fresh and win.
+            for pid, v in saved.items():
+                osd._pool_pg_nums.setdefault(pid, v)
         if not self.mon_addrs:
             self._publish_addrs()
+            osd._on_map_change(self.osdmap)
+            if osd._split_task is not None:
+                await osd._split_task
 
     async def set_pg_num(self, pool_name: str, new_pg_num: int) -> int:
         """Static mode: raise pg_num, split every OSD's collections,

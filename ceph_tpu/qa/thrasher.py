@@ -98,6 +98,12 @@ class Thrasher:
         self.min_interval = min_interval
         self.max_interval = max_interval
         self.kills = 0
+        self.splits = 0
+        # pool eligible for pg_num raises mid-thrash (reference
+        # thrashosds' chance_pgnum_grow), capped at max_splits
+        # doublings; None disables
+        self.split_pool: "Optional[str]" = None
+        self.max_splits = 2
         self._stop = asyncio.Event()
 
     def _live(self) -> "list[int]":
@@ -109,6 +115,18 @@ class Thrasher:
             await asyncio.sleep(self.rng.uniform(self.min_interval,
                                                  self.max_interval))
             live = self._live()
+            if self.split_pool is not None \
+                    and self.splits < self.max_splits \
+                    and self.rng.random() < 0.25:
+                # pg_num raise mid-thrash (possibly with OSDs down:
+                # they reconcile at revive) — reference thrashosds
+                # chance_pgnum_grow
+                pool = self.cluster.osdmap.pool_by_name(self.split_pool)
+                new = pool.pg_num * 2
+                dout("qa", 5, f"thrasher: pg_num {pool.pg_num}->{new}")
+                await self.cluster.set_pg_num(self.split_pool, new)
+                self.splits += 1
+                continue
             if down and (len(live) <= self.min_live
                          or self.rng.random() < 0.5):
                 victim = down.pop(self.rng.randrange(len(down)))
@@ -164,13 +182,18 @@ def _forensics(cluster: MiniCluster, pool, oid: str) -> str:
 
 async def run_thrash(cluster: MiniCluster, pool: str,
                      duration: float = 10.0, seed: int = 0,
-                     min_live: int = 3) -> dict:
+                     min_live: int = 3,
+                     with_splits: bool = False) -> dict:
     """Thrash ``pool`` for ``duration`` seconds, heal, verify.
 
-    Returns stats; raises AssertionError on any committed-data loss.
+    ``with_splits`` mixes pg_num raises into the kill/revive schedule
+    (reference thrashosds chance_pgnum_grow).  Returns stats; raises
+    AssertionError on any committed-data loss.
     """
     wl = Workload(cluster, pool, seed=seed)
     th = Thrasher(cluster, seed=seed + 1, min_live=min_live)
+    if with_splits:
+        th.split_pool = pool
     wtask = asyncio.ensure_future(wl.run())
     ttask = asyncio.ensure_future(th.run())
     await asyncio.sleep(duration)
@@ -205,4 +228,4 @@ async def run_thrash(cluster: MiniCluster, pool: str,
         except Exception:  # noqa: BLE001 — clean errors are acceptable
             pass
     return {"acked": wl.acked, "failed": wl.failed, "kills": th.kills,
-            "objects": len(wl.committed)}
+            "splits": th.splits, "objects": len(wl.committed)}

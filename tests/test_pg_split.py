@@ -117,6 +117,29 @@ class TestSplitStatic:
         loop.run_until_complete(go())
 
 
+class TestThrashWithSplits:
+    def test_kills_revives_and_splits_no_data_loss(self, loop):
+        """The full storm: OSD kills/revives AND pg_num raises under a
+        live workload (reference thrashosds chance_pgnum_grow).  The
+        invariant: every acked write readable byte-equal after heal.
+        This combination found (and now guards) the stale-revive
+        corruption class: a shard down across a split revives with
+        old copies and post-split fresh logs — version reconciliation
+        in peering must quarantine it, and the rollback-safety gate
+        must never revert a possibly-acked newest version."""
+        async def go():
+            from ceph_tpu.qa.thrasher import run_thrash
+            async with MiniCluster(n_osds=7) as c:
+                c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "3",
+                                        "m": "2"}, pg_num=4,
+                                 stripe_unit=64)
+                r = await run_thrash(c, "ec", duration=8.0, seed=11,
+                                     min_live=4, with_splits=True)
+                assert r["splits"] >= 1
+                assert r["acked"] > 100
+        loop.run_until_complete(go())
+
+
 class TestSplitMonMode:
     def test_pool_set_pg_num_via_mon(self, loop):
         async def go():
