@@ -20,6 +20,27 @@ LONGRUNAVG = "longrunavg"    # (sum, count) pair -> average
 HISTOGRAM = "histogram"      # log2-bucketed value histogram
 
 
+def hist_bucket_bound(i: int) -> int:
+    """Inclusive upper bound of log2 bucket ``i``: bucket i holds the
+    values whose bit_length is i, i.e. [2^(i-1), 2^i - 1] (0 for i=0)."""
+    return (1 << i) - 1
+
+
+def hist_quantile(buckets, count: int, q: float) -> int:
+    """Estimate quantile ``q`` from log2 buckets: the upper bound of the
+    first bucket whose cumulative count reaches q * count (conservative:
+    never under-reports a latency percentile)."""
+    if not count:
+        return 0
+    target = q * count
+    cum = 0
+    for i, n in enumerate(buckets):
+        cum += n
+        if cum >= target:
+            return hist_bucket_bound(i)
+    return hist_bucket_bound(len(buckets) - 1)
+
+
 class _Counter:
     __slots__ = ("name", "kind", "desc", "unit", "value", "sum", "count",
                  "buckets")
@@ -120,10 +141,17 @@ class PerfCounters:
                     out[name] = {"avgcount": c.count, "sum": c.sum,
                                  "avg": avg}
                 elif c.kind == HISTOGRAM:
-                    out[name] = {"count": c.count, "sum": c.sum,
-                                 "buckets": {
-                                     str(1 << (i - 1) if i else 0): n
-                                     for i, n in enumerate(c.buckets) if n}}
+                    # buckets keyed by inclusive UPPER bound so the mgr
+                    # prometheus module can serialize them directly as
+                    # cumulative `le` histogram series; p50/p99 derived
+                    # here so `perf dump` is usable without a scraper
+                    out[name] = {
+                        "count": c.count, "sum": c.sum,
+                        "buckets": {str(hist_bucket_bound(i)): n
+                                    for i, n in enumerate(c.buckets)
+                                    if n},
+                        "p50": hist_quantile(c.buckets, c.count, 0.50),
+                        "p99": hist_quantile(c.buckets, c.count, 0.99)}
         return out
 
     def schema(self) -> dict:
@@ -131,6 +159,12 @@ class PerfCounters:
             return {name: {"type": c.kind, "description": c.desc,
                            "unit": c.unit}
                     for name, c in self._counters.items()}
+
+    def histogram_dump(self) -> dict:
+        """Only the histogram counters ('perf histogram dump')."""
+        full = self.dump()
+        return {n: v for n, v in full.items()
+                if isinstance(v, dict) and "buckets" in v}
 
     def reset(self) -> None:
         with self._lock:
@@ -195,3 +229,23 @@ class PerfCountersCollection:
     def schema(self) -> dict:
         with self._lock:
             return {name: pc.schema() for name, pc in self._groups.items()}
+
+    def histogram_dump(self) -> dict:
+        with self._lock:
+            groups = list(self._groups.items())
+        out = {}
+        for name, pc in groups:
+            hists = pc.histogram_dump()
+            if hists:
+                out[name] = hists
+        return out
+
+    def reset(self) -> None:
+        """Zero every group (histograms included) in one shot — the
+        'perf reset' admin command; each group resets under its own
+        lock so dumps racing the reset see either state, never a mix
+        of cleared buckets with a stale count."""
+        with self._lock:
+            groups = list(self._groups.values())
+        for pc in groups:
+            pc.reset()

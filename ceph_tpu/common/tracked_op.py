@@ -19,11 +19,23 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Sequence
 
 from .log import dout
 
 _ids = itertools.count(1)
+
+
+def format_slow_ops(count: int, oldest_age: float,
+                    daemons: "Sequence[str]" = ()) -> str:
+    """The one slow-ops message every surface shows ('ceph status',
+    'ceph health', mgr status module) — one format, zero drift."""
+    if not count:
+        return ""
+    msg = f"{count} slow ops, oldest age {oldest_age:.1f}s"
+    if daemons:
+        msg += f" ({', '.join(daemons)} have slow ops)"
+    return msg
 
 
 class TrackedOp:
@@ -139,3 +151,14 @@ class OpTracker:
         with self._lock:
             return [o for o in self.in_flight.values()
                     if o.age >= self.complaint_time]
+
+    def slow_summary(self) -> dict:
+        """What health surfaces need (mgr report + mon beacon): slow
+        in-flight ops right now, the lifetime total, and the oldest
+        blocked age — the reference's 'N slow ops, oldest one blocked
+        for X sec' data."""
+        slow = self.slow_ops()
+        return {"count": len(slow),
+                "total": self.slow_ops_total,
+                "oldest_age": round(max((o.age for o in slow),
+                                        default=0.0), 3)}

@@ -50,10 +50,25 @@ class StatusModule(MgrModule):
     def status(self) -> dict:
         now = time.monotonic()
         daemons = {}
+        slow_count, slow_oldest, slow_daemons = 0, 0.0, []
         for name, rep in self.mgr.reports.items():
+            st = rep.get("status", {})
             daemons[name] = {"age": round(now - rep["ts"], 1),
-                             "status": rep.get("status", {})}
-        return {"num_daemons": len(daemons), "daemons": daemons}
+                             "status": st}
+            so = st.get("slow_ops") or {}
+            if self.mgr.is_fresh(rep) and so.get("count"):
+                slow_count += int(so["count"])
+                slow_oldest = max(slow_oldest,
+                                  float(so.get("oldest_age", 0.0)))
+                slow_daemons.append(name)
+        from ..common.tracked_op import format_slow_ops
+        return {"num_daemons": len(daemons), "daemons": daemons,
+                "slow_ops": {
+                    "count": slow_count,
+                    "oldest_age": round(slow_oldest, 3),
+                    "daemons": sorted(slow_daemons),
+                    "message": format_slow_ops(slow_count,
+                                               slow_oldest)}}
 
 
 class HttpModule(MgrModule):
@@ -104,6 +119,12 @@ class HttpModule(MgrModule):
             writer.close()
 
 
+# canonical histogram bound set served to prometheus: log2 buckets 0..
+# 2^40-1 (µs-scaled counters top out around 13 days); anything beyond
+# folds into +Inf, keeping the le set identical across daemons
+_CANON_BUCKETS = 41
+
+
 class PrometheusModule(HttpModule):
     """Text-format exporter (reference src/pybind/mgr/prometheus)."""
 
@@ -114,24 +135,82 @@ class PrometheusModule(HttpModule):
         return self.render().encode(), "text/plain; version=0.0.4"
 
     def render(self) -> str:
-        """Aggregate reports into prometheus exposition text."""
+        """Aggregate reports into prometheus exposition text.
+
+        Counter kinds map onto the prometheus data model the way the
+        reference exporter does: u64/u64_counter -> one counter series;
+        TIME/LONGRUNAVG -> ``_sum``/``_count`` pair; HISTOGRAM -> full
+        cumulative ``_bucket``(le)/``_sum``/``_count`` series built from
+        the log2 buckets `perf dump` now exposes (upper-bound keyed)."""
         lines = ["# HELP ceph_daemon_up 1 if the daemon reported recently",
                  "# TYPE ceph_daemon_up gauge"]
         for name, rep in sorted(self.mgr.reports.items()):
             up = 1 if self.mgr.is_fresh(rep) else 0
             lines.append(f'ceph_daemon_up{{ceph_daemon="{name}"}} {up}')
+        # slow ops ride the report status (OpTracker summary), not the
+        # counter dump — surface them as a per-daemon gauge.  A stale
+        # report exports gauge 0 (a dead daemon's last count must not
+        # pin the CephTpuSlowOps alert forever — same freshness rule
+        # as the status module and the mon health check) but OMITS the
+        # monotonic total: zeroing it would read as a counter reset
+        # and increase() would invent slow ops on the next fresh scrape.
+        lines.append("# TYPE ceph_slow_ops gauge")
+        lines.append("# TYPE ceph_slow_ops_total counter")
+        for name, rep in sorted(self.mgr.reports.items()):
+            fresh = self.mgr.is_fresh(rep)
+            so = rep.get("status", {}).get("slow_ops") or {}
+            lines.append(f'ceph_slow_ops{{ceph_daemon="{name}"}} '
+                         f'{int(so.get("count", 0)) if fresh else 0}')
+            if fresh:
+                lines.append(
+                    f'ceph_slow_ops_total{{ceph_daemon="{name}"}} '
+                    f'{int(so.get("total", 0))}')
         seen: "set[str]" = set()
         for name, rep in sorted(self.mgr.reports.items()):
             for group, counters in rep.get("perf", {}).items():
                 for cname, val in counters.items():
                     metric = f"ceph_{cname}"
-                    if isinstance(val, dict):
-                        val = val.get("sum", val.get("avgcount", 0))
-                    if metric not in seen:
-                        seen.add(metric)
-                        lines.append(f"# TYPE {metric} counter")
-                    lines.append(
-                        f'{metric}{{ceph_daemon="{name}"}} {val}')
+                    label = f'ceph_daemon="{name}"'
+                    if isinstance(val, dict) and "buckets" in val:
+                        if metric not in seen:
+                            seen.add(metric)
+                            lines.append(f"# TYPE {metric} histogram")
+                        # every daemon emits the SAME canonical bound
+                        # set: sparse per-daemon bounds would misalign
+                        # `sum(...) by (le)` and skew every
+                        # histogram_quantile in the shipped dashboards
+                        # (samples past the last bound live in +Inf)
+                        counts = {int(b): int(n)
+                                  for b, n in val["buckets"].items()}
+                        cum = 0
+                        for i in range(_CANON_BUCKETS):
+                            ub = (1 << i) - 1
+                            cum += counts.get(ub, 0)
+                            lines.append(
+                                f'{metric}_bucket{{{label},'
+                                f'le="{ub}"}} {cum}')
+                        lines.append(f'{metric}_bucket{{{label},'
+                                     f'le="+Inf"}} {val["count"]}')
+                        lines.append(
+                            f'{metric}_sum{{{label}}} {val["sum"]}')
+                        lines.append(
+                            f'{metric}_count{{{label}}} {val["count"]}')
+                    elif isinstance(val, dict):
+                        # TIME / LONGRUNAVG: (sum, count) pair
+                        if metric not in seen:
+                            seen.add(metric)
+                            lines.append(f"# TYPE {metric}_sum counter")
+                            lines.append(
+                                f"# TYPE {metric}_count counter")
+                        lines.append(f'{metric}_sum{{{label}}} '
+                                     f'{val.get("sum", 0)}')
+                        lines.append(f'{metric}_count{{{label}}} '
+                                     f'{val.get("avgcount", 0)}')
+                    else:
+                        if metric not in seen:
+                            seen.add(metric)
+                            lines.append(f"# TYPE {metric} counter")
+                        lines.append(f'{metric}{{{label}}} {val}')
         return "\n".join(lines) + "\n"
 
 
@@ -236,6 +315,11 @@ async def report_loop(daemon, mgr_addr: str) -> None:
                 "status": {"up": daemon.up,
                            "num_pgs": len(daemon.backends),
                            "epoch": daemon.osdmap.epoch,
+                           # slow-op summary for the status module /
+                           # SLOW_OPS surfaces (reference DaemonState
+                           # health metrics riding MMgrReport)
+                           "slow_ops":
+                               daemon.op_tracker.slow_summary(),
                            # pool geometry for the dashboard +
                            # pg_autoscaler (reference: mgr consumes the
                            # osdmap directly; here it rides the report)

@@ -52,7 +52,15 @@ class DashboardModule(HttpModule):
             health = "HEALTH_OK"
         else:
             health = "HEALTH_WARN" if up else "HEALTH_ERR"
-        out = {"health": health,
+        checks = []
+        slow = self.mgr.modules["status"].status()["slow_ops"]
+        if slow["count"]:
+            checks.append({"check": "SLOW_OPS",
+                           "severity": "HEALTH_WARN",
+                           "message": slow["message"]})
+            if health == "HEALTH_OK":
+                health = "HEALTH_WARN"
+        out = {"health": health, "checks": checks,
                "num_daemons": len(daemons), "num_up": up,
                "daemons": daemons, "pools": pools}
         auto = self.mgr.modules.get("pg_autoscaler")

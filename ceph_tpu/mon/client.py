@@ -151,12 +151,18 @@ class MonClient(Dispatcher):
             except (ConnectionError, OSError):
                 continue
 
-    async def send_beacon(self, osd_id: int) -> None:
+    async def send_beacon(self, osd_id: int,
+                          slow_ops: "dict | None" = None) -> None:
+        fields = {"osd_id": osd_id, "epoch": self.osdmap.epoch}
+        if slow_ops is not None:
+            # slow-op summary rides the beacon so the mon health
+            # ruleset can raise SLOW_OPS (reference: osd beacons +
+            # MOSDFailure feed the mon's health service)
+            fields["slow_ops"] = dict(slow_ops)
         for rank in sorted(self.mon_addrs):
             try:
                 conn = self.ms.get_connection(self.mon_addrs[rank])
-                await conn.send_message(MOSDBeacon(
-                    {"osd_id": osd_id, "epoch": self.osdmap.epoch}))
+                await conn.send_message(MOSDBeacon(fields))
             except (ConnectionError, OSError):
                 continue
 

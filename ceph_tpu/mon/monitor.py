@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..common.config import Config
 from ..common.log import dout
+from ..common.tracked_op import format_slow_ops
 from ..ec.registry import factory_from_profile
 from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
@@ -80,6 +81,9 @@ class MonDaemon(Dispatcher):
         # volatile control state
         self.subs: "Set[str]" = set()            # subscriber addresses
         self.last_beacon: "Dict[int, float]" = {}
+        # per-osd slow-op summary carried on beacons (feeds the
+        # SLOW_OPS health check): osd -> {count, total, oldest_age}
+        self.osd_slow_ops: "Dict[int, dict]" = {}
         self.failure_reports: "Dict[int, Set[int]]" = {}
         self._tick_task: "Optional[asyncio.Task]" = None
         from ..common.lockdep import DepLock
@@ -341,6 +345,10 @@ class MonDaemon(Dispatcher):
                 ops.append({"op": "mark_up", "osd": osd,
                             "addr": msg["addr"]})
                 self.last_beacon[osd] = time.monotonic()
+                # a (re)booting daemon starts with a clean slate: a
+                # re-used id must not inherit its predecessor's
+                # slow-op summary until its first beacon
+                self.osd_slow_ops.pop(osd, None)
                 await self._propose_osd_ops(ops)
             elif self.elector.leader is not None and \
                     not self.elector.electing:
@@ -348,6 +356,8 @@ class MonDaemon(Dispatcher):
                 await self._send_mon(self.elector.leader, msg)
         elif t == "osd_beacon":
             self.last_beacon[int(msg["osd_id"])] = time.monotonic()
+            self.osd_slow_ops[int(msg["osd_id"])] = dict(
+                msg.get("slow_ops") or {})
         elif t == "osd_failure":
             await self._handle_failure(msg)
         else:
@@ -415,10 +425,37 @@ class MonDaemon(Dispatcher):
 
     # --- commands (the 'ceph' CLI surface) ------------------------------------
 
-    def _health(self) -> "tuple[str, list]":
+    def _slow_ops_summary(self) -> "tuple[int, float, list]":
+        """(count, oldest_age, daemons) of slow ops across UP osds —
+        beacons from since-downed osds must not pin the warning."""
+        # drop entries for osds purged from the map (bounded state)
+        for osd in [o for o in self.osd_slow_ops
+                    if o not in self.osdmap.osds]:
+            del self.osd_slow_ops[osd]
+        count, oldest, daemons = 0, 0.0, []
+        for osd, so in sorted(self.osd_slow_ops.items()):
+            info = self.osdmap.osds.get(osd)
+            if info is None or not info.up or not so.get("count"):
+                continue
+            count += int(so["count"])
+            oldest = max(oldest, float(so.get("oldest_age", 0.0)))
+            daemons.append(f"osd.{osd}")
+        return count, oldest, daemons
+
+    def _health(self, slow_summary: "tuple | None" = None
+                ) -> "tuple[str, list]":
         """One health ruleset feeding BOTH 'status' and 'health' — the
-        two surfaces must never disagree."""
+        two surfaces must never disagree.  ``slow_summary``: a
+        precomputed _slow_ops_summary() so 'status' evaluates it once."""
         checks = []
+        slow_n, slow_oldest, slow_daemons = (
+            slow_summary if slow_summary is not None
+            else self._slow_ops_summary())
+        if slow_n:
+            checks.append({
+                "check": "SLOW_OPS", "severity": "HEALTH_WARN",
+                "message": format_slow_ops(slow_n, slow_oldest,
+                                           slow_daemons)})
         down = [i for i, o in self.osdmap.osds.items()
                 if not o.up and o.in_cluster]
         if down:
@@ -761,7 +798,9 @@ class MonDaemon(Dispatcher):
             return 0, {"map": self.osdmap.to_dict()}
         if prefix == "status":
             up = sum(1 for o in self.osdmap.osds.values() if o.up)
-            status, _checks = self._health()
+            slow = self._slow_ops_summary()
+            status, _checks = self._health(slow)
+            slow_n, slow_oldest, _d = slow
             return 0, {
                 "mon": {"rank": self.rank, "quorum": self.elector.quorum,
                         "leader": self.elector.leader},
@@ -769,6 +808,9 @@ class MonDaemon(Dispatcher):
                            "num_osds": len(self.osdmap.osds),
                            "num_up_osds": up},
                 "pools": len(self.osdmap.pools),
+                "slow_ops": {
+                    "count": slow_n, "oldest_age": slow_oldest,
+                    "message": format_slow_ops(slow_n, slow_oldest)},
                 "health": status}
         if prefix == "health":
             status, checks = self._health()

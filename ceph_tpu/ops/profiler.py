@@ -1,0 +1,127 @@
+"""KernelProfiler — device-step telemetry for the EC hot path.
+
+The reference instruments its hot path with perf counters
+(src/common/perf_counters.h:34) and LTTng tracepoints; the TPU analog
+needs two things the jax.profiler trace (osd 'profile start') cannot
+give cheaply: always-on latency HISTOGRAMS per kernel kind and roofline
+counters derived from static shape analysis — the same machine model
+tools/roofline_probe.py measures (bytes through HBM, GF(2^8) multiplies
+through the VPU/MXU, achieved GB/s per launch).
+
+One instance per daemon; its counter group ("kernel") registers into
+the daemon's PerfCountersCollection so the numbers ride `perf dump`,
+MMgrReport, and the mgr prometheus exporter with no extra plumbing.
+
+Timing contract: ``measure``/``record`` callers must synchronize the
+device before the clock stops — the EncodeService fetches results via
+np.asarray (which blocks until ready) inside its measure block, and
+host-side kernels are synchronous by nature.  A naive stop-the-clock on
+dispatch would time the enqueue, not the kernel (utils/devtime.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..common.perf_counters import PerfCounters, PerfCountersBuilder
+
+KINDS = ("encode", "decode", "crc32c")
+
+
+def encode_cost(B: int, k: int, m: int, w_bytes: int) -> "tuple[int, int]":
+    """(bytes moved, GF multiplies) of one (B, k, W)->(B, m, W) encode:
+    k rows read + m rows written through HBM per stripe; the matrix
+    multiply is one GF(2^8) multiply per (input row, output row, byte)."""
+    return B * (k + m) * w_bytes, B * k * m * w_bytes
+
+
+def decode_cost(n_present: int, n_rebuilt: int,
+                w_bytes: int) -> "tuple[int, int]":
+    """(bytes moved, GF multiplies) of applying a (n_rebuilt, n_present)
+    decode matrix to n_present surviving chunks of w_bytes each."""
+    return ((n_present + n_rebuilt) * w_bytes,
+            n_present * n_rebuilt * w_bytes)
+
+
+def crc_cost(nbytes: int) -> "tuple[int, int]":
+    """crc32c streams the data once; no GF(2^8) multiplies."""
+    return nbytes, 0
+
+
+class _Measure:
+    """Context manager timing one kernel launch; no-op when disabled."""
+
+    __slots__ = ("_prof", "_kind", "_bytes", "_mults", "_t0")
+
+    def __init__(self, prof: "KernelProfiler", kind: str,
+                 bytes_moved: int, gf_mults: int) -> None:
+        self._prof = prof
+        self._kind = kind
+        self._bytes = bytes_moved
+        self._mults = gf_mults
+
+    def __enter__(self) -> "_Measure":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if exc[0] is None:
+            self._prof.record(self._kind,
+                              time.perf_counter() - self._t0,
+                              self._bytes, self._mults)
+        return False
+
+
+class KernelProfiler:
+    """Log2 latency histograms + roofline counters per kernel kind."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        b = PerfCountersBuilder("kernel")
+        for kind in KINDS:
+            b.add_histogram(f"kernel_{kind}_lat",
+                            f"{kind} step wall time", "us")
+            b.add_u64_counter(f"kernel_{kind}_launches",
+                              f"{kind} kernel launches")
+            b.add_u64_counter(f"kernel_{kind}_bytes",
+                              f"bytes moved by {kind} (shape-derived)",
+                              "bytes")
+            b.add_u64_counter(f"kernel_{kind}_gf_mults",
+                              f"GF(2^8) multiplies in {kind} "
+                              f"(shape-derived)")
+            b.add_longrunavg(f"kernel_{kind}_gbs",
+                             f"achieved {kind} GB/s per launch", "GB/s")
+        b.add_histogram("kernel_encode_queue_lat",
+                        "encode-request wait in the cross-PG batch "
+                        "queue", "us")
+        self.counters: PerfCounters = b.create_perf_counters()
+
+    def record(self, kind: str, seconds: float,
+               bytes_moved: int = 0, gf_mults: int = 0) -> None:
+        if not self.enabled:
+            return
+        pc = self.counters
+        pc.hinc(f"kernel_{kind}_lat", seconds * 1e6)
+        pc.inc(f"kernel_{kind}_launches")
+        if bytes_moved:
+            pc.inc(f"kernel_{kind}_bytes", int(bytes_moved))
+        if gf_mults:
+            pc.inc(f"kernel_{kind}_gf_mults", int(gf_mults))
+        if bytes_moved and seconds > 0:
+            pc.tinc(f"kernel_{kind}_gbs", bytes_moved / seconds / 1e9)
+
+    def measure(self, kind: str, bytes_moved: int = 0,
+                gf_mults: int = 0) -> _Measure:
+        """``with profiler.measure("encode", bytes, mults): <launch +
+        fetch>`` — the block must leave the device synchronized."""
+        return _Measure(self, kind, bytes_moved, gf_mults)
+
+    def queue_wait(self, seconds: float) -> None:
+        if self.enabled:
+            self.counters.hinc("kernel_encode_queue_lat", seconds * 1e6)
+
+
+# Shared disabled instance: call sites built without a daemon (unit
+# harnesses, standalone EncodeService) record into this and it drops
+# everything — no per-call None checks in the hot path.
+NULL = KernelProfiler(enabled=False)

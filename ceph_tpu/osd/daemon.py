@@ -56,6 +56,19 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("tier_flush", "cache-tier flushes to base")
           .add_u64_counter("tier_evict", "cache-tier evictions")
           .add_time_avg("op_latency", "client op latency")
+          # write-pipeline stage histograms (µs, log2 buckets): the
+          # per-op breakdown dump_historic_ops shows, aggregated
+          # (reference l_osd_op_w_prepare_lat / l_osd_op_w_process_lat)
+          .add_histogram("op_w_queue_lat",
+                         "admission -> encode-start wait", "us")
+          .add_histogram("op_w_encode_lat",
+                         "encode stage (incl. batched device wait)",
+                         "us")
+          .add_histogram("subop_w_rtt",
+                         "sub-write fan-out -> per-shard commit ack",
+                         "us")
+          .add_histogram("op_w_commit_lat",
+                         "admission -> all-shards-committed", "us")
           .create_perf_counters())
     coll.add(pc)
     return pc
@@ -102,6 +115,13 @@ class OSDDaemon(Dispatcher):
         self.admin_socket = None
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
+        # kernel telemetry (encode/decode/crc32c latency histograms +
+        # roofline counters); its "kernel" group rides perf dump and
+        # the mgr report like any other counter group
+        from ..ops.profiler import KernelProfiler
+        self.profiler = KernelProfiler()
+        self.perf_coll.add(self.profiler.counters)
+        self.encode_service.profiler = self.profiler
         # cephx ticket validation (rotating secrets arrive from the mon
         # at boot / lazily on unknown generations; static-mode harnesses
         # inject them directly)
@@ -469,7 +489,10 @@ class OSDDaemon(Dispatcher):
     async def _beacon_loop(self) -> None:
         interval = float(self.config.get("osd_heartbeat_interval"))
         while True:
-            await self.monc.send_beacon(self.whoami)
+            # the beacon carries the slow-op summary so the mon can
+            # fold SLOW_OPS into cluster health ('ceph status')
+            await self.monc.send_beacon(
+                self.whoami, slow_ops=self.op_tracker.slow_summary())
             await asyncio.sleep(interval)
 
     # --- cache tiering (reference PrimaryLogPG promote/flush/evict +
@@ -775,6 +798,17 @@ class OSDDaemon(Dispatcher):
         a = AdminSocket(path)
         a.register("perf dump", lambda _c: self.perf_dump(),
                    "per-daemon performance counters")
+        a.register("perf histogram dump",
+                   lambda _c: self.perf_coll.histogram_dump(),
+                   "latency histograms only, with buckets/sum/count "
+                   "and derived p50/p99")
+        a.register("perf schema",
+                   lambda _c: self.perf_coll.schema(),
+                   "counter types/descriptions/units")
+        a.register("perf reset",
+                   lambda _c: (self.perf_coll.reset(),
+                               {"success": True})[1],
+                   "zero every perf counter and histogram")
         a.register("dump_ops_in_flight",
                    lambda _c: self.op_tracker.dump_in_flight(),
                    "ops currently being processed")
@@ -854,7 +888,8 @@ class OSDDaemon(Dispatcher):
                        mesh_plane=self.mesh_plane,
                        device_mesh=getattr(pool, "device_mesh", False),
                        fast_read=lambda p=pgid[0]: getattr(
-                           self.osdmap.get_pool(p), "fast_read", False))
+                           self.osdmap.get_pool(p), "fast_read", False),
+                       perf=self.perf, profiler=self.profiler)
         be.last_epoch = self.osdmap.epoch
         self.backends[pgid] = be
         return be
@@ -1439,7 +1474,8 @@ class OSDDaemon(Dispatcher):
                     top.mark("started_write")
                 version = await be.submit_transaction(
                     oid, mutations, reqid=str(msg.get("reqid", "")),
-                    trace_id=top.trace_id if top else "")
+                    trace_id=top.trace_id if top else "",
+                    tracked=top)
                 if getattr(pool, "tier_of", None) is not None and any(
                         m.op == "delete" for m in mutations):
                     # write-through deletes: a surviving base copy

@@ -38,6 +38,7 @@ import numpy as np
 from ..common.log import dout
 from ..ec.interface import ErasureCodeError, ErasureCodeInterface
 from ..objectstore.store import NotFound, ObjectStore
+from ..ops import profiler as profiler_mod
 from ..objectstore.transaction import Transaction
 from ..objectstore.types import Collection, NO_GEN, ObjectId
 from ..ops import crc32c as crcmod
@@ -134,6 +135,13 @@ class Op:
     # distributed trace id (reference ZTracer span threaded through EC
     # sub-writes, ECBackend.cc:2063-2068); "" = untraced
     trace_id: str = ""
+    # stage-timing anchors (op-path telemetry): admission into the
+    # pipeline and sub-write fan-out, both time.monotonic()
+    admitted_at: float = 0.0
+    sent_at: float = 0.0
+    # the daemon-level TrackedOp carrying this mutation, when any:
+    # stage marks land on it so dump_historic_ops shows the breakdown
+    tracked: "Any" = None
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
 
 
@@ -214,7 +222,7 @@ class ECBackend:
                  encode_service=None, scheduler=None,
                  config=None, mesh_plane=None,
                  device_mesh: bool = False,
-                 fast_read=False) -> None:
+                 fast_read=False, perf=None, profiler=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -234,6 +242,10 @@ class ECBackend:
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
         self.config = config
+        # daemon perf group (stage histograms: queue wait / encode /
+        # sub-op rtt / commit) and kernel profiler (decode + crc timing)
+        self.perf = perf
+        self.profiler = profiler or profiler_mod.NULL
         # device-mesh collective data plane (pool flag device_mesh):
         # sub-write encode/fan-out + recovery decode ride XLA collectives
         # over a (pg, shard) mesh; the messenger carries only metadata
@@ -588,10 +600,17 @@ class ECBackend:
 
     # ================================================================ WRITES
 
+    def _stage_hinc(self, name: str, seconds: float) -> None:
+        """Record a write-pipeline stage duration (microseconds) into
+        the daemon's perf histograms; no-op for harness-built backends."""
+        if self.perf is not None:
+            self.perf.hinc(name, seconds * 1e6)
+
     async def submit_transaction(self, oid: str,
                                  ops: "Sequence[ClientOp]",
                                  reqid: str = "",
-                                 trace_id: str = "") -> Version:
+                                 trace_id: str = "",
+                                 tracked=None) -> Version:
         """Primary entry (reference ECBackend::submit_transaction
         ECBackend.cc:1483 -> start_rmw :1839).  Returns the committed
         version once every up shard acked.  ``reqid`` dedups client
@@ -609,7 +628,8 @@ class ECBackend:
         # buffered-write admission (lost-update window)
         async with self.cls_lock:
             op = await self.enqueue_transaction(oid, ops,
-                                                trace_id=trace_id)
+                                                trace_id=trace_id,
+                                                tracked=tracked)
         version = await op.on_commit
         if reqid:
             self.completed_reqids[reqid] = version
@@ -620,7 +640,8 @@ class ECBackend:
 
     async def enqueue_transaction(self, oid: str,
                                   ops: "Sequence[ClientOp]",
-                                  trace_id: str = "") -> Op:
+                                  trace_id: str = "",
+                                  tracked=None) -> Op:
         """Admit a mutation into the pipeline and return its Op without
         waiting for commit.  The pipeline commits strictly in admission
         order, so once op A is enqueued, no later op can commit before
@@ -628,7 +649,8 @@ class ECBackend:
         read-modify-write atomicity (exec holds cls_lock across its
         reads AND this enqueue)."""
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
-                trace_id=trace_id)
+                trace_id=trace_id, tracked=tracked,
+                admitted_at=time.monotonic())
         op.on_commit = asyncio.get_event_loop().create_future()
         self._hit_set_track(oid)
         # peering drains + blocks the pipeline (reference: client ops are
@@ -887,6 +909,11 @@ class ECBackend:
         acting = self.get_acting()
         op.acting = list(acting)
         op.version = (self.last_epoch, self.pg_log.head[1] + 1)
+        # stage telemetry: pipeline wait ends, the encode stage starts
+        t_encode = time.monotonic()
+        self._stage_hinc("op_w_queue_lat", t_encode - op.admitted_at)
+        if op.tracked is not None:
+            op.tracked.mark("encode_start")
         if op.delete or op.plan.invalidates_cache:
             # barrier op (pipeline drained, see _state_head_ready): drop
             # cached pre-truncate/pre-delete stripes
@@ -1069,6 +1096,12 @@ class ECBackend:
 
         # encode done — now (atomically w.r.t. the event loop) enter the
         # commit stage with the full pending set before any send awaits
+        op.sent_at = time.monotonic()
+        if not op.delete:
+            self._stage_hinc("op_w_encode_lat", op.sent_at - t_encode)
+        if op.tracked is not None:
+            op.tracked.mark("encoded")
+            op.tracked.mark("subops_sent")
         op.pending_commits = {s for s in range(self.k + self.m)
                               if s < len(acting) and acting[s] != NONE_OSD}
         self.waiting_commit.append(op)
@@ -1147,6 +1180,11 @@ class ECBackend:
 
     def _sub_write_committed(self, op: Op, shard: int) -> None:
         op.pending_commits.discard(shard)
+        if op.sent_at:
+            self._stage_hinc("subop_w_rtt",
+                             time.monotonic() - op.sent_at)
+        if op.tracked is not None:
+            op.tracked.mark(f"sub_write_committed(shard={shard})")
         self._check_commit_queue()
 
     def _check_commit_queue(self) -> None:
@@ -1198,6 +1236,11 @@ class ECBackend:
         if op.pinned:
             self.extent_cache.release_write(op.oid, op.pinned)
             op.pinned = []
+        if op.admitted_at:
+            self._stage_hinc("op_w_commit_lat",
+                             time.monotonic() - op.admitted_at)
+        if op.tracked is not None:
+            op.tracked.mark("committed")
         if not op.on_commit.done():
             op.on_commit.set_result(op.version)
         if self.waiting_state:
@@ -1433,9 +1476,12 @@ class ECBackend:
                 if hinfo.valid() and hinfo.total_chunk_size == st["size"]:
                     # -1 seed matches the HashInfo chain start
                     # (reference seeds shard crcs with -1, ECUtil.cc:172)
-                    got = crcmod.crc32c(
-                        np.frombuffer(data[:st["size"]], dtype=np.uint8),
-                        0xFFFFFFFF)
+                    bm, _ = profiler_mod.crc_cost(st["size"])
+                    with self.profiler.measure("crc32c", bm):
+                        got = crcmod.crc32c(
+                            np.frombuffer(data[:st["size"]],
+                                          dtype=np.uint8),
+                            0xFFFFFFFF)
                     if got != hinfo.get_chunk_hash(shard):
                         raise ECError(
                             f"crc mismatch {sid.name}@{shard}: "
@@ -1873,7 +1919,13 @@ class ECBackend:
             if parts:
                 buf = b"".join(parts)[:clen].ljust(clen, b"\0")
                 shards[shard] = np.frombuffer(buf, dtype=np.uint8)
-        logical = ecutil.decode_concat(self.sinfo, self.codec, shards)
+        missing = sum(1 for s in range(self.k) if s not in shards)
+        bm, gm = profiler_mod.decode_cost(
+            len(shards), missing, clen)
+        with self.profiler.measure("decode", bm,
+                                   gm if missing else 0):
+            logical = ecutil.decode_concat(self.sinfo, self.codec,
+                                           shards)
         lo = off - start
         return bytes(logical[lo:lo + length].tobytes())
 
@@ -1961,9 +2013,12 @@ class ECBackend:
             arrs = {s: np.frombuffer(
                 b"".join(bo[o] for o in sorted(bo)), dtype=np.uint8)
                 for s, bo in shard_bufs.items()}
-            decoded = ecutil.decode(self.sinfo, self.codec, arrs,
-                                    sorted(rop.missing_on),
-                                    chunk_size=full_size)
+            bm, gm = profiler_mod.decode_cost(
+                len(arrs), len(rop.missing_on), full_size)
+            with self.profiler.measure("decode", bm, gm):
+                decoded = ecutil.decode(self.sinfo, self.codec, arrs,
+                                        sorted(rop.missing_on),
+                                        chunk_size=full_size)
         else:
             arrs = {}
             for shard, by_off in shard_bufs.items():
@@ -1982,8 +2037,12 @@ class ECBackend:
                     None, self.mesh_plane.reconstruct,
                     self.codec, arrs, sorted(rop.missing_on))
             else:
-                decoded = ecutil.decode(self.sinfo, self.codec, arrs,
-                                        sorted(rop.missing_on))
+                bm, gm = profiler_mod.decode_cost(
+                    len(arrs), len(rop.missing_on), csize)
+                with self.profiler.measure("decode", bm, gm):
+                    decoded = ecutil.decode(self.sinfo, self.codec,
+                                            arrs,
+                                            sorted(rop.missing_on))
         rop.recovered = {s: bytes(a.tobytes()) for s, a in decoded.items()}
         rop.attrs = read.attrs.get(oid, {})
         rop.omap = read.omap.get(oid, {})

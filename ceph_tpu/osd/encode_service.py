@@ -26,11 +26,13 @@ sub-threshold batches fall back to the host ``encode_chunks`` call.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..ec.interface import ErasureCodeInterface
+from ..ops import profiler as profiler_mod
 from .ecutil import StripeInfo
 
 # Pad batch depth to the next power of two (bounded by max_batch) so the
@@ -44,13 +46,14 @@ def _bucket(n: int, cap: int) -> int:
 
 
 class _Request:
-    __slots__ = ("data", "with_crc", "future")
+    __slots__ = ("data", "with_crc", "future", "t0")
 
     def __init__(self, data: np.ndarray, with_crc: bool,
                  future: "asyncio.Future") -> None:
         self.data = data            # (k, W) uint8, W % 4 == 0
         self.with_crc = with_crc
         self.future = future
+        self.t0 = time.perf_counter()   # queue-wait histogram anchor
 
 
 class EncodeService:
@@ -64,9 +67,14 @@ class EncodeService:
     """
 
     def __init__(self, max_batch: int = 128,
-                 min_device_bytes: int = 64 * 1024) -> None:
+                 min_device_bytes: int = 64 * 1024,
+                 profiler: "Optional[profiler_mod.KernelProfiler]" = None
+                 ) -> None:
         self.max_batch = max(1, int(max_batch))
         self.min_device_bytes = int(min_device_bytes)
+        # kernel telemetry (latency histograms + roofline counters);
+        # the daemon injects its per-daemon profiler
+        self.profiler = profiler or profiler_mod.NULL
         self._pending: "Dict[Tuple, List[_Request]]" = {}
         self._codecs: "Dict[Tuple, ErasureCodeInterface]" = {}
         self._flusher: "Optional[asyncio.Task]" = None
@@ -118,7 +126,11 @@ class EncodeService:
     def _host_encode(self, codec: ErasureCodeInterface,
                      shards: np.ndarray) -> np.ndarray:
         self.stats["host_requests"] += 1
-        parity = np.asarray(codec.encode_chunks(shards))
+        bm, gm = profiler_mod.encode_cost(
+            1, codec.get_data_chunk_count(),
+            codec.get_coding_chunk_count(), shards.shape[1])
+        with self.profiler.measure("encode", bm, gm):
+            parity = np.asarray(codec.encode_chunks(shards))
         return np.concatenate([shards, parity], axis=0)
 
     # --- flusher --------------------------------------------------------------
@@ -149,6 +161,9 @@ class EncodeService:
         _c_bytes, W = key
         B = len(reqs)
         self.stats["max_batch"] = max(self.stats["max_batch"], B)
+        now = time.perf_counter()
+        for r in reqs:
+            self.profiler.queue_wait(now - r.t0)
         total = B * codec.get_data_chunk_count() * W
         if total < self.min_device_bytes:
             for r in reqs:
@@ -182,10 +197,15 @@ class EncodeService:
         # blocked event loop starves the next batching window (measured:
         # avg batch 1.1 with 8 concurrent writers before this).
         def _dispatch_and_fetch():
-            parity_dev, crcs_dev = codec.encode_device(
-                u32, with_crc=with_crc)
-            return (np.asarray(parity_dev),
-                    np.asarray(crcs_dev) if with_crc else None)
+            # the np.asarray fetches block until the device is done, so
+            # the measure block times real kernel wall time (the profiler
+            # counters are lock-protected; this runs on an executor thread)
+            bm, gm = profiler_mod.encode_cost(Bb, k, m, W)
+            with self.profiler.measure("encode", bm, gm):
+                parity_dev, crcs_dev = codec.encode_device(
+                    u32, with_crc=with_crc)
+                return (np.asarray(parity_dev),
+                        np.asarray(crcs_dev) if with_crc else None)
 
         parity, crcs = await loop.run_in_executor(None, _dispatch_and_fetch)
         self.stats["device_batches"] += 1

@@ -183,7 +183,12 @@ class TestPerfCounters:
         assert d["numpg"] == 33
         assert d["op_w_lat"] == {"avgcount": 2, "sum": 2.0}
         assert d["op_size"]["count"] == 1
-        assert "4096" in d["op_size"]["buckets"]
+        # buckets are keyed by inclusive upper bound (4096 -> le 8191)
+        # and the dump carries derived percentiles
+        assert d["op_size"]["buckets"] == {"8191": 1}
+        assert d["op_size"]["p50"] == 8191
+        assert d["op_size"]["p99"] == 8191
+        assert d["op_size"]["sum"] == 4096
 
     def test_timer_and_kind_guard(self):
         pc = self.build()

@@ -1,0 +1,324 @@
+"""End-to-end EC hot-path telemetry (PR: observability).
+
+Kernel profiling (ops/profiler.py) -> perf counters -> MMgrReport ->
+mgr prometheus module, plus slow-op surfacing and the frozen metric
+schema.  Reference: src/common/perf_counters.h:34 histograms consumed
+by `perf dump` / the prometheus exporter, and the SLOW_OPS health
+warning fed by OpTracker complaints.
+"""
+
+import asyncio
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.perf_counters import (PerfCountersBuilder,
+                                           PerfCountersCollection)
+from ceph_tpu.qa.cluster import MiniCluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+# ------------------------------------------------------------------ units
+
+def test_histogram_dump_shape_and_reset():
+    """Satellite: histogram dump is {buckets, sum, count, p50, p99}
+    (upper-bound-keyed buckets) and reset clears all of it."""
+    pc = (PerfCountersBuilder("t")
+          .add_histogram("lat", "test", "us")
+          .create_perf_counters())
+    for v in (0, 1, 5, 5, 100, 100, 100, 100, 100, 4000):
+        pc.hinc("lat", v)
+    d = pc.dump()["lat"]
+    assert d["count"] == 10
+    assert d["sum"] == 4511
+    # v=5 -> bucket 3 (le=7); v=100 -> bucket 7 (le=127)
+    assert d["buckets"]["7"] == 2
+    assert d["buckets"]["127"] == 5
+    assert d["p50"] == 127          # 5th/6th sample sit in the 100s
+    assert d["p99"] == 4095         # 4000 -> bucket 12 (le 2^12-1)
+    assert sum(d["buckets"].values()) == d["count"]
+    pc.reset()
+    d = pc.dump()["lat"]
+    assert d == {"count": 0, "sum": 0.0, "buckets": {},
+                 "p50": 0, "p99": 0}
+
+
+def test_histogram_collection_dump_and_reset():
+    coll = PerfCountersCollection()
+    pc = (PerfCountersBuilder("g")
+          .add_u64_counter("n", "")
+          .add_histogram("h", "", "us")
+          .create_perf_counters())
+    coll.add(pc)
+    pc.inc("n")
+    pc.hinc("h", 9)
+    hd = coll.histogram_dump()
+    assert set(hd) == {"g"} and set(hd["g"]) == {"h"}   # counters excluded
+    coll.reset()
+    assert coll.dump()["g"]["n"] == 0
+    assert coll.dump()["g"]["h"]["count"] == 0
+
+
+def test_perf_histogram_tool_percentiles_and_diff():
+    import perf_histogram as ph
+    before = {"g": {"h": {"count": 2, "sum": 8.0,
+                          "buckets": {"3": 1, "7": 1}}}}
+    after = {"g": {"h": {"count": 6, "sum": 500.0,
+                         "buckets": {"3": 1, "7": 1, "127": 4}}},
+             "g2": {"new": {"count": 1, "sum": 1.0,
+                            "buckets": {"1": 1}}}}
+    d = ph.diff_histograms(before, after)
+    assert d["g"]["h"]["count"] == 4
+    assert d["g"]["h"]["buckets"] == {"127": 4}      # only the interval
+    assert d["g"]["h"]["p50"] == 127
+    assert d["g2"]["new"]["count"] == 1              # restart-from-zero
+    table = ph.format_histograms(d)
+    assert "g.h" in table and "p99" in table
+    assert ph.quantile_from_buckets({}, 0, 0.99) == 0
+
+
+# ------------------------------------------- end-to-end kernel telemetry
+
+def _merged_kernel_dump(cluster) -> dict:
+    out: dict = {}
+    for osd in cluster.osds.values():
+        for name, val in osd.perf_coll.dump().get("kernel", {}).items():
+            if isinstance(val, dict) and "buckets" in val:
+                agg = out.setdefault(name, {"count": 0, "sum": 0.0})
+                agg["count"] += val["count"]
+                agg["sum"] += val["sum"]
+            elif isinstance(val, dict):
+                agg = out.setdefault(name, {"avgcount": 0, "sum": 0.0})
+                agg["avgcount"] += val["avgcount"]
+                agg["sum"] += val["sum"]
+            else:
+                out[name] = out.get(name, 0) + val
+    return out
+
+
+def test_kernel_histograms_populate_after_roundtrip(loop):
+    """Acceptance: one jax_rs k=3,m=2 write+read round-trip populates
+    encode/decode kernel latency histograms and roofline counters."""
+    async def go():
+        async with MiniCluster(n_osds=5) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                   "m": "2"}, pg_num=2, stripe_unit=512)
+            for osd in c.osds.values():
+                osd.encode_service.min_device_bytes = 0  # device path
+            client = await c.client()
+            io = client.io_ctx("p")
+            payload = bytes(np.arange(6144, dtype=np.uint8) % 251)
+            await io.write_full("obj", payload)
+            assert await io.read("obj") == payload
+
+            k = _merged_kernel_dump(c)
+            # latency histograms non-empty, with consistent buckets
+            assert k["kernel_encode_lat"]["count"] > 0
+            assert k["kernel_decode_lat"]["count"] > 0
+            assert k["kernel_crc32c_lat"]["count"] > 0
+            # roofline counters: bytes, GF multiplies, achieved GB/s
+            assert k["kernel_encode_bytes"] > 0
+            assert k["kernel_encode_gf_mults"] > 0
+            assert k["kernel_encode_gbs"]["avgcount"] > 0
+            assert k["kernel_encode_gbs"]["sum"] > 0
+            assert k["kernel_decode_bytes"] > 0
+            assert k["kernel_encode_queue_lat"]["count"] > 0
+            # write-pipeline stage histograms on the primary
+            stage = {}
+            for osd in c.osds.values():
+                for name, val in osd.perf_coll.dump()[
+                        f"osd.{osd.whoami}"].items():
+                    if isinstance(val, dict) and "buckets" in val:
+                        stage[name] = stage.get(name, 0) + val["count"]
+            assert stage["op_w_queue_lat"] > 0
+            assert stage["op_w_encode_lat"] > 0
+            assert stage["subop_w_rtt"] > 0
+            assert stage["op_w_commit_lat"] > 0
+    loop.run_until_complete(go())
+
+
+def test_stage_marks_on_historic_ops(loop):
+    """dump_historic_ops shows the per-op stage breakdown."""
+    async def go():
+        async with MiniCluster(n_osds=5) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                   "m": "2"}, pg_num=2, stripe_unit=512)
+            client = await c.client()
+            await client.io_ctx("p").write_full("o", b"z" * 3072)
+            events = set()
+            for osd in c.osds.values():
+                for op in osd.op_tracker.dump_historic()["ops"]:
+                    for ev in op["type_events"]:
+                        events.add(ev["event"])
+            for want in ("encode_start", "encoded", "subops_sent",
+                         "committed"):
+                assert want in events, (want, events)
+            assert any(e.startswith("sub_write_committed(")
+                       for e in events)
+    loop.run_until_complete(go())
+
+
+# ------------------------------------------------------- prometheus export
+
+async def _http_get(port: int, path: str = "/metrics") -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    return data.partition(b"\r\n\r\n")[2].decode()   # body only
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$|^#')
+
+
+def _parse_series(body: str) -> dict:
+    """{metric{labels}: float} for every sample line; asserts every
+    line is well-formed exposition text."""
+    out = {}
+    for line in body.strip().splitlines():
+        assert _SAMPLE_RE.match(line), f"malformed line: {line!r}"
+        if line.startswith("#"):
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
+def test_prometheus_histogram_series_and_slow_ops(loop):
+    """Exporter serves cumulative _bucket/_sum/_count histogram series
+    and the SLOW_OPS pipeline fires end to end with a tiny
+    osd_op_complaint_time."""
+    async def go():
+        cfg = Config()
+        cfg.set("mgr_stats_period", 0.1)
+        cfg.set("mgr_prometheus_port", 0)
+        cfg.set("osd_op_complaint_time", 0.05)
+        async with MiniCluster(n_osds=5, config=cfg, mgr=True) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "3",
+                                   "m": "2"}, pg_num=2, stripe_unit=512)
+            client = await c.client()
+            io = client.io_ctx("p")
+            payload = bytes(3072)
+            await io.write_full("obj", payload)
+            assert await io.read("obj") == payload
+            # a wedged op: in flight longer than the complaint time
+            stuck = c.osds[0].op_tracker.create("test stuck op")
+            await asyncio.sleep(0.3)    # > complaint time + a report
+
+            body = await _http_get(c.mgr.prometheus_port())
+            series = _parse_series(body)
+
+            # cumulative histogram triplet for the encode kernel
+            buckets = {n: v for n, v in series.items()
+                       if n.startswith("ceph_kernel_encode_lat_bucket")}
+            assert buckets, body
+            by_daemon: dict = {}
+            for n, v in buckets.items():
+                daemon = re.search(r'ceph_daemon="([^"]+)"', n).group(1)
+                le = re.search(r'le="([^"]+)"', n).group(1)
+                by_daemon.setdefault(daemon, []).append(
+                    (float("inf") if le == "+Inf" else float(le), v))
+            populated = 0
+            for daemon, pts in by_daemon.items():
+                pts.sort()
+                counts = [v for _le, v in pts]
+                assert counts == sorted(counts), f"non-cumulative {daemon}"
+                assert pts[-1][0] == float("inf")
+                total = series[f'ceph_kernel_encode_lat_count'
+                               f'{{ceph_daemon="{daemon}"}}']
+                assert pts[-1][1] == total
+                assert f'ceph_kernel_encode_lat_sum' \
+                       f'{{ceph_daemon="{daemon}"}}' in series
+                populated += total > 0
+            assert populated >= 1        # the primary really encoded
+            # stage histogram rides the same pipeline
+            assert any(n.startswith("ceph_op_w_commit_lat_bucket")
+                       for n in series)
+
+            # SLOW_OPS: prometheus gauge, status module, dashboard
+            assert sum(v for n, v in series.items()
+                       if n.startswith("ceph_slow_ops{")) >= 1
+            st = c.mgr.modules["status"].status()
+            assert st["slow_ops"]["count"] >= 1
+            assert st["slow_ops"]["oldest_age"] > 0
+            assert "slow ops, oldest age" in st["slow_ops"]["message"]
+            assert "osd.0" in st["slow_ops"]["daemons"]
+            snap = c.mgr.modules["dashboard"].snapshot()
+            assert snap["health"] == "HEALTH_WARN", snap
+            assert any(ch["check"] == "SLOW_OPS"
+                       for ch in snap["checks"])
+            stuck.finish()
+            assert c.osds[0].op_tracker.slow_ops_total >= 1
+    loop.run_until_complete(go())
+
+
+# ------------------------------------------------------- schema stability
+
+# Frozen observability surface: every series here is load-bearing for
+# the shipped dashboards/alerts (monitoring/).  A PR that renames or
+# drops one must update monitoring/ AND this list — never silently.
+REQUIRED_PERF_COUNTERS = {
+    "osd": {"op", "op_w", "op_r", "subop_w", "subop_r", "op_latency",
+            "op_w_queue_lat", "op_w_encode_lat", "subop_w_rtt",
+            "op_w_commit_lat"},
+    "kernel": {"kernel_encode_lat", "kernel_decode_lat",
+               "kernel_crc32c_lat", "kernel_encode_launches",
+               "kernel_decode_launches", "kernel_crc32c_launches",
+               "kernel_encode_bytes", "kernel_decode_bytes",
+               "kernel_crc32c_bytes", "kernel_encode_gf_mults",
+               "kernel_decode_gf_mults", "kernel_crc32c_gf_mults",
+               "kernel_encode_gbs", "kernel_decode_gbs",
+               "kernel_crc32c_gbs", "kernel_encode_queue_lat"},
+}
+
+REQUIRED_PROM_SERIES = {
+    "ceph_daemon_up", "ceph_slow_ops", "ceph_slow_ops_total",
+    "ceph_op", "ceph_op_w", "ceph_op_r",
+    "ceph_op_latency_sum", "ceph_op_latency_count",
+    "ceph_kernel_encode_lat_bucket", "ceph_kernel_encode_lat_sum",
+    "ceph_kernel_encode_lat_count",
+    "ceph_kernel_decode_lat_bucket",
+    "ceph_kernel_encode_bytes", "ceph_kernel_encode_gf_mults",
+    "ceph_kernel_encode_gbs_sum", "ceph_kernel_encode_gbs_count",
+    "ceph_op_w_queue_lat_bucket", "ceph_op_w_encode_lat_bucket",
+    "ceph_subop_w_rtt_bucket", "ceph_op_w_commit_lat_bucket",
+}
+
+
+def test_metric_schema_frozen(loop):
+    async def go():
+        cfg = Config()
+        cfg.set("mgr_stats_period", 0.1)
+        cfg.set("mgr_prometheus_port", 0)
+        async with MiniCluster(n_osds=3, config=cfg, mgr=True) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=2, stripe_unit=512)
+            osd = c.osds[0]
+            dump = osd.perf_coll.dump()
+            for group, names in REQUIRED_PERF_COUNTERS.items():
+                gname = f"osd.{osd.whoami}" if group == "osd" else group
+                missing = names - set(dump.get(gname, {}))
+                assert not missing, f"perf dump dropped {missing}"
+            await asyncio.sleep(0.25)   # let every osd report
+            body = await _http_get(c.mgr.prometheus_port())
+            series = _parse_series(body)
+            names = {n.split("{", 1)[0] for n in series}
+            missing = REQUIRED_PROM_SERIES - names
+            assert not missing, f"prometheus endpoint dropped {missing}"
+    loop.run_until_complete(go())

@@ -30,9 +30,32 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import perf_histogram  # noqa: E402 (tools/perf_histogram.py)
 
 from ceph_tpu.common.config import Config  # noqa: E402
 from ceph_tpu.qa.cluster import MiniCluster  # noqa: E402
+
+
+def _merged_histograms(osds) -> dict:
+    """Merge every daemon's histogram counters (buckets/sum/count add)
+    so the percentiles reflect the whole cluster's op population."""
+    merged: dict = {}
+    for osd in osds:
+        for group, counters in osd.perf_coll.histogram_dump().items():
+            # per-daemon groups ("osd.0") fold into one logical group
+            gkey = "osd" if group.startswith("osd.") else group
+            mg = merged.setdefault(gkey, {})
+            for cname, h in counters.items():
+                agg = mg.setdefault(cname, {"count": 0, "sum": 0.0,
+                                            "buckets": {}})
+                agg["count"] += int(h.get("count", 0))
+                agg["sum"] += float(h.get("sum", 0.0))
+                for ub, n in h.get("buckets", {}).items():
+                    agg["buckets"][ub] = \
+                        agg["buckets"].get(ub, 0) + int(n)
+    return merged
 
 
 async def run(args) -> dict:
@@ -60,6 +83,8 @@ async def run(args) -> dict:
         for osd in c.osds.values():
             for key in osd.encode_service.stats:
                 osd.encode_service.stats[key] = 0
+            # warmup ops must not pollute the latency percentiles
+            osd.perf_coll.reset()
 
         stop = time.monotonic() + args.seconds
         totals = {"ops": 0, "bytes": 0}
@@ -88,6 +113,16 @@ async def run(args) -> dict:
         avg_batch = (agg.get("device_requests", 0)
                      / agg["device_batches"]
                      if agg.get("device_batches") else 0.0)
+        # latency percentiles from the run's perf histograms (stage +
+        # kernel), merged across daemons
+        hists = _merged_histograms(c.osds.values())
+        pcts = {f"{group}.{cname}": {
+                    **perf_histogram.percentiles(h),
+                    "count": h["count"], "unit": "us"}
+                for group, counters in sorted(hists.items())
+                for cname, h in sorted(counters.items())
+                if h.get("count")}
+        print(perf_histogram.format_histograms(hists), file=sys.stderr)
         return {
             "metric": "osd_write_path",
             "seconds": round(elapsed, 3),
@@ -97,6 +132,7 @@ async def run(args) -> dict:
                 totals["bytes"] / elapsed / 2**30, 3),
             "encode_service": {**agg,
                                "avg_device_batch": round(avg_batch, 2)},
+            "latency_percentiles": pcts,
         }
 
 

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""perf_histogram — dump and diff perf histograms from a live daemon.
+
+The 'ceph daemon <id> perf histogram dump' equivalent: connects to a
+daemon's admin socket, fetches the histogram counters ({buckets, sum,
+count, p50, p99} per counter, log2 microsecond buckets), and prints a
+table.  ``diff`` mode takes two snapshots (either two JSON files, or
+one socket polled twice --seconds apart) and reports the percentiles of
+only the interval's samples — the way you bracket a benchmark run.
+
+Usage:
+  python tools/perf_histogram.py dump /run/osd.0.asok
+  python tools/perf_histogram.py diff /run/osd.0.asok --seconds 10
+  python tools/perf_histogram.py diff before.json after.json
+  python tools/perf_histogram.py dump /run/osd.0.asok --json
+
+The percentile helpers are imported by tools/osd_bench.py to print
+latency percentiles from in-process counter dumps after a run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def histogram_dump(sock_path: str) -> dict:
+    """{group: {counter: hist}} from a daemon's admin socket."""
+    from ceph_tpu.common.admin_socket import admin_command
+    return admin_command(sock_path, "perf histogram dump")
+
+
+def quantile_from_buckets(buckets: "dict[str, int]", count: int,
+                          q: float) -> int:
+    """Quantile from an upper-bound-keyed bucket dict (the `perf dump`
+    histogram shape).  Thin adapter over the daemon-side estimator
+    (common/perf_counters.hist_quantile) so the two can never drift."""
+    from ceph_tpu.common.perf_counters import hist_quantile
+    if not count:
+        return 0
+    arr = [0] * 64
+    for ub, n in buckets.items():
+        # invert hist_bucket_bound: upper bound 2^i - 1 -> bucket i
+        arr[min((int(ub) + 1).bit_length() - 1, 63)] += int(n)
+    return hist_quantile(arr, count, q)
+
+
+def percentiles(hist: dict, qs=(0.5, 0.9, 0.99)) -> "dict[str, int]":
+    """{'p50': ..., ...} for one histogram counter dict."""
+    return {f"p{int(q * 100)}": quantile_from_buckets(
+        hist.get("buckets", {}), int(hist.get("count", 0)), q)
+        for q in qs}
+
+
+def diff_histograms(before: dict, after: dict) -> dict:
+    """Per-counter delta of two {group: {counter: hist}} dumps: bucket
+    counts, sum, and count subtract; percentiles recomputed over the
+    interval's samples only.  Counters absent from ``before`` count
+    from zero (a daemon restarted mid-interval)."""
+    out: dict = {}
+    for group, counters in after.items():
+        bg = before.get(group, {})
+        for cname, h in counters.items():
+            b = bg.get(cname, {})
+            bb = b.get("buckets", {})
+            buckets = {}
+            for ub, n in h.get("buckets", {}).items():
+                d = int(n) - int(bb.get(ub, 0))
+                if d > 0:
+                    buckets[ub] = d
+            count = int(h.get("count", 0)) - int(b.get("count", 0))
+            if count <= 0:
+                continue
+            entry = {"count": count,
+                     "sum": h.get("sum", 0.0) - b.get("sum", 0.0),
+                     "buckets": buckets}
+            entry.update(percentiles(entry))
+            out.setdefault(group, {})[cname] = entry
+    return out
+
+
+def format_histograms(dump: dict) -> str:
+    """Fixed-width table: one row per counter with count/mean/p50/p99."""
+    rows = [("counter", "count", "mean", "p50", "p90", "p99")]
+    for group in sorted(dump):
+        for cname in sorted(dump[group]):
+            h = dump[group][cname]
+            count = int(h.get("count", 0))
+            mean = (h.get("sum", 0.0) / count) if count else 0.0
+            ps = percentiles(h)
+            rows.append((f"{group}.{cname}", str(count),
+                         f"{mean:.1f}", str(ps["p50"]),
+                         str(ps["p90"]), str(ps["p99"])))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(col.ljust(w) for col, w in zip(row, widths))
+        for row in rows)
+
+
+def _load(src: str) -> dict:
+    """A JSON file path or an admin-socket path."""
+    if os.path.isfile(src) and not _is_socket(src):
+        with open(src) as f:
+            return json.load(f)
+    return histogram_dump(src)
+
+
+def _is_socket(path: str) -> bool:
+    import stat
+    try:
+        return stat.S_ISSOCK(os.stat(path).st_mode)
+    except OSError:
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("mode", choices=("dump", "diff"))
+    p.add_argument("sources", nargs="+",
+                   help="admin socket path (dump/diff --seconds) or "
+                        "two JSON snapshot files (diff)")
+    p.add_argument("--seconds", type=float, default=0.0,
+                   help="diff: poll one socket twice this far apart")
+    p.add_argument("--json", action="store_true",
+                   help="emit raw JSON instead of the table")
+    args = p.parse_args(argv)
+
+    if args.mode == "dump":
+        out = _load(args.sources[0])
+    elif len(args.sources) >= 2:
+        out = diff_histograms(_load(args.sources[0]),
+                              _load(args.sources[1]))
+    else:
+        before = histogram_dump(args.sources[0])
+        time.sleep(max(args.seconds, 0.1))
+        out = diff_histograms(before, histogram_dump(args.sources[0]))
+    print(json.dumps(out, indent=1) if args.json
+          else format_histograms(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
