@@ -6,11 +6,23 @@ acting primary), sends over the messenger (``_send_op`` :716), and
 resends on map changes or connection resets.  The client never asks a
 server where data lives — placement is pure computation on the OSDMap,
 the defining RADOS trait.
+
+Flow control: the OSD answers ops it cannot serve right now (peering,
+mid-split, queue past its high-watermark) with MOSDBackoff instead of
+letting them ride out the op timeout (reference
+doc/dev/osd_internals/backoff.rst).  Live backoffs are tracked per
+(pool, pg); ops targeting a blocked PG park behind an asyncio.Event
+released by the matching unblock, a new osdmap epoch, or a connection
+reset — so resend is event-driven, and a blocked op never burns retry
+attempts.  Plain retries (resets, ESTALE, no primary) use capped
+exponential backoff with jitter, woken early by map changes.
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common.log import dout
@@ -27,6 +39,23 @@ class ObjecterError(Exception):
     def __init__(self, msg: str, errno: int = 0) -> None:
         super().__init__(msg)
         self.errno = errno
+
+
+class _Backoff:
+    """One live OSD backoff on a (pool, pg) (reference Backoff.h).
+    Parked ops await ``event``; it fires on unblock, new map epoch, or
+    session reset — never on a timer alone."""
+
+    __slots__ = ("id", "pgid", "reason", "conn", "event", "since")
+
+    def __init__(self, bid: int, pgid: "Tuple[int, int]", reason: str,
+                 conn) -> None:
+        self.id = bid
+        self.pgid = pgid
+        self.reason = reason
+        self.conn = conn
+        self.event = asyncio.Event()
+        self.since = time.monotonic()
 
 
 class Objecter(Dispatcher):
@@ -46,9 +75,18 @@ class Objecter(Dispatcher):
         self.osdmap = osdmap
         self.max_retries = max_retries
         self.backoff = backoff
+        self.backoff_max = float(ms.conf("objecter_retry_backoff_max"))
         self.ms.add_dispatcher(self)
         self._next_tid = 0
         self._inflight: "Dict[int, asyncio.Future]" = {}
+        # live OSD backoffs: (pool, pg) -> _Backoff; ops targeting a
+        # blocked PG park instead of sending
+        self.backoffs: "Dict[Tuple[int, int], _Backoff]" = {}
+        # pulsed on every new osdmap epoch: wakes jitter-sleepers and
+        # (via on_map_change) releases every parked op
+        self._map_event = asyncio.Event()
+        self.stats = {"backoffs_received": 0, "unblocks_received": 0,
+                      "backoff_parks": 0, "map_wakeups": 0}
         # (pool_id, oid, watch_id) -> callback(oid, payload)
         self.watch_callbacks: "Dict[tuple, Any]" = {}
         # cephx: service ticket attached to every op; ``ticket_renewer``
@@ -78,6 +116,92 @@ class Objecter(Dispatcher):
         primary = next((o for o in acting if o != NONE_OSD), NONE_OSD)
         return pool_id, pg, primary
 
+    # --- retry pacing / backoff parking --------------------------------------
+
+    def backoff_delay(self, attempt: int) -> float:
+        """Capped exponential backoff with jitter: uniform over the
+        UPPER HALF of min(cap, base * 2^n) ("equal jitter").  Jitter
+        desynchronizes the retry herd so clients don't re-arrive in
+        lockstep and re-overload the OSD they are waiting out; the
+        half-bound floor matters just as much — a zero-delay roll would
+        burn retry attempts faster than the mon can mark a dead primary
+        down and publish the map the retry needs (the map event wakes
+        waiters early anyway, so the floor costs nothing in mon mode)."""
+        bound = min(self.backoff_max, self.backoff * (2 ** attempt))
+        return random.uniform(bound / 2, bound)
+
+    async def _resend_wait(self, attempt: int,
+                           seen_epoch: "Optional[int]" = None) -> None:
+        """Pace a retry, but wake EARLY on a new osdmap epoch — a map
+        change is exactly the event a stale-target/down-primary retry
+        is waiting for, so sleeping through it wastes the whole delay.
+        ``seen_epoch`` is the epoch the failed attempt targeted: if the
+        map already moved past it while the failure was propagating,
+        the awaited event has ALREADY happened — re-target now instead
+        of clearing the shared event and sleeping through it."""
+        if seen_epoch is not None and self.osdmap.epoch > seen_epoch:
+            await asyncio.sleep(0)
+            return
+        delay = self.backoff_delay(attempt)
+        self._map_event.clear()
+        try:
+            await asyncio.wait_for(self._map_event.wait(),
+                                   max(delay, 0.001))
+        except asyncio.TimeoutError:
+            pass
+
+    async def _park(self, rec: _Backoff) -> float:
+        """Park behind a live backoff until unblock / map change /
+        reset; a stale record (peer died without either) falls back to
+        the op timeout and is dropped so the op re-probes.  Returns
+        seconds parked."""
+        t0 = time.monotonic()
+        self.stats["backoff_parks"] += 1
+        try:
+            await asyncio.wait_for(rec.event.wait(), self.op_timeout)
+        except asyncio.TimeoutError:
+            if self.backoffs.get(rec.pgid) is rec:
+                self.backoffs.pop(rec.pgid, None)
+            # wake every OTHER op parked on this record too: once the
+            # record is gone, a later unblock can't release them, and
+            # each would otherwise stall out its own full op_timeout
+            rec.event.set()
+            dout("client", 1, f"backoff on pg {rec.pgid} never "
+                              f"unblocked; dropping and re-probing")
+        return time.monotonic() - t0
+
+    def on_map_change(self, _osdmap: "Optional[OSDMap]" = None) -> None:
+        """New epoch: release every parked op and wake retry sleepers
+        (reference: a map change triggers _scan_requests + resend).
+        Backoffs die here — if the OSD is still blocked it re-asserts
+        on the resend, and a moved PG resends to its new primary."""
+        self.stats["map_wakeups"] += 1
+        self._map_event.set()
+        for key, rec in list(self.backoffs.items()):
+            rec.event.set()
+            self.backoffs.pop(key, None)
+
+    def ms_handle_reset(self, conn) -> None:
+        """A dropped session clears its backoffs (reference
+        Session::clear_backoffs): the unblock will never arrive on a
+        dead connection, and the op should re-probe the (possibly new)
+        primary instead."""
+        for key, rec in list(self.backoffs.items()):
+            if rec.conn is conn:
+                rec.event.set()
+                self.backoffs.pop(key, None)
+
+    def dump_backoffs(self) -> dict:
+        """Admin surface ('dump_backoffs', both client and OSD sockets):
+        live blocks plus lifetime protocol counters."""
+        now = time.monotonic()
+        return {
+            "backoffs": [{"pgid": list(k), "id": rec.id,
+                          "reason": rec.reason,
+                          "age": round(now - rec.since, 3)}
+                         for k, rec in sorted(self.backoffs.items())],
+            **self.stats}
+
     # --- submit (reference op_submit Objecter.cc:2256) -----------------------
 
     async def op_submit(self, pool_id: int, oid: str, ops: "List[dict]",
@@ -98,7 +222,14 @@ class Objecter(Dispatcher):
         tid = self.new_tid()
         reqid = f"{self.ms.name}:{tid}"
         renewed = False
-        for attempt in range(self.max_retries):
+        attempt = 0
+        # backoff parks never consume attempts (a block/unblock cycle is
+        # the OSD doing flow control, not failing the op) but total park
+        # time is still bounded, so a wedged peer can't pin an op forever
+        park_budget = self.op_timeout * self.max_retries
+        parked = 0.0
+        while attempt < self.max_retries:
+            epoch0 = self.osdmap.epoch      # the map this attempt targets
             if pg is not None:
                 tgt_pool, tgt_pg = pool_id, pg
                 _up, acting = self.osdmap.pg_to_up_acting_osds(
@@ -109,8 +240,17 @@ class Objecter(Dispatcher):
             if primary == NONE_OSD:
                 last_err = ObjecterError(
                     f"pg {tgt_pool}.{tgt_pg} has no primary")
-                await asyncio.sleep(self.backoff * (attempt + 1))
+                attempt += 1
+                await self._resend_wait(attempt, seen_epoch=epoch0)
                 continue
+            rec = self.backoffs.get((tgt_pool, tgt_pg))
+            if rec is not None:
+                parked += await self._park(rec)
+                if parked > park_budget:
+                    raise ObjecterError(
+                        f"op on {oid} blocked by osd backoff "
+                        f"({rec.reason}) for {parked:.1f}s")
+                continue        # re-target: the map may have moved it
             fut = asyncio.get_event_loop().create_future()
             self._inflight[tid] = fut
             fields = {"tid": tid, "pool": tgt_pool, "pg": tgt_pg,
@@ -131,16 +271,37 @@ class Objecter(Dispatcher):
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_err = e
                 self._inflight.pop(tid, None)
-                await asyncio.sleep(self.backoff * (attempt + 1))
+                attempt += 1
+                await self._resend_wait(attempt, seen_epoch=epoch0)
                 continue
             finally:
                 self._inflight.pop(tid, None)
+            if reply.TYPE == "osd_backoff":
+                # blocked, not failed: park behind the registered
+                # backoff HERE, charging the park budget — if the
+                # unblock already raced ahead and popped the record,
+                # pace the resend like a plain retry instead, so a
+                # flapping queue (block/unblock per op) can never spin
+                # this loop at zero cost and past the old retry bound
+                rec = self.backoffs.get((tgt_pool, tgt_pg))
+                t0 = time.monotonic()
+                if rec is not None:
+                    parked += await self._park(rec)
+                else:
+                    await self._resend_wait(0)
+                    parked += time.monotonic() - t0
+                if parked > park_budget:
+                    raise ObjecterError(
+                        f"op on {oid} blocked by osd backoff for "
+                        f"{parked:.1f}s")
+                continue
             outs = list(reply.get("outs", []))
             result = int(reply.get("result", 0))
             if result == -ESTALE:  # wrong primary / PG peering
                 last_err = ObjecterError(
                     f"stale target for {oid}: {outs}")
-                await asyncio.sleep(self.backoff * (attempt + 1))
+                attempt += 1
+                await self._resend_wait(attempt, seen_epoch=epoch0)
                 continue
             if result != 0:
                 errs = [o.get("error") for o in outs if "error" in o]
@@ -162,6 +323,27 @@ class Objecter(Dispatcher):
             f"op on {oid} failed after {self.max_retries} tries: {last_err}")
 
     async def ms_dispatch(self, conn, msg) -> bool:
+        if msg.TYPE == "osd_backoff":
+            key = (int(msg["pgid"][0]), int(msg["pgid"][1]))
+            if str(msg["op"]) == "block":
+                self.stats["backoffs_received"] += 1
+                rec = self.backoffs.get(key)
+                if rec is None:
+                    rec = _Backoff(int(msg["id"]), key,
+                                   str(msg.get("reason", "")), conn)
+                    self.backoffs[key] = rec
+                # wake the blocked op's wait NOW (the block rides the
+                # reply path carrying the op's tid) so it parks on the
+                # event instead of riding out the full op timeout
+                fut = self._inflight.get(int(msg.get("tid", 0)))
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+            else:
+                self.stats["unblocks_received"] += 1
+                rec = self.backoffs.pop(key, None)
+                if rec is not None:
+                    rec.event.set()
+            return True
         if msg.TYPE == "watch_notify":
             # deliver to the registered callback, then ack so the
             # notifier's collect completes (reference Objecter watch

@@ -27,12 +27,39 @@ class RadosClient:
         from ..mon.client import attach_monc
         self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
         self.objecter = Objecter(self.ms, self.osdmap)
+        self.admin_socket = None
+        if self.monc is not None:
+            # every new epoch wakes the objecter's parked/sleeping ops:
+            # resend is map-driven, not timer-driven
+            self.monc.map_callbacks.append(self.objecter.on_map_change)
 
     async def connect(self, addr: str = "") -> None:
         await self.ms.bind(addr or f"client:{id(self) & 0xFFFF}")
         if self.monc is not None:
             await self.monc.subscribe_osdmap()
             await self.monc.wait_for_map()
+        self._start_admin_socket()
+
+    def _start_admin_socket(self) -> None:
+        """Client-side admin socket (reference: librados registers its
+        Objecter dumps on the client admin socket) — the peer of the
+        OSD's 'dump_backoffs', so a block can be observed from BOTH
+        ends of the protocol."""
+        path = str(self.ms.conf("admin_socket"))
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+        a = AdminSocket(path.replace("$name", self.ms.name))
+        a.register("dump_backoffs",
+                   lambda _c: self.objecter.dump_backoffs(),
+                   "live osd backoffs this client honors, plus "
+                   "block/unblock counters")
+        a.register("status",
+                   lambda _c: {"name": self.ms.name,
+                               "epoch": self.osdmap.epoch},
+                   "client status")
+        a.start()
+        self.admin_socket = a
 
     async def mon_command(self, cmd: dict) -> dict:
         if self.monc is None:
@@ -65,6 +92,8 @@ class RadosClient:
         self.objecter.ticket_renewer = renewer
 
     async def shutdown(self) -> None:
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
         await self.ms.shutdown()
 
     def io_ctx(self, pool_name: str) -> "IoCtx":

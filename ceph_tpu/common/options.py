@@ -137,10 +137,36 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="seconds to wait for a shard's scrub map",
            services=("osd",)),
     Option("osd_ec_sub_read_timeout", float, 5.0, LEVEL_ADVANCED, min=0.1,
-           desc="seconds before a silent shard read is treated as EIO "
-                "and the read re-plans around it (a dropped reply must "
-                "never hang a ReadOp forever)",
+           desc="HARD per-shard window: seconds before a silent shard "
+                "read is treated as EIO even when no redundancy is "
+                "left to decode around it (a dropped reply must never "
+                "hang a ReadOp forever).  NOT the early-fallback knob "
+                "— that is osd_ec_subread_timeout (one underscore "
+                "apart; check which one you mean)",
+           see_also=("osd_ec_subread_timeout",), services=("osd",)),
+    Option("osd_ec_subread_timeout", float, 1.0, LEVEL_ADVANCED, min=0.05,
+           desc="per-shard silence threshold for the EC read watchdog: "
+                "a shard quiet this long triggers fallback decode (EIO "
+                "+ re-plan) well before the client-visible op deadline; "
+                "the effective threshold is min(this, "
+                "osd_ec_sub_read_timeout)",
+           see_also=("osd_ec_sub_read_timeout", "rados_osd_op_timeout"),
            services=("osd",)),
+    # --- backoff protocol (reference doc/dev/osd_internals/backoff.rst)
+    Option("osd_backoff_enabled", bool, True, LEVEL_ADVANCED,
+           desc="send MOSDBackoff block/unblock to clients when a PG is "
+                "peering, mid-split, or the op queue is past its "
+                "high-watermark, instead of parking ops server-side or "
+                "bouncing them with ESTALE", services=("osd",)),
+    Option("osd_backoff_queue_high", int, 256, LEVEL_ADVANCED, min=0,
+           desc="admitted-client-op high-watermark: arrivals past it "
+                "are shed via backoff instead of queueing toward "
+                "timeout (0 = no queue backoffs)",
+           see_also=("osd_backoff_queue_low",), services=("osd",)),
+    Option("osd_backoff_queue_low", int, 128, LEVEL_ADVANCED, min=0,
+           desc="admitted-client-op low-watermark: queue backoffs "
+                "unblock once in-flight ops drain to this",
+           see_also=("osd_backoff_queue_high",), services=("osd",)),
     Option("osd_min_pg_log_entries", int, 250, LEVEL_ADVANCED, min=1,
            desc="pg log entries kept below which no trim happens",
            services=("osd",)),
@@ -239,8 +265,17 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="client op retry attempts across map changes",
            services=("client",)),
     Option("objecter_retry_backoff", float, 0.05, LEVEL_ADVANCED,
-           min=0.001, desc="base client retry backoff (s), scales "
-                           "linearly per attempt", services=("client",)),
+           min=0.001, desc="base client retry backoff (s); each retry "
+                           "sleeps uniform over the upper half of "
+                           "min(cap, base * 2^attempt) — capped "
+                           "exponential with (equal) jitter",
+           see_also=("objecter_retry_backoff_max",),
+           services=("client",)),
+    Option("objecter_retry_backoff_max", float, 1.0, LEVEL_ADVANCED,
+           min=0.001, desc="cap on the jittered client retry backoff "
+                           "(s); a new osdmap epoch wakes waiters "
+                           "early, so resend is event-driven, not "
+                           "timer-bound", services=("client",)),
     Option("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED, min=1,
            desc="max concurrent client ops", services=("client",)),
     Option("client_striper_stripe_unit", int, 64 << 10, LEVEL_ADVANCED,

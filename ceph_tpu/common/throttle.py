@@ -64,8 +64,11 @@ class Throttle:
             return True
 
     def put(self, count: int) -> None:
-        if self._max <= 0:
-            return
+        # decrement UNCONDITIONALLY (reference Throttle::put): a caller
+        # that took a count while max was positive must be able to
+        # return it after a runtime reset_max(0), or the strand leaks
+        # phantom occupancy into the next reset_max(>0).  Callers that
+        # were admitted uncounted (max<=0) are clamped at zero.
         with self._cond:
             self._cur = max(0, self._cur - count)
             self._cond.notify_all()

@@ -124,6 +124,12 @@ class HttpModule(MgrModule):
 # folds into +Inf, keeping the le set identical across daemons
 _CANON_BUCKETS = 41
 
+# scalar perf values that go DOWN as well as up: the perf dump flattens
+# u64 gauges and u64 counters to the same plain number, so the exporter
+# needs the distinction here — typing a shrinking series as 'counter'
+# makes every decrease read as a counter reset to rate()/increase()
+_GAUGE_SERIES = frozenset(("ceph_osd_backoffs_active",))
+
 
 class PrometheusModule(HttpModule):
     """Text-format exporter (reference src/pybind/mgr/prometheus)."""
@@ -209,7 +215,9 @@ class PrometheusModule(HttpModule):
                     else:
                         if metric not in seen:
                             seen.add(metric)
-                            lines.append(f"# TYPE {metric} counter")
+                            kind = ("gauge" if metric in _GAUGE_SERIES
+                                    else "counter")
+                            lines.append(f"# TYPE {metric} {kind}")
                         lines.append(f'{metric}{{{label}}} {val}')
         return "\n".join(lines) + "\n"
 

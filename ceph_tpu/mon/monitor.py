@@ -84,7 +84,12 @@ class MonDaemon(Dispatcher):
         # per-osd slow-op summary carried on beacons (feeds the
         # SLOW_OPS health check): osd -> {count, total, oldest_age}
         self.osd_slow_ops: "Dict[int, dict]" = {}
-        self.failure_reports: "Dict[int, Set[int]]" = {}
+        # failed osd -> reporter -> monotonic stamp of its NEWEST
+        # report; stamps age out past osd_heartbeat_grace so a reporter
+        # from hours ago can't still count toward
+        # mon_osd_min_down_reporters (reference OSDMonitor::
+        # check_failure report expiry via failure_info_t)
+        self.failure_reports: "Dict[int, Dict[int, float]]" = {}
         self._tick_task: "Optional[asyncio.Task]" = None
         from ..common.lockdep import DepLock
         self._cmd_lock = DepLock("mon.command")
@@ -375,8 +380,17 @@ class MonDaemon(Dispatcher):
         # carry the reporter's up_from epoch and stale ones are dropped)
         if not self.osdmap.is_up(int(msg["reporter"])):
             return
-        reporters = self.failure_reports.setdefault(failed, set())
-        reporters.add(int(msg["reporter"]))
+        reporters = self.failure_reports.setdefault(failed, {})
+        now = time.monotonic()
+        # age out stale reports FIRST: a reporter whose complaint is
+        # older than the heartbeat grace would have re-reported by now
+        # if the target were still unreachable — counting it alongside
+        # fresh reports lets two ancient reports plus one new one
+        # spuriously down an OSD (reference check_failure expiry)
+        grace = float(self.config.get("osd_heartbeat_grace"))
+        for r in [r for r, ts in reporters.items() if now - ts > grace]:
+            del reporters[r]
+        reporters[int(msg["reporter"])] = now
         need = int(self.config.get("mon_osd_min_down_reporters"))
         if len(reporters) >= need:
             self.failure_reports.pop(failed, None)

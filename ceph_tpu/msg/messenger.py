@@ -714,6 +714,13 @@ class Messenger:
             conn._abort()
             if conn in self._accepted:
                 self._accepted.remove(conn)
+            # server-side session teardown notifies dispatchers like
+            # the client side does (reference ms_handle_reset fires for
+            # accepted sessions too): the OSD uses this to drop per-
+            # session state — e.g. backoff records whose unblock could
+            # never be delivered — for clients that died mid-block
+            for d in self.dispatchers:
+                d.ms_handle_reset(conn)
 
     # --- dispatch ----------------------------------------------------------------
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,10 +39,12 @@ from .replicated import ReplicateCodec
 from ..common.tracked_op import OpTracker
 from .scheduler import CLIENT, MClockScheduler
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
-                       MECSubOpWriteReply, MOSDOp, MOSDOpReply, MOSDPGPush,
-                       MOSDPGPushReply, MOSDPing, MOSDPingReply,
-                       MWatchNotify, pack_buffers, unpack_buffers)
+                       MECSubOpWriteReply, MOSDBackoff, MOSDOp,
+                       MOSDOpReply, MOSDPGPush, MOSDPGPushReply, MOSDPing,
+                       MOSDPingReply, MWatchNotify, pack_buffers,
+                       unpack_buffers)
 from .osdmap import OSDMap
+from ..common.throttle import Throttle
 
 
 def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
@@ -55,6 +58,16 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("tier_promote", "cache-tier promotions")
           .add_u64_counter("tier_flush", "cache-tier flushes to base")
           .add_u64_counter("tier_evict", "cache-tier evictions")
+          # RADOS backoff protocol (reference l_osd_backoffs +
+          # doc/dev/osd_internals/backoff.rst): the gauge is the live
+          # block count (nonzero = this OSD is actively shedding load),
+          # the counters are lifetime block/unblock sends
+          .add_u64("osd_backoffs_active",
+                   "backoffs currently blocking client sessions")
+          .add_u64_counter("osd_backoffs_sent",
+                           "backoff blocks sent to clients")
+          .add_u64_counter("osd_backoff_unblocks_sent",
+                           "backoff unblocks sent to clients")
           .add_time_avg("op_latency", "client op latency")
           # write-pipeline stage histograms (µs, log2 buckets): the
           # per-op breakdown dump_historic_ops shows, aggregated
@@ -155,6 +168,18 @@ class OSDDaemon(Dispatcher):
         self._splitting_old: "Dict[int, int]" = {}
         self._split_pending: "Dict[int, int]" = {}
         self._inflight_client_ops = 0
+        # client-op admission control (reference backoff.rst + the op
+        # queue throttles): arrivals past the high-watermark are shed
+        # via MOSDBackoff instead of queueing toward the op timeout;
+        # the throttle count is released per completed op and queue
+        # backoffs unblock once it drains to the low-watermark
+        self.op_throttle = Throttle(
+            f"osd.{osd_id}:client_ops",
+            int(self.config.get("osd_backoff_queue_high")))
+        # live backoffs sent: pgid -> backoff id -> record; a record
+        # exists from block-send until its matching unblock-send
+        self.backoffs: "Dict[Tuple[int, int], Dict[int, dict]]" = {}
+        self._next_backoff_id = 0
         self.split_moved = 0          # lifetime objects moved by splits
         if self.monc is not None:
             self.monc.map_callbacks.append(self._on_map_change)
@@ -267,13 +292,25 @@ class OSDDaemon(Dispatcher):
                         dout("osd", 0, f"osd.{self.whoami} split "
                                        f"quiesce timed out; proceeding")
                     # the move itself is fully synchronous: no other
-                    # coroutine interleaves with it
-                    self.split_moved += self.split_pool_pgs(
-                        pool_id, old, new)
+                    # coroutine interleaves with it.  A failed move must
+                    # NOT abort the loop: the gate accounting below has
+                    # to run for every pool, or its 'split' backoffs are
+                    # never unblocked and the stale _splitting_old entry
+                    # re-gates ops on the next map change forever.
+                    try:
+                        self.split_moved += self.split_pool_pgs(
+                            pool_id, old, new)
+                    except Exception as e:  # noqa: BLE001 — objects may
+                        # be stranded in parent collections; reads go
+                        # through the wrong-pg gate and a later epoch
+                        # re-attempts, but clients must resume NOW
+                        dout("osd", 0, f"split of pool {pool_id} "
+                                       f"failed: {type(e).__name__}: {e}")
                     left = self._split_pending.get(pool_id, 1) - 1
                     if left <= 0:
-                        self._split_pending.pop(pool_id, None)
-                        self._splitting_old.pop(pool_id, None)
+                        # ungate + unblock: every session backed off on
+                        # this pool's PGs mid-split resends now
+                        self._split_done(pool_id)
                     else:
                         self._split_pending[pool_id] = left
             self._split_task = asyncio.ensure_future(run_splits())
@@ -815,6 +852,16 @@ class OSDDaemon(Dispatcher):
         a.register("dump_historic_ops",
                    lambda _c: self.op_tracker.dump_historic(),
                    "recently completed ops with event timelines")
+        a.register("dump_backoffs",
+                   lambda _c: self.dump_backoffs(),
+                   "live client backoffs (blocks not yet unblocked) "
+                   "and the admission queue watermarks")
+        a.register("injectdataerr",
+                   lambda c: self.inject_data_error(
+                       int(c["pool"]), str(c["oid"]), int(c["shard"]),
+                       int(c.get("offset", 0))),
+                   "QA: flip a byte of a stored shard chunk so deep "
+                   "scrub / read-path crc must detect it")
         a.register("config get",
                    lambda c: {c["key"]: self.config.get(c["key"])},
                    "read a config value")
@@ -891,6 +938,9 @@ class OSDDaemon(Dispatcher):
                            self.osdmap.get_pool(p), "fast_read", False),
                        perf=self.perf, profiler=self.profiler)
         be.last_epoch = self.osdmap.epoch
+        # activation hook: peering completion releases the PG's
+        # backoffs so blocked sessions resend (backoff protocol)
+        be.on_activate = lambda p=pgid: self._pg_activated(p)
         self.backends[pgid] = be
         return be
 
@@ -994,6 +1044,176 @@ class OSDDaemon(Dispatcher):
                 asyncio.ensure_future(
                     self.monc.report_failure(self.whoami, osd))
             raise
+
+    # --- RADOS backoff protocol (reference Session backoff handling in
+    # --- src/osd/OSD.cc + doc/dev/osd_internals/backoff.rst) -----------------
+
+    def _backoff_enabled(self) -> bool:
+        return bool(self.config.get("osd_backoff_enabled"))
+
+    def _backoffs_live(self) -> int:
+        return sum(len(r) for r in self.backoffs.values())
+
+    def _want_backoff(self, pgid: "Tuple[int, int]") -> "Optional[str]":
+        """Reason an arriving client op should be backed off, or None
+        to admit.  Split is checked first: a splitting pool's PGs also
+        re-peer, and the split is the blocker whose completion actually
+        gates the unblock."""
+        if self._split_task is not None and not self._split_task.done() \
+                and pgid[0] in self._splitting_old:
+            return "split"
+        be = self.backends.get(pgid)
+        if be is not None and be.peering:
+            return "peering"
+        return None
+
+    async def _send_backoff(self, conn, pgid: "Tuple[int, int]",
+                            msg: MOSDOp, reason: str) -> None:
+        """Block the session for this PG instead of parking the op: the
+        op is dropped HERE and the client resends after the unblock —
+        the reference's replacement for server-side op parking, which
+        wedged op slots and deadlocked under cross-OSD drains."""
+        recs = self.backoffs.setdefault(pgid, {})
+        bid = next((b for b, rec in recs.items()
+                    if rec["conn"] is conn and rec["reason"] == reason),
+                   None)
+        if bid is None:
+            self._next_backoff_id += 1
+            bid = self._next_backoff_id
+            recs[bid] = {"conn": conn, "reason": reason,
+                         "since": time.monotonic()}
+            # count NEW records only: a client re-probing a long-lived
+            # block re-sends the same bid, and counting repeats would
+            # make the blocks-vs-unblocks imbalance alert fire on
+            # perfectly healthy (if slow) release paths
+            self.perf.inc("osd_backoffs_sent")
+        self.perf.set("osd_backoffs_active", self._backoffs_live())
+        dout("osd", 10, f"osd.{self.whoami} backoff block pg {pgid} "
+                        f"({reason}) tid {msg.get('tid')}")
+        try:
+            await conn.send_message(MOSDBackoff({
+                "op": "block", "pgid": list(pgid), "id": bid,
+                "reason": reason, "tid": msg.get("tid"),
+                "epoch": self.osdmap.epoch}))
+        except (ConnectionError, OSError):
+            recs.pop(bid, None)
+            if not recs:
+                self.backoffs.pop(pgid, None)
+            self.perf.set("osd_backoffs_active", self._backoffs_live())
+
+    def _release_backoffs(self, pool_id: "Optional[int]" = None,
+                          pgid: "Optional[Tuple[int, int]]" = None,
+                          reason: "Optional[str]" = None) -> None:
+        """Send the unblocks matching the filter (PG activated, split
+        finished, queue drained to the low-watermark).  Records drop
+        synchronously — a re-block racing the async sends gets a fresh
+        id — and the unblock sends ride their own task so release can
+        be called from sync contexts (throttle put, split accounting)."""
+        to_send = []
+        for p, recs in list(self.backoffs.items()):
+            if pgid is not None and p != tuple(pgid):
+                continue
+            if pool_id is not None and p[0] != pool_id:
+                continue
+            for bid, rec in list(recs.items()):
+                if reason is not None and rec["reason"] != reason:
+                    continue
+                recs.pop(bid)
+                to_send.append((p, bid, rec))
+            if not recs:
+                self.backoffs.pop(p, None)
+        if not to_send:
+            return
+        self.perf.set("osd_backoffs_active", self._backoffs_live())
+
+        async def _send_unblocks():
+            for p, bid, rec in to_send:
+                self.perf.inc("osd_backoff_unblocks_sent")
+                dout("osd", 10, f"osd.{self.whoami} backoff unblock "
+                                f"pg {p} ({rec['reason']})")
+                try:
+                    await rec["conn"].send_message(MOSDBackoff({
+                        "op": "unblock", "pgid": list(p), "id": bid,
+                        "reason": rec["reason"],
+                        "epoch": self.osdmap.epoch}))
+                except (ConnectionError, OSError):
+                    pass    # dead session: its reset cleared the client
+        asyncio.ensure_future(_send_unblocks())
+
+    def _pg_activated(self, pgid: "Tuple[int, int]") -> None:
+        """ECBackend activation hook: peering finished (or aborted), so
+        every session blocked on the PG resumes and resends (reference:
+        PG activation releases its backoffs)."""
+        self._release_backoffs(pgid=tuple(pgid), reason="peering")
+
+    def _split_done(self, pool_id: int) -> None:
+        """All pending splits of a pool consumed: ungate and unblock."""
+        self._split_pending.pop(pool_id, None)
+        self._splitting_old.pop(pool_id, None)
+        self._release_backoffs(pool_id=pool_id, reason="split")
+
+    def _maybe_release_queue_backoffs(self) -> None:
+        if not self.backoffs:
+            return
+        if self.op_throttle.current <= \
+                int(self.config.get("osd_backoff_queue_low")):
+            self._release_backoffs(reason="queue")
+
+    def ms_handle_reset(self, conn) -> None:
+        """A dead session's backoffs are garbage: the client side
+        cleared them on its own reset, and the unblock could never be
+        delivered anyway.  (tcp: fired when the accepted session dies;
+        async+local has no session teardown — there the record drops
+        when the release-path unblock send fails.)"""
+        changed = False
+        for p, recs in list(self.backoffs.items()):
+            for bid in [b for b, rec in recs.items()
+                        if rec["conn"] is conn]:
+                recs.pop(bid)
+                changed = True
+            if not recs:
+                self.backoffs.pop(p, None)
+        if changed:
+            self.perf.set("osd_backoffs_active", self._backoffs_live())
+
+    def dump_backoffs(self) -> dict:
+        """Admin surface (mirrors the client objecter's dump)."""
+        now = time.monotonic()
+        return {
+            "backoffs": [
+                {"pgid": list(p), "id": bid, "reason": rec["reason"],
+                 "age": round(now - rec["since"], 3)}
+                for p, recs in sorted(self.backoffs.items())
+                for bid, rec in sorted(recs.items())],
+            "queue": {"in_flight": self.op_throttle.current,
+                      "high": self.op_throttle.max,
+                      "low": int(self.config.get(
+                          "osd_backoff_queue_low"))}}
+
+    def inject_data_error(self, pool_id: int, oid: str,
+                          shard: int, offset: int = 0) -> dict:
+        """QA fault injection (reference 'ceph tell osd.N
+        injectdataerr'): flip one byte of the stored shard chunk,
+        bypassing the EC write path, so the on-disk bytes no longer
+        match the HashInfo crc chain — exactly what deep scrub (and the
+        read path's full-chunk crc verify) must catch and repair."""
+        from ..objectstore.types import Collection, ObjectId
+        from ..objectstore.transaction import Transaction
+        pg = self.osdmap.object_to_pg(pool_id, oid)
+        cid = Collection(pool_id, pg, shard)
+        sid = ObjectId(oid, shard)
+        data = bytes(self.store.read(cid, sid))
+        if not data:
+            raise NotFound(f"injectdataerr: no bytes for {oid!r} "
+                           f"shard {shard} on osd.{self.whoami}")
+        off = max(0, min(int(offset), len(data) - 1))
+        t = Transaction()
+        t.write(cid, sid, off, bytes([data[off] ^ 0xFF]))
+        self.store.apply_transaction(t)
+        dout("osd", 1, f"osd.{self.whoami} injectdataerr: flipped byte "
+                       f"{off} of {oid!r} shard {shard} (pg {pool_id}.{pg})")
+        return {"injected": True, "pgid": [pool_id, pg], "shard": shard,
+                "offset": off}
 
     # --- dispatch (reference ms_fast_dispatch OSD.cc:6990) -------------------
 
@@ -1178,14 +1398,43 @@ class OSDDaemon(Dispatcher):
                 # issuer holds a client slot while awaiting us, so two
                 # OSDs cross-copying at full slot occupancy would
                 # deadlock until the op timeout.  (The flag only skips
-                # QoS queueing; cap checks still apply.)
+                # QoS queueing; cap checks still apply.)  Internal ops
+                # are also never backed off: the issuer's mini-objecter
+                # has no backoff session state, and parking it would
+                # wedge the client slot it holds.
                 top.mark("reached_pg")
                 await self._do_client_op(conn, msg, top)
                 return
-            top.mark("queued_for_pg")
-            async with self.op_scheduler.queued(CLIENT):
-                top.mark("reached_pg")
-                await self._do_client_op(conn, msg, top)
+            took = False
+            if self._backoff_enabled():
+                pgid = (int(msg["pool"]), int(msg["pg"]))
+                reason = self._want_backoff(pgid)
+                # the high-watermark is runtime-mutable ('config set
+                # osd_backoff_queue_high'): track it per admission, or
+                # the registered config command silently does nothing
+                high = int(self.config.get("osd_backoff_queue_high"))
+                if high != self.op_throttle.max:
+                    self.op_throttle.reset_max(high)
+                if reason is None and high > 0:
+                    took = self.op_throttle.get_or_fail(1)
+                    if not took:
+                        # queue past the high-watermark: shed the op
+                        # via backoff instead of letting it age toward
+                        # the client's op timeout
+                        reason = "queue"
+                if reason is not None:
+                    top.mark(f"backoff_{reason}")
+                    await self._send_backoff(conn, pgid, msg, reason)
+                    return
+            try:
+                top.mark("queued_for_pg")
+                async with self.op_scheduler.queued(CLIENT):
+                    top.mark("reached_pg")
+                    await self._do_client_op(conn, msg, top)
+            finally:
+                if took:
+                    self.op_throttle.put(1)
+                self._maybe_release_queue_backoffs()
 
     # op name -> required osd permission: mutations 'w', class exec 'x',
     # everything else 'r' (reference OSDCap check in do_op)

@@ -317,6 +317,10 @@ class ECBackend:
         self._lock = DepLock("ecbackend.pipeline")
         self._not_peering = asyncio.Event()
         self._not_peering.set()
+        # daemon hook fired whenever peering ends (activation or give-up):
+        # the OSD releases this PG's client backoffs so blocked
+        # sessions resend (reference: activation requeues waiting ops)
+        self.on_activate: "Optional[Callable[[], None]]" = None
         # shard-local state
         self.pg_log = PGLog()
         # objects THIS shard is missing (persisted; cleared by pushes)
@@ -1586,10 +1590,21 @@ class ECBackend:
         """A shard whose reply is silently lost (injected drop, dying
         peer) must never pin a ReadOp forever: after the timeout,
         synthesize EIO for the stuck shards so the normal re-plan path
-        (get_remaining_shards, ECBackend.cc:1633) widens around them."""
-        timeout = self.opt("osd_ec_sub_read_timeout", 5.0)
+        (get_remaining_shards, ECBackend.cc:1633) widens around them.
+
+        Two thresholds: osd_ec_subread_timeout (~1s) triggers EARLY
+        fallback decode — but only while the surviving shards can still
+        decode, because the synthesized EIO writes the slow shard off
+        for this read; when no redundancy is left (every candidate
+        shard is slow), waiting IS the only correct move, and the slow
+        shards keep their full osd_ec_sub_read_timeout window.  So one
+        silent shard costs ~1s, never the whole rados_osd_op_timeout —
+        a read stuck until the client gives up is indistinguishable
+        from an outage."""
+        hard = self.opt("osd_ec_sub_read_timeout", 5.0)
+        early = min(hard, self.opt("osd_ec_subread_timeout", 1.0))
         while not rop.done.done():
-            await asyncio.sleep(timeout / 2)
+            await asyncio.sleep(early / 2)
             if rop.done.done():
                 return
             now = time.monotonic()
@@ -1597,11 +1612,22 @@ class ECBackend:
             # just before this tick keeps its own full window instead
             # of being synthesized EIO almost immediately
             stuck = {s for s in rop.in_progress
-                     if now - rop.issued_at.get(s, now) >= timeout}
+                     if now - rop.issued_at.get(s, now) >= hard}
+            slow = {s for s in rop.in_progress
+                    if now - rop.issued_at.get(s, now) >= early} - stuck
+            if slow:
+                survivors = (set(self._avail_shards())
+                             - rop.bad_shards - stuck - slow)
+                try:
+                    self._min_to_read(survivors, rop.want_to_read)
+                    stuck |= slow       # redundancy exists: re-plan now
+                except ErasureCodeError:
+                    pass                # none left: let the slow shards
+                    #                     ride out the hard window
             if not stuck:
-                continue  # nothing silent for a full window yet
+                continue  # nothing over its window yet
             dout("osd", 1, f"read tid {rop.tid}: shards {sorted(stuck)} "
-                           f"silent for {timeout}s, treating as EIO")
+                           f"silent past their window, treating as EIO")
             for shard in stuck:
                 self.handle_sub_read_reply(MECSubOpReadReply({
                     "pgid": list(self.pgid), "shard": shard,
@@ -1678,6 +1704,15 @@ class ECBackend:
         if rop is None:
             return
         shard = int(msg["shard"])
+        if shard in rop.bad_shards:
+            # a LATE reply from a shard already written off (watchdog
+            # EIO synthesis, earlier error): the re-plan excluded it and
+            # may have switched plans — e.g. sub-chunk partial -> full
+            # chunk — so merging its stale buffers into rop.complete
+            # would zero-pad into the decode and return silently
+            # corrupted bytes.  No re-plan ever re-reads a bad shard,
+            # so nothing from it can be wanted.
+            return
         bufs = unpack_buffers(list(msg.get("lens", [])), msg.data)
         for rec in msg.get("buffers_read", []):
             shard_bufs = rop.complete.setdefault(
@@ -2567,6 +2602,7 @@ class ECBackend:
             finally:
                 self.peering = False
                 self._not_peering.set()
+                self._notify_active()
                 # never leave a writer parked on a degraded future a
                 # dead recovery run will not resolve (e.g. _do_peer
                 # raised mid-recovery); waiters re-check state and
@@ -2577,6 +2613,18 @@ class ECBackend:
                 self.degraded = {}
                 self._recovery_prio.clear()
                 self._recovery_trace.clear()
+
+    def _notify_active(self) -> None:
+        """Tell the daemon peering ended — on FAILURE too: a blocked
+        client must resend (and get ESTALE or a fresh backoff) rather
+        than hang on an unblock that will never come."""
+        if self.on_activate is None:
+            return
+        try:
+            self.on_activate()
+        except Exception as e:  # noqa: BLE001 — a hook error must not
+            # poison peering itself
+            dout("osd", 1, f"on_activate hook failed: {e}")
 
     async def _do_peer(self) -> dict:
         # (re)assert the admission gate: this run may follow an earlier
@@ -2808,6 +2856,7 @@ class ECBackend:
         self.active_acting = list(self.get_acting())
         self.peering = False
         self._not_peering.set()
+        self._notify_active()
 
         sleep_s = self.opt("osd_recovery_sleep", 0.0)
         counts = {"recovered": 0, "failed": 0}

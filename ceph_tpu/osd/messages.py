@@ -204,6 +204,22 @@ class MScrubShardReply(Message):
 
 
 @register_message
+class MOSDBackoff(Message):
+    """RADOS backoff protocol (reference src/messages/MOSDBackoff.h +
+    doc/dev/osd_internals/backoff.rst): an OSD that cannot serve a PG
+    right now (peering, mid-split, op queue past its high-watermark)
+    tells the client session to STOP sending ops for that PG instead of
+    letting it burn timeout/retry cycles; the matching unblock releases
+    the parked ops for an event-driven resend.
+
+    fields: op ('block'|'unblock'), pgid, id (per-OSD backoff id),
+    reason ('peering'|'split'|'queue'), epoch, and — block only — tid of
+    the op that tripped it, so the client wakes exactly that op's wait
+    instead of letting it ride out the full op timeout."""
+    TYPE = "osd_backoff"
+
+
+@register_message
 class MOSDMapMsg(Message):
     """Map epoch broadcast (reference MOSDMap.h); full map json in data."""
     TYPE = "osd_map"

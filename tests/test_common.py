@@ -294,3 +294,20 @@ class TestThrottle:
     def test_unlimited(self):
         t = Throttle("x", 0)
         assert t.get_or_fail(1 << 40)
+
+    def test_put_drains_across_runtime_reset(self):
+        """A count taken while max was positive must return after
+        reset_max(0) (reference put decrements unconditionally) — else
+        restoring the max later inherits phantom occupancy."""
+        t = Throttle("x", 10)
+        assert t.get_or_fail(5)
+        t.reset_max(0)
+        t.put(5)                      # NOT a no-op despite max<=0
+        t.reset_max(10)
+        assert t.current == 0
+        assert t.get_or_fail(10)      # full capacity back
+        # uncounted admissions (taken at max<=0) clamp at zero
+        t2 = Throttle("y", 0)
+        assert t2.get_or_fail(3)
+        t2.put(3)
+        assert t2.current == 0

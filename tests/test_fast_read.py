@@ -164,3 +164,35 @@ def test_pool_set_fast_read_mon_command(loop):
             assert pool.fast_read
             assert await io.read("obj") == b"q" * 800
     loop.run_until_complete(go())
+
+
+def test_normal_read_falls_back_early_on_one_slow_shard(loop):
+    """Satellite (PR robustness): WITHOUT fast_read, one silent/slow
+    shard triggers fallback decode at osd_ec_subread_timeout (~1s by
+    default), well before both the hard osd_ec_sub_read_timeout and the
+    client-visible rados_osd_op_timeout — as long as the survivors can
+    still decode (the all-slow case above keeps waiting instead)."""
+    async def go():
+        cfg = Config()
+        cfg.set("osd_ec_subread_timeout", 0.4)
+        cfg.set("osd_ec_sub_read_timeout", 8.0)
+        async with MiniCluster(n_osds=6, config=cfg) as c:
+            c.create_ec_pool("nf2", PROFILE, pg_num=4, stripe_unit=256)
+            client = await c.client()
+            io = client.io_ctx("nf2")
+            data = b"z" * 4000
+            await io.write_full("obj", data)
+            _pgid, _acting, victim = await _non_primary_shard_osd(
+                c, "nf2", "obj")
+            # one shard slower than the HARD timeout: only the early
+            # fallback can finish this read promptly
+            _slow_sub_reads(c.osds[victim], delay=10.0)
+            t0 = time.monotonic()
+            assert await io.read("obj") == data
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, \
+                f"fallback decode took {elapsed:.2f}s (early watchdog " \
+                f"not firing)"
+            assert elapsed >= 0.35, \
+                f"{elapsed=} — test no longer exercises the watchdog"
+    loop.run_until_complete(go())

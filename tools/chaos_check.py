@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""chaos_check — one-shot chaos harness / tier-2 smoke gate.
+
+Runs the thrasher kill/revive schedule under a live write/read workload
+with BOTH fault planes lit up:
+
+- messenger injection (ms_inject_delay_max / ms_inject_drop_ratio /
+  ms_inject_socket_failures — the reference msgr-failures qa facet), and
+- objectstore injection (`injectdataerr`: periodic byte flips in stored
+  shard chunks, the reference `ceph tell osd.N injectdataerr`),
+
+then heals the cluster (revive + peer + deep-scrub repair) and verifies
+the only invariant that matters: EVERY acknowledged write reads back
+byte-equal — no lost bytes, no duplicated appends (a duplicated append
+shows up as got != want, same check).  Backoffs stay on (the default),
+so the run also exercises block/park/unblock under failure traffic.
+
+Exit codes: 0 = clean; 1 = data loss / mismatch / hung read;
+2 = harness error.  Usable directly as a CI smoke gate:
+
+  python tools/chaos_check.py --duration 8 --seed 7
+  python tools/chaos_check.py --pool-type replicated --no-splits
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.common.log import dout  # noqa: E402
+from ceph_tpu.qa.cluster import MiniCluster  # noqa: E402
+from ceph_tpu.qa.thrasher import Thrasher, Workload, _forensics  # noqa: E402
+
+
+async def _corruptor(cluster: MiniCluster, wl: Workload, pool_name: str,
+                     interval: float, seed: int, stats: dict,
+                     stop: asyncio.Event, max_per_object: int) -> None:
+    """Periodically flip a byte of a random committed object's shard
+    through the daemon's injectdataerr path.  The read path's crc
+    verify + re-plan must route around it; deep scrub repairs the rest
+    before the final verification.
+
+    ``max_per_object`` caps DISTINCT corrupted shards per object below
+    the pool's redundancy (lifetime, conservatively ignoring interim
+    rewrites/repairs): flipping more shards than the code can decode
+    around would make the gate report the harness's own injection as
+    data loss."""
+    rng = random.Random(seed)
+    pool = cluster.osdmap.pool_by_name(pool_name)
+    flipped: "dict[str, set]" = {}
+    while not stop.is_set():
+        try:
+            await asyncio.wait_for(stop.wait(), interval)
+            return
+        except asyncio.TimeoutError:
+            pass
+        oids = [o for o in sorted(wl.committed)
+                if len(flipped.get(o, ())) < max_per_object]
+        if not oids:
+            continue
+        oid = rng.choice(oids)
+        pg = cluster.osdmap.object_to_pg(pool.pool_id, oid)
+        _u, acting = cluster.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+        live = [(s, o) for s, o in enumerate(acting)
+                if o >= 0 and o in cluster.osds and cluster.osds[o].up
+                and s not in flipped.get(oid, ())]
+        if not live:
+            continue
+        shard, osd_id = rng.choice(live)
+        try:
+            cluster.osds[osd_id].inject_data_error(
+                pool.pool_id, oid, shard,
+                offset=rng.randrange(1 << 12))
+            stats["corruptions"] += 1
+            flipped.setdefault(oid, set()).add(shard)
+        except Exception as e:  # noqa: BLE001 — object mid-rewrite /
+            # shard empty on this osd: injection is best-effort chaos
+            dout("qa", 10, f"injectdataerr {oid} skipped: {e}")
+
+
+async def run_chaos(args) -> int:
+    cfg = Config()
+    cfg.set("ms_inject_delay_max", args.delay_max)
+    cfg.set("ms_inject_drop_ratio", args.drop_ratio)
+    if args.socket_failures:
+        cfg.set("ms_inject_socket_failures", args.socket_failures)
+    # a dropped reply must cost ~2s of retry, not the default 10s op
+    # timeout — the gate wants op CHURN under failure, not one wedged
+    # writer riding out the whole chaos window
+    cfg.set("rados_osd_op_timeout", args.op_timeout)
+    async with MiniCluster(n_osds=args.osds, config=cfg) as cluster:
+        if args.pool_type == "ec":
+            cluster.create_ec_pool(
+                "chaos", {"plugin": "jax_rs", "k": str(args.k),
+                          "m": str(args.m)},
+                pg_num=args.pg_num, stripe_unit=64)
+            min_live = args.k + 1
+            # strictly below m so corruption can never combine with one
+            # concurrently-missing shard (thrasher kill mid-write) into
+            # more failures than decode can reconstruct — m=1 pools get
+            # messenger chaos only
+            max_corrupt = max(0, args.m - 1)
+        else:
+            cluster.create_replicated_pool("chaos", size=3,
+                                           pg_num=args.pg_num,
+                                           stripe_unit=256)
+            min_live = 2
+            max_corrupt = 1
+        wl = Workload(cluster, "chaos", seed=args.seed)
+        th = Thrasher(cluster, seed=args.seed + 1, min_live=min_live)
+        if not args.no_splits:
+            th.split_pool = "chaos"
+        stats = {"corruptions": 0}
+        stop = asyncio.Event()
+        tasks = [asyncio.ensure_future(wl.run()),
+                 asyncio.ensure_future(th.run()),
+                 asyncio.ensure_future(_corruptor(
+                     cluster, wl, "chaos", args.corrupt_interval,
+                     args.seed + 2, stats, stop, max_corrupt))]
+        await asyncio.sleep(args.duration)
+        th.stop()
+        wl.stop()
+        stop.set()
+        await asyncio.gather(*tasks)
+        failures: "list[str]" = []
+        if wl.read_mismatch is not None:
+            failures.append(f"read-after-ack mismatch on "
+                            f"{wl.read_mismatch} during chaos")
+        # heal: everything up, peered, then repair injected corruption
+        for i, osd in list(cluster.osds.items()):
+            if not osd.up:
+                await cluster.revive_osd(i)
+        await cluster.peer_all()
+        scrub = await cluster.scrub_pool("chaos", deep=True, repair=True)
+        repaired = sum(len(r.get("repaired", [])) for r in scrub.values())
+        # the gate: every acked write byte-equal (lost AND duplicated
+        # writes both fail the equality), unknown-outcome reads clean
+        client = await cluster.client()
+        io = client.io_ctx("chaos")
+        pool_obj = cluster.osdmap.pool_by_name("chaos")
+        for oid, want in sorted(wl.committed.items()):
+            try:
+                got = await asyncio.wait_for(io.read(oid), timeout=15.0)
+            except Exception as e:  # noqa: BLE001 — unreadable = lost
+                failures.append(f"LOST {oid}: read failed ({e})\n"
+                                + _forensics(cluster, pool_obj, oid))
+                continue
+            if got != want:
+                kind = ("DUPLICATED/OVERGROWN" if len(got) > len(want)
+                        else "LOST/TRUNCATED")
+                failures.append(
+                    f"{kind} {oid}: {len(got)} bytes vs {len(want)} "
+                    f"acked\n" + _forensics(cluster, pool_obj, oid))
+        for oid in sorted(wl.dropped - set(wl.committed)):
+            try:
+                await asyncio.wait_for(io.read(oid), timeout=15.0)
+            except asyncio.TimeoutError:
+                failures.append(f"read of {oid} HUNG after heal")
+            except Exception:  # noqa: BLE001 — clean error is fine for
+                pass           # an unknown-outcome object
+        backoffs = sum(
+            o.perf_coll.dump()[f"osd.{o.whoami}"]["osd_backoffs_sent"]
+            for o in cluster.osds.values())
+        report = {
+            "ok": not failures,
+            "acked": wl.acked, "failed_ops": wl.failed,
+            "objects": len(wl.committed), "kills": th.kills,
+            "splits": th.splits, "corruptions": stats["corruptions"],
+            "scrub_repaired": repaired, "backoffs_sent": backoffs,
+            "failures": failures,
+        }
+        print(json.dumps(report, indent=2))
+        return 0 if not failures else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds of chaos before heal+verify")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--osds", type=int, default=7)
+    ap.add_argument("--pool-type", choices=("ec", "replicated"),
+                    default="ec")
+    ap.add_argument("--k", type=int, default=3)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--pg-num", type=int, default=8)
+    ap.add_argument("--delay-max", type=float, default=0.005,
+                    help="ms_inject_delay_max (s)")
+    ap.add_argument("--drop-ratio", type=float, default=0.02,
+                    help="ms_inject_drop_ratio")
+    ap.add_argument("--socket-failures", type=int, default=0,
+                    help="ms_inject_socket_failures (one-in-N)")
+    ap.add_argument("--corrupt-interval", type=float, default=1.0,
+                    help="seconds between injectdataerr byte flips")
+    ap.add_argument("--op-timeout", type=float, default=2.0,
+                    help="rados_osd_op_timeout for the workload client")
+    ap.add_argument("--no-splits", action="store_true",
+                    help="disable pg_num raises mid-chaos")
+    args = ap.parse_args(argv)
+    try:
+        return asyncio.new_event_loop().run_until_complete(
+            run_chaos(args))
+    except Exception:  # noqa: BLE001 — harness error, not a data verdict
+        traceback.print_exc()
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
