@@ -28,6 +28,14 @@ class RadosClient:
         self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
         self.objecter = Objecter(self.ms, self.osdmap)
         self.admin_socket = None
+        # client-side clog handle (reference: librados carries a
+        # LogClient too — client-observed errors belong in the cluster
+        # log just like daemon ones)
+        from ..common.logclient import LogClient
+        self.clog = LogClient(
+            name, self.ms._config,
+            send_fn=self.monc.send_log if self.monc is not None
+            else None)
         if self.monc is not None:
             # every new epoch wakes the objecter's parked/sleeping ops:
             # resend is map-driven, not timer-driven
@@ -35,6 +43,7 @@ class RadosClient:
 
     async def connect(self, addr: str = "") -> None:
         await self.ms.bind(addr or f"client:{id(self) & 0xFFFF}")
+        self.clog.start()
         if self.monc is not None:
             await self.monc.subscribe_osdmap()
             await self.monc.wait_for_map()
@@ -58,6 +67,11 @@ class RadosClient:
                    lambda _c: {"name": self.ms.name,
                                "epoch": self.osdmap.epoch},
                    "client status")
+        from ..common.log import register_log_commands
+        register_log_commands(a)
+        a.register("clog stats",
+                   lambda _c: self.clog.dump(),
+                   "cluster-log client counters")
         a.start()
         self.admin_socket = a
 
@@ -92,6 +106,7 @@ class RadosClient:
         self.objecter.ticket_renewer = renewer
 
     async def shutdown(self) -> None:
+        await self.clog.stop()
         if self.admin_socket is not None:
             self.admin_socket.stop()
         await self.ms.shutdown()

@@ -1,0 +1,231 @@
+"""Crash capture — post-mortem dumps for daemons that die mid-task.
+
+Reference: src/ceph-crash + the mgr ``crash`` module.  The reference
+watches /var/lib/ceph/crash for meta files written by a dying process
+and posts them to the cluster; ``ceph crash ls/info`` then serves them
+and unarchived recent crashes raise RECENT_CRASH health.
+
+Here the handler is in-process: daemons wrap their long-running task
+loops and dispatch paths with ``CrashHandler.task`` / ``capture``.  An
+unhandled exception produces a dump carrying everything a post-mortem
+needs — the exception + traceback, the tail of the dout ring
+(``Log.dump_recent`` — the reference's most loved crash feature), the
+non-default config, and the trace_ids of recent ops so the death can be
+correlated with ``dump_historic_ops`` on peer daemons.  Dumps persist
+to a crash directory (one JSON meta per crash, ceph-crash layout) and
+post to the mon's paxos-backed crash service; boot re-posts anything
+found on disk, so a crash survives both the daemon and the mon quorum
+of the day.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import traceback
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from .log import get_log
+from .logclient import LogClient, conf_get
+
+
+def crash_summary(meta: dict) -> dict:
+    """The 'crash ls' row for one dump."""
+    return {"crash_id": meta.get("crash_id", "?"),
+            "timestamp": meta.get("timestamp", "?"),
+            "entity_name": meta.get("entity_name", "?"),
+            "exception": meta.get("exception", {}),
+            "archived": bool(meta.get("archived", False))}
+
+
+class CrashHandler:
+    """``post_fn``: async callable taking one meta dict (MonClient.
+    send_crash, or the mon's own propose path); optional, like every
+    other leg of the pipeline — a static-mode daemon still persists."""
+
+    def __init__(self, name: str, config=None, log=None,
+                 op_tracker=None, clog: "Optional[LogClient]" = None,
+                 post_fn: "Optional[Callable]" = None) -> None:
+        self.name = name
+        self.config = config
+        self.log = log or get_log()
+        self.op_tracker = op_tracker
+        self.clog = clog
+        self.post_fn = post_fn
+        base = ""
+        if config is not None:
+            try:
+                base = str(config.get("crash_dir"))
+            except Exception:  # noqa: BLE001 — bare/partial schemas
+                base = ""
+        self.dir = os.path.join(base, name) if base else ""
+        self.dumps: "Dict[str, dict]" = {}
+        self._load()
+
+    # --- persistence ----------------------------------------------------------
+
+    def _load(self) -> None:
+        if not self.dir or not os.path.isdir(self.dir):
+            return
+        for crash_id in sorted(os.listdir(self.dir)):
+            path = os.path.join(self.dir, crash_id, "meta.json")
+            try:
+                with open(path) as f:
+                    self.dumps[crash_id] = json.load(f)
+            except (OSError, ValueError):
+                continue
+
+    def _persist(self, meta: dict) -> None:
+        if not self.dir:
+            return
+        d = os.path.join(self.dir, meta["crash_id"])
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1)
+        except OSError as e:
+            self.log.dout("none", 0,
+                          f"{self.name}: crash dump persist failed: {e}")
+
+    # --- capture --------------------------------------------------------------
+
+    def _recent_ops(self) -> "List[str]":
+        if self.op_tracker is None:
+            return []
+        try:
+            dumped = self.op_tracker.dump_in_flight()["ops"] \
+                + self.op_tracker.dump_historic()["ops"]
+            return [o["trace_id"] for o in dumped[-20:]]
+        except Exception:  # noqa: BLE001 — never fail the capture
+            return []
+
+    def capture(self, exc: BaseException, context: str = "") -> "Optional[dict]":
+        """Persist + post one crash dump; returns the meta (None for
+        cancellations, which are lifecycle, not crashes)."""
+        if isinstance(exc, asyncio.CancelledError):
+            return None
+        now = time.time()
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%S",
+                              time.gmtime(now)) + f".{int(now % 1 * 1e6):06d}Z"
+        crash_id = f"{stamp}_{uuid.uuid4()}"
+        tail = int(self._conf("crash_log_tail", 100))
+        with self.log._lock:
+            ring = list(self.log._ring)[-tail:]
+        config_diff = {}
+        if self.config is not None:
+            try:
+                config_diff = {k: str(v) for k, v in
+                               self.config.dump(
+                                   include_defaults=False).items()}
+            except Exception:  # noqa: BLE001
+                pass
+        meta = {
+            "crash_id": crash_id,
+            "timestamp": stamp,
+            "stamp": now,
+            "entity_name": self.name,
+            "context": context,
+            "exception": {"type": type(exc).__name__,
+                          "message": str(exc)},
+            "backtrace": traceback.format_exception(
+                type(exc), exc, exc.__traceback__),
+            "recent_events": ring,
+            "config_diff": config_diff,
+            "recent_ops": self._recent_ops(),
+        }
+        self.dumps[crash_id] = meta
+        self._persist(meta)
+        # the ring itself gets the full post-mortem, like the
+        # reference's dump-on-assert
+        self.log.dout("none", -1,
+                      f"{self.name} crashed in {context or 'task'}: "
+                      f"{type(exc).__name__}: {exc} "
+                      f"(crash dump {crash_id})")
+        if self.clog is not None:
+            self.clog.cluster.error(
+                f"{self.name} crashed in {context or 'task'}: "
+                f"{type(exc).__name__}: {exc} (crash dump {crash_id})")
+        if self.post_fn is not None:
+            async def post(meta=meta) -> None:
+                try:
+                    await self.post_fn(meta)
+                except Exception:  # noqa: BLE001 — boot re-posts
+                    pass
+            try:
+                asyncio.ensure_future(post())
+            except RuntimeError:
+                pass            # no loop (sync teardown context)
+        return meta
+
+    def _conf(self, name: str, default):
+        return conf_get(self.config, name, default)
+
+    # --- task wrapping --------------------------------------------------------
+
+    async def dispatch_guard(self, fn, conn, msg):
+        """The ms_dispatch crash shell, shared by every daemon: an
+        unhandled exception in any message path leaves a dump (ring
+        tail + recent trace_ids) before propagating."""
+        try:
+            return await fn(conn, msg)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            self.capture(e, f"ms_dispatch({msg.TYPE})")
+            raise
+
+    def task(self, coro, context: str = "") -> "asyncio.Task":
+        """ensure_future with crash capture: the daemon-loop spawner.
+        The exception is captured, not re-raised — the task is already
+        dead either way, and re-raising only produces 'exception never
+        retrieved' noise over the dump we just wrote."""
+        async def run() -> None:
+            try:
+                await coro
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — the whole point
+                self.capture(e, context)
+        t = asyncio.ensure_future(run())
+        # a task cancelled before its first step never awaited ``coro``
+        # — close it so teardown doesn't warn (no-op once it has run)
+        t.add_done_callback(lambda _t: coro.close())
+        return t
+
+    # --- posting / listing ----------------------------------------------------
+
+    async def post_all(self) -> int:
+        """Boot path: (re-)post every dump on disk; the mon dedups by
+        crash_id, so this is idempotent."""
+        if self.post_fn is None:
+            return 0
+        n = 0
+        for meta in list(self.dumps.values()):
+            try:
+                await self.post_fn(meta)
+                n += 1
+            except Exception:  # noqa: BLE001 — next boot retries
+                break
+        return n
+
+    def recent_count(self, max_age: "Optional[float]" = None) -> int:
+        if max_age is None:
+            max_age = float(self._conf("mgr_crash_warn_recent_age",
+                                       1209600.0))
+        now = time.time()
+        return sum(1 for m in self.dumps.values()
+                   if now - float(m.get("stamp", 0.0)) < max_age)
+
+    def ls(self) -> "List[dict]":
+        return [crash_summary(m) for m in
+                sorted(self.dumps.values(),
+                       key=lambda m: m.get("stamp", 0.0))]
+
+    def dump(self) -> dict:
+        """Admin/report surface."""
+        return {"total": len(self.dumps),
+                "recent": self.recent_count(),
+                "dir": self.dir}

@@ -70,16 +70,29 @@ class Log:
         gather, output = self._subsys.get(subsys, self._subsys["none"])
         if level > gather:
             return
-        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
+        now = time.time()
+        # sub-second precision: crash forensics order events that are
+        # microseconds apart — whole-second stamps made the ring tail
+        # an unordered blur
+        ts = (time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(now))
+              + f".{int(now % 1 * 1e6):06d}")
         line = f"{ts} {self.name} {level} {subsys}: {msg}"
         with self._lock:
             self._ring.append(line)
-            if level <= output and self._stream is not None:
-                try:
-                    self._stream.write(line + "\n")
-                    self._stream.flush()
-                except (OSError, ValueError):
-                    pass
+            if level <= output:
+                stream = self._stream
+                if stream is None and level < 0:
+                    # derr with no stream configured: a crashing daemon
+                    # must say SOMETHING somewhere — fall back to stderr
+                    # (the reference always has a log file; we often
+                    # run with stream=None in tests/harnesses)
+                    stream = sys.stderr
+                if stream is not None:
+                    try:
+                        stream.write(line + "\n")
+                        stream.flush()
+                    except (OSError, ValueError):
+                        pass
 
     def derr(self, subsys: str, msg: str) -> None:
         self.dout(subsys, -1, msg)
@@ -115,3 +128,91 @@ def get_log() -> Log:
 
 def dout(subsys: str, level: int, msg: str) -> None:
     _global.dout(subsys, level, msg)
+
+
+# --- admin-socket surface ('log dump' / 'log set-level' / 'log get-level')
+
+def register_log_commands(asok, log: "Optional[Log]" = None) -> None:
+    """Register the runtime log controls on a daemon's admin socket
+    (reference: the 'log dump' / 'log reopen' / injectargs debug_*
+    admin commands).  'log dump' flushes the ring to the daemon's log
+    stream AND returns the lines, so it works both attached and over
+    'ceph daemon <sock> log dump'."""
+    log = log or get_log()
+
+    def _dump(cmd: dict) -> dict:
+        lines = log.dump_recent()
+        num = int(cmd.get("num", 0) or 0)
+        return {"count": len(lines),
+                "lines": lines[-num:] if num > 0 else lines}
+
+    def _set_level(cmd: dict) -> dict:
+        subsys = str(cmd["subsys"])
+        gather = int(cmd["gather"])
+        out = cmd.get("output")
+        log.set_level(subsys, gather,
+                      int(out) if out not in (None, "") else None)
+        g, o = log.get_level(subsys)
+        return {"success": True, subsys: {"gather": g, "output": o}}
+
+    def _get_level(cmd: dict) -> dict:
+        subsys = cmd.get("subsys")
+        if subsys:
+            g, o = log.get_level(str(subsys))
+            return {str(subsys): {"gather": g, "output": o}}
+        with log._lock:
+            return {s: {"gather": g, "output": o}
+                    for s, (g, o) in sorted(log._subsys.items())}
+
+    asok.register("log dump", _dump,
+                  "write the recent-events ring to the log stream and "
+                  "return the lines (crash-forensics ring, live)")
+    asok.register("log set-level", _set_level,
+                  "set a subsystem's gather (ring) and optional output "
+                  "(stream) debug level at runtime")
+    asok.register("log get-level", _get_level,
+                  "current per-subsystem gather/output debug levels")
+
+
+# --- config glue: 'config set debug_<subsys> N[/M]' -> Log.set_level
+
+def attach_debug_options(config, log: "Optional[Log]" = None) -> None:
+    """Map the debug_* option family onto the live Log, now and on
+    every runtime change (reference: md_config_t subsys observers
+    feeding SubsystemMap).  Accepts 'N' (gather=output=N) or the
+    reference's 'G/O' form.  Idempotent per Config instance — daemons
+    sharing one Config (MiniCluster) attach once."""
+    log = log or get_log()
+    if getattr(config, "_debug_log_observer", None) is not None:
+        return
+    keys = [n for n in config.schema
+            if n.startswith("debug_") and n != "debug_default"]
+    if not keys:
+        return
+
+    def apply(names) -> None:
+        for n in names:
+            raw = str(config.get(n)).strip()
+            if not raw:
+                continue            # unset: keep the Log's defaults
+            try:
+                parts = raw.split("/", 1)
+                gather = int(parts[0])
+                output = int(parts[1]) if len(parts) > 1 else gather
+            except ValueError:
+                log.dout("none", 0, f"bad {n} value {raw!r} "
+                                    f"(want 'N' or 'G/O'); ignored")
+                continue
+            log.set_level(n[len("debug_"):], gather, output)
+
+    class _Obs:
+        def get_tracked_keys(self):
+            return keys
+
+        def handle_conf_change(self, _config, changed):
+            apply(changed)
+
+    obs = _Obs()
+    config.add_observer(obs)
+    config._debug_log_observer = obs
+    apply(keys)

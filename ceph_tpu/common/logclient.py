@@ -1,0 +1,261 @@
+"""LogClient — the cluster-log ("clog") sender every daemon carries.
+
+Reference: src/common/LogClient.h / LogEntry.h.  A daemon logs
+*significant events* (boot, crash, mark-down, operator-visible errors)
+to a named channel — ``cluster`` for events, ``audit`` for the command
+trail — at a severity (DBG/INF/WRN/ERR/SEC).  Entries batch locally and
+ship to the monitor as one ``MLog`` message per flush interval; the
+paxos-backed LogMonitor (mon/monitor.py) orders them cluster-wide and
+serves ``ceph log last``.
+
+Throttling mirrors the reference's mon_cluster_log protections:
+consecutive duplicate messages collapse into one entry with a
+``[repeated N times]`` suffix, and a bounded pending queue sheds
+overflow, summarized as a single WRN entry — a clog storm (a crashing
+op handler hit in a loop) costs the mon O(flush interval), never
+O(events).
+
+Every clog entry also mirrors into the local dout ring, so a daemon cut
+off from the quorum still has the event in ``log dump``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from .log import Log, get_log
+
+
+def conf_get(config, name: str, default):
+    """Read an option with a fallback for bare/partial schemas (shared
+    by LogClient and CrashHandler — components that must keep working
+    under harness configs that predate their options)."""
+    if config is None:
+        return default
+    try:
+        return config.get(name)
+    except Exception:  # noqa: BLE001 — unknown option in this schema
+        return default
+
+# severities, most to least verbose (reference clog_type)
+CLOG_DBG = "DBG"
+CLOG_INF = "INF"
+CLOG_WRN = "WRN"
+CLOG_ERR = "ERR"
+CLOG_SEC = "SEC"
+
+SEVERITIES = (CLOG_DBG, CLOG_INF, CLOG_WRN, CLOG_ERR, CLOG_SEC)
+
+# clog severity -> dout level for the local ring mirror (WRN+ at 0 so
+# they always gather; DBG stays chatty-local)
+_DOUT_LEVEL = {CLOG_DBG: 10, CLOG_INF: 1, CLOG_WRN: 0, CLOG_ERR: -1,
+               CLOG_SEC: -1}
+
+
+def format_clog_line(entry: dict) -> str:
+    """One canonical rendering shared by 'ceph log last' and the docs
+    (reference LogEntry::operator<< — '<stamp> <name> (<channel>) ...
+    : [<prio>] <message>')."""
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S",
+                       time.localtime(float(entry.get("stamp", 0.0))))
+    return (f"{ts} {entry.get('name', '?')} ({entry.get('channel', '?')})"
+            f" [{entry.get('prio', '?')}] : {entry.get('message', '')}")
+
+
+class LogChannel:
+    """One named channel of a LogClient (reference LogChannelRef)."""
+
+    def __init__(self, client: "LogClient", name: str) -> None:
+        self.client = client
+        self.name = name
+
+    def log(self, prio: str, message: str) -> None:
+        self.client._enqueue(self.name, prio, message)
+
+    def debug(self, message: str) -> None:
+        self.log(CLOG_DBG, message)
+
+    def info(self, message: str) -> None:
+        self.log(CLOG_INF, message)
+
+    def warn(self, message: str) -> None:
+        self.log(CLOG_WRN, message)
+
+    def error(self, message: str) -> None:
+        self.log(CLOG_ERR, message)
+
+    def sec(self, message: str) -> None:
+        self.log(CLOG_SEC, message)
+
+
+class LogClient:
+    """``send_fn`` is an async callable taking a list of wire-entry
+    dicts (MonClient.send_log, or the mon's own propose path); with no
+    sender (static-mode harnesses) entries still mirror to the local
+    ring and count toward the per-severity counters."""
+
+    def __init__(self, name: str, config=None,
+                 send_fn: "Optional[Callable]" = None,
+                 log: "Optional[Log]" = None) -> None:
+        self.name = name
+        self.config = config
+        self.send_fn = send_fn
+        self.log = log or get_log()
+        self.cluster = LogChannel(self, "cluster")
+        self.audit = LogChannel(self, "audit")
+        # per-severity lifetime counts (the ceph_clog_messages series)
+        self.counts: "Dict[str, int]" = {s: 0 for s in SEVERITIES}
+        self.sent_entries = 0
+        self.lost_entries = 0            # shed by the pending cap
+        self._pending: "List[dict]" = []
+        self._lost_since_flush = 0
+        self._seq = 0
+        # per-process incarnation: the mon's (name, inst, seq) dedup
+        # must not mistake a RESTARTED daemon's fresh seq 1 for a
+        # duplicate of its previous life's seq 1
+        self.incarnation = uuid.uuid4().hex[:12]
+        self._flush_task: "Optional[asyncio.Task]" = None
+
+    # --- config ---------------------------------------------------------------
+
+    def _conf(self, name: str, default):
+        return conf_get(self.config, name, default)
+
+    # --- convenience: default channel is 'cluster' ----------------------------
+
+    def channel(self, name: str) -> LogChannel:
+        if name == "cluster":
+            return self.cluster
+        if name == "audit":
+            return self.audit
+        return LogChannel(self, name)
+
+    def debug(self, message: str) -> None:
+        self.cluster.debug(message)
+
+    def info(self, message: str) -> None:
+        self.cluster.info(message)
+
+    def warn(self, message: str) -> None:
+        self.cluster.warn(message)
+
+    def error(self, message: str) -> None:
+        self.cluster.error(message)
+
+    def sec(self, message: str) -> None:
+        self.cluster.sec(message)
+
+    # --- enqueue / throttle ---------------------------------------------------
+
+    def _enqueue(self, channel: str, prio: str, message: str) -> None:
+        if prio not in self.counts:
+            prio = CLOG_INF
+        self.counts[prio] += 1
+        # local mirror first: the ring must have the event even if the
+        # mon never does
+        self.log.dout(channel, _DOUT_LEVEL[prio],
+                      f"[{prio}] {message}")
+        if self.send_fn is None or prio == CLOG_DBG:
+            # DBG never ships to the mon (reference clog_to_monitors
+            # default drops debug) — it would drown the cluster log
+            return
+        last = self._pending[-1] if self._pending else None
+        if last is not None and last["channel"] == channel \
+                and last["prio"] == prio \
+                and last["message"] == message:
+            # duplicate collapse: a storm of one message becomes one
+            # entry with a repeat count
+            last["repeat"] += 1
+            return
+        max_pending = int(self._conf("mon_client_log_max_pending", 64))
+        if len(self._pending) >= max_pending:
+            self.lost_entries += 1
+            self._lost_since_flush += 1
+            return
+        self._seq += 1
+        self._pending.append({
+            "stamp": time.time(), "name": self.name,
+            "inst": self.incarnation, "channel": channel,
+            "prio": prio, "message": message,
+            "seq": self._seq, "repeat": 1})
+
+    # --- flush ----------------------------------------------------------------
+
+    def _drain(self) -> "List[dict]":
+        """Pending -> wire entries (repeat suffixes + the shed summary),
+        clearing local state before the async send so a racing enqueue
+        starts a fresh batch."""
+        if not self._pending and not self._lost_since_flush:
+            return []
+        out = []
+        for e in self._pending:
+            msg = e["message"]
+            if e["repeat"] > 1:
+                msg += f" [repeated {e['repeat']} times]"
+            out.append({"stamp": e["stamp"], "name": e["name"],
+                        "inst": e["inst"], "channel": e["channel"],
+                        "prio": e["prio"], "message": msg,
+                        "seq": e["seq"]})
+        if self._lost_since_flush:
+            self._seq += 1
+            out.append({
+                "stamp": time.time(), "name": self.name,
+                "inst": self.incarnation,
+                "channel": "cluster", "prio": CLOG_WRN,
+                "message": f"{self._lost_since_flush} cluster log "
+                           f"entries shed (rate limited at "
+                           f"{self.name})",
+                "seq": self._seq})
+        self._pending = []
+        self._lost_since_flush = 0
+        return out
+
+    async def flush(self) -> int:
+        """Ship everything pending; returns entries sent.  A failed
+        send drops the batch (the cluster log is advisory — blocking a
+        daemon on mon availability would invert the dependency the way
+        the reference refuses to)."""
+        entries = self._drain()
+        if not entries or self.send_fn is None:
+            return 0
+        try:
+            await self.send_fn(entries)
+        except Exception as e:  # noqa: BLE001 — mon unreachable
+            self.lost_entries += len(entries)
+            self.log.dout("mon", 5,
+                          f"{self.name}: clog flush failed: {e}")
+            return 0
+        self.sent_entries += len(entries)
+        return len(entries)
+
+    def start(self) -> None:
+        """Begin the periodic flush loop (call once an event loop is
+        running)."""
+        if self._flush_task is not None or self.send_fn is None:
+            return
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(
+                    float(self._conf("mon_client_log_interval", 1.0)))
+                await self.flush()
+        self._flush_task = asyncio.ensure_future(loop())
+
+    async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        try:
+            await asyncio.wait_for(self.flush(), 1.0)
+        except Exception:  # noqa: BLE001 — shutting down anyway
+            pass
+
+    def dump(self) -> dict:
+        """Admin/report surface."""
+        return {"counts": dict(self.counts),
+                "pending": len(self._pending),
+                "sent": self.sent_entries,
+                "lost": self.lost_entries}

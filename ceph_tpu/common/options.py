@@ -399,6 +399,45 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="unix socket path for runtime admin commands"),
     Option("debug_default", int, 1, LEVEL_BASIC, min=0, max=20,
            desc="default per-subsystem debug level"),
+    # per-subsystem debug levels ('N' or the reference's 'G/O' form;
+    # empty = keep the Log defaults).  Runtime-mutable: 'config set
+    # debug_osd 10/5' retunes Log.set_level live via the observer in
+    # common/log.py (attach_debug_options).
+    *(Option(f"debug_{s}", str, "", LEVEL_ADVANCED,
+             desc=f"debug level for the {s!r} subsystem: gather "
+                  f"(ring) level, or 'gather/output'",
+             see_also=("debug_default",))
+      for s in ("ms", "osd", "mon", "mgr", "ec", "pg", "objectstore",
+                "client", "bench")),
+    # --- cluster log (clog) / LogMonitor ------------------------------------
+    Option("mon_client_log_interval", float, 1.0, LEVEL_ADVANCED,
+           min=0.02, desc="seconds between clog batch flushes from a "
+                          "daemon to the mon"),
+    Option("mon_client_log_max_pending", int, 64, LEVEL_ADVANCED,
+           min=1, desc="clog entries buffered per daemon between "
+                       "flushes; overflow is shed and summarized as "
+                       "one WRN entry (storm protection)"),
+    Option("mon_log_max", int, 1000, LEVEL_ADVANCED, min=1,
+           desc="cluster log entries the mon keeps per channel "
+                "(older entries trim; 'ceph log last' serves from "
+                "this window)", services=("mon",)),
+    # --- crash telemetry ----------------------------------------------------
+    Option("crash_dir", str, "", LEVEL_ADVANCED,
+           desc="directory for crash dumps (one meta.json per crash "
+                "under <crash_dir>/<daemon>/<crash_id>/; dumps found "
+                "at boot re-post to the mon).  Empty = in-memory only "
+                "(still posted to the mon).  tools/ceph_daemon.py "
+                "defaults it under the daemon's --data dir"),
+    Option("crash_log_tail", int, 100, LEVEL_ADVANCED, min=1,
+           desc="dout ring lines captured into each crash dump"),
+    Option("mgr_crash_warn_recent_age", float, 1209600.0,
+           LEVEL_ADVANCED, min=0.1,
+           desc="unarchived crash dumps newer than this raise the "
+                "RECENT_CRASH health warning (default two weeks)",
+           services=("mon", "mgr")),
+    Option("mon_crash_max", int, 256, LEVEL_ADVANCED, min=1,
+           desc="crash dumps the mon retains (oldest trim first)",
+           services=("mon",)),
     # --- objectstore --------------------------------------------------------
     Option("objectstore_type", str, "mem", LEVEL_ADVANCED, (FLAG_STARTUP,),
            enum_values=("mem", "file", "kv", "kvstore", "block",

@@ -171,6 +171,39 @@ class PrometheusModule(HttpModule):
                 lines.append(
                     f'ceph_slow_ops_total{{ceph_daemon="{name}"}} '
                     f'{int(so.get("total", 0))}')
+        # cluster-log + crash telemetry, also riding the report status
+        # (PR 3): always emitted (zero included) so the frozen-schema
+        # check and the shipped alert exprs never see a gap
+        # reporting daemons (OSDs) from their status, plus the mgr's own
+        # handles — its crashes must not be invisible to the very alert
+        # this exporter serves.  (mon telemetry surfaces through the
+        # mon itself: RECENT_CRASH health + 'ceph crash ls'.)
+        clog_rows = {name: rep.get("status", {}).get("clog") or {}
+                     for name, rep in self.mgr.reports.items()}
+        crash_rows = {name: rep.get("status", {}).get("crashes") or {}
+                      for name, rep in self.mgr.reports.items()}
+        # getattr: harnesses render through duck-typed mgr stands-ins
+        mgr_clog = getattr(self.mgr, "clog", None)
+        if mgr_clog is not None:
+            clog_rows["mgr"] = mgr_clog.counts
+        mgr_crash = getattr(self.mgr, "crash", None)
+        if mgr_crash is not None:
+            crash_rows["mgr"] = mgr_crash.dump()
+        lines.append("# TYPE ceph_clog_messages counter")
+        for name, counts in sorted(clog_rows.items()):
+            for sev in ("DBG", "INF", "WRN", "ERR", "SEC"):
+                lines.append(
+                    f'ceph_clog_messages{{ceph_daemon="{name}",'
+                    f'severity="{sev}"}} {int(counts.get(sev, 0))}')
+        lines.append("# TYPE ceph_crash_total counter")
+        lines.append("# TYPE ceph_recent_crash gauge")
+        for name, cr in sorted(crash_rows.items()):
+            lines.append(f'ceph_crash_total{{ceph_daemon="{name}"}} '
+                         f'{int(cr.get("total", 0))}')
+            # age-based daemon-side view; the mon's RECENT_CRASH check
+            # additionally honors 'ceph crash archive'
+            lines.append(f'ceph_recent_crash{{ceph_daemon="{name}"}} '
+                         f'{int(cr.get("recent", 0))}')
         seen: "set[str]" = set()
         for name, rep in sorted(self.mgr.reports.items()):
             for group, counters in rep.get("perf", {}).items():
@@ -224,7 +257,8 @@ class PrometheusModule(HttpModule):
 
 class MgrDaemon(Dispatcher):
     def __init__(self, config: "Optional[Config]" = None,
-                 addr: str = "local:mgr") -> None:
+                 addr: str = "local:mgr",
+                 mon_addrs: "Optional[Dict[int, str]]" = None) -> None:
         self.config = config or Config()
         self.addr = addr
         self.ms = Messenger.create("mgr", self.config)
@@ -237,6 +271,22 @@ class MgrDaemon(Dispatcher):
         # harness/deployer in mon-managed clusters); modules that ACT
         # (pg_autoscaler mode=on) need it, advisory ones don't
         self.mon_command = None
+        # clog + crash telemetry: with mon addresses, the mgr logs and
+        # posts crashes like any other daemon (its tick loop dying used
+        # to be perfectly silent)
+        self.monc = None
+        if mon_addrs:
+            from ..mon.client import MonClient
+            self.monc = MonClient(self.ms, mon_addrs)
+        from ..common.crash import CrashHandler
+        from ..common.logclient import LogClient
+        self.clog = LogClient(
+            "mgr", self.config,
+            send_fn=self.monc.send_log if self.monc else None)
+        self.crash = CrashHandler(
+            "mgr", self.config, clog=self.clog,
+            post_fn=self.monc.send_crash if self.monc else None)
+        self.admin_socket = None
         self.register_module(StatusModule)
         self.register_module(PrometheusModule)
         from .dashboard import DashboardModule
@@ -253,9 +303,30 @@ class MgrDaemon(Dispatcher):
     async def init(self) -> None:
         await self.ms.bind(self.addr)
         self.addr = self.ms.listen_addr
+        from ..common.log import attach_debug_options
+        attach_debug_options(self.config)
+        self.clog.start()
         for mod in self.modules.values():
             await mod.serve()
-        self._tasks.append(asyncio.ensure_future(self._tick_loop()))
+        self._tasks.append(self.crash.task(self._tick_loop(),
+                                           "tick_loop"))
+        self._start_admin_socket()
+        await self.crash.post_all()
+
+    def _start_admin_socket(self) -> None:
+        path = str(self.config.get("admin_socket"))
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+        from ..common.log import register_log_commands
+        a = AdminSocket(path.replace("$name", "mgr"))
+        register_log_commands(a)
+        a.register("status",
+                   lambda _c: {"num_reports": len(self.reports),
+                               "modules": sorted(self.modules)},
+                   "mgr status")
+        a.start()
+        self.admin_socket = a
 
     async def _tick_loop(self) -> None:
         """Periodic module work (reference mgr tick): currently the
@@ -275,6 +346,9 @@ class MgrDaemon(Dispatcher):
             t.cancel()
         for mod in self.modules.values():
             mod.shutdown()
+        await self.clog.stop()
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
         await self.ms.shutdown()
 
     def is_fresh(self, rep: dict, mult: float = 3.0) -> bool:
@@ -284,6 +358,10 @@ class MgrDaemon(Dispatcher):
         return time.monotonic() - rep["ts"] < mult * period
 
     async def ms_dispatch(self, conn, msg: Message) -> bool:
+        return await self.crash.dispatch_guard(
+            self._handle_report, conn, msg)
+
+    async def _handle_report(self, conn, msg: Message) -> bool:
         if msg.TYPE != "mgr_report":
             return False
         self.reports[str(msg["daemon"])] = {
@@ -328,6 +406,15 @@ async def report_loop(daemon, mgr_addr: str) -> None:
                            # health metrics riding MMgrReport)
                            "slow_ops":
                                daemon.op_tracker.slow_summary(),
+                           # clog per-severity counts + crash dump
+                           # tally (ceph_clog_messages / _crash series)
+                           "clog": dict(getattr(
+                               daemon, "clog").counts)
+                           if hasattr(daemon, "clog") else {},
+                           "crashes": {
+                               "total": len(daemon.crash.dumps),
+                               "recent": daemon.crash.recent_count()}
+                           if hasattr(daemon, "crash") else {},
                            # pool geometry for the dashboard +
                            # pg_autoscaler (reference: mgr consumes the
                            # osdmap directly; here it rides the report)

@@ -60,6 +60,21 @@ class DashboardModule(HttpModule):
                            "message": slow["message"]})
             if health == "HEALTH_OK":
                 health = "HEALTH_WARN"
+        # crash tallies ride the reports (age-based view; the mon's
+        # check additionally honors 'ceph crash archive')
+        crashed = sorted(
+            name for name, rep in self.mgr.reports.items()
+            if self.mgr.is_fresh(rep)
+            and int((rep.get("status", {}).get("crashes")
+                     or {}).get("recent", 0)))
+        if crashed:
+            checks.append({"check": "RECENT_CRASH",
+                           "severity": "HEALTH_WARN",
+                           "message": f"{len(crashed)} daemons have "
+                                      f"recent crash dumps "
+                                      f"({', '.join(crashed)})"})
+            if health == "HEALTH_OK":
+                health = "HEALTH_WARN"
         out = {"health": health, "checks": checks,
                "num_daemons": len(daemons), "num_up": up,
                "daemons": daemons, "pools": pools}

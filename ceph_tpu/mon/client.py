@@ -16,8 +16,8 @@ from ..common.log import dout
 from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..osd.osdmap import OSDMap
-from .messages import (MMonCommand, MMonCommandReply, MMonSubscribe,
-                       MOSDBeacon, MOSDBoot, MOSDFailure)
+from .messages import (MCrashReport, MLog, MMonCommand, MMonCommandReply,
+                       MMonSubscribe, MOSDBeacon, MOSDBoot, MOSDFailure)
 
 EAGAIN = 11
 
@@ -165,6 +165,36 @@ class MonClient(Dispatcher):
                 await conn.send_message(MOSDBeacon(fields))
             except (ConnectionError, OSError):
                 continue
+
+    async def send_log(self, entries: "List[dict]") -> None:
+        """Ship a clog batch (LogClient flush).  Sent to every mon —
+        peons forward to the leader, which dedups by (name, seq), so
+        the broadcast is loss-resistant without duplicating entries."""
+        sent = False
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MLog({"entries": list(entries)}))
+                sent = True
+            except (ConnectionError, OSError):
+                continue
+        if not sent:
+            raise MonClientError("no mon reachable for clog")
+
+    async def send_crash(self, meta: dict) -> None:
+        """Post one crash dump (ceph-crash analog); mon dedups by
+        crash_id, so re-posting on boot is safe."""
+        sent = False
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MCrashReport(
+                    {"dumps": [dict(meta)]}))
+                sent = True
+            except (ConnectionError, OSError):
+                continue
+        if not sent:
+            raise MonClientError("no mon reachable for crash post")
 
     async def report_failure(self, reporter: int, failed: int) -> None:
         for rank in sorted(self.mon_addrs):

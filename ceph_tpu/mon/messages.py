@@ -51,3 +51,20 @@ class MOSDBeacon(Message):
 class MOSDFailure(Message):
     """fields: reporter, failed_osd, since (reference MOSDFailure.h)."""
     TYPE = "osd_failure"
+
+
+@register_message
+class MLog(Message):
+    """Daemon -> mon cluster-log batch (reference MLog.h).  fields:
+    entries: [{stamp, name, channel, prio, message, seq}].  Peons
+    forward to the leader; the leader dedups by (name, seq) and
+    proposes through paxos (LogMonitor)."""
+    TYPE = "log"
+
+
+@register_message
+class MCrashReport(Message):
+    """Daemon -> mon crash dump post (the ceph-crash 'crash post'
+    analog).  fields: dumps: [crash meta dicts].  Dedup by crash_id on
+    the mon, so boot-time re-posts are idempotent."""
+    TYPE = "crash_report"

@@ -25,12 +25,17 @@ stop past the grace, or when enough peers report it failed
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..common.config import Config
-from ..common.log import dout
+from ..common.crash import CrashHandler, crash_summary
+from ..common.log import (attach_debug_options, dout,
+                          register_log_commands)
+from ..common.logclient import (CLOG_INF, SEVERITIES, LogClient,
+                                format_clog_line)
 from ..common.tracked_op import format_slow_ops
 from ..ec.registry import factory_from_profile
 from ..msg.message import Message
@@ -38,9 +43,9 @@ from ..msg.messenger import Dispatcher, Messenger
 from ..osd.messages import MOSDMapMsg
 from ..osd.osdmap import OSDMap, POOL_ERASURE, POOL_REPLICATED
 from .elector import Elector
-from .messages import (MMonCommand, MMonCommandReply, MMonElection,
-                       MMonPaxosMsg, MMonSubscribe, MOSDBeacon, MOSDBoot,
-                       MOSDFailure)
+from .messages import (MCrashReport, MLog, MMonCommand, MMonCommandReply,
+                       MMonElection, MMonPaxosMsg, MMonSubscribe,
+                       MOSDBeacon, MOSDBoot, MOSDFailure)
 from .paxos import Paxos, PaxosError, PaxosTransport
 
 EAGAIN = 11
@@ -90,6 +95,24 @@ class MonDaemon(Dispatcher):
         # mon_osd_min_down_reporters (reference OSDMonitor::
         # check_failure report expiry via failure_info_t)
         self.failure_reports: "Dict[int, Dict[int, float]]" = {}
+        # LogMonitor state (reference src/mon/LogMonitor.cc): the
+        # cluster log, per channel, rebuilt deterministically from the
+        # paxos log; trimmed at mon_log_max
+        self.cluster_log: "Dict[str, collections.deque]" = {}
+        self._clog_applied_seq: "Dict[str, int]" = {}   # commit dedup
+        self._clog_prefilter: "Dict[str, int]" = {}     # propose dedup
+        self._log_seq = 0                               # mon ordering
+        # crash service state (reference mgr crash module, stored
+        # mon-side here so health + 'crash ls' replicate with quorum)
+        self.crashes: "Dict[str, dict]" = {}
+        # this mon's own clog handle — audit entries and cluster events
+        # batch through it and land in the paxos log like any daemon's
+        self.clog = LogClient(f"mon.{rank}", self.config,
+                              send_fn=self._submit_log_entries)
+        self.crash = CrashHandler(f"mon.{rank}", self.config,
+                                  clog=self.clog,
+                                  post_fn=self._submit_crash_dump)
+        self.admin_socket = None
         self._tick_task: "Optional[asyncio.Task]" = None
         from ..common.lockdep import DepLock
         self._cmd_lock = DepLock("mon.command")
@@ -100,14 +123,48 @@ class MonDaemon(Dispatcher):
 
     async def init(self) -> None:
         await self.ms.bind(self.mon_addrs[self.rank])
+        attach_debug_options(self.config)
         self.running = True
-        self._tick_task = asyncio.ensure_future(self._tick_loop())
+        self.clog.start()
+        # the tick loop dying is exactly the kind of silent death the
+        # crash pipeline exists for (a mon that stops ticking stops
+        # marking OSDs down)
+        self._tick_task = self.crash.task(self._tick_loop(),
+                                          "tick_loop")
+        self._start_admin_socket()
         await self.elector.start_election()
+        await self.crash.post_all()
+
+    def _start_admin_socket(self) -> None:
+        path = str(self.config.get("admin_socket"))
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+        a = AdminSocket(path.replace("$name", f"mon.{self.rank}"))
+        register_log_commands(a)
+        a.register("status",
+                   lambda _c: {"rank": self.rank,
+                               "leader": self.elector.leader,
+                               "quorum": self.elector.quorum,
+                               "epoch": self.osdmap.epoch},
+                   "mon status")
+        a.register("config get",
+                   lambda c: {c["key"]: self.config.get(c["key"])},
+                   "read a config value")
+        a.register("config set",
+                   lambda c: (self.config.set(c["key"], c["value"]),
+                              {"success": True})[1],
+                   "set a config value at runtime")
+        a.start()
+        self.admin_socket = a
 
     async def shutdown(self) -> None:
         self.running = False
         if self._tick_task:
             self._tick_task.cancel()
+        await self.clog.stop()
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
         await self.ms.shutdown()
 
     @property
@@ -165,6 +222,52 @@ class MonDaemon(Dispatcher):
                     self.central_config[op["name"]] = op["value"]
                 elif op["op"] == "rm":
                     self.central_config.pop(op["name"], None)
+        elif txn.get("service") == "log":
+            # LogMonitor apply: entries land in per-channel rings with a
+            # mon-assigned total order.  (name+incarnation, seq) dedup
+            # is applied HERE, deterministically — the same committed
+            # order on every mon yields the same log (a racing
+            # double-propose of one batch collapses to one copy
+            # everywhere).  The incarnation keys a restarted daemon's
+            # fresh seq space away from its previous life's floor.
+            for e in txn["ops"]:
+                key = self._clog_key(e)
+                seq = int(e.get("seq", -1))
+                if key and seq >= 0:
+                    if seq <= self._clog_applied_seq.get(key, -1):
+                        continue
+                    self._clog_applied_seq[key] = seq
+                self._log_seq += 1
+                ch = str(e.get("channel", "cluster"))
+                ring = self.cluster_log.get(ch)
+                if ring is None:
+                    ring = collections.deque(
+                        maxlen=int(self.config.get("mon_log_max")))
+                    self.cluster_log[ch] = ring
+                ring.append(dict(e, mon_seq=self._log_seq))
+        elif txn.get("service") == "crash":
+            for op in txn["ops"]:
+                kind = op["op"]
+                if kind == "new":
+                    meta = dict(op["meta"])
+                    cid = str(meta.get("crash_id", ""))
+                    if cid and cid not in self.crashes:
+                        meta.setdefault("archived", False)
+                        self.crashes[cid] = meta
+                        keep = int(self.config.get("mon_crash_max"))
+                        while len(self.crashes) > keep:
+                            oldest = min(
+                                self.crashes,
+                                key=lambda c: self.crashes[c].get(
+                                    "stamp", 0.0))
+                            del self.crashes[oldest]
+                elif kind == "archive":
+                    c = self.crashes.get(str(op.get("id", "")))
+                    if c is not None:
+                        c["archived"] = True
+                elif kind == "archive_all":
+                    for c in self.crashes.values():
+                        c["archived"] = True
         elif txn.get("service") == "auth":
             # AuthMonitor analog (reference src/mon/AuthMonitor.cc):
             # entity db + rotating service secrets are paxos state so a
@@ -306,6 +409,75 @@ class MonDaemon(Dispatcher):
         value = json.dumps({"service": "auth", "ops": ops}).encode()
         return await self.paxos.propose(value)
 
+    # --- LogMonitor / crash-service submit paths -----------------------------
+
+    @staticmethod
+    def _clog_key(e: dict) -> str:
+        """Dedup identity of one wire entry: sender name + process
+        incarnation (a respawned daemon restarts seq at 1; keying by
+        name alone would drop its whole second life under the first
+        life's floor)."""
+        name = str(e.get("name", ""))
+        return f"{name}:{e.get('inst', '')}" if name else ""
+
+    async def _submit_log_entries(self, entries: "List[dict]") -> None:
+        """Route a clog batch toward the paxos log: the leader proposes
+        (after a (name+inst, seq) prefilter — the same batch arrives
+        once per mon via the client broadcast), a peon forwards to the
+        leader, and with no quorum the batch drops (the cluster log is
+        advisory; the daemon's local ring still has the entries)."""
+        if self.is_leader:
+            fresh = []
+            for e in entries:
+                key = self._clog_key(e)
+                seq = int(e.get("seq", -1))
+                if key and seq >= 0:
+                    floor = max(self._clog_prefilter.get(key, -1),
+                                self._clog_applied_seq.get(key, -1))
+                    if seq <= floor:
+                        continue
+                fresh.append(dict(e))
+            if not fresh:
+                return
+            try:
+                await self.paxos.propose(json.dumps(
+                    {"service": "log", "ops": fresh}).encode())
+            except PaxosError as e:
+                dout("mon", 5, f"clog propose failed: {e}")
+                return
+            # advance the prefilter only AFTER a successful propose: a
+            # failed one must leave the redundant broadcast copies
+            # (forwarded by the other mons) eligible to land the batch
+            for e in fresh:
+                key = self._clog_key(e)
+                seq = int(e.get("seq", -1))
+                if key and seq >= 0:
+                    self._clog_prefilter[key] = max(
+                        self._clog_prefilter.get(key, -1), seq)
+        elif self.elector.leader is not None \
+                and not self.elector.electing:
+            await self._send_mon(self.elector.leader,
+                                 MLog({"entries": list(entries)}))
+
+    async def _submit_crash_dump(self, meta: dict) -> None:
+        await self._submit_crash_dumps([meta])
+
+    async def _submit_crash_dumps(self, dumps: "List[dict]") -> None:
+        if self.is_leader:
+            ops = [{"op": "new", "meta": dict(m)} for m in dumps
+                   if str(m.get("crash_id", "")) not in self.crashes]
+            if not ops:
+                return
+            try:
+                await self.paxos.propose(json.dumps(
+                    {"service": "crash", "ops": ops}).encode())
+            except PaxosError as e:
+                dout("mon", 5, f"crash propose failed: {e}")
+        elif self.elector.leader is not None \
+                and not self.elector.electing:
+            await self._send_mon(self.elector.leader,
+                                 MCrashReport({"dumps": list(dumps)}))
+
     async def _ticket_authority(self, service: str):
         """Get (bootstrapping through paxos if needed) the rotating
         ticket authority for a service — the secret must be proposed so
@@ -322,6 +494,10 @@ class MonDaemon(Dispatcher):
     # --- dispatch -------------------------------------------------------------
 
     async def ms_dispatch(self, conn, msg: Message) -> bool:
+        return await self.crash.dispatch_guard(
+            self._ms_dispatch_inner, conn, msg)
+
+    async def _ms_dispatch_inner(self, conn, msg: Message) -> bool:
         t = msg.TYPE
         if t == "mon_election":
             if msg["op"] == "lease":
@@ -354,6 +530,10 @@ class MonDaemon(Dispatcher):
                 # re-used id must not inherit its predecessor's
                 # slow-op summary until its first beacon
                 self.osd_slow_ops.pop(osd, None)
+                if any(op["op"] == "add_osd" for op in ops):
+                    self.clog.cluster.info(
+                        f"osd.{osd} joined the cluster at {msg['addr']}")
+                self.clog.cluster.info(f"osd.{osd} boot")
                 await self._propose_osd_ops(ops)
             elif self.elector.leader is not None and \
                     not self.elector.electing:
@@ -365,6 +545,26 @@ class MonDaemon(Dispatcher):
                 msg.get("slow_ops") or {})
         elif t == "osd_failure":
             await self._handle_failure(msg)
+        elif t == "log":
+            await self._submit_log_entries(list(msg.get("entries") or []))
+        elif t == "crash_report":
+            dumps = list(msg.get("dumps") or [])
+            # newness check BEFORE the propose: the client broadcasts
+            # to every mon, and only the first arrival should echo into
+            # the cluster log (the store itself dedups by crash_id)
+            fresh = [m for m in dumps
+                     if str(m.get("crash_id", "")) not in self.crashes]
+            await self._submit_crash_dumps(dumps)
+            if self.is_leader:
+                for m in fresh:
+                    # surface the crash in the cluster log too, so
+                    # 'ceph log last' alone tells the story
+                    exc = m.get("exception", {})
+                    self.clog.cluster.error(
+                        f"{m.get('entity_name', '?')} crash dump "
+                        f"{m.get('crash_id', '?')}: "
+                        f"{exc.get('type', '?')}: "
+                        f"{exc.get('message', '')}")
         else:
             return False
         return True
@@ -394,6 +594,9 @@ class MonDaemon(Dispatcher):
         need = int(self.config.get("mon_osd_min_down_reporters"))
         if len(reporters) >= need:
             self.failure_reports.pop(failed, None)
+            self.clog.cluster.warn(
+                f"osd.{failed} marked down after {len(reporters)} "
+                f"failure report(s)")
             await self._propose_osd_ops(
                 [{"op": "mark_down", "osd": failed}])
 
@@ -428,9 +631,15 @@ class MonDaemon(Dispatcher):
                 seen = self.last_beacon.get(osd)
                 if info.up and seen is not None and now - seen > grace:
                     ops.append({"op": "mark_down", "osd": osd})
+                    self.clog.cluster.warn(
+                        f"osd.{osd} marked down: no beacon for "
+                        f"{now - seen:.1f}s (grace {grace}s)")
                 if not info.up and info.in_cluster and seen is not None \
                         and now - seen > down_out:
                     ops.append({"op": "mark_out", "osd": osd})
+                    self.clog.cluster.warn(
+                        f"osd.{osd} marked out after {down_out:.0f}s "
+                        f"down")
             if ops:
                 try:
                     await self._propose_osd_ops(ops)
@@ -455,6 +664,15 @@ class MonDaemon(Dispatcher):
             oldest = max(oldest, float(so.get("oldest_age", 0.0)))
             daemons.append(f"osd.{osd}")
         return count, oldest, daemons
+
+    def _recent_crashes(self) -> "List[dict]":
+        """Unarchived crash dumps inside the warn window (reference
+        mgr crash module RECENT_CRASH)."""
+        age = float(self.config.get("mgr_crash_warn_recent_age"))
+        now = time.time()
+        return [c for c in self.crashes.values()
+                if not c.get("archived")
+                and now - float(c.get("stamp", 0.0)) < age]
 
     def _health(self, slow_summary: "tuple | None" = None
                 ) -> "tuple[str, list]":
@@ -484,6 +702,16 @@ class MonDaemon(Dispatcher):
                            "severity": "HEALTH_WARN",
                            "message": f"{len(out)} osds out: "
                                       f"{sorted(out)}"})
+        recent = self._recent_crashes()
+        if recent:
+            entities = sorted({c.get("entity_name", "?")
+                               for c in recent})
+            checks.append({
+                "check": "RECENT_CRASH", "severity": "HEALTH_WARN",
+                "message": f"{len(recent)} recent crash"
+                           f"{'es' if len(recent) != 1 else ''} "
+                           f"({', '.join(entities)}); see 'ceph crash "
+                           f"ls', silence with 'ceph crash archive'"})
         if len(self.elector.quorum) <= len(self.mon_addrs) // 2:
             checks.append({"check": "MON_QUORUM",
                            "severity": "HEALTH_ERR",
@@ -515,6 +743,15 @@ class MonDaemon(Dispatcher):
                 result, out = -EAGAIN, {"error": str(e)}
             except Exception as e:  # noqa: BLE001 — command errors -> reply
                 result, out = -22, {"error": f"{type(e).__name__}: {e}"}
+        # every command leaves an audit-channel trail (reference
+        # Monitor::handle_command '[audit] from=... cmd=...: dispatch')
+        # — batched through this mon's clog, so a command storm costs
+        # one proposal per flush interval, not one per command
+        peer = str(getattr(conn, "peer_name", "") or "")
+        self.clog.audit.log(
+            CLOG_INF, f"from='{peer}' "
+                      f"cmd={json.dumps(cmd, sort_keys=True)}: "
+                      f"dispatch, result={result}")
         await conn.send_message(MMonCommandReply({
             "tid": tid, "result": result, "out": out}))
 
@@ -523,7 +760,10 @@ class MonDaemon(Dispatcher):
         "osd pool", "osd erasure-code-profile", "osd pg-upmap",
         "osd set", "osd unset", "osd out", "osd in", "osd down",
         "osd tier", "config set", "config rm", "auth get-or-create",
-        "auth caps", "auth rm", "auth rotate")
+        "auth caps", "auth rm", "auth rotate", "crash archive")
+    # exact-match writes (prefix-matching would swallow their read
+    # siblings: 'log' vs 'log last')
+    _MON_WRITE_EXACT = ("log",)
 
     def _check_mon_caps(self, conn, cmd: dict):
         """Per-entity mon caps at command dispatch (reference MonCap
@@ -554,8 +794,9 @@ class MonDaemon(Dispatcher):
             return -13, {"error": f"entity {peer!r} not authorized"}
         from ..auth.caps import Caps
         prefix = cmd.get("prefix", "")
-        need = "w" if any(prefix.startswith(p)
-                          for p in self._MON_WRITE_PREFIXES) else "r"
+        need = "w" if (prefix in self._MON_WRITE_EXACT
+                       or any(prefix.startswith(p)
+                              for p in self._MON_WRITE_PREFIXES)) else "r"
         if not Caps(ent.get("caps", "")).allows("mon", need):
             return -13, {"error": f"{peer}: mon cap {need!r} required "
                                   f"for {prefix!r}"}
@@ -813,7 +1054,7 @@ class MonDaemon(Dispatcher):
         if prefix == "status":
             up = sum(1 for o in self.osdmap.osds.values() if o.up)
             slow = self._slow_ops_summary()
-            status, _checks = self._health(slow)
+            status, checks = self._health(slow)
             slow_n, slow_oldest, _d = slow
             return 0, {
                 "mon": {"rank": self.rank, "quorum": self.elector.quorum,
@@ -825,7 +1066,10 @@ class MonDaemon(Dispatcher):
                 "slow_ops": {
                     "count": slow_n, "oldest_age": slow_oldest,
                     "message": format_slow_ops(slow_n, slow_oldest)},
-                "health": status}
+                "health": status,
+                # the checks themselves ride along ('ceph -s' shows
+                # RECENT_CRASH / SLOW_OPS details, not just the color)
+                "checks": checks}
         if prefix == "health":
             status, checks = self._health()
             return 0, {"status": status, "checks": checks}
@@ -879,6 +1123,72 @@ class MonDaemon(Dispatcher):
             await self._propose_osd_ops([{
                 "op": "pg_upmap", "pool": pool.pool_id, "pg": pg,
                 "mapping": mapping}])
+            return 0, {}
+        if prefix == "log last":
+            # 'ceph log last [n] [channel]' (reference LogMonitor):
+            # channel 'cluster' (default), 'audit', or '*' for the
+            # merged view in commit order
+            num = int(cmd.get("num", 20))
+            channel = str(cmd.get("channel", "cluster"))
+            if channel == "*":
+                entries = sorted(
+                    (e for ring in self.cluster_log.values()
+                     for e in ring),
+                    key=lambda e: e.get("mon_seq", 0))
+            else:
+                entries = list(self.cluster_log.get(channel, ()))
+            level = cmd.get("level")
+            if level:
+                order = {s: i for i, s in enumerate(SEVERITIES)}
+                if str(level).upper() not in order:
+                    return -22, {"error": f"bad level {level!r}"}
+                want = order[str(level).upper()]
+                entries = [e for e in entries
+                           if order.get(str(e.get("prio")), 1) >= want]
+            if num > 0:
+                entries = entries[-num:]
+            return 0, {"entries": [dict(e) for e in entries],
+                       "lines": [format_clog_line(e) for e in entries]}
+        if prefix == "log":
+            # operator injection: 'ceph log <message>' drops a marker
+            # into the cluster log (reference Monitor 'log' command) —
+            # the canonical "maintenance starts here" breadcrumb
+            message = str(cmd.get("message", "")).strip()
+            if not message:
+                return -22, {"error": "empty log message"}
+            prio = str(cmd.get("level", CLOG_INF)).upper()
+            if prio not in SEVERITIES:
+                return -22, {"error": f"bad level {prio!r}"}
+            entry = {"stamp": time.time(),
+                     "name": peer or f"mon.{self.rank}",
+                     "channel": str(cmd.get("channel", "cluster")),
+                     "prio": prio, "message": message, "seq": -1}
+            await self.paxos.propose(json.dumps(
+                {"service": "log", "ops": [entry]}).encode())
+            return 0, {}
+        if prefix == "crash ls":
+            rows = [crash_summary(m) for m in
+                    sorted(self.crashes.values(),
+                           key=lambda m: m.get("stamp", 0.0))]
+            return 0, {"crashes": rows,
+                       "recent": len(self._recent_crashes())}
+        if prefix == "crash info":
+            meta = self.crashes.get(str(cmd.get("id", "")))
+            if meta is None:
+                return -2, {"error": f"no crash {cmd.get('id')!r}"}
+            return 0, {"crash": dict(meta)}
+        if prefix == "crash archive":
+            cid = str(cmd.get("id", ""))
+            if cid not in self.crashes:
+                return -2, {"error": f"no crash {cid!r}"}
+            await self.paxos.propose(json.dumps(
+                {"service": "crash",
+                 "ops": [{"op": "archive", "id": cid}]}).encode())
+            return 0, {}
+        if prefix == "crash archive-all":
+            await self.paxos.propose(json.dumps(
+                {"service": "crash",
+                 "ops": [{"op": "archive_all"}]}).encode())
             return 0, {}
         if prefix == "config set":
             value = json.dumps({"service": "config", "ops": [

@@ -125,6 +125,24 @@ class OSDDaemon(Dispatcher):
         self.op_scheduler = MClockScheduler.from_config(self.config)
         # per-op event timelines + historic ops (reference TrackedOp)
         self.op_tracker = OpTracker.from_config(self.config)
+        # cluster log + crash telemetry (reference LogClient +
+        # ceph-crash): clog batches significant events to the mon's
+        # LogMonitor; the crash handler persists dumps for any task
+        # loop / dispatch path that dies on an unhandled exception
+        from ..common.crash import CrashHandler
+        from ..common.logclient import LogClient
+        self.clog = LogClient(
+            f"osd.{osd_id}", self.config,
+            send_fn=self.monc.send_log if self.monc is not None
+            else None)
+        self.crash = CrashHandler(
+            f"osd.{osd_id}", self.config,
+            op_tracker=self.op_tracker, clog=self.clog,
+            post_fn=self.monc.send_crash if self.monc is not None
+            else None)
+        # QA: next matching path raises an unhandled exception
+        # ('injectcrash' admin command / chaos_check --expect-crash-dump)
+        self._crash_injected: "Optional[str]" = None
         self.admin_socket = None
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
@@ -188,6 +206,9 @@ class OSDDaemon(Dispatcher):
 
     async def init(self) -> None:
         self.store.mount()
+        from ..common.log import attach_debug_options
+        attach_debug_options(self.config)
+        self.clog.start()
         self._load_consumed_pg_nums()
         addr = self.osdmap.get_addr(self.whoami) if self.monc is None \
             else self.addr
@@ -208,7 +229,8 @@ class OSDDaemon(Dispatcher):
             else:
                 dout("osd", 0, f"osd.{self.whoami}: boot not acknowledged "
                                f"by any mon; serving anyway")
-            self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+            self._beacon_task = self.crash.task(self._beacon_loop(),
+                                                "beacon_loop")
             if str(self.config.get("auth_client_required")) == "cephx":
                 await self._refresh_service_keys()
         # load_pgs: re-instantiate backends for collections on disk
@@ -218,12 +240,17 @@ class OSDDaemon(Dispatcher):
         self._start_admin_socket()
         if self.mgr_addr:
             from ..mgr.daemon import report_loop
-            self._mgr_task = asyncio.ensure_future(
-                report_loop(self, self.mgr_addr))
+            self._mgr_task = self.crash.task(
+                report_loop(self, self.mgr_addr), "mgr_report_loop")
         self.up = True
         # writeback tiering agent (no-ops unless cache pools exist)
-        self._agent_task = asyncio.ensure_future(self._cache_agent_loop())
+        self._agent_task = self.crash.task(self._cache_agent_loop(),
+                                           "cache_agent_loop")
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
+        self.clog.info(f"osd.{self.whoami} up at {self.ms.listen_addr}")
+        # dumps from previous incarnations (kill -9 + respawn against
+        # the same crash_dir) re-post; the mon dedups by crash_id
+        await self.crash.post_all()
 
     # --- peering on map change (reference: new interval -> PG peers) ---------
 
@@ -313,7 +340,8 @@ class OSDDaemon(Dispatcher):
                         self._split_done(pool_id)
                     else:
                         self._split_pending[pool_id] = left
-            self._split_task = asyncio.ensure_future(run_splits())
+            self._split_task = self.crash.task(run_splits(),
+                                               "pg_split")
         for pool_id, pool in osdmap.pools.items():
             for pg in range(pool.pg_num):
                 _u, acting = osdmap.pg_to_up_acting_osds(pool_id, pg)
@@ -862,6 +890,21 @@ class OSDDaemon(Dispatcher):
                        int(c.get("offset", 0))),
                    "QA: flip a byte of a stored shard chunk so deep "
                    "scrub / read-path crc must detect it")
+        a.register("injectcrash",
+                   lambda c: self.inject_crash(str(c.get("where",
+                                                         "op"))),
+                   "QA: next client op dies on an unhandled exception "
+                   "(exercises crash dump + clog ERR + RECENT_CRASH)")
+        a.register("crash ls",
+                   lambda _c: {"crashes": self.crash.ls(),
+                               **self.crash.dump()},
+                   "crash dumps this daemon has captured")
+        a.register("clog stats",
+                   lambda _c: self.clog.dump(),
+                   "cluster-log client counters (per-severity counts, "
+                   "sent/lost/pending)")
+        from ..common.log import register_log_commands
+        register_log_commands(a)
         a.register("config get",
                    lambda c: {c["key"]: self.config.get(c["key"])},
                    "read a config value")
@@ -895,6 +938,16 @@ class OSDDaemon(Dispatcher):
         a.start()
         self.admin_socket = a
 
+    def inject_crash(self, where: str = "op") -> dict:
+        """QA (chaos_check --expect-crash-dump / tests): arm a one-shot
+        unhandled exception in the named path ('op': the next client op
+        handler).  The crash pipeline must then produce a dump, a clog
+        ERR, and RECENT_CRASH — if it doesn't, the gate fails."""
+        if where not in ("op",):
+            raise ValueError(f"unknown injection point {where!r}")
+        self._crash_injected = where
+        return {"armed": where}
+
     async def shutdown(self) -> None:
         self.up = False
         if self._beacon_task:
@@ -903,6 +956,8 @@ class OSDDaemon(Dispatcher):
             self._agent_task.cancel()
         if self._mgr_task:
             self._mgr_task.cancel()
+        # flush pending clog entries while the messenger still works
+        await self.clog.stop()
         if self.admin_socket is not None:
             self.admin_socket.stop()
         await self.ms.shutdown()
@@ -1231,6 +1286,13 @@ class OSDDaemon(Dispatcher):
             trace_id=str(tr.get("id", "")))
 
     async def ms_dispatch(self, conn, msg: Message) -> bool:
+        """Crash-guarded dispatch: an unhandled exception in any
+        message path leaves a crash dump before propagating — 'the OSD
+        stopped replying' becomes a one-command diagnosis."""
+        return await self.crash.dispatch_guard(
+            self._ms_dispatch_inner, conn, msg)
+
+    async def _ms_dispatch_inner(self, conn, msg: Message) -> bool:
         t = msg.TYPE
         if t in ("ec_sub_write", "ec_sub_read", "pg_query", "pg_push",
                  "pg_rewind") and self._splitting_old:
@@ -1265,7 +1327,10 @@ class OSDDaemon(Dispatcher):
                     asyncio.ensure_future(_deliver_after_split())
                     return True
         if t == "osd_op":
-            asyncio.ensure_future(self._handle_client_op(conn, msg))
+            # crash-wrapped: a client-op handler dying unhandled is
+            # exactly the post-mortem case (the client just times out)
+            self.crash.task(self._handle_client_op(conn, msg),
+                            "client_op")
         elif t == "ec_sub_write":
             pgid_m = (int(msg["pgid"][0]), int(msg["pgid"][1]))
             wrong = None
@@ -1392,6 +1457,15 @@ class OSDDaemon(Dispatcher):
             f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
             trace_id=str(msg.get("trace_id", "")))
         with top:
+            if self._crash_injected == "op" \
+                    and not bool(msg.get("internal")):
+                # QA one-shot: die UNHANDLED (past the errno-mapping
+                # try below), exercising the whole crash pipeline; the
+                # client's retry after the op timeout then succeeds
+                self._crash_injected = None
+                raise RuntimeError(
+                    "injected unhandled exception in op handler "
+                    "(injectcrash)")
             if bool(msg.get("internal")):
                 # cluster-internal op (a copy_from read another primary
                 # issued): must NOT queue behind the CLIENT class — the

@@ -73,7 +73,8 @@ class MiniCluster:
             from ..mgr import MgrDaemon
             self.mgr = MgrDaemon(
                 self.config,
-                addr="127.0.0.1:0" if self._tcp else "local:mgr")
+                addr="127.0.0.1:0" if self._tcp else "local:mgr",
+                mon_addrs=self.mon_addrs or None)
             await self.mgr.init()
             for osd in self.osds.values():
                 osd.mgr_addr = self.mgr.addr

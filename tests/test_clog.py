@@ -1,0 +1,341 @@
+"""Cluster log, audit channel, and crash telemetry (PR: observability).
+
+clog (common/logclient.py) -> MLog -> paxos LogMonitor (mon/monitor.py)
+-> 'ceph log last', plus ceph-crash-style dump capture
+(common/crash.py) -> 'ceph crash ls/info/archive' and the RECENT_CRASH
+health warning.  Reference: src/common/LogClient.h, src/mon/
+LogMonitor.cc, src/ceph-crash + the mgr crash module.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.log import Log, get_log
+from ceph_tpu.common.logclient import (CLOG_ERR, CLOG_INF, CLOG_WRN,
+                                       LogClient, format_clog_line)
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _cfg(tmp_path=None, **kw) -> Config:
+    cfg = Config()
+    cfg.set("mon_client_log_interval", 0.1)
+    cfg.set("mgr_crash_warn_recent_age", 120.0)
+    if tmp_path is not None:
+        cfg.set("crash_dir", str(tmp_path / "crash"))
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return cfg
+
+
+# ------------------------------------------------------------------- units
+
+def test_logclient_dedup_collapses_storm():
+    """Satellite: a storm of one message flushes as ONE entry with a
+    repeat suffix — the mon pays O(flush), not O(events)."""
+    sent = []
+
+    async def send(entries):
+        sent.extend(entries)
+
+    lc = LogClient("osd.9", None, send_fn=send)
+    for _ in range(500):
+        lc.cluster.warn("queue full")
+    lc.cluster.error("gave up")
+    asyncio.new_event_loop().run_until_complete(lc.flush())
+    assert len(sent) == 2, sent
+    assert "[repeated 500 times]" in sent[0]["message"]
+    assert sent[0]["prio"] == CLOG_WRN
+    assert sent[1]["prio"] == CLOG_ERR
+    assert lc.counts[CLOG_WRN] == 500
+    assert lc.counts[CLOG_ERR] == 1
+
+
+def test_logclient_pending_cap_sheds_and_summarizes():
+    sent = []
+
+    async def send(entries):
+        sent.extend(entries)
+
+    cfg = Config()
+    cfg.set("mon_client_log_max_pending", 4)
+    lc = LogClient("osd.9", cfg, send_fn=send)
+    for i in range(50):
+        lc.cluster.info(f"distinct event {i}")   # no dedup possible
+    asyncio.new_event_loop().run_until_complete(lc.flush())
+    # 4 kept + 1 shed-summary WRN
+    assert len(sent) == 5, [e["message"] for e in sent]
+    assert "shed" in sent[-1]["message"]
+    assert sent[-1]["prio"] == CLOG_WRN
+    assert lc.lost_entries == 46
+    # counters still saw every event
+    assert lc.counts[CLOG_INF] == 50
+
+
+def test_logclient_dbg_stays_local():
+    sent = []
+
+    async def send(entries):
+        sent.extend(entries)
+
+    lc = LogClient("x", None, send_fn=send)
+    lc.cluster.debug("noisy")
+    asyncio.new_event_loop().run_until_complete(lc.flush())
+    assert not sent
+    assert lc.counts["DBG"] == 1
+
+
+def test_dout_subsecond_timestamp_and_derr_stderr(capsys):
+    """Satellite: dout stamps carry sub-second precision, and derr
+    with no stream configured still reaches stderr."""
+    log = Log("t", stream=None)
+    log.dout("osd", 1, "plain")           # level 1 > output nowhere
+    log.derr("osd", "it broke")
+    err = capsys.readouterr().err
+    assert "it broke" in err              # derr fell back to stderr
+    assert "plain" not in err             # non-error stayed ring-only
+    line = list(log._ring)[0]
+    ts = line.split()[0]
+    assert "." in ts and len(ts.split(".")[1]) == 6, line
+
+
+def test_format_clog_line():
+    line = format_clog_line({"stamp": 0.0, "name": "osd.1",
+                             "channel": "cluster", "prio": "ERR",
+                             "message": "boom"})
+    assert "osd.1 (cluster) [ERR] : boom" in line
+
+
+# ---------------------------------------------------- end-to-end (mon mode)
+
+def test_clog_reaches_log_last_and_audit_records_commands(loop, tmp_path):
+    """Acceptance: OSD clog entries appear in 'ceph log last' through a
+    real MiniCluster; the audit channel records mon commands; operator
+    injection works; the rate limit collapses a storm end to end."""
+    async def go():
+        async with MiniCluster(n_osds=3, n_mons=1,
+                               config=_cfg(tmp_path)) as c:
+            await c.create_ec_pool_cmd(
+                "p", {"plugin": "jax_rs", "k": "2", "m": "1"}, pg_num=2)
+            admin = await c._admin_client()
+
+            # boot events from the OSDs' clog handles flow to the mon
+            await asyncio.sleep(0.3)
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 50, "channel": "cluster"})
+            lines = out["lines"]
+            assert any("osd.0 boot" in l for l in lines), lines
+            assert any(l.split()[1] == "osd.0" for l in lines), lines
+
+            # audit channel recorded the pool-create commands
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 50, "channel": "audit"})
+            assert any("osd pool create" in l and
+                       "from='client.admin'" in l
+                       for l in out["lines"]), out["lines"]
+
+            # operator injection: 'ceph log <message>'
+            await admin.mon_command(
+                {"prefix": "log", "message": "maintenance starts"})
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 5, "channel": "cluster"})
+            assert any("maintenance starts" in l for l in out["lines"])
+
+            # a clog storm from one daemon collapses via dedup: one
+            # wire entry, not 300
+            mon = c.leader_mon()
+            before = len(mon.cluster_log["cluster"])
+            for _ in range(300):
+                c.osds[1].clog.warn("op queue saturated")
+            await asyncio.sleep(0.4)
+            ring = list(mon.cluster_log["cluster"])
+            storm = [e for e in ring[before:]
+                     if "op queue saturated" in e["message"]]
+            assert len(storm) == 1, [e["message"] for e in storm]
+            assert "[repeated 300 times]" in storm[0]["message"]
+
+            # severity filter
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 50, "channel": "cluster",
+                 "level": "WRN"})
+            assert all(" [WRN] " in l or " [ERR] " in l
+                       or " [SEC] " in l for l in out["lines"])
+    loop.run_until_complete(go())
+
+
+def test_crash_dump_and_recent_crash_health(loop, tmp_path):
+    """Acceptance: an injected unhandled exception in an OSD op handler
+    yields (a) a crash dump listable via 'ceph crash ls' with traceback
+    and ring tail, (b) RECENT_CRASH in 'ceph status' that clears after
+    'ceph crash archive', (c) a cluster-log ERR via 'ceph log last'."""
+    async def go():
+        cfg = _cfg(tmp_path, rados_osd_op_timeout=1.0)
+        async with MiniCluster(n_osds=3, n_mons=1, config=cfg) as c:
+            await c.create_ec_pool_cmd(
+                "p", {"plugin": "jax_rs", "k": "2", "m": "1"}, pg_num=2)
+            admin = await c._admin_client()
+            io = admin.io_ctx("p")
+            await io.write_full("obj", b"a" * 256)
+
+            # find the primary that will serve "obj" and arm the crash
+            pool = admin.osdmap.pool_by_name("p")
+            pg = admin.osdmap.object_to_pg(pool.pool_id, "obj")
+            _u, acting = admin.osdmap.pg_to_up_acting_osds(
+                pool.pool_id, pg)
+            victim = c.osds[admin.osdmap.primary_of(acting)]
+            victim.inject_crash()
+            # the armed op dies unhandled; the objecter's retry after
+            # the op timeout then succeeds (one-shot injection)
+            await io.write_full("obj", b"b" * 256)
+            assert await io.read("obj") == b"b" * 256
+
+            await asyncio.sleep(0.3)        # crash post + clog flush
+            # (a) crash ls + info with traceback and ring tail
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert out["recent"] >= 1, out
+            row = out["crashes"][-1]
+            assert row["entity_name"] == f"osd.{victim.whoami}"
+            assert not row["archived"]
+            info = await admin.mon_command(
+                {"prefix": "crash info", "id": row["crash_id"]})
+            meta = info["crash"]
+            assert "injectcrash" in meta["exception"]["message"]
+            assert any("RuntimeError" in l for l in meta["backtrace"])
+            assert meta["recent_events"], meta.keys()
+            assert meta["context"] == "client_op"
+            # the dump persisted to the crash directory too
+            path = os.path.join(str(tmp_path / "crash"),
+                                f"osd.{victim.whoami}",
+                                row["crash_id"], "meta.json")
+            with open(path) as f:
+                assert json.load(f)["crash_id"] == row["crash_id"]
+
+            # (b) RECENT_CRASH in ceph status, cleared by archive
+            st = await admin.mon_command({"prefix": "status"})
+            assert st["health"] == "HEALTH_WARN"
+            assert any(ch["check"] == "RECENT_CRASH"
+                       for ch in st["checks"]), st
+            await admin.mon_command(
+                {"prefix": "crash archive", "id": row["crash_id"]})
+            st = await admin.mon_command({"prefix": "status"})
+            assert not any(ch["check"] == "RECENT_CRASH"
+                           for ch in st["checks"]), st
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert out["crashes"][-1]["archived"]
+
+            # (c) cluster-log ERR entry for the crash
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 10, "channel": "cluster",
+                 "level": "ERR"})
+            assert any("crash" in l and f"osd.{victim.whoami}" in l
+                       for l in out["lines"]), out["lines"]
+    loop.run_until_complete(go())
+
+
+def test_crash_archive_all_and_unknown_ids(loop, tmp_path):
+    async def go():
+        async with MiniCluster(n_osds=3, n_mons=1,
+                               config=_cfg(tmp_path)) as c:
+            admin = await c._admin_client()
+            # post two synthetic crashes through the daemon pipeline
+            for osd in (c.osds[0], c.osds[1]):
+                osd.crash.capture(RuntimeError("synthetic"), "test")
+            await asyncio.sleep(0.3)
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert len(out["crashes"]) == 2, out
+            from ceph_tpu.client.objecter import ObjecterError
+            from ceph_tpu.mon.client import MonClientError
+            with pytest.raises((MonClientError, ObjecterError)):
+                await admin.mon_command(
+                    {"prefix": "crash info", "id": "nope"})
+            await admin.mon_command({"prefix": "crash archive-all"})
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert all(r["archived"] for r in out["crashes"])
+            assert out["recent"] == 0
+            st = await admin.mon_command({"prefix": "health"})
+            assert not any(ch["check"] == "RECENT_CRASH"
+                           for ch in st["checks"])
+    loop.run_until_complete(go())
+
+
+def test_crash_dump_reposts_after_restart(loop, tmp_path):
+    """ceph-crash semantics: dumps on disk re-post at boot; the mon
+    dedups by crash_id."""
+    async def go():
+        async with MiniCluster(n_osds=3, n_mons=1,
+                               config=_cfg(tmp_path)) as c:
+            admin = await c._admin_client()
+            meta = c.osds[0].crash.capture(ValueError("died"), "test")
+            await asyncio.sleep(0.3)
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert [r["crash_id"] for r in out["crashes"]] \
+                == [meta["crash_id"]]
+            await c.kill_osd(0)
+            await c.revive_osd(0)
+            # the revived daemon reloaded + re-posted the dump
+            assert meta["crash_id"] in c.osds[0].crash.dumps
+            await asyncio.sleep(0.3)
+            out = await admin.mon_command({"prefix": "crash ls"})
+            assert len(out["crashes"]) == 1     # deduped, not doubled
+            # the SECOND life's clog entries must land too: its seqs
+            # restart at 1, and only the per-process incarnation keeps
+            # them clear of the first life's dedup floor
+            out = await admin.mon_command(
+                {"prefix": "log last", "num": 100,
+                 "channel": "cluster"})
+            ups = [l for l in out["lines"]
+                   if "osd.0 up at" in l]
+            assert len(ups) == 2, out["lines"]
+    loop.run_until_complete(go())
+
+
+# --------------------------------------------------- admin-socket log verbs
+
+def test_admin_socket_log_verbs(loop, tmp_path):
+    """Satellite: 'log dump' / 'log set-level' / 'log get-level' on a
+    daemon admin socket (the previously dead Log.dump_recent)."""
+    async def go():
+        cfg = _cfg(tmp_path)
+        cfg.set("admin_socket", str(tmp_path / "$name.asok"))
+        async with MiniCluster(n_osds=3, config=cfg) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=2, stripe_unit=64)
+            client = await c.client()
+            await client.io_ctx("p").write_full("o", b"z" * 128)
+            from ceph_tpu.common.admin_socket import admin_command
+            sock = str(tmp_path / "osd.0.asok")
+
+            def run(prefix, **kw):
+                return admin_command(sock, prefix, **kw)
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: run("log dump", num=20))
+            assert out["count"] > 0
+            assert len(out["lines"]) <= 20
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: run("log set-level", subsys="osd",
+                                  gather=15, output=3))
+            assert out["osd"] == {"gather": 15, "output": 3}
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: run("log get-level", subsys="osd"))
+            assert out["osd"] == {"gather": 15, "output": 3}
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: run("log get-level"))
+            assert "ms" in out and "osd" in out
+            # mon-less client socket got the verbs too
+            csock = str(tmp_path / f"{client.ms.name}.asok")
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: admin_command(csock, "log get-level"))
+            assert "osd" in out
+        get_log().set_level("osd", 5, 1)    # restore for other tests
+    loop.run_until_complete(go())

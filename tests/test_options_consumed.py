@@ -23,9 +23,48 @@ def loop():
 def test_schema_covers_major_subsystems():
     names = set(OPTIONS)
     for fam in ("osd_recovery_", "osd_scrub_", "osd_mclock_", "mon_",
-                "ms_", "objecter_", "client_striper_", "rados_"):
+                "ms_", "objecter_", "client_striper_", "rados_",
+                "debug_", "crash_"):
         assert any(n.startswith(fam) for n in names), fam
     assert len(names) >= 90
+
+
+def test_debug_options_map_to_log_levels(loop):
+    """Satellite: 'config set debug_<subsys> N[/M]' retunes
+    Log.set_level at runtime through the observer machinery — both at
+    daemon init (pre-set values) and on later runtime sets."""
+    from ceph_tpu.common.log import get_log
+
+    async def go():
+        cfg = Config()
+        cfg.set("debug_pg", "12")           # pre-init value applies
+        async with MiniCluster(n_osds=3, config=cfg) as c:
+            log = get_log()
+            assert log.get_level("pg") == (12, 12)
+            # runtime change via the same path the admin-socket
+            # 'config set' and mon central config use
+            cfg.set("debug_osd", "10/4")
+            assert log.get_level("osd") == (10, 4)
+            cfg.set("debug_osd", "7")
+            assert log.get_level("osd") == (7, 7)
+            # a bad value is rejected without wedging the observer
+            cfg.set("debug_ms", "not-a-level")
+            g, o = log.get_level("ms")
+            cfg.set("debug_ms", "9/2")
+            assert log.get_level("ms") == (9, 2)
+            # gathered-at-new-level entries land in the ring
+            c.osds[0].ms  # touch to keep the cluster referenced
+        log.set_level("osd", 5, 1)
+        log.set_level("pg", 5, 1)
+        log.set_level("ms", 5, 1)
+    loop.run_until_complete(go())
+
+
+def test_debug_options_runtime_mutable_flags():
+    for name, opt in OPTIONS.items():
+        if name.startswith("debug_") and name != "debug_default":
+            assert opt.is_runtime(), name
+            assert opt.type is str, name
 
 
 def test_pg_log_trimming_respects_limits(loop):

@@ -298,7 +298,44 @@ REQUIRED_PROM_SERIES = {
     "ceph_kernel_encode_gbs_sum", "ceph_kernel_encode_gbs_count",
     "ceph_op_w_queue_lat_bucket", "ceph_op_w_encode_lat_bucket",
     "ceph_subop_w_rtt_bucket", "ceph_op_w_commit_lat_bucket",
+    # cluster log + crash telemetry (PR 3): emitted for every daemon
+    # even at zero, so the RECENT_CRASH alert and the clog-rate panels
+    # never see series gaps
+    "ceph_clog_messages", "ceph_crash_total", "ceph_recent_crash",
 }
+
+
+def test_clog_and_crash_series_with_labels(loop):
+    """ceph_clog_messages carries a severity label and counts real clog
+    traffic; ceph_crash_total / ceph_recent_crash follow crash capture."""
+    async def go():
+        cfg = Config()
+        cfg.set("mgr_stats_period", 0.1)
+        cfg.set("mgr_prometheus_port", 0)
+        async with MiniCluster(n_osds=3, config=cfg, mgr=True) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=2, stripe_unit=512)
+            c.osds[0].clog.warn("something odd")
+            c.osds[0].clog.warn("something odd")
+            c.osds[0].crash.capture(RuntimeError("boom"), "test")
+            await asyncio.sleep(0.3)
+            body = await _http_get(c.mgr.prometheus_port())
+            series = _parse_series(body)
+            assert series['ceph_clog_messages{ceph_daemon="osd.0",'
+                          'severity="WRN"}'] == 2
+            # the crash capture itself clogs one ERR
+            assert series['ceph_clog_messages{ceph_daemon="osd.0",'
+                          'severity="ERR"}'] >= 1
+            assert series['ceph_clog_messages{ceph_daemon="osd.1",'
+                          'severity="WRN"}'] == 0
+            assert series['ceph_crash_total{ceph_daemon="osd.0"}'] == 1
+            assert series['ceph_recent_crash{ceph_daemon="osd.0"}'] == 1
+            assert series['ceph_crash_total{ceph_daemon="osd.1"}'] == 0
+            # dashboard surfaces RECENT_CRASH from the same reports
+            snap = c.mgr.modules["dashboard"].snapshot()
+            assert any(ch["check"] == "RECENT_CRASH"
+                       for ch in snap["checks"]), snap
+    loop.run_until_complete(go())
 
 
 def test_metric_schema_frozen(loop):

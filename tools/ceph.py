@@ -38,7 +38,10 @@ _PREFIXES = ["osd erasure-code-profile set", "osd erasure-code-profile get",
              "osd erasure-code-profile ls", "osd erasure-code-profile rm",
              "osd pool create", "osd pool ls", "osd dump", "osd tree",
              "osd down", "osd out", "osd in", "status", "health",
-             "config get", "config set"]
+             "config get", "config set",
+             "log last", "log",
+             "crash ls", "crash info", "crash archive-all",
+             "crash archive"]
 
 
 def build_cmd(words: "list[str]", kwargs: dict) -> dict:
@@ -67,6 +70,27 @@ def build_cmd(words: "list[str]", kwargs: dict) -> dict:
         # the value is everything after the name (spaces preserved)
         cmd["value"] = (" ".join(rest[1:]) if len(rest) > 1
                         else kwargs.get("value"))
+    elif prefix == "log last":
+        # ceph log last [n] [channel] [level]
+        if rest and rest[0].isdigit():
+            cmd["num"] = int(rest.pop(0))
+        if rest:
+            cmd["channel"] = rest.pop(0)
+        if rest:
+            cmd["level"] = rest.pop(0)
+    elif prefix == "log":
+        # ceph log <message...>: operator breadcrumb into the cluster log
+        if not rest:
+            raise SystemExit("log: needs a message")
+        cmd["message"] = " ".join(rest)
+        if "channel" in kwargs:
+            cmd["channel"] = kwargs["channel"]
+        if "level" in kwargs:
+            cmd["level"] = kwargs["level"]
+    elif prefix in ("crash info", "crash archive"):
+        if not rest:
+            raise SystemExit(f"{prefix}: needs a crash id")
+        cmd["id"] = rest[0]
     return cmd
 
 
@@ -103,8 +127,27 @@ def main(argv=None) -> int:
     if args.words[0] == "daemon":
         # admin-socket passthrough (reference 'ceph daemon <sock> cmd')
         from ceph_tpu.common.admin_socket import admin_command
-        path, prefix = args.words[1], " ".join(args.words[2:])
+        path, words = args.words[1], list(args.words[2:])
         kwargs = dict(kv.split("=", 1) for kv in args.kw)
+        # positional forms for the log verbs:
+        #   ceph daemon <sock> log set-level <subsys> <gather> [output]
+        #   ceph daemon <sock> log get-level [subsys]
+        #   ceph daemon <sock> log dump [n]
+        if words[:2] == ["log", "set-level"]:
+            if len(words) < 4:
+                p.error("log set-level <subsys> <gather> [output]")
+            kwargs.update(subsys=words[2], gather=words[3])
+            if len(words) > 4:
+                kwargs["output"] = words[4]
+            words = words[:2]
+        elif words[:2] == ["log", "get-level"]:
+            if len(words) > 2:
+                kwargs["subsys"] = words[2]
+            words = words[:2]
+        elif words[:2] == ["log", "dump"] and len(words) > 2:
+            kwargs["num"] = words[2]
+            words = words[:2]
+        prefix = " ".join(words)
         print(json.dumps(admin_command(path, prefix, **kwargs), indent=1))
         return 0
 

@@ -7,12 +7,22 @@ tcp sockets, persist to sqlite-backed FileStores, and can be kill -9'd
 and respawned against the same data directory.
 
   python tools/ceph_daemon.py mon --rank 0 \
-      --mon-addrs 0=127.0.0.1:7101,1=127.0.0.1:7102
+      --mon-addrs 0=127.0.0.1:7101,1=127.0.0.1:7102 --asok /run/ceph_tpu
   python tools/ceph_daemon.py osd --id 3 --addr 127.0.0.1:0 \
       --mon-addrs 0=127.0.0.1:7101 --data /tmp/osd3 [--mgr 127.0.0.1:7300]
 
 The process prints one JSON "ready" line on stdout once serving (the
 launcher waits for it) and runs until killed.
+
+Observability plumbing per process:
+- ``--asok DIR`` binds an admin socket at DIR/<name>.asok, serving the
+  runtime log verbs alongside the usual dumps:
+      python tools/ceph.py daemon /run/ceph_tpu/osd.3.asok log dump
+      python tools/ceph.py daemon ... log set-level osd 10 5
+      python tools/ceph.py daemon ... log get-level
+- OSD crash dumps persist under <data>/crash/ by default (override
+  with -o crash_dir=...) and re-post to the mon on respawn, so a
+  kill -9'd daemon's last exception survives into 'ceph crash ls'.
 """
 
 from __future__ import annotations
@@ -54,6 +64,9 @@ def parse_mon_addrs(spec: str) -> "dict[int, str]":
 def base_config(args) -> Config:
     cfg = Config()
     cfg.set("ms_type", "async+tcp")
+    if getattr(args, "asok", ""):
+        os.makedirs(args.asok, exist_ok=True)
+        cfg.set("admin_socket", os.path.join(args.asok, "$name.asok"))
     for kv in args.option or []:
         k, v = kv.split("=", 1)
         cfg.set(k, v)
@@ -78,6 +91,10 @@ async def run_osd(args) -> None:
 
     os.makedirs(args.data, exist_ok=True)
     cfg = base_config(args)
+    if cfg.origin("crash_dir") == "default":
+        # real processes get durable crash dumps next to their data:
+        # a kill -9 + respawn re-posts them to the mon (ceph-crash)
+        cfg.set("crash_dir", os.path.join(args.data, "crash"))
     kind = str(cfg.get("objectstore_type"))
     if kind == "mem":       # processes need durable state to survive
         kind = "file"       # kill -9 + respawn; -o objectstore_type=kv
@@ -101,6 +118,9 @@ def main(argv=None) -> int:
     pm = sub.add_parser("mon")
     pm.add_argument("--rank", type=int, required=True)
     pm.add_argument("--mon-addrs", required=True)
+    pm.add_argument("--asok", default="",
+                    help="admin-socket dir (binds <dir>/<name>.asok "
+                         "serving log dump / set-level / get-level)")
     pm.add_argument("-o", "--option", action="append",
                     help="config override key=value")
     po = sub.add_parser("osd")
@@ -109,6 +129,8 @@ def main(argv=None) -> int:
     po.add_argument("--mon-addrs", required=True)
     po.add_argument("--data", required=True)
     po.add_argument("--mgr", default="")
+    po.add_argument("--asok", default="",
+                    help="admin-socket dir (binds <dir>/<name>.asok)")
     po.add_argument("-o", "--option", action="append")
     args = p.parse_args(argv)
     try:

@@ -166,6 +166,33 @@ async def run_chaos(args) -> int:
                 failures.append(f"read of {oid} HUNG after heal")
             except Exception:  # noqa: BLE001 — clean error is fine for
                 pass           # an unknown-outcome object
+        # crash telemetry gate: any guarded task loop that died during
+        # chaos must have left a dump (the crash.task wrapper writes
+        # one before the loop is lost); --expect-crash-dump goes
+        # further and proves the pipeline live by injecting an
+        # unhandled exception into an op handler and requiring the dump
+        crash_dumps = {f"osd.{i}": len(o.crash.dumps)
+                       for i, o in cluster.osds.items()}
+        if args.expect_crash_dump:
+            pg = cluster.osdmap.object_to_pg(pool_obj.pool_id,
+                                             "crash-probe")
+            _u, acting = cluster.osdmap.pg_to_up_acting_osds(
+                pool_obj.pool_id, pg)
+            probe_osd = cluster.osds[cluster.osdmap.primary_of(acting)]
+            before = len(probe_osd.crash.dumps)
+            probe_osd.inject_crash()
+            try:
+                await asyncio.wait_for(
+                    io.write_full("crash-probe", b"x" * 64), 15.0)
+            except Exception:  # noqa: BLE001 — the first send dies by
+                pass           # design; the verdict is the dump below
+            if len(probe_osd.crash.dumps) <= before:
+                failures.append(
+                    f"osd.{probe_osd.whoami} died on an injected "
+                    f"exception WITHOUT leaving a crash dump")
+            else:
+                crash_dumps[f"osd.{probe_osd.whoami}"] = \
+                    len(probe_osd.crash.dumps)
         backoffs = sum(
             o.perf_coll.dump()[f"osd.{o.whoami}"]["osd_backoffs_sent"]
             for o in cluster.osds.values())
@@ -175,6 +202,9 @@ async def run_chaos(args) -> int:
             "objects": len(wl.committed), "kills": th.kills,
             "splits": th.splits, "corruptions": stats["corruptions"],
             "scrub_repaired": repaired, "backoffs_sent": backoffs,
+            "crash_dumps": crash_dumps,
+            "clog": {f"osd.{i}": o.clog.dump()["counts"]
+                     for i, o in cluster.osds.items()},
             "failures": failures,
         }
         print(json.dumps(report, indent=2))
@@ -204,6 +234,10 @@ def main(argv=None) -> int:
                     help="rados_osd_op_timeout for the workload client")
     ap.add_argument("--no-splits", action="store_true",
                     help="disable pg_num raises mid-chaos")
+    ap.add_argument("--expect-crash-dump", action="store_true",
+                    help="after heal, inject an unhandled exception "
+                         "into an op handler and FAIL unless it left "
+                         "a crash dump (crash-pipeline liveness gate)")
     args = ap.parse_args(argv)
     try:
         return asyncio.new_event_loop().run_until_complete(
