@@ -155,9 +155,14 @@ def bench_native_percore() -> float:
 
 
 def main() -> int:
+    from ceph_tpu.utils.devtime import retry_transient
+
     percore = bench_native_percore()
     baseline = min(percore * BASELINE_CORES, BASELINE_DRAM_GIBS)
-    value, platform = bench_device()
+    # the whole device probe retries on the flaky-tunnel-RPC class too:
+    # chained_time retries its inner dispatches, but the FIRST compile
+    # (make_encode_step) can also die on a dropped remote_compile stream
+    value, platform = retry_transient(bench_device, attempts=3)
     print(json.dumps({
         "metric": f"ec_encode_crc32c_k{K}m{M}_1MiB_stripe_{platform}",
         "value": round(value, 3),

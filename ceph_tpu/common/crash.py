@@ -185,7 +185,10 @@ class CrashHandler:
         async def run() -> None:
             try:
                 await coro
-            except asyncio.CancelledError:
+            except (asyncio.CancelledError, GeneratorExit):
+                # teardown, not a crash: cancellation and event-loop
+                # close (GeneratorExit hits coroutines destroyed while
+                # suspended) must not leave phantom dumps
                 raise
             except BaseException as e:  # noqa: BLE001 — the whole point
                 self.capture(e, context)

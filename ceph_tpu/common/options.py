@@ -302,8 +302,15 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
            enum_values=("wpq", "mclock"), desc="op scheduler implementation",
            services=("osd",)),
+    Option("osd_op_num_shards", int, 5, LEVEL_ADVANCED, min=1,
+           desc="op work-queue shards: a pgid hashes to exactly one "
+                "shard, so same-PG ops stay FIFO while distinct PGs run "
+                "concurrently (reference ShardedOpWQ)",
+           services=("osd",)),
     Option("osd_op_num_concurrent", int, 8, LEVEL_ADVANCED, min=1,
-           desc="op scheduler slots (the ShardedOpWQ thread-pool analog)",
+           desc="op scheduler slots PER SHARD (the reference's "
+                "osd_op_num_threads_per_shard analog; total concurrency "
+                "= osd_op_num_shards x this)",
            services=("osd",)),
     Option("osd_mclock_scheduler_client_res", float, 50.0, LEVEL_ADVANCED,
            min=0, desc="mclock: client reservation (ops/s)"),
@@ -371,6 +378,14 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="compress messenger frame data segments"),
     Option("ms_compression_algorithm", str, "zstd", LEVEL_ADVANCED,
            desc="frame compression algorithm (compressor plugin name)"),
+    Option("ms_cork_max_bytes", int, 256 << 10, LEVEL_ADVANCED, min=0,
+           desc="max bytes per corked flush burst; a deeper out-queue "
+                "flushes as several capped write+drain bursts (0 "
+                "disables corking: every frame drains individually)"),
+    Option("ms_cork_flush_us", float, 0.0, LEVEL_ADVANCED, min=0,
+           desc="extra microseconds the cork flusher waits for more "
+                "frames before the syscall burst (0 = one event-loop "
+                "yield, coalescing whatever is already runnable)"),
     Option("ms_inject_socket_failures", int, 0, LEVEL_DEV, min=0,
            desc="one-in-N chance to kill a socket on send/recv (QA)"),
     Option("ms_inject_delay_max", float, 0.0, LEVEL_DEV, min=0,
@@ -450,4 +465,14 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("objectstore_fsync", bool, False, LEVEL_ADVANCED,
            desc="fsync file-store transactions (durable but slow in QA)",
            services=("osd",)),
+    Option("osd_wal_group_commit", bool, True, LEVEL_ADVANCED,
+           desc="blockstore: coalesce transactions queued during the "
+                "in-flight fsync into one WAL append + fsync pair run "
+                "off the event loop (the kv_sync_thread analog); off = "
+                "one synchronous fsync pair per transaction",
+           services=("osd",)),
+    Option("osd_wal_group_commit_max_txns", int, 256, LEVEL_ADVANCED,
+           min=1,
+           desc="max transactions folded into one WAL group-commit "
+                "record", services=("osd",)),
 )

@@ -111,6 +111,14 @@ class Connection:
         self._salt = os.urandom(4)
         self._peer_salt = b"\x00" * 4
         self._task: "Optional[asyncio.Task]" = None
+        # corked out-queue (reference AsyncConnection out_q + MSG_MORE
+        # coalescing): send_message enqueues, the flusher writes every
+        # queued frame in one syscall burst and drains ONCE — an EC
+        # primary's k+m sub-writes leave in one burst instead of k+m
+        # write/drain round-trips
+        self._out_q: "List[bytes]" = []
+        self._flush_task: "Optional[asyncio.Task]" = None
+        self._flush_done: "Optional[asyncio.Future]" = None
 
     # --- crypto/frame helpers -------------------------------------------------
 
@@ -194,17 +202,11 @@ class Connection:
         await self._transmit(frame)
 
     async def _transmit(self, frame: bytes) -> None:
-        inj = self.messenger.injector
-        dropped = inj.drop()
-        if dropped and self.policy.lossy:
-            dout("ms", 5, f"{self.messenger.name}: injected drop to "
-                 f"{self.peer_addr}")
-            return
-        if inj.kill_socket():
-            dout("ms", 5, f"{self.messenger.name}: injected socket kill to "
-                 f"{self.peer_addr}")
-            self._abort()
-            return
+        """Queue the frame on the corked out-queue and wait for its
+        flush (FIFO preserved: one flusher drains the queue in order).
+
+        With ms_cork_max_bytes=0 corking is off and the frame writes +
+        drains individually, the old per-frame behavior."""
         if not self.policy.lossy:
             # wait for an (re)established session
             try:
@@ -213,28 +215,102 @@ class Connection:
                 return
         elif not self._connected.is_set():
             raise ConnectionError(f"no session to {self.peer_addr}")
-        writer = self._writer
-        if writer is None:
+        cork_max = int(self.messenger.conf("ms_cork_max_bytes"))
+        if cork_max <= 0:
+            await self._write_burst([frame])
             return
-        async with self._send_lock:
-            # injection sleeps run INSIDE the send lock: later frames
-            # queue behind the delayed one, so lossless FIFO ordering
-            # survives (real TCP never reorders within a connection)
-            if dropped:
-                # lossless drop = retransmit, never loss.  Aborting the
-                # session instead would strand the unacked tail on
-                # ACCEPTED connections, which have no reconnect replay
-                # loop (only outgoing ones run _run_outgoing).
-                dout("ms", 5, f"{self.messenger.name}: injected drop to "
-                     f"{self.peer_addr}, lossless retransmit")
-                await asyncio.sleep(0.02 + inj.rng.random() * 0.05)
+        self._out_q.append(frame)
+        if self._flush_done is None:
+            self._flush_done = asyncio.get_event_loop().create_future()
+        done = self._flush_done
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.ensure_future(self._flush_loop())
+        # wait for the burst that carries OUR frame (backpressure rides
+        # the single drain inside it); senders coalesced into the same
+        # burst all resume together — that is the corking win
+        await done
+
+    async def _flush_loop(self) -> None:
+        """Single per-connection flusher: gives the event loop one pass
+        (or ms_cork_flush_us) so every runnable sender joins the burst,
+        then writes the queued frames back-to-back and drains once per
+        burst.  ms_cork_max_bytes caps each burst — a deep queue flushes
+        as several capped bursts, not one unbounded write."""
+        flush_us = float(self.messenger.conf("ms_cork_flush_us"))
+        cork_max = max(1, int(self.messenger.conf("ms_cork_max_bytes")))
+        while self._out_q and not self.closed:
+            if flush_us > 0:
+                await asyncio.sleep(flush_us / 1e6)
             else:
-                await inj.maybe_delay()
+                await asyncio.sleep(0)
+            frames, self._out_q = self._out_q, []
+            done, self._flush_done = self._flush_done, None
             try:
-                writer.write(frame)
+                i = 0
+                while i < len(frames):
+                    burst, size = [], 0
+                    while i < len(frames) and (
+                            not burst
+                            or size + len(frames[i]) <= cork_max):
+                        size += len(frames[i])
+                        burst.append(frames[i])
+                        i += 1
+                    await self._write_burst(burst)
+            finally:
+                if done is not None and not done.done():
+                    done.set_result(None)
+        # teardown: a close mid-sleep must not leave senders parked on
+        # a flush that will never run (lossless frames survive in
+        # unacked and replay on reconnect)
+        if self._flush_done is not None and not self._flush_done.done():
+            self._flush_done.set_result(None)
+            self._flush_done = None
+
+    async def _write_burst(self, frames: "List[bytes]") -> None:
+        """Write frames in one syscall burst under the send lock.
+        Injection semantics are per frame, exactly as the per-frame
+        path applied them: lossy drops skip the frame, socket kills
+        abort the session, delays/lossless-drops sleep IN ORDER inside
+        the lock so FIFO survives."""
+        inj = self.messenger.injector
+        burst: "List[bytes]" = []
+        killed = False
+        async with self._send_lock:
+            for frame in frames:
+                dropped = inj.drop()
+                if dropped and self.policy.lossy:
+                    dout("ms", 5, f"{self.messenger.name}: injected drop "
+                         f"to {self.peer_addr}")
+                    continue
+                if inj.kill_socket():
+                    dout("ms", 5, f"{self.messenger.name}: injected "
+                         f"socket kill to {self.peer_addr}")
+                    killed = True
+                    break
+                if dropped:
+                    # lossless drop = retransmit, never loss.  Aborting
+                    # the session instead would strand the unacked tail
+                    # on ACCEPTED connections, which have no reconnect
+                    # replay loop (only outgoing ones run _run_outgoing).
+                    dout("ms", 5, f"{self.messenger.name}: injected drop "
+                         f"to {self.peer_addr}, lossless retransmit")
+                    await asyncio.sleep(0.02 + inj.rng.random() * 0.05)
+                else:
+                    await inj.maybe_delay()
+                burst.append(frame)
+            writer = self._writer
+            if killed:
+                self._abort()
+                return
+            if writer is None or not burst:
+                return
+            try:
+                writer.write(b"".join(burst))
                 await writer.drain()
             except (ConnectionError, OSError):
                 self._abort()
+                return
+        self.messenger.note_cork_flush(len(burst))
 
     async def _send_ctrl(self, fields: dict) -> None:
         # Control frames consume real seq numbers too: every frame on a
@@ -586,6 +662,11 @@ class Messenger:
         self._peer_in_seq: "Dict[str, int]" = {}
         self.stopped = False
         self.injector = _Injector(self)
+        # corked-send telemetry (per-connection flushers report here);
+        # on_cork_flush(frames) is the daemon's perf-histogram hook
+        self.cork_stats = {"cork_flushes": 0, "cork_frames": 0,
+                           "max_cork_frames": 0}
+        self.on_cork_flush = None
         self.dispatch_throttle = Throttle(
             f"{name}-dispatch", int(self.conf("ms_dispatch_throttle_bytes")))
         self.local = self.conf("ms_type") == "async+local"
@@ -626,6 +707,19 @@ class Messenger:
     @property
     def secure(self) -> bool:
         return bool(self.conf("ms_secure_mode"))
+
+    def note_cork_flush(self, frames: int) -> None:
+        if frames <= 0:
+            return
+        self.cork_stats["cork_flushes"] += 1
+        self.cork_stats["cork_frames"] += frames
+        self.cork_stats["max_cork_frames"] = max(
+            self.cork_stats["max_cork_frames"], frames)
+        if self.on_cork_flush is not None:
+            try:
+                self.on_cork_flush(frames)
+            except Exception:  # noqa: BLE001 — telemetry must not
+                pass           # break the send path
 
     # --- lifecycle -------------------------------------------------------------
 

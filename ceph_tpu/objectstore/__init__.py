@@ -18,7 +18,8 @@ from .filestore import FileStore  # noqa: F401
 from .kvstore import KVStore  # noqa: F401
 
 
-def create_store(kind: str, path: str = "") -> ObjectStore:
+def create_store(kind: str, path: str = "",
+                 config=None) -> ObjectStore:
     """Factory keyed by the objectstore_type option."""
     if kind == "mem":
         return MemStore()
@@ -34,9 +35,10 @@ def create_store(kind: str, path: str = "") -> ObjectStore:
         return KVStore(path=path)
     if kind == "block":
         # the raw-block backend: allocator + WAL + no-overwrite data
-        # on one flat device file (objectstore/blockstore.py)
+        # on one flat device file (objectstore/blockstore.py); config
+        # carries the osd_wal_group_commit_* knobs
         from .blockstore import BlockStore
         if not path:
             raise StoreError("block store needs objectstore_path")
-        return BlockStore(path)
+        return BlockStore(path, config=config)
     raise StoreError(f"unknown objectstore type {kind!r}")

@@ -34,6 +34,7 @@ contract as the reference.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import struct
@@ -95,7 +96,8 @@ class _Onode:
 
 
 class BlockStore(ObjectStore):
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str,
+                 config=None) -> None:
         super().__init__()
         self.path = path
         self.fd = -1
@@ -112,6 +114,46 @@ class BlockStore(ObjectStore):
         self._t_colls: "Dict[str, Optional[bool]]" = {}
         self._t_alloc: "List[int]" = []        # lbas allocated this txn
         self._t_ref: "Dict[int, int]" = {}     # lba -> ref delta
+        # --- WAL group commit (the kv_sync_thread analog) -----------------
+        # queue_transaction() applies a txn's mutations immediately
+        # (data pwrites land in the page cache, metadata publishes in
+        # memory) and parks its caller on a future; the committer folds
+        # every record queued during the in-flight fsync into ONE WAL
+        # append + ONE data-fsync/wal-fsync pair, run in an executor
+        # thread so the event loop never blocks on durability.
+        def _cfg(key, default):
+            try:
+                return config.get(key) if config is not None else default
+            except Exception:  # noqa: BLE001 — bare configs
+                return default
+        self.group_commit = bool(_cfg("osd_wal_group_commit", True))
+        self.group_commit_max = int(
+            _cfg("osd_wal_group_commit_max_txns", 256))
+        self._gc_queue: "List[tuple]" = []     # (rec, freed, future)
+        self._gc_task: "Optional[asyncio.Task]" = None
+        # freed lbas whose commit FAILED: their transactions are
+        # published in memory but not durable, so the pre-image blocks
+        # stay quarantined until a checkpoint (which captures the
+        # published state wholesale) makes releasing them safe —
+        # dropping them instead would leak allocator space per failure
+        self._orphan_freed: "List[int]" = []
+        # serializes every durability pass (group batches AND the sync
+        # per-txn path) so WAL record order always matches the order
+        # the transactions were applied to memory
+        self._commit_mutex = threading.Lock()
+        # QA: fail the next group commit between the data fsync and the
+        # WAL record (tests/test_group_commit.py crash-replay gate)
+        self.inject_wal_crash = False
+        self.on_group_commit = None            # callback(batch_size)
+        self.stats = {
+            "fsyncs": 0,             # every fsync issued (data + wal)
+            "commits": 0,            # durable transactions
+            "group_commits": 0,      # committer passes (1 fsync pair)
+            "group_commit_txns": 0,  # txns folded into those passes
+            "max_group_commit": 0,   # largest batch observed
+            "wal_records": 0,
+            "checkpoints": 0,
+        }
 
     # --- layout helpers ------------------------------------------------------
 
@@ -165,7 +207,10 @@ class BlockStore(ObjectStore):
 
     def umount(self) -> None:
         if self.fd >= 0:
-            self._checkpoint()
+            with self._commit_mutex:
+                with self._lock:
+                    self._drain_gc_locked()
+                    self._checkpoint()
             os.close(self.fd)
             self.fd = -1
 
@@ -182,6 +227,12 @@ class BlockStore(ObjectStore):
 
     def _checkpoint(self) -> None:
         slot = 1 - self.ckpt_slot
+        # the checkpoint captures the PUBLISHED in-memory state, which
+        # includes any failed-commit transactions — their quarantined
+        # frees become safe (and durable) here
+        if self._orphan_freed:
+            self.free.update(self._orphan_freed)
+            self._orphan_freed.clear()
         # WAL resets at each checkpoint: the slot captures everything
         self.wal_head = 0
         payload = zlib.compress(json.dumps(self._meta_dict(),
@@ -197,6 +248,8 @@ class BlockStore(ObjectStore):
         # replayed over the fresh checkpoint
         os.pwrite(self.fd, b"\0" * 16, self._wal_off)
         os.fsync(self.fd)
+        self.stats["fsyncs"] += 2
+        self.stats["checkpoints"] += 1
 
     def _load_slot(self, slot: int):
         hdr = os.pread(self.fd, 16, self._ckpt_off(slot))
@@ -270,28 +323,213 @@ class BlockStore(ObjectStore):
                 self.free.discard(lba)
         self.high_lba = max(self.high_lba, rec.get("high_lba", 0))
 
-    def _wal_append(self, rec: dict) -> None:
-        payload = zlib.compress(json.dumps(rec, sort_keys=True).encode(),
-                                1)
-        frame = struct.pack("<QII", rec["seq"], len(payload),
+    def _merge_records(self, recs: "List[dict]") -> dict:
+        """Fold N transaction records into one WAL record: onode and
+        collection POST-states are last-writer-wins (physical logging),
+        refcount deltas sum.  One record = one fsync pair for the whole
+        batch — the group-commit payoff."""
+        onodes: "Dict[str, Optional[dict]]" = {}
+        colls: "Dict[str, bool]" = {}
+        ref: "Dict[str, int]" = {}
+        high = 0
+        for r in recs:
+            onodes.update(r["onodes"])
+            colls.update(r["colls"])
+            for k, d in r["ref"].items():
+                ref[k] = ref.get(k, 0) + int(d)
+            high = max(high, int(r.get("high_lba", 0)))
+        return {"onodes": onodes, "colls": colls,
+                "ref": {k: v for k, v in ref.items() if v != 0},
+                "high_lba": high}
+
+    def _commit_records(self, recs: "List[dict]",
+                        freed: "List[int]") -> None:
+        """Make applied-but-volatile records durable (caller holds
+        ``_commit_mutex``): fsync the data blocks, then land ONE merged
+        WAL record with its own fsync — or, when the ring is full, fold
+        the already-published state into a checkpoint instead.  ``freed``
+        lbas (quarantined at publish so no new allocation can overwrite
+        a block the pre-image still needs) release here, once the frees
+        are durable."""
+        # data blocks durable BEFORE the commit record — exactly the
+        # ordering of the old per-txn path
+        os.fsync(self.fd)
+        self.stats["fsyncs"] += 1
+        if self.inject_wal_crash:
+            self.inject_wal_crash = False
+            raise StoreError("injected crash between data fsync and "
+                             "WAL commit record")
+        merged = recs[0] if len(recs) == 1 else self._merge_records(recs)
+        # seq/wal_head are COMMITTER-domain state: every writer (group
+        # passes, sync drains, checkpoints) holds _commit_mutex, so the
+        # compression, WAL pwrites, and the WAL fsync below run WITHOUT
+        # self._lock — event-loop stagings and reads proceed while the
+        # record lands.  self._lock guards only the shared allocator
+        # (free set) and the checkpoint's full-metadata serialize.
+        seq = self.seq + 1
+        payload = zlib.compress(
+            json.dumps(dict(merged, seq=seq),
+                       sort_keys=True).encode(), 1)
+        frame = struct.pack("<QII", seq, len(payload),
                             zlib.crc32(payload)) + payload
         if self.wal_head + len(frame) + 16 > WAL_BYTES:
-            # WAL full: fold everything into a checkpoint instead
-            self._checkpoint()
-            if len(frame) + 16 > WAL_BYTES:
-                # one record larger than the whole ring would overrun
-                # into the checkpoint slots — refuse loudly (split the
-                # transaction) rather than corrupt the store
-                raise StoreError(
-                    f"transaction record {len(frame)}B exceeds the "
-                    f"{WAL_BYTES}B WAL ring")
-        os.pwrite(self.fd, frame, self._wal_off + self.wal_head)
-        # pre-invalidate the NEXT frame slot so replay cannot run past
-        # this record into stale bytes
-        os.pwrite(self.fd, b"\0" * 16,
-                  self._wal_off + self.wal_head + len(frame))
-        os.fsync(self.fd)
-        self.wal_head += len(frame)
+            # Ring full (or one oversized record): the published
+            # in-memory state already contains this batch, so a
+            # checkpoint IS the commit.  Absorb anything still
+            # queued behind us first — its effects are in the
+            # state the checkpoint captures, and appending its
+            # record afterwards would double-apply refcount deltas
+            # on replay.
+            with self._lock:
+                extra = self._gc_queue[:]
+                del self._gc_queue[:]
+                for _rec, efreed, _fut in extra:
+                    freed = freed + efreed
+                for lba in freed:
+                    self.free.add(lba)
+                self.seq = seq
+                self._checkpoint()
+            if extra:
+                self._gc_batch_done(len(extra))
+                self._resolve([f for _r, _e, f in extra])
+        else:
+            os.pwrite(self.fd, frame,
+                      self._wal_off + self.wal_head)
+            # pre-invalidate the NEXT frame slot so replay cannot
+            # run past this record into stale bytes
+            os.pwrite(self.fd, b"\0" * 16,
+                      self._wal_off + self.wal_head + len(frame))
+            os.fsync(self.fd)
+            self.stats["fsyncs"] += 1
+            self.stats["wal_records"] += 1
+            self.seq = seq
+            self.wal_head += len(frame)
+            with self._lock:
+                for lba in freed:
+                    self.free.add(lba)
+
+    # --- group commit (the kv_sync_thread analog) ----------------------------
+
+    @staticmethod
+    def _resolve(futs: "List", err: "Optional[BaseException]" = None
+                 ) -> None:
+        """Resolve awaiters from any thread (the committer runs in an
+        executor; futures belong to the event loop)."""
+        for f in futs:
+            def _set(f=f):
+                if not f.done():
+                    if err is not None:
+                        f.set_exception(err)
+                    else:
+                        f.set_result(None)
+            try:
+                f.get_loop().call_soon_threadsafe(_set)
+            except RuntimeError:       # loop already closed (teardown)
+                pass
+
+    def _gc_batch_done(self, n: int) -> None:
+        self.stats["group_commits"] += 1
+        self.stats["group_commit_txns"] += n
+        self.stats["commits"] += n
+        self.stats["max_group_commit"] = max(
+            self.stats["max_group_commit"], n)
+        if self.on_group_commit is not None:
+            try:
+                self.on_group_commit(n)
+            except Exception:  # noqa: BLE001 — telemetry must not fail IO
+                pass
+
+    async def queue_transaction(self, txn) -> None:
+        """Async commit entry (BlueStore queue_transaction analog):
+        mutations apply immediately (page-cache pwrites + in-memory
+        metadata), durability happens on the group committer — every
+        record queued while an fsync pair is in flight folds into the
+        next one.  Returns once THIS transaction is durable."""
+        if not self.group_commit:
+            self.apply_transaction(txn)
+            return
+        loop = asyncio.get_event_loop()
+        with self._lock:
+            self._txn_begin()
+            try:
+                for op in txn.ops:
+                    self._apply_op(op)
+            except Exception:
+                self._txn_rollback()
+                raise
+            staged = self._txn_publish()
+            if staged is None:
+                return
+            rec, freed = staged
+            fut = loop.create_future()
+            self._gc_queue.append((rec, freed, fut))
+        if self._gc_task is None or self._gc_task.done():
+            self._gc_task = asyncio.ensure_future(self._gc_loop())
+        await fut
+
+    async def _gc_loop(self) -> None:
+        """The committer task: while records are queued, run commit
+        passes in an executor thread.  Arrivals during a pass coalesce
+        into the next one — the natural group-commit window."""
+        loop = asyncio.get_event_loop()
+        while True:
+            with self._lock:
+                if not self._gc_queue:
+                    return
+            await loop.run_in_executor(None, self._commit_some)
+
+    def _commit_some(self) -> int:
+        """One committer pass: pop up to group_commit_max queued
+        records, land them with one fsync pair, resolve their futures.
+        Never raises — a durability failure resolves the batch's
+        futures with the error (the OSD replies committed=False)."""
+        with self._commit_mutex:
+            with self._lock:
+                batch = self._gc_queue[:self.group_commit_max]
+                del self._gc_queue[:len(batch)]
+            if not batch:
+                return 0
+            try:
+                self._commit_records([r for r, _f2, _f3 in batch],
+                                     [l for _r, fl, _f in batch
+                                      for l in fl])
+            except BaseException as e:  # noqa: BLE001 — fail the waiters
+                with self._lock:
+                    self._orphan_freed.extend(
+                        l for _r, fl, _f in batch for l in fl)
+                self._resolve([f for _r, _e2, f in batch], e)
+                return len(batch)
+            self._gc_batch_done(len(batch))
+            self._resolve([f for _r, _e2, f in batch])
+            return len(batch)
+
+    def _drain_gc_locked(self) -> None:
+        """Commit every queued record ahead of a synchronous commit
+        point, in order (caller holds ``_commit_mutex``): WAL record
+        order must always match the order transactions were applied to
+        the in-memory state, or replay reverts newer post-states."""
+        while self._gc_queue:
+            batch = self._gc_queue[:]
+            del self._gc_queue[:]
+            try:
+                self._commit_records([r for r, _f2, _f3 in batch],
+                                     [l for _r, fl, _f in batch
+                                      for l in fl])
+            except BaseException as e:
+                self._orphan_freed.extend(
+                    l for _r, fl, _f in batch for l in fl)
+                self._resolve([f for _r, _e2, f in batch], e)
+                raise
+            self._gc_batch_done(len(batch))
+            self._resolve([f for _r, _e2, f in batch])
+
+    def apply_transaction(self, txn, on_commit=None) -> None:
+        # _commit_mutex outranks _lock everywhere (the committer thread
+        # takes mutex -> lock); taking it here, before the base class
+        # takes _lock, keeps the order consistent and serializes this
+        # sync commit against in-flight group batches
+        with self._commit_mutex:
+            super().apply_transaction(txn, on_commit)
 
     # --- allocator -----------------------------------------------------------
 
@@ -323,24 +561,27 @@ class BlockStore(ObjectStore):
             self.free.add(lba)
         self._txn_begin()
 
-    def _txn_commit(self) -> None:
+    def _txn_publish(self) -> "Optional[tuple]":
+        """Publish the staged transaction into the in-memory maps and
+        return ``(record, freed_lbas)`` for the durability pass, or
+        None for an empty transaction.
+
+        Blocks whose refcount drops to zero are NOT returned to the
+        allocator here: until the record is durable, a crash replays to
+        the pre-transaction state, whose onodes still reference those
+        blocks — reusing one before durability would overwrite live
+        pre-image bytes (the no-overwrite discipline).  They quarantine
+        in ``freed`` and release in _commit_records."""
         if not (self._t_onodes or self._t_colls or self._t_ref):
-            return
-        # seq increments only AFTER the record is durable: the WAL-full
-        # path checkpoints inside _wal_append, and that checkpoint must
-        # capture the PRE-transaction state under the PRE-transaction
-        # seq (a post-seq checkpoint of pre-state silently loses this
-        # and every later committed transaction on crash)
-        rec = {"seq": self.seq + 1,
-               "onodes": {k: (o.to_dict() if o is not None else None)
+            self._txn_begin()
+            return None
+        rec = {"onodes": {k: (o.to_dict() if o is not None else None)
                           for k, o in self._t_onodes.items()},
-               "colls": self._t_colls,
+               "colls": dict(self._t_colls),
                "ref": {str(k): v for k, v in self._t_ref.items()
                        if v != 0},
                "high_lba": self.high_lba}
-        os.fsync(self.fd)          # data blocks durable BEFORE commit
-        self._wal_append(rec)      # <- the commit point
-        self.seq += 1
+        freed: "List[int]" = []
         for key, o in self._t_onodes.items():
             if o is None:
                 self.onodes.pop(key, None)
@@ -352,11 +593,29 @@ class BlockStore(ObjectStore):
             cur = self.refs.get(lba, 0) + delta
             if cur <= 0:
                 self.refs.pop(lba, None)
-                self.free.add(lba)
+                freed.append(lba)
             else:
                 self.refs[lba] = cur
                 self.free.discard(lba)
         self._txn_begin()
+        return rec, freed
+
+    def _txn_commit(self) -> None:
+        """Synchronous per-transaction commit (apply_transaction path;
+        the caller holds _commit_mutex via the override below).  Any
+        group-queued records commit FIRST so WAL order matches the
+        order their effects were published to memory."""
+        staged = self._txn_publish()
+        if staged is None:
+            return
+        rec, freed = staged
+        self._drain_gc_locked()
+        try:
+            self._commit_records([rec], freed)
+        except BaseException:
+            self._orphan_freed.extend(freed)
+            raise
+        self.stats["commits"] += 1
 
     # --- onode access (txn-aware overlay) ------------------------------------
 

@@ -131,6 +131,16 @@ class ObjectStore:
             merged.append(t)
         self.apply_transaction(merged)
 
+    async def queue_transaction(self, txn: Transaction) -> None:
+        """Async commit entry (the reference queue_transaction): apply
+        ``txn`` and return once it is durable.  The base implementation
+        commits synchronously inline — correct for every backend, with
+        per-transaction durability cost.  BlockStore overrides it with
+        a WAL group-commit pipeline that coalesces all transactions
+        queued during the in-flight fsync into one append+fsync pair
+        run off the event loop."""
+        self.apply_transaction(txn)
+
     def _apply_op(self, op: dict) -> None:
         kind = op["op"]
         cid = Collection.from_key(op["cid"])

@@ -37,7 +37,7 @@ from .ecutil import StripeInfo
 from .encode_service import EncodeService
 from .replicated import ReplicateCodec
 from ..common.tracked_op import OpTracker
-from .scheduler import CLIENT, MClockScheduler
+from .scheduler import CLIENT, ShardedOpWQ
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDBackoff, MOSDOp,
                        MOSDOpReply, MOSDPGPush, MOSDPGPushReply, MOSDPing,
@@ -82,6 +82,17 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
                          "us")
           .add_histogram("op_w_commit_lat",
                          "admission -> all-shards-committed", "us")
+          # write-path pipeline health (sharded WQ + WAL group commit +
+          # messenger corking): batch/depth histograms, not latencies —
+          # the "unit" is a count, bucketed log2 like everything else
+          .add_histogram("osd_shard_queue_depth",
+                         "op work-queue depth at enqueue (per shard)",
+                         "ops")
+          .add_histogram("osd_wal_group_commit_batch",
+                         "transactions folded per WAL group commit",
+                         "txns")
+          .add_histogram("ms_cork_flush_frames",
+                         "frames per corked messenger flush", "frames")
           .create_perf_counters())
     coll.add(pc)
     return pc
@@ -101,7 +112,7 @@ class OSDDaemon(Dispatcher):
                  config: "Optional[Config]" = None,
                  mon_addrs: "Optional[Dict[int, str]]" = None,
                  addr: str = "", mgr_addr: str = "",
-                 mesh_plane=None) -> None:
+                 mesh_plane=None, encode_service=None) -> None:
         self.whoami = osd_id
         # device-mesh data plane shared by co-hosted OSDs (None = the
         # messenger carries all chunk bytes, the reference behavior)
@@ -117,12 +128,13 @@ class OSDDaemon(Dispatcher):
         self.addr = addr or f"local:osd.{osd_id}"
         self.backends: "Dict[Tuple[int, int], ECBackend]" = {}
         # one cross-PG batched device encode queue per daemon: every
-        # primary this OSD hosts funnels sub-write encodes through it
+        # primary this OSD hosts funnels sub-write encodes through it.
+        # Co-hosted daemons (MiniCluster, one process per slice) may
+        # inject a SHARED service so batches form across daemons too —
+        # the accelerator is one device either way
         # (BASELINE.json north-star deviation; see osd/encode_service.py)
-        self.encode_service = EncodeService.from_config(self.config)
-        # op QoS: client vs recovery vs scrub share the op slots per the
-        # configured policy (reference ShardedOpWQ + mClockScheduler)
-        self.op_scheduler = MClockScheduler.from_config(self.config)
+        self.encode_service = encode_service \
+            or EncodeService.from_config(self.config)
         # per-op event timelines + historic ops (reference TrackedOp)
         self.op_tracker = OpTracker.from_config(self.config)
         # cluster log + crash telemetry (reference LogClient +
@@ -146,6 +158,20 @@ class OSDDaemon(Dispatcher):
         self.admin_socket = None
         self.perf_coll = PerfCountersCollection()
         self.perf = _osd_perf(self.perf_coll, f"osd.{osd_id}")
+        # sharded op work queue (reference ShardedOpWQ): client ops
+        # hash pgid -> shard, stay FIFO per PG, and run concurrently
+        # across PGs; each shard owns an mClock/wpq scheduler instance
+        self.op_wq = ShardedOpWQ.from_config(
+            self.config, task_factory=self.crash.task,
+            on_enqueue=lambda depth: self.perf.hinc(
+                "osd_shard_queue_depth", depth))
+        # WAL group-commit telemetry: the store reports each committer
+        # batch size (blockstore only; other stores never fire it)
+        self.store.on_group_commit = lambda n: self.perf.hinc(
+            "osd_wal_group_commit_batch", n)
+        # messenger corking telemetry: frames per flushed syscall burst
+        self.ms.on_cork_flush = lambda n: self.perf.hinc(
+            "ms_cork_flush_frames", n)
         # kernel telemetry (encode/decode/crc32c latency histograms +
         # roofline counters); its "kernel" group rides perf dump and
         # the mgr report like any other counter group
@@ -442,6 +468,26 @@ class OSDDaemon(Dispatcher):
                                if "missing" in kv else {})
             except ValueError:
                 missing_raw = {}
+            # retry dedup must SURVIVE the split: children get fresh
+            # trimmed logs, so the reqids riding the parent's log
+            # entries (pg_log_entry_t::reqid analog) are about to be
+            # wiped — carry a map in PGMETA instead, or a client
+            # retrying a committed mutation across the split reapplies
+            # it (duplicate append, thrash-found).  Source it from the
+            # parent BACKEND's completed_reqids — populated only by
+            # ACKED ops — never from raw log entries: a divergent
+            # partial apply sitting in a shard's log would otherwise
+            # become a false dedup hit, turning a retry that MUST
+            # reapply into a silently lost write (also thrash-found).
+            try:
+                reqids = (json.loads(kv["reqids"].decode())
+                          if "reqids" in kv else {})
+            except ValueError:
+                reqids = {}
+            parent_be = self.backends.get((pool_id, c.pg))
+            if parent_be is not None:
+                for r, v in parent_be.completed_reqids.items():
+                    reqids[r] = list(v)
             t = Transaction()
             touched: "set" = set()
             created: "set" = set()
@@ -497,6 +543,11 @@ class OSDDaemon(Dispatcher):
                     "missing": json.dumps(
                         by_pg.get(pg, {})).encode(),
                     "gap_from": json.dumps(None).encode(),
+                    # wholesale copy is safe: reqids are client-unique
+                    # per logical op, and a retry targets the pg its
+                    # OBJECT hashes to — the map entry is only ever
+                    # consulted where it is correct
+                    "reqids": json.dumps(reqids).encode(),
                 }
             t.touch(c, ObjectId(PGMETA_OID))
             t.omap_setkeys(c, ObjectId(PGMETA_OID), meta_kv(c.pg))
@@ -847,6 +898,13 @@ class OSDDaemon(Dispatcher):
             es["device_requests"] / es["device_batches"], 2) \
             if es.get("device_batches") else 0.0
         out["encode_service"] = es
+        # write-path pipeline counters: shard WQ occupancy, WAL
+        # group-commit amortization, messenger cork bursts
+        out["op_wq"] = self.op_wq.dump()
+        store_stats = getattr(self.store, "stats", None)
+        if store_stats:
+            out["objectstore"] = dict(store_stats)
+        out["msgr"] = dict(self.ms.cork_stats)
         if self.mesh_plane is not None:
             out["mesh_plane"] = dict(self.mesh_plane.stats)
         return out
@@ -986,7 +1044,8 @@ class OSDDaemon(Dispatcher):
                        min_size=lambda p=pgid[0]: self.osdmap.get_pool(
                            p).min_size,
                        encode_service=self.encode_service,
-                       scheduler=self.op_scheduler, config=self.config,
+                       scheduler=self.op_wq.scheduler_for(pgid),
+                       config=self.config,
                        mesh_plane=self.mesh_plane,
                        device_mesh=getattr(pool, "device_mesh", False),
                        fast_read=lambda p=pgid[0]: getattr(
@@ -1122,12 +1181,13 @@ class OSDDaemon(Dispatcher):
             return "peering"
         return None
 
-    async def _send_backoff(self, conn, pgid: "Tuple[int, int]",
-                            msg: MOSDOp, reason: str) -> None:
-        """Block the session for this PG instead of parking the op: the
-        op is dropped HERE and the client resends after the unblock —
-        the reference's replacement for server-side op parking, which
-        wedged op slots and deadlocked under cross-OSD drains."""
+    def _register_backoff(self, conn, pgid: "Tuple[int, int]",
+                          reason: str) -> int:
+        """Record the block SYNCHRONOUSLY at the admission decision:
+        a release sweep (PG activation, split done, queue drain) firing
+        between the decision and the async block send must see the
+        record, or it is orphaned forever and osd_backoffs_active never
+        drains back to zero."""
         recs = self.backoffs.setdefault(pgid, {})
         bid = next((b for b, rec in recs.items()
                     if rec["conn"] is conn and rec["reason"] == reason),
@@ -1143,6 +1203,24 @@ class OSDDaemon(Dispatcher):
             # perfectly healthy (if slow) release paths
             self.perf.inc("osd_backoffs_sent")
         self.perf.set("osd_backoffs_active", self._backoffs_live())
+        return bid
+
+    async def _send_backoff(self, conn, pgid: "Tuple[int, int]",
+                            msg: MOSDOp, reason: str,
+                            bid: "Optional[int]" = None) -> None:
+        """Block the session for this PG instead of parking the op: the
+        op is dropped HERE and the client resends after the unblock —
+        the reference's replacement for server-side op parking, which
+        wedged op slots and deadlocked under cross-OSD drains."""
+        if bid is None:
+            bid = self._register_backoff(conn, pgid, reason)
+        recs = self.backoffs.get(pgid, {})
+        if bid not in recs:
+            # released before the block ever went out (the release's
+            # unblock went nowhere the client knows about): sending
+            # the block NOW would park the session with no unblock
+            # ever coming
+            return
         dout("osd", 10, f"osd.{self.whoami} backoff block pg {pgid} "
                         f"({reason}) tid {msg.get('tid')}")
         try:
@@ -1327,10 +1405,10 @@ class OSDDaemon(Dispatcher):
                     asyncio.ensure_future(_deliver_after_split())
                     return True
         if t == "osd_op":
-            # crash-wrapped: a client-op handler dying unhandled is
-            # exactly the post-mortem case (the client just times out)
-            self.crash.task(self._handle_client_op(conn, msg),
-                            "client_op")
+            # fast-dispatch admission (reference ms_fast_dispatch ->
+            # enqueue_op): backoff/throttle decisions run HERE, in
+            # arrival order, then the op joins its PG's shard FIFO
+            self._enqueue_client_op(conn, msg)
         elif t == "ec_sub_write":
             pgid_m = (int(msg["pgid"][0]), int(msg["pgid"][1]))
             wrong = None
@@ -1355,27 +1433,13 @@ class OSDDaemon(Dispatcher):
                 return True
             be = self._get_backend(pgid_m)
             self.perf.inc("subop_w")
-            span = self._sub_span(msg, "ec_sub_write")
-            try:
-                reply = be.handle_sub_write(msg)
-            except Exception as e:  # noqa: BLE001 — failed apply: this
-                # shard misses the write; a committed:False reply makes
-                # the primary fail the op promptly (a silent drop would
-                # wedge the strictly-ordered commit queue behind it)
-                dout("osd", 0, f"sub_write apply failed: "
-                               f"{type(e).__name__}: {e}")
-                for entry in msg.get("log_entries", []):
-                    be.local_missing[entry["oid"]] = tuple(
-                        entry["version"])
-                reply = MECSubOpWriteReply({
-                    "pgid": list(msg["pgid"]), "shard": msg["shard"],
-                    "from_osd": self.whoami, "tid": msg["tid"],
-                    "committed": False, "applied": False,
-                    "error": f"apply failed: {type(e).__name__}"})
-            if span:
-                span.finish("committed" if reply.get("committed")
-                            else "rejected")
-            await conn.send_message(reply)
+            # own task: the apply STAGES synchronously on the task's
+            # first run (tasks start in creation = delivery order, so
+            # same-shard sub-writes keep their log order) while the
+            # durability wait rides the store's group committer instead
+            # of head-of-line blocking this connection's delivery loop
+            self.crash.task(self._handle_sub_write(conn, be, msg),
+                            "sub_write")
         elif t == "osd_op_reply":
             # reply to a server-side copy_from read this daemon issued
             fut = self._copy_inflight.get(-int(msg.get("tid", 0)))
@@ -1451,60 +1515,135 @@ class OSDDaemon(Dispatcher):
 
     # --- client ops (reference PrimaryLogPG::do_op -> execute_ctx) -----------
 
-    async def _handle_client_op(self, conn, msg: MOSDOp) -> None:
+    async def _handle_sub_write(self, conn, be, msg: Message) -> None:
+        """Shard-side sub-write worker (see the dispatch comment: one
+        task per message, staging in delivery order, durability off the
+        delivery loop)."""
+        span = self._sub_span(msg, "ec_sub_write")
+        try:
+            reply = await be.handle_sub_write(msg)
+        except Exception as e:  # noqa: BLE001 — failed apply: this
+            # shard misses the write; a committed:False reply makes
+            # the primary fail the op promptly (a silent drop would
+            # wedge the strictly-ordered commit queue behind it)
+            dout("osd", 0, f"sub_write apply failed: "
+                           f"{type(e).__name__}: {e}")
+            for entry in msg.get("log_entries", []):
+                be.local_missing[entry["oid"]] = tuple(
+                    entry["version"])
+            reply = MECSubOpWriteReply({
+                "pgid": list(msg["pgid"]), "shard": msg["shard"],
+                "from_osd": self.whoami, "tid": msg["tid"],
+                "committed": False, "applied": False,
+                "error": f"apply failed: {type(e).__name__}"})
+        if span:
+            span.finish("committed" if reply.get("committed")
+                        else "rejected")
+        try:
+            await conn.send_message(reply)
+        except (ConnectionError, OSError):
+            # primary died while we applied: the reply is undeliverable
+            # (it will re-learn shard state through peering) — not a
+            # crash-dump event
+            dout("osd", 5, f"sub_write reply to dead peer dropped "
+                           f"(pg {msg.get('pgid')} tid {msg.get('tid')})")
+
+    def _enqueue_client_op(self, conn, msg: MOSDOp) -> None:
+        """Queue-watermark admission + shard enqueue, synchronously in
+        dispatch order (reference enqueue_op -> ShardedOpWQ::queue).
+        The overload shed happens HERE, before the op ever queues — a
+        full OSD answers immediately instead of burying the block
+        behind a deep shard FIFO.  Peering/split backoffs are decided
+        at DEQUEUE instead (_handle_client_op), as the reference does
+        in do_op."""
+        pgid = (int(msg["pool"]), int(msg["pg"]))
+        took = False
+        internal = bool(msg.get("internal"))
+        if self._backoff_enabled() and not internal:
+            # the high-watermark is runtime-mutable ('config set
+            # osd_backoff_queue_high'): track it per admission, or
+            # the registered config command silently does nothing
+            high = int(self.config.get("osd_backoff_queue_high"))
+            if high != self.op_throttle.max:
+                self.op_throttle.reset_max(high)
+            if high > 0:
+                took = self.op_throttle.get_or_fail(1)
+                if not took:
+                    # queue past the high-watermark: shed the op via
+                    # backoff instead of letting it age toward the
+                    # client's op timeout.  Register NOW (release
+                    # sweeps must see the record); only the send rides
+                    # its own task.  The shed op still leaves a trace
+                    # for dump_historic_ops.
+                    bid = self._register_backoff(conn, pgid, "queue")
+                    top = self.op_tracker.create(
+                        f"osd_op({msg.get('reqid', '')} "
+                        f"{msg.get('oid', '')} [backoff])",
+                        trace_id=str(msg.get("trace_id", "")))
+                    with top:
+                        top.mark("backoff_queue")
+                    self.crash.task(
+                        self._send_backoff(conn, pgid, msg, "queue",
+                                           bid),
+                        "backoff_send")
+                    return
+        if internal:
+            # cluster-internal op (a copy_from read another primary
+            # issued): must NOT queue behind the CLIENT class — the
+            # issuer holds a client slot while awaiting us, so two
+            # OSDs cross-copying at full slot occupancy would
+            # deadlock until the op timeout.  Internal ops are also
+            # never backed off: the issuer's mini-objecter has no
+            # backoff session state, and parking it would wedge the
+            # client slot it holds.
+            self.crash.task(self._handle_client_op(conn, msg, took),
+                            "client_op")
+            return
+        self.op_wq.enqueue(
+            pgid, CLIENT,
+            lambda: self._handle_client_op(conn, msg, took),
+            name="client_op")
+
+    async def _handle_client_op(self, conn, msg: MOSDOp,
+                                took: bool = False) -> None:
+        """The shard work item: runs with a slot already granted by the
+        shard's scheduler (crash-wrapped by the WQ's task factory — a
+        client-op handler dying unhandled is exactly the post-mortem
+        case; the client just times out)."""
         ops = ",".join(o.get("op", "?") for o in msg.get("ops", []))
         top = self.op_tracker.create(
             f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
             trace_id=str(msg.get("trace_id", "")))
         with top:
-            if self._crash_injected == "op" \
-                    and not bool(msg.get("internal")):
-                # QA one-shot: die UNHANDLED (past the errno-mapping
-                # try below), exercising the whole crash pipeline; the
-                # client's retry after the op timeout then succeeds
-                self._crash_injected = None
-                raise RuntimeError(
-                    "injected unhandled exception in op handler "
-                    "(injectcrash)")
-            if bool(msg.get("internal")):
-                # cluster-internal op (a copy_from read another primary
-                # issued): must NOT queue behind the CLIENT class — the
-                # issuer holds a client slot while awaiting us, so two
-                # OSDs cross-copying at full slot occupancy would
-                # deadlock until the op timeout.  (The flag only skips
-                # QoS queueing; cap checks still apply.)  Internal ops
-                # are also never backed off: the issuer's mini-objecter
-                # has no backoff session state, and parking it would
-                # wedge the client slot it holds.
+            try:
+                if self._crash_injected == "op" \
+                        and not bool(msg.get("internal")):
+                    # QA one-shot: die UNHANDLED (past the errno-mapping
+                    # try in _do_client_op), exercising the whole crash
+                    # pipeline; the client's retry after the op timeout
+                    # then succeeds.  Inside the try: the throttle unit
+                    # taken at admission must release even on this path.
+                    self._crash_injected = None
+                    raise RuntimeError(
+                        "injected unhandled exception in op handler "
+                        "(injectcrash)")
+                if self._backoff_enabled() \
+                        and not bool(msg.get("internal")):
+                    # peering/split backoffs are decided here, at
+                    # dequeue (reference do_op -> maybe_backoff): the
+                    # PG's state NOW is what matters, not its state
+                    # when the op entered the shard FIFO
+                    pgid = (int(msg["pool"]), int(msg["pg"]))
+                    reason = self._want_backoff(pgid)
+                    if reason is not None:
+                        top.mark(f"backoff_{reason}")
+                        bid = self._register_backoff(conn, pgid,
+                                                     reason)
+                        await self._send_backoff(conn, pgid, msg,
+                                                 reason, bid)
+                        return
                 top.mark("reached_pg")
                 await self._do_client_op(conn, msg, top)
-                return
-            took = False
-            if self._backoff_enabled():
-                pgid = (int(msg["pool"]), int(msg["pg"]))
-                reason = self._want_backoff(pgid)
-                # the high-watermark is runtime-mutable ('config set
-                # osd_backoff_queue_high'): track it per admission, or
-                # the registered config command silently does nothing
-                high = int(self.config.get("osd_backoff_queue_high"))
-                if high != self.op_throttle.max:
-                    self.op_throttle.reset_max(high)
-                if reason is None and high > 0:
-                    took = self.op_throttle.get_or_fail(1)
-                    if not took:
-                        # queue past the high-watermark: shed the op
-                        # via backoff instead of letting it age toward
-                        # the client's op timeout
-                        reason = "queue"
-                if reason is not None:
-                    top.mark(f"backoff_{reason}")
-                    await self._send_backoff(conn, pgid, msg, reason)
-                    return
-            try:
-                top.mark("queued_for_pg")
-                async with self.op_scheduler.queued(CLIENT):
-                    top.mark("reached_pg")
-                    await self._do_client_op(conn, msg, top)
             finally:
                 if took:
                     self.op_throttle.put(1)

@@ -135,6 +135,9 @@ class Op:
     # distributed trace id (reference ZTracer span threaded through EC
     # sub-writes, ECBackend.cc:2063-2068); "" = untraced
     trace_id: str = ""
+    # client reqid: rides the log entry so retry dedup survives a
+    # primary change (reference pg_log_entry_t::reqid)
+    reqid: str = ""
     # stage-timing anchors (op-path telemetry): admission into the
     # pipeline and sub-write fan-out, both time.monotonic()
     admitted_at: float = 0.0
@@ -289,6 +292,10 @@ class ECBackend:
         # reqid -> committed version: client-retry dedup (the reference
         # stores osd_reqid_t in pg log entries for the same purpose)
         self.completed_reqids: "Dict[str, Version]" = {}
+        # reqid -> in-flight Op: a retry that races its own first
+        # attempt must WAIT on it, not re-enqueue the mutation (a
+        # second enqueue would double-apply an append)
+        self.inflight_reqids: "Dict[str, Op]" = {}
         # peering request/reply correlation (MPGInfo / MPGRewindAck / ...)
         self.pending_queries: "Dict[int, asyncio.Future]" = {}
         self.peering = False
@@ -396,6 +403,22 @@ class ECBackend:
                 if "pglog" in kv:
                     self.pg_log = PGLog.from_dict(
                         json.loads(kv["pglog"].decode()))
+                    # seed retry dedup from the persisted log: a client
+                    # whose ack died with the old primary must get its
+                    # committed version back, not a second apply
+                    for e in self.pg_log.entries:
+                        if e.reqid:
+                            self.completed_reqids[e.reqid] = e.version
+                if "reqids" in kv:
+                    # reqids carried across a pg_num split (the split
+                    # wipes the log the entries rode in; see
+                    # OSDDaemon.split_pool_pgs)
+                    try:
+                        for r, v in json.loads(
+                                kv["reqids"].decode()).items():
+                            self.completed_reqids[r] = ver(v)
+                    except ValueError:
+                        pass
                 if "missing" in kv:
                     self.local_missing = {
                         o: ver(v) for o, v in
@@ -621,20 +644,47 @@ class ECBackend:
         retries of a mutation that already committed."""
         if reqid and reqid in self.completed_reqids:
             return self.completed_reqids[reqid]
-        # degraded-object wait happens BEFORE taking cls_lock: parking
-        # under the lock would serialize every write to the PG behind
-        # one object's recovery (enqueue re-checks under the admission
-        # loop for the rare re-degrade race)
-        await self._wait_degraded(oid, trace_id)
-        # brief cls_lock hold for the ENQUEUE only: object-class
-        # executions hold it across their reads + enqueue, so a plain
-        # write can never slip between a cls method's read and its
-        # buffered-write admission (lost-update window)
-        async with self.cls_lock:
-            op = await self.enqueue_transaction(oid, ops,
-                                                trace_id=trace_id,
-                                                tracked=tracked)
-        version = await op.on_commit
+        if reqid:
+            cur = self.inflight_reqids.get(reqid)
+            if cur is not None:
+                # a client retry raced its own first attempt (op timeout
+                # shorter than a parked pipeline): ride the in-flight
+                # attempt's outcome instead of enqueueing the mutation a
+                # second time — a second enqueue would double-apply an
+                # append (the reference's "dup op in progress" path)
+                return await asyncio.shield(cur)
+            # reserve SYNCHRONOUSLY, before the first await: two
+            # attempts interleaving their degraded/cls waits must
+            # still collapse to one enqueue
+            fut = asyncio.get_event_loop().create_future()
+            self.inflight_reqids[reqid] = fut
+        try:
+            # degraded-object wait happens BEFORE taking cls_lock:
+            # parking under the lock would serialize every write to the
+            # PG behind one object's recovery (enqueue re-checks under
+            # the admission loop for the rare re-degrade race)
+            await self._wait_degraded(oid, trace_id)
+            # brief cls_lock hold for the ENQUEUE only: object-class
+            # executions hold it across their reads + enqueue, so a
+            # plain write can never slip between a cls method's read and
+            # its buffered-write admission (lost-update window)
+            async with self.cls_lock:
+                op = await self.enqueue_transaction(oid, ops,
+                                                    trace_id=trace_id,
+                                                    tracked=tracked,
+                                                    reqid=reqid)
+            version = await op.on_commit
+        except BaseException as e:
+            if reqid:
+                f = self.inflight_reqids.pop(reqid, None)
+                if f is not None and not f.done():
+                    f.set_exception(e)
+                    f.exception()   # mark retrieved: riders are optional
+            raise
+        if reqid:
+            f = self.inflight_reqids.pop(reqid, None)
+            if f is not None and not f.done():
+                f.set_result(version)
         if reqid:
             self.completed_reqids[reqid] = version
             while len(self.completed_reqids) > 4096:
@@ -645,7 +695,8 @@ class ECBackend:
     async def enqueue_transaction(self, oid: str,
                                   ops: "Sequence[ClientOp]",
                                   trace_id: str = "",
-                                  tracked=None) -> Op:
+                                  tracked=None,
+                                  reqid: str = "") -> Op:
         """Admit a mutation into the pipeline and return its Op without
         waiting for commit.  The pipeline commits strictly in admission
         order, so once op A is enqueued, no later op can commit before
@@ -653,7 +704,7 @@ class ECBackend:
         read-modify-write atomicity (exec holds cls_lock across its
         reads AND this enqueue)."""
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
-                trace_id=trace_id, tracked=tracked,
+                trace_id=trace_id, tracked=tracked, reqid=reqid,
                 admitted_at=time.monotonic())
         op.on_commit = asyncio.get_event_loop().create_future()
         self._hit_set_track(oid)
@@ -971,7 +1022,27 @@ class ECBackend:
                 if snap_clone:
                     shard_txns[shard]["snap_clone"] = snap_clone
             use_mesh = self._mesh_usable()
-            for off, buf in sorted(stripes.items()):
+            stripe_items = sorted(stripes.items())
+            enc_results = None
+            if not use_mesh and self.encode_service is not None \
+                    and len(stripe_items) > 1:
+                # multi-stripe op: submit every stripe's encode in one
+                # shot so they ride ONE batched device launch instead
+                # of len(stripes) serial awaits (a 4 MiB write is 8
+                # stripes — serial submission capped its own batch at 1)
+                try:
+                    gathered = await asyncio.gather(*(
+                        self.encode_service.encode(
+                            self.sinfo, self.codec, buf,
+                            with_crc=is_append)
+                        for _off, buf in stripe_items))
+                except Exception as e:  # noqa: BLE001
+                    self._fail_op(op, ECError(
+                        f"batched encode failed for {op.oid}: {e}"))
+                    return
+                enc_results = {o: r for (o, _b), r in
+                               zip(stripe_items, gathered)}
+            for off, buf in stripe_items:
                 crcs = None
                 if use_mesh:
                     # device-mesh plane: ring-encode + per-shard crc as
@@ -1024,7 +1095,10 @@ class ECBackend:
                     self.extent_cache.present_rmw_update(op.oid, off, buf)
                     op.pinned.append((off, int(np.size(buf))))
                     continue
-                if self.encode_service is not None:
+                if enc_results is not None:
+                    allc, crcs = enc_results[off]
+                    shards = {s: allc[s] for s in range(self.k + self.m)}
+                elif self.encode_service is not None:
                     # daemon-wide batched device encode: this op's stripes
                     # ride one (B, k, W) launch with every other PG's
                     # pending sub-writes, crc32c fused on device.  A
@@ -1085,7 +1159,8 @@ class ECBackend:
 
         entry = LogEntry(op.version, op.oid,
                          "delete" if op.delete else "modify",
-                         prior_version=op.oi.version, rollback=rollback)
+                         prior_version=op.oi.version, rollback=rollback,
+                         reqid=op.reqid)
 
         # log trimming: once the log exceeds osd_max_pg_log_entries,
         # trim down to osd_min_pg_log_entries (never past the rollback
@@ -1152,33 +1227,47 @@ class ECBackend:
                     self.peer_missing.setdefault(shard, {})[op.oid] = \
                         op.version
         for shard, msg in local_msgs:
-            try:
-                reply = self.handle_sub_write(msg)
-                if not reply.get("committed", True):
-                    if reply.get("missing"):
-                        op.failed_shards.add(shard)
-                        op.pending_commits.discard(shard)
-                        self.peer_missing.setdefault(shard, {})[op.oid] \
-                            = op.version
-                        self.local_missing[op.oid] = op.version
-                        continue
-                    self._fail_op(op, ECError(
-                        f"write {op.oid}: local shard {shard} rejected "
-                        f"stale interval"))
-                    return
-            except Exception as e:  # noqa: BLE001 — failed local apply
-                # = this shard missed the write: record it missing and
-                # let peering repair, exactly like a failed remote send
-                dout("osd", 0, f"local sub_write shard {shard} failed: "
-                               f"{type(e).__name__}: {e}")
-                op.failed_shards.add(shard)
-                op.pending_commits.discard(shard)
-                self.peer_missing.setdefault(shard, {})[op.oid] = \
-                    op.version
-                self.local_missing[op.oid] = op.version
-                continue
-            self._sub_write_committed(op, shard)
+            # own task per local shard: staging still happens in
+            # creation order (handle_sub_write is synchronous up to its
+            # durability await), but the fsync wait no longer
+            # head-of-line blocks this PG's pipeline — the next op's
+            # encode can join the device batch and its sub-write can
+            # join the store's group commit while we wait
+            asyncio.ensure_future(self._local_sub_write(op, shard, msg))
         self._check_commit_queue()
+
+    async def _local_sub_write(self, op: Op, shard: int,
+                               msg: MECSubOpWrite) -> None:
+        """Apply the primary's own shard (reference: the OSD calls
+        handle_sub_write on itself after fanning out)."""
+        try:
+            reply = await self.handle_sub_write(msg)
+            if not reply.get("committed", True):
+                if reply.get("missing"):
+                    op.failed_shards.add(shard)
+                    op.pending_commits.discard(shard)
+                    self.peer_missing.setdefault(shard, {})[op.oid] \
+                        = op.version
+                    self.local_missing[op.oid] = op.version
+                    self._check_commit_queue()
+                    return
+                self._fail_op(op, ECError(
+                    f"write {op.oid}: local shard {shard} rejected "
+                    f"stale interval"))
+                return
+        except Exception as e:  # noqa: BLE001 — failed local apply
+            # = this shard missed the write: record it missing and
+            # let peering repair, exactly like a failed remote send
+            dout("osd", 0, f"local sub_write shard {shard} failed: "
+                           f"{type(e).__name__}: {e}")
+            op.failed_shards.add(shard)
+            op.pending_commits.discard(shard)
+            self.peer_missing.setdefault(shard, {})[op.oid] = \
+                op.version
+            self.local_missing[op.oid] = op.version
+            self._check_commit_queue()
+            return
+        self._sub_write_committed(op, shard)
 
     # --- pipeline stage 3: commit --------------------------------------------
 
@@ -1279,9 +1368,17 @@ class ECBackend:
 
     # ------------------------------------------------------------ shard side
 
-    def handle_sub_write(self, msg: MECSubOpWrite) -> MECSubOpWriteReply:
+    async def handle_sub_write(self, msg: MECSubOpWrite
+                               ) -> MECSubOpWriteReply:
         """Apply a per-shard transaction + log entries atomically
-        (reference handle_sub_write ECBackend.cc:915)."""
+        (reference handle_sub_write ECBackend.cc:915).
+
+        Async since the WAL group-commit change: the store APPLY is
+        still synchronous (everything up to the final await runs
+        without interleaving, so same-shard sub-writes stage in arrival
+        order), but durability rides the store's group committer — a
+        committed=True reply still means exactly what it meant before:
+        the transaction is on stable storage."""
         shard = int(msg["shard"])
         if int(msg.get("epoch", 1 << 62)) < self.peered_epoch:
             # a NEWER primary has already peered us: this sub-write is
@@ -1389,10 +1486,24 @@ class ECBackend:
         self.pg_log.trim_to(ver(msg.get("trim_to", [0, 0])))
         self._pg_meta_txn(t, cid)
         try:
-            self.store.apply_transaction(t)
+            # the store apply runs synchronously inside this call (the
+            # coroutine suspends only for durability), so a staging
+            # failure raises before any other sub-write can interleave
+            await self.store.queue_transaction(t)
         except Exception:
-            self.pg_log = PGLog.from_dict(log_snapshot)
-            self.log_gap_from = gap_snapshot
+            if not entries or self.pg_log.head == entries[-1].version:
+                # nothing interleaved past us: roll the in-memory log
+                # back so it never claims an entry no data backs
+                self.pg_log = PGLog.from_dict(log_snapshot)
+                self.log_gap_from = gap_snapshot
+            else:
+                # a later sub-write advanced the log during our
+                # durability wait: a snapshot restore would wipe ITS
+                # entry too.  Leave the log and record our objects
+                # missing on this shard — peering repairs them, the
+                # committed=False reply keeps the primary honest.
+                for e in entries:
+                    self.local_missing[e.oid] = tuple(e.version)
             raise
         return MECSubOpWriteReply({
             "pgid": list(self.pgid), "shard": shard,
@@ -2365,6 +2476,12 @@ class ECBackend:
             for oid, e in latest.items():
                 missing[oid] = e.version
         self.pg_log = auth
+        for e in auth.entries:
+            # merged entries carry their client reqids: retry dedup
+            # keeps working across the primary change that caused this
+            # merge (reference: merge_log carries pg_log_entry_t::reqid)
+            if e.reqid:
+                self.completed_reqids[e.reqid] = e.version
         self.local_missing = missing
         self.log_gap_from = None
         self._pg_meta_txn(t, cid)
@@ -2401,6 +2518,12 @@ class ECBackend:
             # (reference falls back to backfill the same way)
             self.pg_log = PGLog()
             div = []
+        for e in div:
+            # a pruned entry's mutation is UNDONE: its reqid must not
+            # dedup the client's retry, which now genuinely has to
+            # reapply (a stale hit here silently loses the write)
+            if e.reqid:
+                self.completed_reqids.pop(e.reqid, None)
         if self.log_gap_from is not None \
                 and self.pg_log.head <= self.log_gap_from:
             # the rewind dropped everything past the gap: contiguous again

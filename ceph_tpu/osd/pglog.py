@@ -38,6 +38,11 @@ class LogEntry:
     #  - "old_attrs": {name: bytes|None} before attr writes -> restore
     #  - "removed": object content snapshot is at generation `gen`
     rollback: dict = field(default_factory=dict)
+    # originating client reqid (reference pg_log_entry_t::reqid): rides
+    # the log so retry dedup SURVIVES primary death — a new primary
+    # seeds completed_reqids from its log and never reapplies a
+    # committed mutation whose ack was lost
+    reqid: str = ""
 
     def to_dict(self) -> dict:
         rb = dict(self.rollback)
@@ -46,9 +51,12 @@ class LogEntry:
             rb["old_attrs"] = {
                 k: (v.hex() if isinstance(v, (bytes, bytearray)) else v)
                 for k, v in rb["old_attrs"].items()}
-        return {"version": list(self.version), "oid": self.oid,
-                "op": self.op, "prior": list(self.prior_version),
-                "rollback": rb}
+        out = {"version": list(self.version), "oid": self.oid,
+               "op": self.op, "prior": list(self.prior_version),
+               "rollback": rb}
+        if self.reqid:
+            out["reqid"] = self.reqid
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "LogEntry":
@@ -58,7 +66,8 @@ class LogEntry:
                 k: (bytes.fromhex(v) if isinstance(v, str) else v)
                 for k, v in rb["old_attrs"].items()}
         return cls(ver(d["version"]), d["oid"], d["op"],
-                   ver(d.get("prior", ZERO)), rb)
+                   ver(d.get("prior", ZERO)), rb,
+                   d.get("reqid", ""))
 
 
 class PGLog:

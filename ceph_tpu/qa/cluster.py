@@ -28,7 +28,8 @@ from ..osd.osdmap import OSDMap, POOL_ERASURE
 class MiniCluster:
     def __init__(self, n_osds: int = 6, n_mons: int = 0,
                  config: "Optional[Config]" = None,
-                 mgr: bool = False) -> None:
+                 mgr: bool = False, store: str = "mem",
+                 store_dir: "Optional[str]" = None) -> None:
         self.config = config or Config()
         if config is None or self.config.origin("ms_type") == "default":
             # default to the in-process transport; an explicit ms_type in
@@ -36,11 +37,27 @@ class MiniCluster:
             self.config.set("ms_type", "async+local")
         self.n_osds = n_osds
         self.with_mgr = mgr
+        # objectstore backend per OSD: "mem" (default, the fast test
+        # harness) or "block" (the raw-block WAL store — real fsyncs,
+        # real group commit; store_dir holds the device files)
+        self.store_type = store
+        self.store_dir = store_dir
+        self._own_store_dir = False
+        if store == "block" and store_dir is None:
+            import tempfile
+            self.store_dir = tempfile.mkdtemp(prefix="ceph_tpu_bs_")
+            self._own_store_dir = True    # removed at stop()
         # one device-mesh data plane shared by all in-process OSDs (the
         # "co-hosted on one slice" topology); pools opt in per-pool via
         # device_mesh=True
         from ..parallel.plane import MeshDataPlane
         self.mesh_plane = MeshDataPlane()
+        # ONE cross-PG encode service shared by every co-hosted daemon:
+        # in-process daemons share the accelerator, so their sub-write
+        # encodes stack into common (B, k, W) launches — the per-daemon
+        # batcher generalized to the co-hosted topology
+        from ..osd.encode_service import EncodeService
+        self.encode_service = EncodeService.from_config(self.config)
         self._cephx_auth = None
         self.mgr = None
         self.mon_addrs: "Dict[int, str]" = {
@@ -60,11 +77,23 @@ class MiniCluster:
                 self.osdmap.mark_up(i, self._initial_addr(i))
             self.osdmap.bump()
             for i in range(n_osds):
-                self.osds[i] = OSDDaemon(i, self.osdmap,
-                                         config=self.config,
-                                         mesh_plane=self.mesh_plane)
+                self.osds[i] = OSDDaemon(
+                    i, self.osdmap, store=self._make_store(i),
+                    config=self.config, mesh_plane=self.mesh_plane,
+                    encode_service=self.encode_service)
         else:
             self.osdmap = None  # authoritative map lives on the mons
+
+    def _make_store(self, osd_id: int):
+        """None -> the daemon's MemStore default; 'block' -> a raw-block
+        WAL store backed by a device file under store_dir."""
+        if self.store_type != "block":
+            return None
+        import os
+        from ..objectstore.blockstore import BlockStore
+        return BlockStore(os.path.join(self.store_dir,
+                                       f"osd{osd_id}.img"),
+                          config=self.config)
 
     # --- lifecycle ------------------------------------------------------------
 
@@ -87,9 +116,11 @@ class MiniCluster:
             await self.wait_for_leader()
             for i in range(self.n_osds):
                 self.osds[i] = OSDDaemon(
-                    i, config=self.config, mon_addrs=self.mon_addrs,
+                    i, store=self._make_store(i),
+                    config=self.config, mon_addrs=self.mon_addrs,
                     mgr_addr=self.mgr.addr if self.mgr else "",
-                    mesh_plane=self.mesh_plane)
+                    mesh_plane=self.mesh_plane,
+                    encode_service=self.encode_service)
             for osd in self.osds.values():
                 await osd.init()
             if self.mgr is not None:
@@ -137,6 +168,11 @@ class MiniCluster:
             await mon.shutdown()
         if self.mgr is not None:
             await self.mgr.shutdown()
+        if self._own_store_dir and self.store_dir:
+            # the auto-created block-device dir is ours to reap; a
+            # caller-supplied store_dir is the caller's state
+            import shutil
+            shutil.rmtree(self.store_dir, ignore_errors=True)
 
     async def __aenter__(self) -> "MiniCluster":
         await self.start()
@@ -269,11 +305,13 @@ class MiniCluster:
             osd = OSDDaemon(osd_id, store=old.store, config=self.config,
                             mon_addrs=self.mon_addrs,
                             mgr_addr=old.mgr_addr,
-                            mesh_plane=self.mesh_plane)
+                            mesh_plane=self.mesh_plane,
+                            encode_service=self.encode_service)
         else:
             osd = OSDDaemon(osd_id, self.osdmap, store=old.store,
                             config=self.config, mgr_addr=old.mgr_addr,
-                            mesh_plane=self.mesh_plane)
+                            mesh_plane=self.mesh_plane,
+                            encode_service=self.encode_service)
         if self._cephx_auth is not None:
             osd.ticket_verifier.update_secrets(
                 self._cephx_auth.export_secrets())

@@ -78,7 +78,26 @@ class Workload:
                 try:
                     got = await io.read(oid)
                     if got != self.committed[oid]:
+                        # the verdict FIRST: the diagnostics below are
+                        # best-effort (mid-split state, mon-mode
+                        # osdmap=None) and must never swallow a
+                        # detected corruption into the degraded-read
+                        # except handler
                         self.read_mismatch = oid
+                        try:
+                            import sys as _sys
+                            want = self.committed[oid]
+                            n = min(len(got), len(want))
+                            pool_obj = self.cluster.osdmap.pool_by_name(
+                                self.pool)
+                            print(f"READ-MISMATCH {oid}: "
+                                  f"got={len(got)} want={len(want)} "
+                                  f"prefix_eq={got[:n] == want[:n]}\n"
+                                  + _forensics(self.cluster, pool_obj,
+                                               oid),
+                                  file=_sys.stderr)
+                        except Exception:  # noqa: BLE001 — forensics
+                            pass           # are advisory
                         return
                 except Exception:  # noqa: BLE001 — degraded read
                     self.failed += 1

@@ -26,6 +26,39 @@ from typing import Any, Callable
 
 import numpy as np
 
+# Transient tunnel-RPC failure markers: a remote-attached accelerator
+# occasionally drops one RPC ("remote_compile: read body closed",
+# stream resets) and the very next dispatch succeeds.  BENCH_r05 lost a
+# whole bench round to exactly one of these.
+_TRANSIENT_MARKERS = ("read body closed", "remote_compile",
+                      "UNAVAILABLE", "DEADLINE_EXCEEDED",
+                      "Connection reset", "EOF")
+_TRANSIENT_TYPES = ("JaxRuntimeError", "XlaRuntimeError", "RpcError")
+
+
+def is_transient_device_error(e: BaseException) -> bool:
+    """True for the flaky-RPC class of device errors worth retrying:
+    the exception type is a jax/XLA runtime error AND the message
+    carries a known transient marker (a compile error or NaN check
+    would match the type but never the markers — those must surface)."""
+    if type(e).__name__ not in _TRANSIENT_TYPES:
+        return False
+    msg = str(e)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def retry_transient(fn, attempts: int = 3, backoff_s: float = 0.5):
+    """Run ``fn()``, retrying up to ``attempts-1`` times on transient
+    device-RPC errors (bounded — a persistent failure still surfaces,
+    with the original traceback)."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — filtered below
+            if attempt + 1 >= attempts or not is_transient_device_error(e):
+                raise
+            time.sleep(backoff_s * (attempt + 1))
+
 
 def chained_time(body: "Callable[[Any, Any], Any]", x0,
                  iters_lo: int = 2, iters_hi: int = 22,
@@ -48,7 +81,11 @@ def chained_time(body: "Callable[[Any, Any], Any]", x0,
                    for leaf in jax.tree_util.tree_leaves(out))
 
     def once(n):
-        return float(np.asarray(run(x0, n)))
+        # the timing probe rides a remote tunnel: retry the flaky-RPC
+        # class a bounded number of times instead of losing the whole
+        # bench round to one dropped stream (BENCH_r05 rc=1)
+        return retry_transient(
+            lambda: float(np.asarray(run(x0, n))), attempts=4)
 
     once(iters_lo)
     while True:

@@ -276,7 +276,11 @@ def test_prometheus_histogram_series_and_slow_ops(loop):
 REQUIRED_PERF_COUNTERS = {
     "osd": {"op", "op_w", "op_r", "subop_w", "subop_r", "op_latency",
             "op_w_queue_lat", "op_w_encode_lat", "subop_w_rtt",
-            "op_w_commit_lat"},
+            "op_w_commit_lat",
+            # write-path pipeline (sharded WQ / WAL group commit /
+            # messenger corking) batch+depth histograms
+            "osd_shard_queue_depth", "osd_wal_group_commit_batch",
+            "ms_cork_flush_frames"},
     "kernel": {"kernel_encode_lat", "kernel_decode_lat",
                "kernel_crc32c_lat", "kernel_encode_launches",
                "kernel_decode_launches", "kernel_crc32c_launches",
@@ -302,6 +306,11 @@ REQUIRED_PROM_SERIES = {
     # even at zero, so the RECENT_CRASH alert and the clog-rate panels
     # never see series gaps
     "ceph_clog_messages", "ceph_crash_total", "ceph_recent_crash",
+    # write-path pipeline histograms (PR 4: sharded WQ + WAL group
+    # commit + messenger corking) — the grafana pipeline panels
+    "ceph_osd_shard_queue_depth_bucket",
+    "ceph_osd_wal_group_commit_batch_bucket",
+    "ceph_ms_cork_flush_frames_bucket",
 }
 
 

@@ -119,5 +119,7 @@ def test_cluster_recovery_throttled_under_mclock(loop):
             for i in range(n_obj):
                 assert await io.read(f"o{i}") == bytes([i]) * 2000
             prim = c.osdmap.primary_of(acting)
-            assert c.osds[prim].op_scheduler.stats.get("recovery", 0) > 0
+            # recovery rides the PG's shard scheduler (ShardedOpWQ)
+            assert sum(s.scheduler.stats.get("recovery", 0)
+                       for s in c.osds[prim].op_wq.shards) > 0
     loop.run_until_complete(go())

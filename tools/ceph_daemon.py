@@ -99,7 +99,7 @@ async def run_osd(args) -> None:
     if kind == "mem":       # processes need durable state to survive
         kind = "file"       # kill -9 + respawn; -o objectstore_type=kv
     store_path = os.path.join(args.data, "store.db")
-    store = create_store(kind, store_path)
+    store = create_store(kind, store_path, config=cfg)
     if not os.path.exists(store_path):
         store.mkfs()   # only a genuinely fresh dir formats; a corrupt
         # or locked store must fail loudly at mount, not be re-formatted

@@ -86,8 +86,33 @@ async def _corruptor(cluster: MiniCluster, wl: Workload, pool_name: str,
             dout("qa", 10, f"injectdataerr {oid} skipped: {e}")
 
 
+async def _wal_crasher(cluster: MiniCluster, interval: float,
+                       seed: int, stats: dict,
+                       stop: asyncio.Event) -> None:
+    """Group-commit fault plane: periodically arm inject_wal_crash on a
+    random live BlockStore — the next committer pass dies between the
+    data fsync and the WAL record.  Affected txns error (their
+    sub-writes reply committed=False, clients retry); the invariant
+    stays: an acked write survives, an unacked one may vanish but must
+    never half-apply."""
+    rng = random.Random(seed)
+    while not stop.is_set():
+        try:
+            await asyncio.wait_for(stop.wait(), interval)
+            return
+        except asyncio.TimeoutError:
+            pass
+        live = [o for o in cluster.osds.values()
+                if o.up and hasattr(o.store, "inject_wal_crash")]
+        if not live:
+            continue
+        rng.choice(live).store.inject_wal_crash = True
+        stats["wal_crashes"] += 1
+
+
 async def run_chaos(args) -> int:
     cfg = Config()
+    cfg.set("ms_type", args.ms_type)
     cfg.set("ms_inject_delay_max", args.delay_max)
     cfg.set("ms_inject_drop_ratio", args.drop_ratio)
     if args.socket_failures:
@@ -96,7 +121,8 @@ async def run_chaos(args) -> int:
     # timeout — the gate wants op CHURN under failure, not one wedged
     # writer riding out the whole chaos window
     cfg.set("rados_osd_op_timeout", args.op_timeout)
-    async with MiniCluster(n_osds=args.osds, config=cfg) as cluster:
+    async with MiniCluster(n_osds=args.osds, config=cfg,
+                           store=args.store) as cluster:
         if args.pool_type == "ec":
             cluster.create_ec_pool(
                 "chaos", {"plugin": "jax_rs", "k": str(args.k),
@@ -116,15 +142,25 @@ async def run_chaos(args) -> int:
             max_corrupt = 1
         wl = Workload(cluster, "chaos", seed=args.seed)
         th = Thrasher(cluster, seed=args.seed + 1, min_live=min_live)
-        if not args.no_splits:
+        if not args.no_splits and not args.no_thrash:
             th.split_pool = "chaos"
-        stats = {"corruptions": 0}
+        stats = {"corruptions": 0, "wal_crashes": 0}
         stop = asyncio.Event()
         tasks = [asyncio.ensure_future(wl.run()),
-                 asyncio.ensure_future(th.run()),
                  asyncio.ensure_future(_corruptor(
                      cluster, wl, "chaos", args.corrupt_interval,
                      args.seed + 2, stats, stop, max_corrupt))]
+        if not args.no_thrash:
+            tasks.append(asyncio.ensure_future(th.run()))
+        else:
+            # messenger/store fault planes only (the pipeline pass):
+            # daemons stay up; sockets die mid-cork and group commits
+            # crash mid-fsync instead
+            th.stop()
+        if args.wal_crash_interval > 0 and args.store == "block":
+            tasks.append(asyncio.ensure_future(_wal_crasher(
+                cluster, args.wal_crash_interval, args.seed + 3,
+                stats, stop)))
         await asyncio.sleep(args.duration)
         th.stop()
         wl.stop()
@@ -196,12 +232,27 @@ async def run_chaos(args) -> int:
         backoffs = sum(
             o.perf_coll.dump()[f"osd.{o.whoami}"]["osd_backoffs_sent"]
             for o in cluster.osds.values())
+        # write-path pipeline accounting: WAL group-commit amortization
+        # and corked-messenger bursts under chaos
+        wal = {"fsyncs": 0, "commits": 0, "group_commits": 0,
+               "group_commit_txns": 0}
+        for o in cluster.osds.values():
+            for k, v in (getattr(o.store, "stats", None) or {}).items():
+                if k in wal:
+                    wal[k] += v
+        cork = {"cork_flushes": 0, "cork_frames": 0}
+        for o in cluster.osds.values():
+            for k in cork:
+                cork[k] += o.ms.cork_stats[k]
         report = {
             "ok": not failures,
             "acked": wl.acked, "failed_ops": wl.failed,
             "objects": len(wl.committed), "kills": th.kills,
             "splits": th.splits, "corruptions": stats["corruptions"],
+            "wal_crashes": stats["wal_crashes"],
             "scrub_repaired": repaired, "backoffs_sent": backoffs,
+            "wal": wal, "msgr_cork": cork,
+            "store": args.store, "ms_type": args.ms_type,
             "crash_dumps": crash_dumps,
             "clog": {f"osd.{i}": o.clog.dump()["counts"]
                      for i, o in cluster.osds.items()},
@@ -238,10 +289,44 @@ def main(argv=None) -> int:
                     help="after heal, inject an unhandled exception "
                          "into an op handler and FAIL unless it left "
                          "a crash dump (crash-pipeline liveness gate)")
+    ap.add_argument("--store", choices=("mem", "block"), default="mem",
+                    help="objectstore backend (block = raw-block WAL "
+                         "store: real fsyncs + group commit)")
+    ap.add_argument("--ms-type", choices=("async+local", "async+tcp"),
+                    default="async+local",
+                    help="messenger transport (async+tcp exercises the "
+                         "corked out-queue over real sockets)")
+    ap.add_argument("--wal-crash-interval", type=float, default=0.0,
+                    help="seconds between injected group-commit "
+                         "crashes (block store only; 0 = off)")
+    ap.add_argument("--no-thrash", action="store_true",
+                    help="keep every OSD up: messenger/store fault "
+                         "planes only")
+    ap.add_argument("--pipeline-pass", action="store_true",
+                    help="after the main round, run a corked-messenger "
+                         "+ group-commit round: async+tcp transport, "
+                         "block store, socket kills mid-cork, crashes "
+                         "mid-group-commit — same no-lost/no-dup gate")
     args = ap.parse_args(argv)
     try:
-        return asyncio.new_event_loop().run_until_complete(
+        rc = asyncio.new_event_loop().run_until_complete(
             run_chaos(args))
+        if args.pipeline_pass and rc == 0:
+            import copy
+            p = copy.copy(args)
+            p.store = "block"
+            p.ms_type = "async+tcp"
+            p.socket_failures = args.socket_failures or 400
+            p.wal_crash_interval = args.wal_crash_interval or 1.0
+            p.duration = min(args.duration, 6.0)
+            p.expect_crash_dump = False
+            # socket-kill + group-commit crash planes only: OSD
+            # kill/revive over tcp is a separate (known-fragile)
+            # regime the main round already covers on async+local
+            p.no_thrash = True
+            rc = asyncio.new_event_loop().run_until_complete(
+                run_chaos(p))
+        return rc
     except Exception:  # noqa: BLE001 — harness error, not a data verdict
         traceback.print_exc()
         return 2

@@ -60,7 +60,8 @@ def _merged_histograms(osds) -> dict:
 
 async def run(args) -> dict:
     cfg = Config()
-    async with MiniCluster(n_osds=args.osds, config=cfg) as c:
+    async with MiniCluster(n_osds=args.osds, config=cfg,
+                           store=args.store) as c:
         c.create_ec_pool(
             "bench", {"plugin": "jax_rs", "k": str(args.k),
                       "m": str(args.m), "technique": args.technique},
@@ -75,16 +76,33 @@ async def run(args) -> dict:
         ios = [cl.io_ctx("bench") for cl in clients]
 
         # warmup: populate the jit cache for the batch shapes the timed
-        # phase will hit (first compile is 1-40s depending on backend)
+        # phase will hit (first compile is 1-40s depending on backend).
+        # Must run at FULL concurrency for a while: the batched encode
+        # buckets depths to powers of two, and every depth the timed
+        # phase reaches (1, 2, 4, ...) is its own compiled shape — a
+        # shape first seen mid-measurement stalls the whole pipeline
+        # for its compile.
+        warm_stop = time.monotonic() + args.warm_seconds
+
         async def warm(ci: int) -> None:
-            for i in range(3):
-                await ios[ci].write_full(f"warm-{ci}", payloads[0])
+            i = 0
+            while i < 3 or time.monotonic() < warm_stop:
+                await ios[ci].write_full(f"warm-{ci}",
+                                         payloads[i % len(payloads)])
+                i += 1
         await asyncio.gather(*(warm(i) for i in range(args.clients)))
         for osd in c.osds.values():
             for key in osd.encode_service.stats:
                 osd.encode_service.stats[key] = 0
-            # warmup ops must not pollute the latency percentiles
+            # warmup ops must not pollute the latency percentiles or
+            # the fsync/group-commit/cork accounting
             osd.perf_coll.reset()
+            store_stats = getattr(osd.store, "stats", None)
+            if store_stats:
+                for key in store_stats:
+                    store_stats[key] = 0
+            for key in osd.ms.cork_stats:
+                osd.ms.cork_stats[key] = 0
 
         stop = time.monotonic() + args.seconds
         totals = {"ops": 0, "bytes": 0}
@@ -102,10 +120,12 @@ async def run(args) -> dict:
         await asyncio.gather(*(client_loop(i)
                                for i in range(args.clients)))
         elapsed = time.monotonic() - t0
-        # aggregate encode-service stats across daemons
+        # aggregate encode-service stats across daemons; co-hosted
+        # daemons share ONE service instance — count each object once
         agg = {}
-        for osd in c.osds.values():
-            for k, v in osd.encode_service.stats.items():
+        for svc in {id(o.encode_service): o.encode_service
+                    for o in c.osds.values()}.values():
+            for k, v in svc.stats.items():
                 if k == "max_batch":
                     agg[k] = max(agg.get(k, 0), v)
                 else:
@@ -113,12 +133,40 @@ async def run(args) -> dict:
         avg_batch = (agg.get("device_requests", 0)
                      / agg["device_batches"]
                      if agg.get("device_batches") else 0.0)
-        # latency percentiles from the run's perf histograms (stage +
-        # kernel), merged across daemons
+        # WAL group-commit + messenger-cork accounting: the write-path
+        # pipeline's amortization, visible per OSD_BENCH row
+        wal = {"fsyncs": 0, "commits": 0, "group_commits": 0,
+               "group_commit_txns": 0, "max_group_commit": 0}
+        for osd in c.osds.values():
+            for k, v in (getattr(osd.store, "stats", None) or {}).items():
+                if k in wal:
+                    wal[k] = (max(wal[k], v) if k == "max_group_commit"
+                              else wal[k] + v)
+        ops_done = max(1, totals["ops"])
+        wal["fsyncs_per_op"] = round(wal["fsyncs"] / ops_done, 2)
+        # the amortization number: the old per-txn path paid exactly 2
+        # fsyncs per transaction; group commit must land well under
+        wal["fsyncs_per_txn"] = round(
+            wal["fsyncs"] / wal["commits"], 2) if wal["commits"] else 0.0
+        wal["avg_group_commit_batch"] = round(
+            wal["group_commit_txns"] / wal["group_commits"], 2) \
+            if wal["group_commits"] else 0.0
+        cork = {"cork_flushes": 0, "cork_frames": 0, "max_cork_frames": 0}
+        for osd in c.osds.values():
+            for k, v in osd.ms.cork_stats.items():
+                cork[k] = (max(cork[k], v) if k == "max_cork_frames"
+                           else cork[k] + v)
+        cork["avg_cork_frames"] = round(
+            cork["cork_frames"] / cork["cork_flushes"], 2) \
+            if cork["cork_flushes"] else 0.0
+        # latency/batch percentiles from the run's perf histograms
+        # (stage + kernel + pipeline counters), merged across daemons
         hists = _merged_histograms(c.osds.values())
         pcts = {f"{group}.{cname}": {
                     **perf_histogram.percentiles(h),
-                    "count": h["count"], "unit": "us"}
+                    "count": h["count"],
+                    "unit": ("us" if cname.endswith("_lat")
+                             or cname.endswith("rtt") else "n")}
                 for group, counters in sorted(hists.items())
                 for cname, h in sorted(counters.items())
                 if h.get("count")}
@@ -130,8 +178,11 @@ async def run(args) -> dict:
             "op_per_s": round(totals["ops"] / elapsed, 1),
             "client_GiB_per_s": round(
                 totals["bytes"] / elapsed / 2**30, 3),
+            "store": args.store,
             "encode_service": {**agg,
                                "avg_device_batch": round(avg_batch, 2)},
+            "wal": wal,
+            "msgr": cork,
             "latency_percentiles": pcts,
         }
 
@@ -141,6 +192,9 @@ def main() -> None:
     p.add_argument("--osds", type=int, default=12)
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--warm-seconds", type=float, default=10.0,
+                   help="full-concurrency warmup so every batch-depth "
+                        "shape compiles before the timed phase")
     p.add_argument("--size", type=int, default=256 * 1024)
     p.add_argument("--k", type=int, default=8)
     p.add_argument("--m", type=int, default=3)
@@ -148,6 +202,10 @@ def main() -> None:
     p.add_argument("--stripe-unit", type=int, default=64 * 1024)
     p.add_argument("--technique", default="cauchy_tpu")
     p.add_argument("--device-mesh", action="store_true")
+    p.add_argument("--store", choices=("mem", "block"), default="mem",
+                   help="objectstore backend: mem (default) or block "
+                        "(raw-block WAL store — real fsyncs, real "
+                        "group commit)")
     args = p.parse_args()
     print(json.dumps(asyncio.run(run(args))))
 
