@@ -38,12 +38,14 @@ from .fs import FileSystem, FSError, _filedata_oid
 class MMDSOp(Message):
     """Client -> mds: fields: tid, op, args (json-able dict)."""
     TYPE = "mds_op"
+    FIELDS = ("tid", "op", "args")
 
 
 @register_message
 class MMDSOpReply(Message):
     """mds -> client: fields: tid, result (0 or -errno), value."""
     TYPE = "mds_op_reply"
+    FIELDS = ("tid", "result", "value")
 
 
 class MDSDaemon(Dispatcher):

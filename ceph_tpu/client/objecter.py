@@ -79,6 +79,11 @@ class Objecter(Dispatcher):
         self.ms.add_dispatcher(self)
         self._next_tid = 0
         self._inflight: "Dict[int, asyncio.Future]" = {}
+        # admission cap (reference objecter_inflight_ops / the
+        # op_budget throttle): submits past the limit queue on the
+        # semaphore instead of flooding the session
+        self._op_budget = asyncio.Semaphore(
+            max(1, int(ms.conf("objecter_inflight_ops"))))
         # live OSD backoffs: (pool, pg) -> _Backoff; ops targeting a
         # blocked PG park instead of sending
         self.backoffs: "Dict[Tuple[int, int], _Backoff]" = {}
@@ -214,7 +219,17 @@ class Objecter(Dispatcher):
         ``pg`` pins the target PG instead of hashing ``oid`` — the PGLS
         path (reference Objecter::_pg_read / CEPH_OSD_OP_PGNLS), which
         enumerates a pool one PG at a time and never redirects through
-        a cache tier (it lists the pool it was asked about)."""
+        a cache tier (it lists the pool it was asked about).
+
+        Admission rides objecter_inflight_ops: the semaphore bounds
+        concurrently submitted logical ops, retries included."""
+        async with self._op_budget:
+            return await self._op_submit(pool_id, oid, ops, data, pg)
+
+    async def _op_submit(self, pool_id: int, oid: str,
+                         ops: "List[dict]", data: bytes = b"",
+                         pg: "Optional[int]" = None
+                         ) -> "Tuple[List[dict], bytes]":
         last_err: "Optional[Exception]" = None
         # one tid per *logical* op: retries reuse it, and the server-side
         # reqid dedup (reference osd_reqid_t in the PG log) keeps a

@@ -68,7 +68,9 @@ class RadosClient:
                                "epoch": self.osdmap.epoch},
                    "client status")
         from ..common.log import register_log_commands
+        from ..common.lockdep import register_lockdep_commands
         register_log_commands(a)
+        register_lockdep_commands(a)
         a.register("clog stats",
                    lambda _c: self.clog.dump(),
                    "cluster-log client counters")
@@ -116,6 +118,18 @@ class RadosClient:
         if pool is None:
             raise ObjecterError(f"no pool {pool_name!r}")
         return IoCtx(self, pool.pool_id)
+
+    def striper_ctx(self, pool_name: str):
+        """libradosstriper-style handle with the layout defaulted from
+        the client_striper_* options (callers wanting a custom layout
+        construct RadosStriper directly, like the reference's
+        set_object_layout_* calls)."""
+        from .striper import RadosStriper
+        return RadosStriper(
+            self.io_ctx(pool_name),
+            stripe_unit=int(self.ms.conf("client_striper_stripe_unit")),
+            stripe_count=int(self.ms.conf("client_striper_stripe_count")),
+            object_size=int(self.ms.conf("client_striper_object_size")))
 
 
 class IoCtx:

@@ -32,6 +32,28 @@ from .log import get_log
 from .logclient import LogClient, conf_get
 
 
+def fallback_spawn(coro, context: str = "",
+                   subsys: str = "none") -> "asyncio.Task":
+    """Spawn shell for components running WITHOUT a CrashHandler (unit
+    tests drive ECBackend/Paxos directly): no dump, but a task death
+    still lands in the dout ring instead of vanishing.  Components
+    owned by a daemon get ``CrashHandler.guard`` swapped in instead."""
+    async def run() -> None:
+        try:
+            await coro
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — log-and-drop shell
+            get_log().dout(subsys, -1,
+                           f"task {context or '?'} died: "
+                           f"{type(e).__name__}: {e}")
+    t = asyncio.ensure_future(run())
+    # a task cancelled before its first step never awaited ``coro`` —
+    # close it so teardown doesn't warn (no-op once it has run)
+    t.add_done_callback(lambda _t: coro.close())
+    return t
+
+
 def crash_summary(meta: dict) -> dict:
     """The 'crash ls' row for one dump."""
     return {"crash_id": meta.get("crash_id", "?"),
@@ -155,6 +177,11 @@ class CrashHandler:
                 except Exception:  # noqa: BLE001 — boot re-posts
                     pass
             try:
+                # the post coroutine swallows every exception itself
+                # (boot re-posts cover a lost send), so there is no
+                # handle worth keeping — and guard() cannot be used
+                # from inside the capture path it implements
+                # cephlint: disable=fire-and-forget
                 asyncio.ensure_future(post())
             except RuntimeError:
                 pass            # no loop (sync teardown context)
@@ -177,11 +204,14 @@ class CrashHandler:
             self.capture(e, f"ms_dispatch({msg.TYPE})")
             raise
 
-    def task(self, coro, context: str = "") -> "asyncio.Task":
-        """ensure_future with crash capture: the daemon-loop spawner.
-        The exception is captured, not re-raised — the task is already
-        dead either way, and re-raising only produces 'exception never
-        retrieved' noise over the dump we just wrote."""
+    def guard(self, coro, context: str = "") -> "asyncio.Task":
+        """ensure_future with crash capture: the daemon-loop spawner,
+        and the sanctioned form for every fire-and-forget spawn
+        (cephlint's fire-and-forget checker exists to funnel bare
+        ``asyncio.ensure_future(...)`` statements here).  The exception
+        is captured, not re-raised — the task is already dead either
+        way, and re-raising only produces 'exception never retrieved'
+        noise over the dump we just wrote."""
         async def run() -> None:
             try:
                 await coro
@@ -197,6 +227,9 @@ class CrashHandler:
         # — close it so teardown doesn't warn (no-op once it has run)
         t.add_done_callback(lambda _t: coro.close())
         return t
+
+    # historical name: the spawner predates the cephlint vocabulary
+    task = guard
 
     # --- posting / listing ----------------------------------------------------
 

@@ -94,6 +94,29 @@ def graph_dump() -> dict:
     return out
 
 
+def register_lockdep_commands(asok) -> None:
+    """Register ``lockdep dump`` on a daemon admin socket.  EVERY
+    daemon serves it (not just the OSD): cephlint's lock-order checker
+    diffs the static async-with graph against these observed edges
+    (``--lockdep-dump``), and an inversion may only ever RUN on a mon
+    or a client.
+
+    ``format=json`` returns just the machine-readable order graph in
+    the runtime lockdep wire shape ``{"edges": [[held, acquiring]...]}``
+    — the exact input cephlint consumes; the default (human) form adds
+    held-lock sites and recent stall reports for operators."""
+    def _dump(cmd: dict) -> dict:
+        if str(cmd.get("format", "")) == "json":
+            return _graph.dump()
+        return {**graph_dump(),
+                "stall_reports": DepLock.stall_reports[-20:]}
+
+    asok.register("lockdep dump", _dump,
+                  "lock order graph (+held locks and stalled-await "
+                  "reports; format=json -> bare {edges} for cephlint "
+                  "--lockdep-dump)")
+
+
 def reset() -> None:
     """Test hook: forget all recorded edges."""
     _graph.edges.clear()

@@ -97,6 +97,28 @@ class Log:
     def derr(self, subsys: str, msg: str) -> None:
         self.dout(subsys, -1, msg)
 
+    # --- config glue ----------------------------------------------------------
+
+    def configure(self, config) -> None:
+        """Apply the log_* option family (ring size, file sink) — the
+        reference's log_max_recent / log_file behavior.  Called from
+        attach_debug_options so every daemon init path hits it."""
+        try:
+            max_recent = int(config.get("log_max_recent"))
+            to_file = bool(config.get("log_to_file"))
+            path = str(config.get("log_file"))
+        except Exception:  # noqa: BLE001 — partial schemas (bare Config)
+            return
+        with self._lock:
+            if max_recent != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=max_recent)
+            if to_file and path and self._stream is None:
+                try:
+                    self._stream = open(path, "a")
+                except OSError as e:
+                    sys.stderr.write(f"log: cannot open {path}: {e}\n")
+
     # --- crash support --------------------------------------------------------
 
     def dump_recent(self, stream: "Optional[io.TextIOBase]" = None) -> "list[str]":
@@ -185,6 +207,7 @@ def attach_debug_options(config, log: "Optional[Log]" = None) -> None:
     log = log or get_log()
     if getattr(config, "_debug_log_observer", None) is not None:
         return
+    log.configure(config)
     keys = [n for n in config.schema
             if n.startswith("debug_") and n != "debug_default"]
     if not keys:

@@ -38,6 +38,11 @@ class Option:
     enum_values: "tuple[str, ...]" = ()
     see_also: "tuple[str, ...]" = ()
     services: "tuple[str, ...]" = ()
+    # Settable-but-inert: kept so operator configs carrying the name
+    # keep validating, exempt from cephlint's dead-option check (the
+    # reference's level=dev + "obsolete" annotations collapsed to one
+    # flag).  A deprecated option must say WHY in its desc.
+    deprecated: bool = False
 
     def validate(self, value: Any) -> Any:
         """Coerce + bounds-check ``value``; raises OptionError."""
@@ -95,13 +100,24 @@ OPTIONS: "dict[str, Option]" = _opts(
            services=("mon",)),
     # --- osd ----------------------------------------------------------------
     Option("osd_heartbeat_interval", float, 1.0, LEVEL_ADVANCED,
-           min=0.05, max=60, desc="seconds between peer pings",
-           services=("osd",)),
+           min=0.05, max=60,
+           desc="seconds between peer pings (deprecated: no osd<->osd "
+                "ping mesh in the rebuild; beacon cadence is "
+                "osd_beacon_report_interval, liveness judgment is "
+                "osd_heartbeat_grace)",
+           see_also=("osd_beacon_report_interval",
+                     "osd_heartbeat_grace"),
+           services=("osd",), deprecated=True),
     Option("osd_heartbeat_min_peers", int, 10, LEVEL_ADVANCED, min=1,
-           desc="minimum heartbeat peers per osd", services=("osd",)),
+           desc="minimum heartbeat peers per osd (deprecated: the "
+                "rebuild has no osd<->osd ping mesh — beacons + "
+                "failure reports cover liveness)",
+           services=("osd",), deprecated=True),
     Option("osd_mon_heartbeat_interval", float, 30.0, LEVEL_ADVANCED,
-           min=1, desc="seconds between mon pings when idle",
-           services=("osd",)),
+           min=1, desc="seconds between mon pings when idle "
+                       "(deprecated: beacons are the only osd->mon "
+                       "liveness channel here)",
+           services=("osd",), deprecated=True),
     Option("osd_beacon_report_interval", float, 5.0, LEVEL_ADVANCED,
            min=0.1, desc="seconds between osd beacons to the mon",
            services=("osd",)),
@@ -109,21 +125,33 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="seconds to sleep between recovery ops (throttle)",
            services=("osd",)),
     Option("osd_recovery_op_priority", int, 3, LEVEL_ADVANCED, min=1,
-           max=63, desc="priority of recovery ops", services=("osd",)),
+           max=63, desc="priority of recovery ops (deprecated: QoS "
+                        "rides the mclock background_recovery class, "
+                        "not numeric priorities)",
+           services=("osd",), deprecated=True),
     Option("osd_max_backfills", int, 1, LEVEL_ADVANCED, min=1,
-           desc="concurrent backfills per osd", services=("osd",)),
+           desc="concurrent backfills per osd (deprecated: recovery "
+                "concurrency is osd_recovery_max_active; there is no "
+                "separate backfill reservation ladder)",
+           services=("osd",), deprecated=True),
     Option("osd_backfill_scan_min", int, 64, LEVEL_ADVANCED, min=1,
-           desc="min objects per backfill scan", services=("osd",)),
+           desc="min objects per backfill scan (deprecated: backfill "
+                "plans from the full object listing in one pass)",
+           services=("osd",), deprecated=True),
     Option("osd_backfill_scan_max", int, 512, LEVEL_ADVANCED, min=1,
-           desc="max objects per backfill scan", services=("osd",)),
+           desc="max objects per backfill scan (deprecated: see "
+                "osd_backfill_scan_min)",
+           services=("osd",), deprecated=True),
     Option("osd_scrub_auto_repair", bool, False, LEVEL_ADVANCED,
            desc="repair inconsistencies found by scrub automatically",
            services=("osd",)),
     Option("osd_scrub_min_interval", float, 86400.0, LEVEL_ADVANCED,
-           min=1, desc="seconds between shallow scrubs of a PG",
+           min=0.05, desc="seconds between shallow scrubs of a PG "
+                          "(sub-second values are for QA)",
            services=("osd",)),
     Option("osd_deep_scrub_interval", float, 604800.0, LEVEL_ADVANCED,
-           min=1, desc="seconds between deep scrubs of a PG",
+           min=0.05, desc="seconds between deep scrubs of a PG "
+                          "(sub-second values are for QA)",
            services=("osd",)),
     Option("osd_scrub_chunk_max", int, 25, LEVEL_ADVANCED, min=1,
            desc="max objects per scrub chunk", services=("osd",)),
@@ -241,7 +269,9 @@ OPTIONS: "dict[str, Option]" = _opts(
                 "(0 = agent off; per-object cache_flush ops still "
                 "work)", services=("osd",)),
     Option("mgr_module_path", str, "", LEVEL_ADVANCED, (FLAG_STARTUP,),
-           desc="extra directory for mgr modules", services=("mgr",)),
+           desc="extra directory for mgr modules (deprecated: modules "
+                "are in-tree; out-of-tree loading is not built)",
+           services=("mgr",), deprecated=True),
     # --- tracing / op tracking ---------------------------------------------
     Option("osd_op_history_size", int, 20, LEVEL_ADVANCED, min=0,
            desc="completed ops kept for dump_historic_ops",
@@ -290,15 +320,19 @@ OPTIONS: "dict[str, Option]" = _opts(
            min=0.1, desc="seconds without reply before reporting a peer down",
            see_also=("osd_heartbeat_interval",), services=("osd", "mon")),
     Option("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
-           min=4096, desc="max recovery payload per push (bytes)",
-           services=("osd",)),
+           min=4096, desc="max recovery payload per push (bytes) "
+                          "(deprecated: pushes ship whole shards; "
+                          "chunked pushes are not built)",
+           services=("osd",), deprecated=True),
     Option("osd_recovery_max_active", int, 3, LEVEL_ADVANCED, min=1,
            desc="concurrent recovery ops per OSD", services=("osd",)),
     Option("osd_max_write_size", int, 90 << 20, LEVEL_ADVANCED, min=4096,
            desc="max single write accepted from clients", services=("osd",)),
     Option("osd_client_message_cap", int, 256, LEVEL_ADVANCED, min=1,
-           desc="max in-flight client messages before backpressure",
-           services=("osd",)),
+           desc="max in-flight client messages before backpressure "
+                "(deprecated: superseded by the osd_backoff_queue_* "
+                "admission watermarks)",
+           services=("osd",), deprecated=True),
     Option("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
            enum_values=("wpq", "mclock"), desc="op scheduler implementation",
            services=("osd",)),
@@ -346,9 +380,6 @@ OPTIONS: "dict[str, Option]" = _opts(
            min=0,
            desc="batches smaller than this fall back to host encode "
                 "(device dispatch overhead exceeds the kernel)"),
-    Option("osd_ec_batch_stripes", int, 64, LEVEL_ADVANCED, min=1,
-           desc="stripes batched per device encode launch across PGs "
-                "(TPU amortization knob)", services=("osd",)),
     Option("osd_fast_read", bool, False, LEVEL_ADVANCED,
            desc="issue redundant shard reads, decode from first k",
            services=("osd",)),

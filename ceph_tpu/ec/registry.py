@@ -156,6 +156,15 @@ class ErasureCodePluginRegistry:
             self.load(name, directory=directory)
         return list(plugins)
 
+    def preload_from_config(self, config) -> "list[str]":
+        """Daemon-start preload driven by the options the reference's
+        global_init reads: the osd_erasure_code_plugins list, looked up
+        in erasure_code_dir (empty = in-tree plugins only)."""
+        plugins = tuple(
+            str(config.get("osd_erasure_code_plugins")).split())
+        directory = str(config.get("erasure_code_dir")) or None
+        return self.preload(plugins, directory=directory)
+
     def factory(self, name: str, profile: Profile,
                 directory: Optional[str] = None) -> ErasureCodeInterface:
         """Instantiate + init a codec from a profile (reference

@@ -27,6 +27,7 @@ class MMgrReport(Message):
     """Daemon -> mgr: fields: daemon ("osd.0"), perf (collection dump),
     status (free-form dict), epoch."""
     TYPE = "mgr_report"
+    FIELDS = ("daemon", "perf", "status", "epoch")
 
 
 class MgrModule:
@@ -319,8 +320,10 @@ class MgrDaemon(Dispatcher):
             return
         from ..common.admin_socket import AdminSocket
         from ..common.log import register_log_commands
+        from ..common.lockdep import register_lockdep_commands
         a = AdminSocket(path.replace("$name", "mgr"))
         register_log_commands(a)
+        register_lockdep_commands(a)
         a.register("status",
                    lambda _c: {"num_reports": len(self.reports),
                                "modules": sorted(self.modules)},

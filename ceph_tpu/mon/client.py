@@ -55,12 +55,16 @@ class MonClient(Dispatcher):
 
     # --- commands -------------------------------------------------------------
 
-    async def command(self, cmd: dict, timeout: float = 5.0,
+    async def command(self, cmd: dict,
+                      timeout: "Optional[float]" = None,
                       attempts: int = 8) -> dict:
         """Send a command, following leader redirects and retrying
         through elections (reference MonClient::start_mon_command +
         forwarding; -EAGAIN means 'not leader / election in progress',
-        which is transient by construction)."""
+        which is transient by construction).  The per-attempt timeout
+        defaults to rados_mon_op_timeout."""
+        if timeout is None:
+            timeout = float(self.ms.conf("rados_mon_op_timeout"))
         last_err: "Optional[str]" = None
         for attempt in range(attempts):
             # leader guess first, then the rest — rebuilt every attempt

@@ -7,50 +7,63 @@ from ..msg.message import Message, register_message
 
 @register_message
 class MMonElection(Message):
-    """fields: op (propose|ack|victory), rank, epoch, quorum?"""
+    """fields: op (propose|ack|victory|lease), rank, epoch, quorum?"""
     TYPE = "mon_election"
+    FIELDS = ("op", "rank", "epoch?", "quorum?")
 
 
 @register_message
 class MMonPaxosMsg(Message):
-    """fields: op (collect|last|begin|accept|commit), rank, + phase fields"""
+    """fields: op (collect|last|begin|accept|commit), rank, + the
+    phase fields (v/pn/value, last_committed, uncommitted_*)."""
     TYPE = "mon_paxos"
+    FIELDS = ("op", "rank", "v?", "pn?", "value?", "last_committed?",
+              "uncommitted_v?", "uncommitted_pn?")
 
 
 @register_message
 class MMonCommand(Message):
     """fields: tid, cmd (dict) — the 'ceph ...' JSON command RPC."""
     TYPE = "mon_command"
+    FIELDS = ("tid", "cmd")
 
 
 @register_message
 class MMonCommandReply(Message):
     """fields: tid, result, out (dict)."""
     TYPE = "mon_command_reply"
+    FIELDS = ("tid", "result", "out")
 
 
 @register_message
 class MMonSubscribe(Message):
     """fields: what (['osdmap', ...]), addr (subscriber's listen addr)."""
     TYPE = "mon_subscribe"
+    FIELDS = ("what", "addr")
 
 
 @register_message
 class MOSDBoot(Message):
     """fields: osd_id, addr (reference MOSDBoot.h)."""
     TYPE = "osd_boot"
+    FIELDS = ("osd_id", "addr")
 
 
 @register_message
 class MOSDBeacon(Message):
-    """fields: osd_id, epoch (reference MOSDBeacon.h)."""
+    """fields: osd_id, epoch (reference MOSDBeacon.h); slow_ops
+    carries the op-tracker's slow-op summary for mon health."""
     TYPE = "osd_beacon"
+    FIELDS = ("osd_id", "epoch", "slow_ops?")
 
 
 @register_message
 class MOSDFailure(Message):
-    """fields: reporter, failed_osd, since (reference MOSDFailure.h)."""
+    """fields: reporter, failed_osd (reference MOSDFailure.h; the
+    reference's failed_since stamp is not carried — the mon stamps
+    receipt time for its grace window)."""
     TYPE = "osd_failure"
+    FIELDS = ("reporter", "failed_osd")
 
 
 @register_message
@@ -60,6 +73,7 @@ class MLog(Message):
     forward to the leader; the leader dedups by (name, seq) and
     proposes through paxos (LogMonitor)."""
     TYPE = "log"
+    FIELDS = ("entries",)
 
 
 @register_message
@@ -68,3 +82,4 @@ class MCrashReport(Message):
     analog).  fields: dumps: [crash meta dicts].  Dedup by crash_id on
     the mon, so boot-time re-posts are idempotent."""
     TYPE = "crash_report"
+    FIELDS = ("dumps",)

@@ -112,6 +112,8 @@ class MonDaemon(Dispatcher):
         self.crash = CrashHandler(f"mon.{rank}", self.config,
                                   clog=self.clog,
                                   post_fn=self._submit_crash_dump)
+        # paxos commit notifications now die loudly (dump + clog)
+        self.paxos.spawn = self.crash.guard
         self.admin_socket = None
         self._tick_task: "Optional[asyncio.Task]" = None
         from ..common.lockdep import DepLock
@@ -140,8 +142,10 @@ class MonDaemon(Dispatcher):
         if not path:
             return
         from ..common.admin_socket import AdminSocket
+        from ..common.lockdep import register_lockdep_commands
         a = AdminSocket(path.replace("$name", f"mon.{self.rank}"))
         register_log_commands(a)
+        register_lockdep_commands(a)
         a.register("status",
                    lambda _c: {"rank": self.rank,
                                "leader": self.elector.leader,
@@ -215,7 +219,7 @@ class MonDaemon(Dispatcher):
             if self.is_leader:
                 # only the leader publishes (subscribers register with
                 # every mon, so a new leader already knows them)
-                asyncio.ensure_future(self._broadcast_map())
+                self.crash.guard(self._broadcast_map(), "broadcast_map")
         elif txn.get("service") == "config":
             for op in txn["ops"]:
                 if op["op"] == "set":
@@ -927,9 +931,26 @@ class MonDaemon(Dispatcher):
             if self.osdmap.pool_by_name(name) is not None:
                 return -17, {"error": f"pool {name} exists"}
             kwargs = dict(cmd.get("kwargs", {}))
+            kwargs.setdefault(
+                "pg_num", int(self.config.get("osd_pool_default_pg_num")))
+            ops = []
             profile_name = kwargs.get("ec_profile", "")
             if kwargs.get("type") == POOL_ERASURE:
+                if not profile_name:
+                    # no profile named: materialize the schema default
+                    # (osd_pool_default_erasure_code_profile, the
+                    # reference's implicit 'default' profile) on first
+                    # use, via the same paxos op as an explicit set
+                    profile_name = "default"
+                    kwargs["ec_profile"] = profile_name
                 prof = self.osdmap.ec_profiles.get(profile_name)
+                if prof is None and profile_name == "default":
+                    prof_s = str(self.config.get(
+                        "osd_pool_default_erasure_code_profile"))
+                    prof = dict(kv.split("=", 1) for kv in prof_s.split())
+                    factory_from_profile(dict(prof))
+                    ops.append({"op": "set_ec_profile",
+                                "name": profile_name, "profile": prof})
                 if prof is None:
                     return -2, {"error": f"no profile {profile_name}"}
                 k, m = int(prof.get("k", 2)), int(prof.get("m", 1))
@@ -937,8 +958,24 @@ class MonDaemon(Dispatcher):
                 # k+1 default (reference): acked-at-exactly-k writes
                 # become unreadable on the next single failure
                 kwargs.setdefault("min_size", min(k + 1, k + m))
-            v = await self._propose_osd_ops([{
-                "op": "create_pool", "name": name, "kwargs": kwargs}])
+            else:
+                kwargs.setdefault(
+                    "size", int(self.config.get("osd_pool_default_size")))
+            # reference OSDMonitor pg-per-osd cap: creation that would
+            # push average PG placements per OSD past the limit bounces
+            placements = int(kwargs["pg_num"]) * int(kwargs.get("size", 3))
+            placements += sum(p.pg_num * p.size
+                              for p in self.osdmap.pools.values())
+            n_osds = max(1, len(self.osdmap.osds))
+            cap = int(self.config.get("mon_max_pg_per_osd"))
+            if placements > cap * n_osds:
+                return -34, {"error":          # ERANGE, like the reference
+                             f"pool would raise PG placements to "
+                             f"{placements} > mon_max_pg_per_osd "
+                             f"({cap}) * {n_osds} osds"}
+            ops.append({"op": "create_pool", "name": name,
+                        "kwargs": kwargs})
+            v = await self._propose_osd_ops(ops)
             pool = self.osdmap.pool_by_name(name)
             return 0, {"pool_id": pool.pool_id, "epoch": v}
         if prefix == "osd pool set":

@@ -32,6 +32,11 @@ class PaxosTransport:
         raise NotImplementedError
 
 
+def _fallback_spawn(coro, context: str = "") -> "asyncio.Task":
+    from ..common.crash import fallback_spawn
+    return fallback_spawn(coro, f"paxos.{context}", subsys="mon")
+
+
 class Paxos:
     """One replicated log instance (Ceph multiplexes all services over a
     single Paxos instance the same way)."""
@@ -43,6 +48,10 @@ class Paxos:
         self.transport = transport
         self.store = store
         self.on_commit = on_commit
+        # fire-and-forget spawner for the async commit notifications;
+        # the mon swaps in CrashHandler.guard once its crash shell is
+        # up, so a dead notify task leaves a dump instead of vanishing
+        self.spawn = _fallback_spawn
         # membership (set by the elector on every election)
         self.quorum: "List[int]" = [rank]
         self.leader: int = rank
@@ -202,6 +211,10 @@ class Paxos:
             self.store[f"pending_value"] = bytes(value)
             for peer in self.quorum:
                 if peer != self.rank:
+                    # the propose lock IS the one-pending-proposal
+                    # invariant: begin must go out inside the round it
+                    # serializes (the 5s commit wait bounds a stall)
+                    # cephlint: disable=lock-order
                     await self.transport.send(peer, "begin", {
                         "v": v, "pn": self.accepted_pn,
                         "value": value.hex()})
@@ -228,8 +241,9 @@ class Paxos:
         # async commit notification to peons
         for peer in self.quorum:
             if peer != self.rank:
-                asyncio.ensure_future(self.transport.send(
-                    peer, "commit", {"v": v, "value": value.hex()}))
+                self.spawn(self.transport.send(
+                    peer, "commit", {"v": v, "value": value.hex()}),
+                    f"paxos_commit_notify(mon.{peer})")
 
     # --- message handlers -----------------------------------------------------
 

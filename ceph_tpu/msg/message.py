@@ -103,8 +103,10 @@ def decode_message(header: bytes, data: bytes,
 @register_message
 class MPing(Message):
     TYPE = "ping"
+    FIELDS = ()
 
 
 @register_message
 class MPong(Message):
     TYPE = "pong"
+    FIELDS = ()

@@ -38,6 +38,8 @@ MAGIC = 0x43545032  # "CTP2"
 _FRAME_HDR = struct.Struct("<IBQQII")  # magic, flags, seq, ack, hlen, dlen
 FLAG_SECURE = 1
 FLAG_COMPRESSED = 2   # data segment compressed (msgr2 compression hooks)
+FLAG_NOCRC = 4        # ms_crc_data=false: trailer is zero, not checked
+                      # (reference crc-mode msgr2 with data crcs off)
 
 
 def entity_addr(addr: str) -> "Tuple[str, int]":
@@ -119,6 +121,10 @@ class Connection:
         self._out_q: "List[bytes]" = []
         self._flush_task: "Optional[asyncio.Task]" = None
         self._flush_done: "Optional[asyncio.Future]" = None
+        # per-session snapshot (frame building is the hot path — no
+        # layered config lookup per frame); new sessions pick up a
+        # runtime ms_crc_data change
+        self._crc_data = bool(messenger.conf("ms_crc_data"))
 
     # --- crypto/frame helpers -------------------------------------------------
 
@@ -155,6 +161,13 @@ class Connection:
             sealed = AESGCM(self._seal_key()).encrypt(
                 self._nonce(seq, outbound=True), body, hdr)
             return hdr + sealed
+        if not force_plain and not self._crc_data:
+            # operator turned payload crcs off (TCP checksums only);
+            # banners stay protected — they carry the session nonce salt
+            flags |= FLAG_NOCRC
+            hdr = _FRAME_HDR.pack(MAGIC, flags, seq, ack, len(header),
+                                  len(data))
+            return hdr + body + struct.pack("<I", 0)
         hdr = _FRAME_HDR.pack(MAGIC, flags, seq, ack, len(header), len(data))
         crc = crcmod.crc32c(hdr + body)
         return hdr + body + struct.pack("<I", crc)
@@ -174,7 +187,13 @@ class Connection:
             body = await reader.readexactly(hlen + dlen)
             crc, = struct.unpack("<I",
                                  await reader.readexactly(4))
-            if crc != crcmod.crc32c(hdr + body):
+            # FLAG_NOCRC is only honored when THIS side also runs
+            # ms_crc_data=false: crc-off is a configuration both ends
+            # opted into, never a per-frame assertion by the wire — a
+            # flipped flags bit (or a misconfigured peer) must fail the
+            # checksum, not silently disable it
+            if not (flags & FLAG_NOCRC and not self._crc_data) and \
+                    crc != crcmod.crc32c(hdr + body):
                 raise MessageError("frame crc mismatch")
         header, data = body[:hlen], body[hlen:]
         if flags & FLAG_COMPRESSED:

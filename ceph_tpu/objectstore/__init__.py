@@ -18,6 +18,14 @@ from .filestore import FileStore  # noqa: F401
 from .kvstore import KVStore  # noqa: F401
 
 
+def create_store_from_config(config, path: str = "") -> ObjectStore:
+    """Daemon boot path: backend from objectstore_type, rooted at
+    ``path`` or objectstore_path (tools/ceph_daemon.py's entry)."""
+    return create_store(str(config.get("objectstore_type")),
+                        path or str(config.get("objectstore_path")),
+                        config=config)
+
+
 def create_store(kind: str, path: str = "",
                  config=None) -> ObjectStore:
     """Factory keyed by the objectstore_type option."""
@@ -26,7 +34,13 @@ def create_store(kind: str, path: str = "",
     if kind == "file":
         if not path:
             raise StoreError("file store needs objectstore_path")
-        return FileStore(path)
+        fsync = False
+        if config is not None:
+            try:
+                fsync = bool(config.get("objectstore_fsync"))
+            except Exception:  # noqa: BLE001 — partial schemas
+                fsync = False
+        return FileStore(path, fsync=fsync)
     if kind in ("kv", "kvstore", "bluestore"):
         # all state in a KeyValueDB (sqlite WAL when a path is given,
         # memdb otherwise) — the reference's kstore layout.  The

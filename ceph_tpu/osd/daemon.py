@@ -30,7 +30,7 @@ from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..objectstore.memstore import MemStore
 from ..objectstore.store import NotFound, ObjectStore
-from .messages import EACCES
+from .messages import EACCES, EFBIG
 from .ecbackend import (EIO, ENOENT, ESTALE, ClientOp, ECBackend, ECError,
                         NONE_OSD, NotActive)
 from .ecutil import StripeInfo
@@ -200,6 +200,10 @@ class OSDDaemon(Dispatcher):
         self._notifies: "Dict[int, Tuple[set, asyncio.Future]]" = {}
         self._mgr_task = None
         self._agent_task = None
+        self._scrub_task = None
+        # pgid -> (last shallow stamp, last deep stamp), monotonic;
+        # seeded on first sight so intervals count from boot, not epoch
+        self._scrub_stamps: "Dict[Tuple[int, int], List[float]]" = {}
         self._beacon_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
         # last-consumed pg_num per pool: a map epoch raising it triggers
@@ -234,6 +238,12 @@ class OSDDaemon(Dispatcher):
         self.store.mount()
         from ..common.log import attach_debug_options
         attach_debug_options(self.config)
+        # preload the configured EC plugin set (reference
+        # global_init_preload_erasure_code): a broken plugin fails the
+        # boot, not the first degraded write that needs it
+        from ..ec.registry import ErasureCodePluginRegistry
+        ErasureCodePluginRegistry.instance().preload_from_config(
+            self.config)
         self.clog.start()
         self._load_consumed_pg_nums()
         addr = self.osdmap.get_addr(self.whoami) if self.monc is None \
@@ -272,6 +282,12 @@ class OSDDaemon(Dispatcher):
         # writeback tiering agent (no-ops unless cache pools exist)
         self._agent_task = self.crash.task(self._cache_agent_loop(),
                                            "cache_agent_loop")
+        # background scrub scheduler (reference OSD::sched_scrub):
+        # shallow every osd_scrub_min_interval, deep every
+        # osd_deep_scrub_interval — day/week defaults mean it idles in
+        # QA unless a test tunes the intervals down
+        self._scrub_task = self.crash.task(self._scrub_loop(),
+                                           "scrub_loop")
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
         self.clog.info(f"osd.{self.whoami} up at {self.ms.listen_addr}")
         # dumps from previous incarnations (kill -9 + respawn against
@@ -589,6 +605,16 @@ class OSDDaemon(Dispatcher):
                 dout("osd", 1, f"osd.{self.whoami} pg {pgid} peered: {res}")
         except Exception as e:  # noqa: BLE001 — peering must not kill the loop
             dout("osd", 0, f"peering {pgid} failed: {type(e).__name__}: {e}")
+            # reference requeue_pg: a failed pass retries after
+            # osd_recovery_retry_interval instead of staying degraded
+            # until the next map epoch happens to arrive
+            retry_s = float(self.config.get("osd_recovery_retry_interval"))
+
+            async def _retry() -> None:
+                await asyncio.sleep(retry_s)
+                if self.up:
+                    self._maybe_repeer(pgid)
+            self.crash.guard(_retry(), f"repeer_retry{pgid}")
 
     async def peer_all_pgs(self) -> "Dict[Tuple[int, int], dict]":
         """Explicit peering sweep (static-map harness + admin use)."""
@@ -603,13 +629,63 @@ class OSDDaemon(Dispatcher):
         return out
 
     async def _beacon_loop(self) -> None:
-        interval = float(self.config.get("osd_heartbeat_interval"))
+        # cephlint (options) found this reading osd_heartbeat_interval:
+        # beacons have their own cadence knob (reference MOSDBeacon
+        # rides osd_beacon_report_interval, not the peer-ping timer).
+        # Clamped to a third of the grace that judges the beacons — a
+        # cadence slower than its own liveness deadline is never what
+        # the operator meant and would flap every OSD down.
+        interval = min(
+            float(self.config.get("osd_beacon_report_interval")),
+            float(self.config.get("osd_heartbeat_grace")) / 3.0)
         while True:
             # the beacon carries the slow-op summary so the mon can
             # fold SLOW_OPS into cluster health ('ceph status')
             await self.monc.send_beacon(
                 self.whoami, slow_ops=self.op_tracker.slow_summary())
             await asyncio.sleep(interval)
+
+    async def _scrub_loop(self) -> None:
+        """Background scrub scheduler.  One scrub at a time per OSD;
+        deep scrubs repair automatically only under
+        osd_scrub_auto_repair (admin-triggered scrubs pass their own
+        repair flag)."""
+        while True:
+            min_i = float(self.config.get("osd_scrub_min_interval"))
+            deep_i = float(self.config.get("osd_deep_scrub_interval"))
+            await asyncio.sleep(min(max(min(min_i, deep_i) / 4.0, 0.05),
+                                    60.0))
+            if not self.up:
+                continue
+            auto_repair = bool(self.config.get("osd_scrub_auto_repair"))
+            now = time.monotonic()
+            for pgid, be in list(self.backends.items()):
+                stamps = self._scrub_stamps.setdefault(
+                    pgid, [now, now])
+                _u, acting = self.osdmap.pg_to_up_acting_osds(*pgid)
+                if self.osdmap.primary_of(acting) != self.whoami \
+                        or be.peering:
+                    continue
+                deep = now - stamps[1] > deep_i
+                if not deep and now - stamps[0] <= min_i:
+                    continue
+                try:
+                    res = await be.scrub(deep=deep,
+                                         repair=deep and auto_repair)
+                    dout("osd", 2,
+                         f"osd.{self.whoami} background "
+                         f"{'deep-' if deep else ''}scrub {pgid}: "
+                         f"{res['objects']} objects, "
+                         f"{len(res['repaired'])} repaired")
+                except Exception as e:  # noqa: BLE001 — scrubbing must
+                    # outlive any one PG's failure (same rule as the
+                    # peering loop); the next tick retries
+                    dout("osd", 1, f"background scrub {pgid} failed: "
+                                   f"{type(e).__name__}: {e}")
+                    continue
+                stamps[0] = time.monotonic()
+                if deep:
+                    stamps[1] = stamps[0]
 
     # --- cache tiering (reference PrimaryLogPG promote/flush/evict +
     # --- the tiering agent; lean writeback mode) ------------------------------
@@ -974,13 +1050,8 @@ class OSDDaemon(Dispatcher):
                    lambda c: {"hit_sets": self._get_backend(
                        (int(c["pool"]), int(c["pg"]))).hit_set_ls()},
                    "archived + open object-access hit sets for a pg")
-        from ..common import lockdep as _lockdep
-        a.register("lockdep dump",
-                   lambda _c: {**_lockdep.graph_dump(),
-                               "stalls":
-                               _lockdep.DepLock.stall_reports[-20:]},
-                   "recorded lock-order edges, currently-held locks, "
-                   "and stalled-await reports (reference lockdep.cc)")
+        from ..common.lockdep import register_lockdep_commands
+        register_lockdep_commands(a)
         a.register("profile start",
                    lambda c: self._profile_ctl(True, c.get("dir", "")),
                    "start a jax.profiler device trace (kernel timeline "
@@ -1008,10 +1079,25 @@ class OSDDaemon(Dispatcher):
 
     async def shutdown(self) -> None:
         self.up = False
+        if not bool(self.config.get("osd_fast_shutdown")):
+            # orderly teardown (osd_fast_shutdown=false, the reference's
+            # pre-Nautilus behavior): stop peering work and let in-flight
+            # client ops drain so the store umounts quiescent instead of
+            # mid-transaction (crash-consistent either way — this only
+            # trades shutdown latency for a clean final state)
+            for t in list(self._peer_tasks.values()):
+                if not t.done():
+                    t.cancel()
+            for _ in range(200):
+                if self._inflight_client_ops == 0:
+                    break
+                await asyncio.sleep(0.01)
         if self._beacon_task:
             self._beacon_task.cancel()
         if self._agent_task:
             self._agent_task.cancel()
+        if self._scrub_task:
+            self._scrub_task.cancel()
         if self._mgr_task:
             self._mgr_task.cancel()
         # flush pending clog entries while the messenger still works
@@ -1050,7 +1136,8 @@ class OSDDaemon(Dispatcher):
                        device_mesh=getattr(pool, "device_mesh", False),
                        fast_read=lambda p=pgid[0]: getattr(
                            self.osdmap.get_pool(p), "fast_read", False),
-                       perf=self.perf, profiler=self.profiler)
+                       perf=self.perf, profiler=self.profiler,
+                       spawn=self.crash.guard)
         be.last_epoch = self.osdmap.epoch
         # activation hook: peering completion releases the PG's
         # backoffs so blocked sessions resend (backoff protocol)
@@ -1155,8 +1242,9 @@ class OSDDaemon(Dispatcher):
             # Never report while WE are shutting down — a dying daemon's
             # sends all fail locally and would frame every live peer.
             if self.monc is not None and self.up:
-                asyncio.ensure_future(
-                    self.monc.report_failure(self.whoami, osd))
+                self.crash.guard(
+                    self.monc.report_failure(self.whoami, osd),
+                    f"report_failure(osd.{osd})")
             raise
 
     # --- RADOS backoff protocol (reference Session backoff handling in
@@ -1271,7 +1359,7 @@ class OSDDaemon(Dispatcher):
                         "epoch": self.osdmap.epoch}))
                 except (ConnectionError, OSError):
                     pass    # dead session: its reset cleared the client
-        asyncio.ensure_future(_send_unblocks())
+        self.crash.guard(_send_unblocks(), "backoff_unblocks")
 
     def _pg_activated(self, pgid: "Tuple[int, int]") -> None:
         """ECBackend activation hook: peering finished (or aborted), so
@@ -1402,7 +1490,8 @@ class OSDDaemon(Dispatcher):
                         except Exception:  # noqa: BLE001 — still serve
                             pass
                         await self.ms_dispatch(c, m)
-                    asyncio.ensure_future(_deliver_after_split())
+                    self.crash.guard(_deliver_after_split(),
+                                     "deliver_after_split")
                     return True
         if t == "osd_op":
             # fast-dispatch admission (reference ms_fast_dispatch ->
@@ -1704,6 +1793,25 @@ class OSDDaemon(Dispatcher):
         except Exception as e:  # noqa: BLE001 — retried on next op
             dout("osd", 1, f"service-key fetch failed: {e}")
 
+    def _op_too_big(self, msg: MOSDOp) -> str:
+        """Non-empty reason when the op breaches the size options."""
+        max_write = int(self.config.get("osd_max_write_size"))
+        max_object = int(self.config.get("osd_object_max_size"))
+        write_bytes = 0
+        for op in msg.get("ops", []):
+            dlen = int(op.get("dlen", 0) or 0)
+            if dlen <= 0:
+                continue            # reads clamp server-side, never EFBIG
+            write_bytes += dlen
+            end = int(op.get("off", 0) or 0) + dlen
+            if end > max_object:
+                return (f"op extends object to {end} > "
+                        f"osd_object_max_size {max_object}")
+        if write_bytes > max_write:
+            return (f"write of {write_bytes} > osd_max_write_size "
+                    f"{max_write}")
+        return ""
+
     async def _do_client_op(self, conn, msg: MOSDOp, top=None) -> None:
         self.perf.inc("op")
         if self._split_task is not None and not self._split_task.done():
@@ -1733,6 +1841,15 @@ class OSDDaemon(Dispatcher):
                     "outs": [{"error": "wrong pg for object "
                                        "(map changed?)"}]}))
                 return
+        # size guards (reference OSD::op_is_too_big: osd_max_write_size
+        # on the mutation payload, osd_object_max_size on the resulting
+        # extent) — EFBIG at admission, never a half-applied monster op
+        too_big = self._op_too_big(msg)
+        if too_big:
+            await conn.send_message(MOSDOpReply({
+                "tid": msg["tid"], "result": -EFBIG,
+                "outs": [{"error": too_big}]}))
+            return
         deny = self._check_osd_caps(msg)
         if deny is not None and "generation" in deny[0] \
                 and self.monc is not None:

@@ -57,6 +57,11 @@ OI_KEY = "_"                 # reference OI_ATTR (object_info_t xattr)
 PGMETA_OID = "_pgmeta_"      # per-collection pg metadata object
 
 
+def _fallback_spawn(coro, context: str = "") -> "asyncio.Task":
+    from ..common.crash import fallback_spawn
+    return fallback_spawn(coro, f"ecbackend.{context}", subsys="osd")
+
+
 class ECError(Exception):
     pass
 
@@ -225,7 +230,8 @@ class ECBackend:
                  encode_service=None, scheduler=None,
                  config=None, mesh_plane=None,
                  device_mesh: bool = False,
-                 fast_read=False, perf=None, profiler=None) -> None:
+                 fast_read=False, perf=None, profiler=None,
+                 spawn=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -245,6 +251,10 @@ class ECBackend:
         # it so client I/O keeps its QoS share (None = unthrottled)
         self.scheduler = scheduler
         self.config = config
+        # fire-and-forget task spawner: the daemon passes
+        # CrashHandler.guard so a dead kick/watchdog/retry task leaves a
+        # crash dump; standalone backends (tests) get a dout fallback
+        self._spawn = spawn or _fallback_spawn
         # daemon perf group (stage histograms: queue wait / encode /
         # sub-op rtt / commit) and kernel profiler (decode + crc timing)
         self.perf = perf
@@ -877,7 +887,8 @@ class ECBackend:
             op.reads_pending = True
             rop = await self._start_read(
                 {op.oid: remaining}, for_recovery=False)
-            asyncio.ensure_future(self._finish_rmw_read(op, rop, remaining))
+            self._spawn(self._finish_rmw_read(op, rop, remaining),
+                        "finish_rmw_read")
 
     async def _finish_rmw_read(self, op: Op, rop: ReadOp,
                                extents: "List[Extent]") -> None:
@@ -1233,7 +1244,8 @@ class ECBackend:
             # head-of-line blocks this PG's pipeline — the next op's
             # encode can join the device batch and its sub-write can
             # join the store's group commit while we wait
-            asyncio.ensure_future(self._local_sub_write(op, shard, msg))
+            self._spawn(self._local_sub_write(op, shard, msg),
+                        "local_sub_write")
         self._check_commit_queue()
 
     async def _local_sub_write(self, op: Op, shard: int,
@@ -1338,7 +1350,7 @@ class ECBackend:
             op.on_commit.set_result(op.version)
         if self.waiting_state:
             # a drained pipeline may unblock a barrier op at the head
-            asyncio.ensure_future(self._kick())
+            self._spawn(self._kick(), "pipeline_kick")
 
     def handle_sub_write_reply(self, msg: MECSubOpWriteReply) -> None:
         op = self.tid_to_op.get(int(msg["tid"]))
@@ -1694,7 +1706,7 @@ class ECBackend:
         await self._issue_shard_reads(rop, need, avail,
                                       list(rop.requests))
         if not rop.done.done():
-            asyncio.ensure_future(self._read_watchdog(rop))
+            self._spawn(self._read_watchdog(rop), "read_watchdog")
         return rop
 
     async def _read_watchdog(self, rop: ReadOp) -> None:
@@ -1785,9 +1797,9 @@ class ECBackend:
                 # concurrent issue: the in-process transport delivers
                 # inline, so a serial loop would stall every later shard
                 # (and fast_read's whole point) behind one slow peer
-                asyncio.ensure_future(
+                self._spawn(
                     self._send_sub_read(avail[shard], shard, to_read,
-                                        msg, rop))
+                                        msg, rop), "send_sub_read")
         for msg in local:
             self.handle_sub_read_reply(self.handle_sub_read(msg))
 
@@ -1851,7 +1863,8 @@ class ECBackend:
                 rop.obj_bad.setdefault(oid, set()).add(shard)
             if not rop.fast_read:
                 rop.retries_pending += 1
-                asyncio.ensure_future(self._retry_reads(rop, list(failed)))
+                self._spawn(self._retry_reads(rop, list(failed)),
+                            "retry_reads")
                 return
             # fast_read already asked every available shard: there is no
             # wider set to re-plan over; completion below decides per

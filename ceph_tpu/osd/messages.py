@@ -25,7 +25,7 @@ from ..msg.message import Message, register_message
 # (the reference wire protocol encodes Linux errnos regardless of the
 # host platform; comparing against the platform's ``errno`` module would
 # mis-route replies on BSD/Darwin where ESTALE is 70).
-EIO, ENOENT, ESTALE, EACCES = 5, 2, 116, 13
+EIO, ENOENT, ESTALE, EACCES, EFBIG = 5, 2, 116, 13, 27
 
 
 def pack_buffers(bufs: "List[bytes]") -> "Tuple[List[int], bytes]":
@@ -53,6 +53,11 @@ class MOSDOp(Message):
     (each write op's dlen says how much it consumes).
     """
     TYPE = "osd_op"
+    FIELDS = ("tid", "pool", "pg", "oid", "ops", "map_epoch",
+              "reqid?",        # client retry-dedup id (rides pg log)
+              "trace_id?",     # root span for the op's sub-op tree
+              "ticket?",       # cephx service ticket
+              "internal?")     # cluster-internal op (copy_from reads)
 
 
 @register_message
@@ -60,6 +65,8 @@ class MOSDOpReply(Message):
     """fields: tid, result (errno-style, 0=ok), outs=[{...}] per-op output
     metadata; read payloads concatenated in ``data``."""
     TYPE = "osd_op_reply"
+    FIELDS = ("tid", "result", "outs",
+              "retry_auth?")   # EACCES refinement: fresh ticket may fix
 
 
 # --- EC sub ops (primary <-> shard) ------------------------------------------
@@ -71,15 +78,22 @@ class MECSubOpWrite(Message):
 
     fields: pgid, shard (target), from_osd, tid, at_version=[epoch,v],
     trim_to, roll_forward_to, log_entries=[...], txn (encoded shard
-    transaction dict with write payloads hex-free: offsets into data).
+    transaction dict with write payloads hex-free: offsets into data),
+    lens (write-payload lengths indexing ``data``), epoch.
     """
     TYPE = "ec_sub_write"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "epoch", "at_version",
+              "trim_to", "roll_forward_to", "log_entries", "txn", "lens",
+              "trace?")        # child span crossing the messenger
 
 
 @register_message
 class MECSubOpWriteReply(Message):
-    """fields: pgid, shard, from_osd, tid, committed, applied."""
+    """fields: pgid, shard, from_osd, tid, committed, applied;
+    error (errno) and missing (divergent-object hint) on failure."""
     TYPE = "ec_sub_write_reply"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "committed", "applied",
+              "error?", "missing?")
 
 
 @register_message
@@ -91,14 +105,20 @@ class MECSubOpRead(Message):
     attrs_to_read = [oid...].
     """
     TYPE = "ec_sub_read"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "to_read",
+              "attrs_to_read", "trace?")
 
 
 @register_message
 class MECSubOpReadReply(Message):
     """fields: pgid, shard, from_osd, tid,
     buffers_read = [{oid, extents: [[off, dlen]...]}]  (dlen indexes data),
-    attrs_read = {oid: {name: hex}}, errors = {oid: errno}."""
+    attrs_read = {oid: {name: hex}}, errors = {oid: errno},
+    lens (buffer lengths indexing ``data``), omap_read (recovery
+    reads of replicated-pool omap)."""
     TYPE = "ec_sub_read_reply"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "buffers_read",
+              "lens", "attrs_read", "errors", "omap_read?")
 
 
 # --- recovery (primary -> peer shard) ----------------------------------------
@@ -109,14 +129,19 @@ class MOSDPGPush(Message):
     """Reference MOSDPGPush.h: push reconstructed shard content to a peer.
 
     fields: pgid, shard, from_osd, tid, oid, version, whole (bool),
-    off, attrs={name: hex}; shard bytes in ``data``."""
+    off, attrs={name: hex}; shard bytes in ``data``.  gen/remove push
+    generation-collection moves, omap rides replicated-pool pushes."""
     TYPE = "pg_push"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "version",
+              "whole", "off", "attrs", "gen?", "remove?", "omap?")
 
 
 @register_message
 class MOSDPGPushReply(Message):
-    """fields: pgid, shard, from_osd, tid, oid, result."""
+    """fields: pgid, shard, from_osd, tid, oid, result, gen."""
     TYPE = "pg_push_reply"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "result",
+              "gen?")
 
 
 # --- peering (reference MOSDPGQuery / MOSDPGNotify / MOSDPGLog) --------------
@@ -125,15 +150,20 @@ class MOSDPGPushReply(Message):
 @register_message
 class MPGQuery(Message):
     """Primary asks a shard for its pg info + log.
-    fields: pgid, shard, from_osd, tid."""
+    fields: pgid, shard, from_osd, tid, epoch."""
     TYPE = "pg_query"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "epoch")
 
 
 @register_message
 class MPGInfo(Message):
     """Shard's reply: fields: pgid, shard, from_osd, tid,
-    log (PGLog.to_dict), objects ([oid...] for backfill planning)."""
+    log (PGLog.to_dict), objects ([oid...] for backfill planning),
+    missing, complete_to, object_versions (shard-local state the
+    primary folds into its peering decisions)."""
     TYPE = "pg_info"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "log", "objects",
+              "missing", "complete_to", "object_versions")
 
 
 @register_message
@@ -141,14 +171,17 @@ class MPGRewind(Message):
     """Primary tells a divergent shard to rewind its log to ``to`` and
     roll back newer entries locally (reference: the peon-side divergent
     entry handling in PGLog::rewind_divergent_log + rollback).
-    fields: pgid, shard, from_osd, tid, to=[epoch,v]."""
+    fields: pgid, shard, from_osd, tid, to=[epoch,v], epoch."""
     TYPE = "pg_rewind"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "to", "epoch")
 
 
 @register_message
 class MPGRewindAck(Message):
-    """fields: pgid, shard, from_osd, tid, head=[epoch,v]."""
+    """fields: pgid, shard, from_osd, tid, head=[epoch,v];
+    rejected set when the shard refused (stale primary epoch)."""
     TYPE = "pg_rewind_ack"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "head", "rejected?")
 
 
 @register_message
@@ -162,13 +195,18 @@ class MPGLog(Message):
     truncated to the auth head), objects ([oid...] — the full live object
     set, for shards so stale they need backfill)."""
     TYPE = "pg_log"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "log", "objects",
+              "epoch")
 
 
 @register_message
 class MPGLogAck(Message):
     """fields: pgid, shard, from_osd, tid, missing={oid: [epoch,v]} — the
-    shard's computed missing set (reference MOSDPGLog's missing reply)."""
+    shard's computed missing set (reference MOSDPGLog's missing
+    reply); rejected set when the shard refused (stale epoch)."""
     TYPE = "pg_log_ack"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "missing",
+              "rejected?")
 
 
 # --- maps / control ----------------------------------------------------------
@@ -180,6 +218,7 @@ class MWatchNotify(Message):
     (reference MWatchNotify).  fields: notify_id, watch_id, oid, pgid;
     data = notify payload."""
     TYPE = "watch_notify"
+    FIELDS = ("notify_id", "watch_id", "oid", "pgid")
 
 
 @register_message
@@ -187,6 +226,7 @@ class MWatchNotifyAck(Message):
     """Client -> OSD: ack for a delivered notify.
     fields: notify_id, watch_id."""
     TYPE = "watch_notify_ack"
+    FIELDS = ("notify_id", "watch_id")
 
 
 @register_message
@@ -194,6 +234,7 @@ class MScrubShard(Message):
     """Primary asks a shard for its scrub map (reference MOSDRepScrub).
     fields: pgid, shard, from_osd, tid, deep."""
     TYPE = "scrub_shard"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "deep")
 
 
 @register_message
@@ -201,6 +242,7 @@ class MScrubShardReply(Message):
     """Shard's scrub map: fields: pgid, shard, from_osd, tid,
     objects ({oid: {size, oi, hinfo, crc?}})."""
     TYPE = "scrub_shard_reply"
+    FIELDS = ("pgid", "shard", "from_osd", "tid", "objects")
 
 
 @register_message
@@ -217,20 +259,25 @@ class MOSDBackoff(Message):
     the op that tripped it, so the client wakes exactly that op's wait
     instead of letting it ride out the full op timeout."""
     TYPE = "osd_backoff"
+    FIELDS = ("op", "pgid", "id", "reason", "epoch", "tid?")
 
 
 @register_message
 class MOSDMapMsg(Message):
     """Map epoch broadcast (reference MOSDMap.h); full map json in data."""
     TYPE = "osd_map"
+    FIELDS = ("epoch",)
 
 
 @register_message
 class MOSDPing(Message):
-    """Heartbeat (reference MOSDPing.h). fields: from_osd, epoch, stamp."""
+    """Heartbeat probe (reference MOSDPing.h).  The rebuild's reply
+    echoes only the probe stamp; sender identity rides the session."""
     TYPE = "osd_ping"
+    FIELDS = ("stamp?",)
 
 
 @register_message
 class MOSDPingReply(Message):
     TYPE = "osd_ping_reply"
+    FIELDS = ("from_osd", "epoch", "stamp")

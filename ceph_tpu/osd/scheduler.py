@@ -42,6 +42,25 @@ DEFAULT_PARAMS: "Dict[str, Tuple[float, float, float]]" = {
     BEST_EFFORT: (0.0, 0.5, 0.0),
 }
 
+# Full option names, spelled out (not f-string-assembled) so the
+# options<->consumer link is grep-able and statically checkable
+# (cephlint's options checker resolves these literals against the
+# registry in common/options.py).
+MCLOCK_OPTIONS: "Dict[str, Tuple[str, str, str]]" = {
+    CLIENT: ("osd_mclock_scheduler_client_res",
+             "osd_mclock_scheduler_client_wgt",
+             "osd_mclock_scheduler_client_lim"),
+    RECOVERY: ("osd_mclock_scheduler_background_recovery_res",
+               "osd_mclock_scheduler_background_recovery_wgt",
+               "osd_mclock_scheduler_background_recovery_lim"),
+    SCRUB: ("osd_mclock_scheduler_background_scrub_res",
+            "osd_mclock_scheduler_background_scrub_wgt",
+            "osd_mclock_scheduler_background_scrub_lim"),
+    BEST_EFFORT: ("osd_mclock_scheduler_background_best_effort_res",
+                  "osd_mclock_scheduler_background_best_effort_wgt",
+                  "osd_mclock_scheduler_background_best_effort_lim"),
+}
+
 
 class _ClassState:
     __slots__ = ("res", "wgt", "lim", "r_tag", "p_tag", "l_tag", "queue")
@@ -67,14 +86,8 @@ class MClockScheduler:
     def from_config(cls, config) -> "OpScheduler":
         if str(config.get("osd_op_queue")) != "mclock":
             return FifoScheduler(int(config.get("osd_op_num_concurrent")))
-        params = {}
-        for name in DEFAULT_PARAMS:
-            key = (f"osd_mclock_scheduler_{name}"
-                   if name == CLIENT else
-                   f"osd_mclock_scheduler_background_{name}")
-            params[name] = (float(config.get(f"{key}_res")),
-                            float(config.get(f"{key}_wgt")),
-                            float(config.get(f"{key}_lim")))
+        params = {name: tuple(float(config.get(opt)) for opt in opts)
+                  for name, opts in MCLOCK_OPTIONS.items()}
         return cls(int(config.get("osd_op_num_concurrent")), params)
 
     # --- public API -----------------------------------------------------------

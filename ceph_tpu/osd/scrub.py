@@ -130,7 +130,14 @@ async def run_scrub(backend, deep: bool = False,
     res = {"objects": len(oids), "deep": deep, "shallow_errors": [],
            "deep_errors": [], "repaired": [], "hinfo_rebuilt": []}
 
-    for oid in oids:
+    # chunked pacing (reference chunky scrub): a breather every
+    # osd_scrub_chunk_max objects keeps a huge PG's scrub from
+    # monopolizing its shard between scheduler slots
+    chunk_max = max(1, int(backend.opt("osd_scrub_chunk_max", 25)))
+    chunk_sleep = float(backend.opt("osd_scrub_sleep", 0.0))
+    for i, oid in enumerate(oids):
+        if i and i % chunk_max == 0 and chunk_sleep > 0:
+            await asyncio.sleep(chunk_sleep)
         if backend.scheduler is not None:
             # the comparison/rebuild work runs INSIDE the scrub slot;
             # repair runs after release (recover_object takes its own

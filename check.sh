@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Repo gate: static invariants first (fast, fails early), then the
+# tier-1 test suite.  Nonzero exit on any non-baselined cephlint
+# finding or any test failure — wire this straight into CI.
+#
+#   ./check.sh            # lint + tier-1 tests
+#   ./check.sh --lint     # lint only (pre-commit speed)
+set -o pipefail
+
+cd "$(dirname "$0")"
+
+echo "== cephlint (tools/cephlint) =="
+lint_json="$(mktemp -t cephlint.XXXXXX.json)"
+trap 'rm -f "$lint_json"' EXIT
+python -m tools.cephlint ceph_tpu --format=json > "$lint_json"
+lint_rc=$?
+if [ "$lint_rc" -le 1 ] && [ -s "$lint_json" ]; then
+    LINT_JSON="$lint_json" python - <<'EOF'
+import json, os
+d = json.load(open(os.environ["LINT_JSON"]))
+print(f"cephlint: {d['count']} finding(s), "
+      f"{d['baseline_suppressed']} baseline-suppressed")
+for f in d["findings"]:
+    print(f"  {f['path']}:{f['line']}: [{f['check']}] {f['message']}")
+EOF
+fi
+if [ "$lint_rc" -ne 0 ]; then
+    echo "cephlint gate FAILED (exit $lint_rc)"
+    exit "$lint_rc"
+fi
+
+if [ "$1" = "--lint" ]; then
+    exit 0
+fi
+
+echo "== tier-1 tests =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly

@@ -1,5 +1,13 @@
 """Daemons consume configuration knobs (VERDICT weak #7: the option
 machinery existed but daemons hard-coded values).
+
+The option<->consumer cross-check itself moved to cephlint's AST
+``options`` checker (tools/cephlint — every ``conf.get`` resolves to a
+registered Option, every non-deprecated Option is consumed), enforced
+tree-wide by test_cephlint.py's repo-clean gate; the scan-shaped test
+that used to live here is retired in its favor.  This file keeps the
+RUNTIME half: values actually flow into behavior, and runtime-mutable
+flags really observe.
 """
 
 import asyncio
@@ -27,6 +35,12 @@ def test_schema_covers_major_subsystems():
                 "debug_", "crash_"):
         assert any(n.startswith(fam) for n in names), fam
     assert len(names) >= 90
+    # deprecated options stay settable (operator configs keep
+    # validating) but are documented as inert
+    for name, opt in OPTIONS.items():
+        if opt.deprecated:
+            assert "deprecated" in opt.desc, name
+            opt.validate(opt.default)
 
 
 def test_debug_options_map_to_log_levels(loop):
@@ -98,6 +112,99 @@ def test_objecter_reads_client_options(loop):
             client = await c.client()
             assert client.objecter.max_retries == 2
             assert client.objecter.op_timeout == 3.5
+    loop.run_until_complete(go())
+
+
+def test_background_scrub_scheduler_repairs_corruption(loop):
+    """osd_scrub_min_interval / osd_deep_scrub_interval /
+    osd_scrub_auto_repair drive the OSD's background scrub loop: with
+    tiny intervals and auto-repair on, injected shard corruption heals
+    with no admin scrub command."""
+    async def go():
+        cfg = Config()
+        cfg.set("osd_scrub_min_interval", 2.0)
+        cfg.set("osd_deep_scrub_interval", 0.3)   # deep fires fast
+        cfg.set("osd_scrub_auto_repair", True)
+        async with MiniCluster(n_osds=4, config=cfg) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=1, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("p")
+            payload = bytes(range(200)) * 2
+            await io.write_full("obj", payload)
+            pool = c.osdmap.pool_by_name("p")
+            _u, acting = c.osdmap.pg_to_up_acting_osds(pool.pool_id, 0)
+            victim = c.osds[acting[1]]
+            victim.inject_data_error(pool.pool_id, "obj", shard=1)
+            be = victim._get_backend((pool.pool_id, 0))
+            cid = be.coll(1)
+            from ceph_tpu.objectstore.types import ObjectId
+            sid = ObjectId("obj", 1)
+            corrupted = bytes(victim.store.read(cid, sid))
+            for _ in range(300):      # scheduler tick is interval/4
+                if bytes(victim.store.read(cid, sid)) != corrupted:
+                    break
+                await asyncio.sleep(0.05)
+            assert bytes(victim.store.read(cid, sid)) != corrupted, \
+                "background deep scrub never repaired the shard"
+            assert await io.read("obj") == payload
+    loop.run_until_complete(go())
+
+
+def test_pool_create_defaults_and_pg_cap(loop):
+    """osd_pool_default_pg_num / osd_pool_default_size /
+    osd_pool_default_erasure_code_profile fill omitted create args;
+    mon_max_pg_per_osd bounces oversized pools with ERANGE."""
+    from tests.test_mon import fast_config
+
+    async def go():
+        cfg = fast_config()
+        cfg.set("osd_pool_default_pg_num", 4)
+        cfg.set("osd_pool_default_size", 2)
+        async with MiniCluster(4, n_mons=1, config=cfg) as c:
+            admin = await c._admin_client()
+            out = await admin.mon_command({
+                "prefix": "osd pool create", "name": "bare",
+                "kwargs": {}})
+            pool = c.mons[0].osdmap.pool_by_name("bare")
+            assert pool.pg_num == 4 and pool.size == 2, out
+            # EC pool with no profile: the schema-default profile
+            # materializes as 'default' via the same paxos op
+            await admin.mon_command({
+                "prefix": "osd pool create", "name": "ec-bare",
+                "kwargs": {"type": "erasure", "stripe_unit": 512}})
+            ec = c.mons[0].osdmap.pool_by_name("ec-bare")
+            assert ec.ec_profile == "default"
+            prof = c.mons[0].osdmap.ec_profiles["default"]
+            assert prof["plugin"] == "jax_rs" and prof["k"] == "4"
+            assert ec.size == 6                 # k+m from the profile
+            # the per-osd placement cap rejects monsters
+            from ceph_tpu.mon.client import MonClientError
+            with pytest.raises(MonClientError,
+                               match="mon_max_pg_per_osd"):
+                await admin.mon_command({
+                    "prefix": "osd pool create", "name": "huge",
+                    "kwargs": {"pg_num": 65536, "size": 3}})
+    loop.run_until_complete(go())
+
+
+def test_osd_size_guards_return_efbig(loop):
+    """osd_max_write_size / osd_object_max_size reject monster ops at
+    admission with EFBIG instead of half-applying them."""
+    async def go():
+        cfg = Config()
+        cfg.set("osd_max_write_size", 4096)
+        async with MiniCluster(n_osds=3, config=cfg) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=1, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("p")
+            await io.write_full("ok", bytes(1024))     # under the cap
+            from ceph_tpu.client.objecter import ObjecterError
+            with pytest.raises(ObjecterError, match="27|EFBIG|"
+                               "osd_max_write_size"):
+                await io.write_full("big", bytes(8192))
+            assert await io.read("ok") == bytes(1024)
     loop.run_until_complete(go())
 
 

@@ -122,13 +122,27 @@ def main(argv=None) -> int:
     p.add_argument("--kw", action="append", default=[],
                    help="key=value argument (profile/pool kwargs)")
     p.add_argument("words", nargs="+")
-    args = p.parse_args(argv)
+    # --key=value command args ('lockdep dump --format=json') would
+    # trip argparse as unknown flags: collect them as words, but ONLY
+    # for the daemon passthrough (mon commands parse positionally and
+    # would silently misread a flag token as an argument)
+    args, extra = p.parse_known_args(argv)
+    bad = [w for w in extra if not (w.startswith("--") and "=" in w)]
+    if bad or (extra and args.words[:1] != ["daemon"]):
+        p.error(f"unrecognized arguments: {' '.join(bad or extra)}")
+    args.words += extra
 
     if args.words[0] == "daemon":
         # admin-socket passthrough (reference 'ceph daemon <sock> cmd')
         from ceph_tpu.common.admin_socket import admin_command
         path, words = args.words[1], list(args.words[2:])
         kwargs = dict(kv.split("=", 1) for kv in args.kw)
+        # --key=value tokens become command args anywhere in the verb
+        # ('ceph daemon <sock> lockdep dump --format=json')
+        for w in [w for w in words if w.startswith("--") and "=" in w]:
+            k, v = w[2:].split("=", 1)
+            kwargs[k] = v
+            words.remove(w)
         # positional forms for the log verbs:
         #   ceph daemon <sock> log set-level <subsys> <gather> [output]
         #   ceph daemon <sock> log get-level [subsys]

@@ -86,7 +86,7 @@ async def run_mon(args) -> None:
 
 
 async def run_osd(args) -> None:
-    from ceph_tpu.objectstore import create_store
+    from ceph_tpu.objectstore import create_store_from_config
     from ceph_tpu.osd.daemon import OSDDaemon
 
     os.makedirs(args.data, exist_ok=True)
@@ -95,11 +95,12 @@ async def run_osd(args) -> None:
         # real processes get durable crash dumps next to their data:
         # a kill -9 + respawn re-posts them to the mon (ceph-crash)
         cfg.set("crash_dir", os.path.join(args.data, "crash"))
-    kind = str(cfg.get("objectstore_type"))
-    if kind == "mem":       # processes need durable state to survive
-        kind = "file"       # kill -9 + respawn; -o objectstore_type=kv
+    if str(cfg.get("objectstore_type")) == "mem":
+        # processes need durable state to survive kill -9 + respawn;
+        # -o objectstore_type=kv overrides
+        cfg.set("objectstore_type", "file")
     store_path = os.path.join(args.data, "store.db")
-    store = create_store(kind, store_path, config=cfg)
+    store = create_store_from_config(cfg, store_path)
     if not os.path.exists(store_path):
         store.mkfs()   # only a genuinely fresh dir formats; a corrupt
         # or locked store must fail loudly at mount, not be re-formatted

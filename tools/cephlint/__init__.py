@@ -1,0 +1,26 @@
+"""cephlint — AST-driven invariant checker for the async EC store.
+
+Reference: the Ceph tree pairs every runtime belt with a compile-time
+suspender — lockdep.cc has static clang-tidy passes, the options table
+has consistency unit tests, messages are versioned encodables checked
+at build time.  This package is that compile-time half for the asyncio
+rebuild: six checkers tuned to the invariants the runtime machinery
+(common/lockdep.py, common/crash.py, the frozen-schema tests) enforces
+after the fact.
+
+Architecture (see README.md beside this file):
+
+- every checker is two-phase: ``collect(module) -> facts`` runs once
+  per file and is cached by content hash; ``report(all_facts) ->
+  findings`` is a cheap whole-tree pass over the collected facts, so
+  cross-file invariants (lock order, option consumption, message
+  symmetry) never force a full re-parse,
+- ``# cephlint: disable=<check>`` pragmas scope suppressions to a line,
+- a baseline file grandfathers known findings so the gate can be turned
+  on before the tree is fully clean.
+"""
+
+from .findings import Finding  # noqa: F401
+from .driver import Linter, lint_paths  # noqa: F401
+
+VERSION = 1

@@ -1,0 +1,63 @@
+"""Baseline suppression — grandfather known findings, gate new ones.
+
+The baseline is a JSON list of finding fingerprints (check + path +
+offending source text, NO line numbers — reindenting or moving code
+within a file does not invalidate entries).  Workflow:
+
+    python -m tools.cephlint ceph_tpu --write-baseline   # snapshot
+    python -m tools.cephlint ceph_tpu                    # gate: only
+                                                         # NEW findings fail
+
+Each entry is consumed at most once per run (two identical violations
+on distinct lines need two entries), so a baseline can never mask a
+newly duplicated violation.  The shipped default
+(tools/cephlint/baseline.json) is EMPTY and the tier-1 suite asserts it
+stays that way — the baseline mechanism exists for downstream forks
+mid-cleanup, not as a parking lot here.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Tuple
+
+from .findings import Finding
+
+
+def load(path: str) -> "Counter[str]":
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    out: "Counter[str]" = Counter()
+    for entry in data:
+        if isinstance(entry, dict):
+            out[f"{entry['check']}|{entry['path']}|{entry['context']}"] += 1
+        else:
+            out[str(entry)] += 1
+    return out
+
+
+def write(path: str, findings: "List[Finding]") -> None:
+    entries = [{"check": f.check, "path": f.path, "context": f.context}
+               for f in sorted(findings, key=Finding.sort_key)]
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def apply(findings: "List[Finding]", baseline: "Counter[str]"
+          ) -> "Tuple[List[Finding], int]":
+    """-> (findings not covered by the baseline, suppressed count)."""
+    budget = Counter(baseline)
+    out: "List[Finding]" = []
+    suppressed = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            suppressed += 1
+        else:
+            out.append(f)
+    return out, suppressed

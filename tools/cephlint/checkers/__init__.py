@@ -1,0 +1,15 @@
+"""Checker registry — the six invariants, by check id."""
+
+from .base import Checker, Module, ReportContext  # noqa: F401
+from .blocking import BlockingCallChecker
+from .kernels import KernelPurityChecker
+from .locks import LockOrderChecker
+from .messages import MsgSymmetryChecker
+from .options import OptionsChecker
+from .tasks import FireAndForgetChecker
+
+ALL_CHECKERS = (BlockingCallChecker, FireAndForgetChecker,
+                LockOrderChecker, MsgSymmetryChecker, OptionsChecker,
+                KernelPurityChecker)
+
+CHECKERS = {c.name: c for c in ALL_CHECKERS}

@@ -1,0 +1,109 @@
+"""Checker interface + shared AST helpers.
+
+Two-phase contract:
+
+- ``collect(module) -> facts``: per-file, pure, returns JSON-serializable
+  facts.  This is the cacheable phase — the driver keys it on the file's
+  content hash, so an unchanged file never re-parses.
+- ``report(facts_by_path, ctx) -> [Finding]``: whole-tree, runs every
+  invocation over the (cheap) collected facts.  Cross-file invariants
+  (lock-order inversions, option consumption, message field symmetry)
+  live here.
+
+A checker that is purely local still uses both phases: collect records
+violations as facts, report converts them to Findings unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..findings import Finding
+
+
+@dataclass
+class Module:
+    path: str                # repo-relative posix path
+    tree: ast.Module
+    lines: "List[str]"       # source lines (for finding context)
+
+    def context(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Checker:
+    name = ""                # check id used in pragmas/baseline/output
+    description = ""
+
+    def collect(self, module: Module) -> dict:
+        raise NotImplementedError
+
+    def report(self, facts: "Dict[str, dict]", ctx: "ReportContext"
+               ) -> "List[Finding]":
+        raise NotImplementedError
+
+
+@dataclass
+class ReportContext:
+    """Knobs the driver threads into report() — runtime artifacts to
+    cross-check against (lockdep dumps), tuning lists."""
+    lockdep_dump: "Optional[dict]" = None     # runtime lockdep graph JSON
+
+
+# --- shared AST helpers -------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name for a call target / attribute chain:
+    ``os.fsync`` -> "os.fsync", ``self.crash.task`` -> "self.crash.task",
+    ``asyncio.get_event_loop().create_task`` ->
+    "asyncio.get_event_loop().create_task".  Unresolvable pieces render
+    as "?" so callers can still suffix-match."""
+    if isinstance(node, ast.Attribute):
+        return f"{dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return f"{dotted(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{dotted(node.value)}[]"
+    return "?"
+
+
+def terminal_attr(node: ast.AST) -> str:
+    """Last attribute/name segment: ``self.ec._lock`` -> "_lock"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def const_str(node: "Optional[ast.AST]") -> "Optional[str]":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_child_functions(node: ast.AST):
+    """Direct child function/async-function defs (no recursion)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child
+
+
+def walk_skip_functions(node: ast.AST):
+    """Yield descendants of ``node`` without descending into nested
+    function definitions or lambdas (their bodies run in a different
+    execution context — e.g. an executor callable inside a coroutine)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(child))

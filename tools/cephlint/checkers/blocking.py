@@ -1,0 +1,85 @@
+"""blocking-call — synchronous stalls inside ``async def``.
+
+The event loop IS the OSD: one blocked coroutine stalls every PG shard,
+heartbeat, and messenger on that loop (the exact class PR 4 moved WAL
+fsyncs off-loop for).  Flags, when the NEAREST enclosing function is a
+coroutine:
+
+- ``time.sleep`` (use ``asyncio.sleep``),
+- ``os.fsync`` / ``os.fdatasync`` / ``os.sync`` (route through
+  ``run_in_executor`` like blockstore's committer),
+- ``subprocess.*`` spawn/wait APIs,
+- builtin ``open()`` (sync file I/O; fine in daemon *setup* paths —
+  pragma those — fatal on the data path),
+- ``<future>.result()`` with no args (blocks; await it instead).
+
+Code inside a nested ``def`` or ``lambda`` is exempt even when the
+nesting coroutine is async: that body runs wherever it is invoked
+(typically an executor thread via ``run_in_executor``), not on the
+loop.  This is exactly the devtime-shim/executor escape hatch the
+runtime uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, dotted, walk_skip_functions
+
+_BLOCKING_EXACT = {"time.sleep", "os.fsync", "os.fdatasync", "os.sync"}
+_BLOCKING_PREFIX = ("subprocess.",)
+
+
+class BlockingCallChecker(Checker):
+    name = "blocking-call"
+    description = "blocking call on the event loop inside async def"
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            # walk the coroutine body, shielding nested (sync) defs and
+            # lambdas; nested *async* defs are visited by the outer
+            # ast.walk as their own AsyncFunctionDef.
+            for child in walk_skip_functions(node):
+                if isinstance(child, ast.AsyncFunctionDef):
+                    # inner coroutine: its own ast.walk visit covers it
+                    continue
+                if not isinstance(child, ast.Call):
+                    continue
+                name = dotted(child.func)
+                why = self._blocking_reason(name, child)
+                if why:
+                    hits.append({"line": child.lineno, "col": child.col_offset,
+                                 "call": name, "why": why,
+                                 "context": module.context(child.lineno)})
+        return {"hits": hits}
+
+    @staticmethod
+    def _blocking_reason(name: str, call: ast.Call) -> str:
+        if name in _BLOCKING_EXACT:
+            return f"{name} blocks the event loop"
+        if any(name.startswith(p) for p in _BLOCKING_PREFIX):
+            return f"{name} runs a blocking subprocess API"
+        if name == "open":
+            return "sync file I/O (open) on the event loop"
+        if name.endswith(".result") and not call.args and not call.keywords:
+            return (f"{name}() blocks on a future result; await it "
+                    f"(or run via run_in_executor)")
+        return ""
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for h in f.get("hits", ()):
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    col=h["col"], context=h["context"],
+                    message=f"{h['why']} (wrap in run_in_executor, or "
+                            f"pragma if this coroutine only runs at "
+                            f"setup/teardown)"))
+        return out

@@ -1,0 +1,157 @@
+"""kernel-purity — no Python side effects inside traced device code.
+
+A jitted function's Python body runs ONCE, at trace time.  A
+``time.time()`` read traces to a constant, ``np.random`` gives every
+retrace a different "constant", a ``print`` fires only on cache miss,
+and a write to captured state (``stats.append(...)``) executes at an
+arbitrary trace moment — none of these do what the author meant, and
+all of them silently "work" in tests that happen to retrace (the
+roofline work in PR 1 grew its profiler OUTSIDE the kernels for exactly
+this reason).
+
+Kernel identification (the tree's three idioms, per
+/opt/skills/guides/pallas_guide.md):
+
+- a function decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``,
+- a local function passed to ``jax.jit(fn)``,
+- a Pallas kernel: a function whose parameters all end in ``_ref``
+  (the Ref-passing convention ``pl.pallas_call`` bodies use; factories
+  returning kernels make the pallas_call argument unresolvable, the
+  parameter convention is the stable marker).
+
+Flagged inside a kernel (and its nested helpers): impure calls
+(``time.*``, ``datetime.*``, ``random.*``, ``np.random.*``, ``print``,
+``open``, ``os.*``, ``input``), ``global``/``nonlocal`` declarations,
+and mutations of captured names (subscript/attribute assignment or a
+mutating method call on a name not local to the kernel).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, dotted, terminal_attr
+
+_IMPURE_PREFIXES = ("time.", "datetime.", "random.", "np.random.",
+                    "numpy.random.", "os.")
+_IMPURE_EXACT = {"print", "open", "input"}
+_MUTATORS = {"append", "extend", "add", "update", "pop", "remove",
+             "clear", "insert", "setdefault", "popitem", "discard",
+             "write"}
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        if dotted(dec) in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            fname = dotted(dec.func)
+            if fname in ("jax.jit", "jit"):
+                return True
+            if fname.endswith("partial") and dec.args and \
+                    dotted(dec.args[0]) in ("jax.jit", "jit"):
+                return True
+    return False
+
+
+def _is_pallas_kernel(fn) -> bool:
+    args = fn.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    return len(params) >= 2 and all(p.endswith("_ref") for p in params)
+
+
+def _local_names(fn) -> "Set[str]":
+    names: "Set[str]" = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs +
+                ([a.vararg] if a.vararg else []) +
+                ([a.kwarg] if a.kwarg else [])):
+        names.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.comprehension) and \
+                isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+class KernelPurityChecker(Checker):
+    name = "kernel-purity"
+    description = "side effects / host state inside jit or Pallas kernels"
+
+    def collect(self, module: Module) -> dict:
+        # names jax.jit(...) is called on, for local-def resolution
+        jitted_names: "Set[str]" = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and \
+                    dotted(node.func) in ("jax.jit", "jit") and node.args:
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Name):
+                    jitted_names.add(tgt.id)
+
+        hits: "List[dict]" = []
+        seen: "Set[int]" = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not (_is_jit_decorated(node) or node.name in jitted_names
+                    or _is_pallas_kernel(node)):
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            self._check_kernel(node, module, hits)
+        return {"hits": hits}
+
+    def _check_kernel(self, fn, module: Module, hits: "List[dict]") -> None:
+        locals_ = _local_names(fn)
+
+        def hit(node, why: str) -> None:
+            hits.append({"line": node.lineno, "col": node.col_offset,
+                         "kernel": fn.name, "why": why,
+                         "context": module.context(node.lineno)})
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                hit(node, f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                          f"write escapes the trace")
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in _IMPURE_EXACT or \
+                        any(name.startswith(p) for p in _IMPURE_PREFIXES):
+                    hit(node, f"impure call {name}() traces to a "
+                              f"constant / fires only on retrace")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id not in locals_:
+                    hit(node, f"mutates captured "
+                              f"{node.func.value.id!r} at trace time")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base is not tgt and \
+                            base.id not in locals_:
+                        hit(node, f"writes captured {base.id!r} at "
+                                  f"trace time")
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for h in f.get("hits", ()):
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    col=h["col"], context=h["context"],
+                    message=f"in kernel {h['kernel']}(): {h['why']}"))
+        return out

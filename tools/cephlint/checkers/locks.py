@@ -1,0 +1,233 @@
+"""lock-order — static DepLock ordering + awaits of sends under a lock.
+
+The runtime half (common/lockdep.py) catches an inconsistent acquire
+order the first time both orders RUN.  This is the half that never
+needs them to run: it extracts every lexical ``async with <DepLock>``
+nesting edge across the whole tree, unions the per-file edges into one
+graph, and reports any cycle — the cross-file A->B / B->A inversion
+that runtime lockdep would raise LockOrderError for on the unlucky
+interleaving.
+
+Second invariant, same checker: an ``await <messenger send>`` while
+holding a DepLock.  A send can park on peer backpressure (corking,
+drain, reconnect backoff) for seconds; holding a lock across it is how
+distributed deadlocks start (the reference forbids sending while
+holding PG locks for the same reason).  The messenger's own internal
+send lock is the serialization point and carries line pragmas.
+
+Cross-check against the runtime: pass ``--lockdep-dump FILE`` (the JSON
+from ``lockdep dump --format=json`` on any daemon admin socket — every
+daemon serves it) and the observed runtime edges are unioned into the
+static graph before cycle detection, so an inversion that needs one
+dynamic hop (hold A, call into a function that takes B) and one lexical
+hop is still caught.
+
+Limits (documented, deliberate): edges are lexical — a lock held across
+a CALL into a function that acquires another lock is only visible to
+the runtime graph (hence the dump cross-check); locks are identified by
+attribute name, so two different attrs named ``_lock`` in different
+classes merge if their DepLock class strings collide (class strings are
+namespaced "subsystem.purpose" to prevent exactly that).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from .base import (Checker, Module, ReportContext, dotted, terminal_attr,
+                   const_str)
+
+_SEND_NAMES = {"send_message", "send", "sendall", "_send_mon",
+               "_send_election", "_send_ctrl", "_transmit", "send_crash"}
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = ("DepLock order inversions + messenger sends awaited "
+                   "under a lock")
+
+    # --- collect --------------------------------------------------------------
+
+    def collect(self, module: Module) -> dict:
+        defs: "List[dict]" = []
+        edges: "List[dict]" = []
+        sends: "List[dict]" = []
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    terminal_attr(node.value.func) == "DepLock":
+                cls = const_str(node.value.args[0]) if node.value.args else None
+                for tgt in node.targets:
+                    attr = terminal_attr(tgt)
+                    if attr and cls:
+                        defs.append({"attr": attr, "cls": cls,
+                                     "line": node.lineno})
+
+        def visit(stmts, held: "List[Tuple[str, int]]") -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit(stmt.body, [])      # new execution context
+                    continue
+                if isinstance(stmt, ast.AsyncWith):
+                    attrs = [(terminal_attr(item.context_expr), stmt.lineno)
+                             for item in stmt.items]
+                    attrs = [(a, ln) for a, ln in attrs if a]
+                    for h, _hl in held:
+                        for a, ln in attrs:
+                            edges.append({
+                                "outer": h, "inner": a, "line": ln,
+                                "context": module.context(ln)})
+                    # ordered multi-item: `async with a, b` = a then b
+                    for i, (a, _ln) in enumerate(attrs):
+                        for b, ln in attrs[i + 1:]:
+                            edges.append({
+                                "outer": a, "inner": b, "line": ln,
+                                "context": module.context(ln)})
+                    visit(stmt.body, held + attrs)
+                    continue
+                if held:
+                    # sends in this statement's own header expressions
+                    # (test/iter/value...); nested statement bodies are
+                    # visited below so they are not scanned here
+                    for expr in self._header_exprs(stmt):
+                        self._scan_sends(expr, held, sends, module)
+                for child_body in self._inner_bodies(stmt):
+                    visit(child_body, held)
+
+        visit(module.tree.body, [])
+        return {"defs": defs, "edges": edges, "sends": sends}
+
+    _BODY_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+    @classmethod
+    def _inner_bodies(cls, stmt: ast.stmt):
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(stmt, field, None)
+            if body:
+                yield body
+        for handler in getattr(stmt, "handlers", ()):
+            yield handler.body
+
+    @classmethod
+    def _header_exprs(cls, stmt: ast.stmt):
+        """The statement's own expression children — everything except
+        nested statement bodies (a leaf statement yields all fields)."""
+        for field, value in ast.iter_fields(stmt):
+            if field in cls._BODY_FIELDS:
+                continue
+            if isinstance(value, ast.expr):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    def _scan_sends(self, expr: ast.expr, held, sends, module) -> None:
+        """Awaited sends in ``expr``, pruning nested defs/lambdas (they
+        run in another context, not under the lock)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Await) and \
+                    isinstance(node.value, ast.Call):
+                call_name = terminal_attr(node.value.func)
+                if call_name in _SEND_NAMES:
+                    sends.append({
+                        "locks": [h for h, _ in held],
+                        "call": dotted(node.value.func),
+                        "line": node.lineno,
+                        "context": module.context(node.lineno)})
+            stack.extend(ast.iter_child_nodes(node))
+
+    # --- report ---------------------------------------------------------------
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        # attr -> set of lock class strings, across the whole tree
+        attr_cls: "Dict[str, Set[str]]" = {}
+        for f in facts.values():
+            for d in f.get("defs", ()):
+                attr_cls.setdefault(d["attr"], set()).add(d["cls"])
+
+        # static edges: cls -> cls with first site
+        sites: "Dict[Tuple[str, str], Tuple[str, int, str]]" = {}
+        succ: "Dict[str, Set[str]]" = {}
+        for path, f in facts.items():
+            for e in f.get("edges", ()):
+                for a in attr_cls.get(e["outer"], ()):
+                    for b in attr_cls.get(e["inner"], ()):
+                        if a == b:
+                            continue
+                        if (a, b) not in sites:
+                            sites[(a, b)] = (path, e["line"], e["context"])
+                        succ.setdefault(a, set()).add(b)
+
+        out: "List[Finding]" = []
+
+        # union in observed runtime edges (lockdep dump diff)
+        runtime_edges: "Set[Tuple[str, str]]" = set()
+        if ctx.lockdep_dump:
+            for a, b in ctx.lockdep_dump.get("edges", ()):
+                if a != b:
+                    runtime_edges.add((a, b))
+                    succ.setdefault(a, set()).add(b)
+
+        # cycles: report every STATIC edge that closes a path back to
+        # its source (runtime-only edges in the path are named in the
+        # message but have no site to anchor a finding to)
+        for (a, b), (path, line, context) in sorted(sites.items()):
+            back = self._path(succ, b, a, skip_edge=(a, b))
+            if back is None:
+                continue
+            via_runtime = [f"{x}->{y}" for x, y in zip(back, back[1:])
+                           if (x, y) in runtime_edges and
+                           (x, y) not in sites]
+            msg = (f"lock order inversion: {a!r} -> {b!r} here, but the "
+                   f"reverse path {' -> '.join(back)} exists elsewhere")
+            if via_runtime:
+                msg += (f" (includes runtime-observed edge(s) "
+                        f"{', '.join(via_runtime)} from the lockdep dump)")
+            out.append(Finding(check=self.name, path=path, line=line,
+                               context=context, message=msg))
+
+        # sends under a known lock
+        for path, f in facts.items():
+            for s in f.get("sends", ()):
+                lock_classes = sorted(
+                    c for attr in s["locks"] for c in attr_cls.get(attr, ()))
+                if not lock_classes:
+                    continue
+                out.append(Finding(
+                    check=self.name, path=path, line=s["line"],
+                    context=s["context"],
+                    message=f"await {s['call']}(...) while holding "
+                            f"DepLock {', '.join(lock_classes)}: a send "
+                            f"can park on peer backpressure — release "
+                            f"the lock first or pragma if this lock IS "
+                            f"the send serialization point"))
+        return out
+
+    @staticmethod
+    def _path(succ: "Dict[str, Set[str]]", src: str, dst: str,
+              skip_edge: "Tuple[str, str]") -> "Optional[List[str]]":
+        """DFS path src -> dst (mirrors the runtime _OrderGraph search),
+        never traversing ``skip_edge`` so an edge is only reported when
+        an INDEPENDENT reverse path exists."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in succ.get(node, ()):
+                if (node, nxt) == skip_edge or nxt in seen:
+                    continue
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+        return None

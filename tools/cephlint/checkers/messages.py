@@ -1,0 +1,302 @@
+"""msg-symmetry — Message field schemas vs. their encode/decode sites.
+
+The reference's messages are versioned encodables: encode() and
+decode() are written twice and drift is caught by ceph-dencoder round
+trips.  Here a message's payload is its ``fields`` dict, so drift looks
+different — a sender sets ``{"pgid": ...}`` while the receiver reads
+``msg["pg_id"]`` and gets a KeyError three hops from the typo.  The
+contract is the class's ``FIELDS`` tuple (field names; a trailing ``?``
+marks optional):
+
+    @register_message
+    class MECSubOpWrite(Message):
+        TYPE = "ec_sub_write"
+        FIELDS = ("pgid", "shard", "from_osd", "tid", ...)
+
+Checked, tree-wide:
+
+- every ``@register_message`` class declares FIELDS,
+- encode side: every construction ``MFoo({...literal...})`` uses only
+  declared keys, and — when the dict is fully literal — sets every
+  non-optional key,
+- decode side: ``msg["key"]`` / ``msg.get("key")`` reads use only
+  declared keys, at sites where the message's type is statically known
+  (a ``msg.TYPE == "x"`` / ``t != "x": return`` dispatch branch, the
+  codebase's universal handler idiom),
+- dead fields: a declared field neither written at any construction
+  site nor read at any resolved read site.
+
+Reads the checker cannot type (no TYPE test in scope) are skipped, not
+guessed — this checker trades recall for zero false positives on the
+decode side.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, const_str, terminal_attr
+
+
+def _parse_fields(node: ast.AST) -> "Optional[List[str]]":
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = const_str(elt)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+class MsgSymmetryChecker(Checker):
+    name = "msg-symmetry"
+    description = "Message FIELDS schema vs encode/decode usage drift"
+
+    # --- collect --------------------------------------------------------------
+
+    def collect(self, module: Module) -> dict:
+        classes: "List[dict]" = []
+        constructs: "List[dict]" = []
+        reads: "List[dict]" = []
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node, classes)
+            elif isinstance(node, ast.Call):
+                self._collect_construct(node, constructs, module)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_reads(node, reads, module)
+        return {"classes": classes, "constructs": constructs,
+                "reads": reads}
+
+    @staticmethod
+    def _collect_class(node: ast.ClassDef, classes: "List[dict]") -> None:
+        registered = any(terminal_attr(d) == "register_message"
+                         for d in node.decorator_list)
+        if not registered:
+            return
+        wire_type = fields = None
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                if stmt.targets[0].id == "TYPE":
+                    wire_type = const_str(stmt.value)
+                elif stmt.targets[0].id == "FIELDS":
+                    fields = _parse_fields(stmt.value)
+        classes.append({"name": node.name, "type": wire_type,
+                        "fields": fields, "line": node.lineno})
+
+    @staticmethod
+    def _collect_construct(node: ast.Call, constructs: "List[dict]",
+                           module: Module) -> None:
+        """``MFoo({...})`` / ``MFoo(dict(base, k=v))`` sites.  Class
+        resolution is by name at report time; the 'M'+Upper prefix
+        filter just keeps the fact stream small."""
+        cls_name = terminal_attr(node.func)
+        if not (len(cls_name) > 1 and cls_name[0] == "M" and
+                cls_name[1].isupper()):
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        keys: "List[str]" = []
+        dynamic = False
+        if isinstance(arg, ast.Dict):
+            for k in arg.keys:
+                s = const_str(k)
+                if s is None:
+                    dynamic = True     # **spread or computed key
+                else:
+                    keys.append(s)
+        elif isinstance(arg, ast.Call) and terminal_attr(arg.func) == "dict":
+            dynamic = bool(arg.args)   # dict(base, k=v): base is opaque
+            for kw in arg.keywords:
+                if kw.arg is None:
+                    dynamic = True
+                else:
+                    keys.append(kw.arg)
+        else:
+            # opaque expression (a dict built elsewhere): no keys to
+            # check, but the class must still count as dynamically
+            # constructed or the dead-field pass would misfire
+            keys, dynamic = [], True
+        constructs.append({"cls": cls_name, "keys": keys,
+                           "dynamic": dynamic, "line": node.lineno,
+                           "context": module.context(node.lineno)})
+
+    def _collect_reads(self, fn, reads: "List[dict]", module: Module) -> None:
+        """Type-resolved field reads inside one handler function.
+
+        Recognized dispatch idioms (both used throughout the tree):
+
+            t = msg.TYPE
+            if t == "ec_sub_write": ... msg["tid"] ...
+
+            if msg.TYPE != "mgr_report": return
+            ... msg["daemon"] ...
+        """
+        # names aliasing <obj>.TYPE  ->  the object variable name
+        type_vars: "Dict[str, str]" = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    isinstance(stmt.value, ast.Attribute) and \
+                    stmt.value.attr == "TYPE" and \
+                    isinstance(stmt.value.value, ast.Name):
+                type_vars[stmt.targets[0].id] = stmt.value.value.id
+
+        def match_test(test: ast.expr) -> "Optional[Tuple[str, str, str]]":
+            """-> (obj var, wire type, 'eq'|'ne') for TYPE compares."""
+            if not (isinstance(test, ast.Compare) and
+                    len(test.ops) == 1 and len(test.comparators) == 1):
+                return None
+            lit = const_str(test.comparators[0])
+            if lit is None:
+                return None
+            left = test.left
+            obj = None
+            if isinstance(left, ast.Name) and left.id in type_vars:
+                obj = type_vars[left.id]
+            elif isinstance(left, ast.Attribute) and left.attr == "TYPE" \
+                    and isinstance(left.value, ast.Name):
+                obj = left.value.id
+            if obj is None:
+                return None
+            if isinstance(test.ops[0], ast.Eq):
+                return obj, lit, "eq"
+            if isinstance(test.ops[0], ast.NotEq):
+                return obj, lit, "ne"
+            return None
+
+        def record(body, obj: str, wire_type: str) -> None:
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    key = None
+                    if isinstance(node, ast.Subscript) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id == obj:
+                        key = const_str(node.slice)
+                    elif isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "get" and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == obj and node.args:
+                        key = const_str(node.args[0])
+                    if key is not None:
+                        reads.append({
+                            "type": wire_type, "key": key,
+                            "line": node.lineno,
+                            "context": module.context(node.lineno)})
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            m = match_test(node.test)
+            if m is None:
+                continue
+            obj, wire_type, op = m
+            if op == "eq":
+                record(node.body, obj, wire_type)
+            elif op == "ne" and node.body and \
+                    isinstance(node.body[-1], (ast.Return, ast.Raise,
+                                               ast.Continue)) and \
+                    node in fn.body:
+                # top-level guard clause: everything AFTER it sees this
+                # type (earlier eq-branches keep their own attribution)
+                record(fn.body[fn.body.index(node) + 1:], obj,
+                       wire_type)
+
+    # --- report ---------------------------------------------------------------
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        # class name -> (path, schema meta); wire type -> class name
+        by_name: "Dict[str, Tuple[str, dict]]" = {}
+        by_type: "Dict[str, str]" = {}
+        for path, f in facts.items():
+            for c in f.get("classes", ()):
+                by_name[c["name"]] = (path, c)
+                if c["type"]:
+                    by_type[c["type"]] = c["name"]
+
+        schemas: "Dict[str, Tuple[Set[str], Set[str]]]" = {}
+        for name, (path, c) in sorted(by_name.items()):
+            if c["fields"] is None:
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=f"class {name}",
+                    message=f"registered message {name} declares no "
+                            f"FIELDS schema (the encode/decode contract "
+                            f"cephlint checks against)"))
+                continue
+            required = {f.rstrip("?") for f in c["fields"]
+                        if not f.endswith("?")}
+            declared = {f.rstrip("?") for f in c["fields"]}
+            schemas[name] = (declared, required)
+
+        used: "Dict[str, Set[str]]" = {n: set() for n in schemas}
+        has_dynamic: "Set[str]" = set()
+
+        for path, f in facts.items():
+            for site in f.get("constructs", ()):
+                name = site["cls"]
+                if name not in schemas:
+                    continue
+                if site["dynamic"]:
+                    has_dynamic.add(name)
+                declared, required = schemas[name]
+                for key in site["keys"]:
+                    used[name].add(key)
+                    if key not in declared:
+                        out.append(Finding(
+                            check=self.name, path=path, line=site["line"],
+                            context=site["context"],
+                            message=f"{name} encoded with field "
+                                    f"{key!r} not in its FIELDS schema "
+                                    f"(receiver-side reads cannot see "
+                                    f"it is expected)"))
+                if not site["dynamic"]:
+                    for missing in sorted(required - set(site["keys"])):
+                        out.append(Finding(
+                            check=self.name, path=path, line=site["line"],
+                            context=site["context"],
+                            message=f"{name} encoded without required "
+                                    f"field {missing!r} (mark it "
+                                    f"optional with '{missing}?' in "
+                                    f"FIELDS if that is intended)"))
+            for r in f.get("reads", ()):
+                name = by_type.get(r["type"])
+                if name is None or name not in schemas:
+                    continue
+                declared, _required = schemas[name]
+                used[name].add(r["key"])
+                if r["key"] not in declared:
+                    out.append(Finding(
+                        check=self.name, path=path, line=r["line"],
+                        context=r["context"],
+                        message=f"{name} decoded field {r['key']!r} is "
+                                f"not in its FIELDS schema — no encode "
+                                f"site can be setting it"))
+
+        for name, (declared, _required) in sorted(schemas.items()):
+            if name in has_dynamic:
+                # a dict(base, ...) construct site can set ANY declared
+                # field; deadness is unprovable for this class
+                continue
+            path, c = by_name[name]
+            # optional fields are exempt: the '?' marker exists for
+            # paths (dynamic dicts, cross-version peers) no static
+            # reference can prove
+            for dead in sorted(_required - used[name]):
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=f"class {name}",
+                    message=f"{name}.FIELDS declares {dead!r} but no "
+                            f"construction or typed read site "
+                            f"references it (dead wire field?)"))
+        return out

@@ -1,0 +1,74 @@
+"""fire-and-forget — spawned tasks whose exceptions vanish.
+
+PR 3's crash handler exists because asyncio drops a dead task's
+exception on the floor until the task object is garbage collected, and
+even then only as an un-attributable "exception was never retrieved"
+warning.  A task spawned and immediately discarded —
+
+    asyncio.ensure_future(self._kick())        # statement, value dropped
+
+— can die silently mid-recovery.  The fix is one of:
+
+- route through the crash shell: ``self.crash.guard(coro, "context")``
+  (dump + clog + RECENT_CRASH on death),
+- store the handle somewhere that is later awaited/cancelled
+  (``self._kick_task = asyncio.ensure_future(...)``),
+- await it.
+
+Flagged: ``asyncio.create_task`` / ``asyncio.ensure_future`` /
+``<loop>.create_task`` calls used as bare expression statements.  Any
+consumption of the return value (assignment, argument position, return,
+await, container append) counts as handled — the checker is
+deliberately shallow there; the runtime crash shell is the belt, this
+is the suspender that catches the sites which bypass BOTH.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, dotted
+
+_SPAWNERS_EXACT = {"asyncio.create_task", "asyncio.ensure_future"}
+_SPAWNER_SUFFIX = (".create_task", ".ensure_future")
+
+
+def _is_spawner(name: str) -> bool:
+    return name in _SPAWNERS_EXACT or name.endswith(_SPAWNER_SUFFIX)
+
+
+class FireAndForgetChecker(Checker):
+    name = "fire-and-forget"
+    description = "task spawned without storing/awaiting/guarding it"
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if isinstance(call, ast.Await):
+                continue                      # awaited: consumed
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted(call.func)
+            if _is_spawner(name):
+                hits.append({"line": node.lineno, "col": node.col_offset,
+                             "call": name,
+                             "context": module.context(node.lineno)})
+        return {"hits": hits}
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for h in f.get("hits", ()):
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    col=h["col"], context=h["context"],
+                    message=f"{h['call']}(...) result discarded: a task "
+                            f"exception here is silently dropped — wrap "
+                            f"in CrashHandler.guard or store the handle"))
+        return out

@@ -1,0 +1,112 @@
+"""CLI — ``python -m tools.cephlint <paths> [options]``.
+
+Exit codes: 0 = clean (after pragmas + baseline), 1 = findings,
+2 = usage / internal error.  ``--format=json`` emits a machine-readable
+report (the CI gate and chaos_check --lint consume it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from . import VERSION
+from . import baseline as baseline_mod
+from .checkers import ALL_CHECKERS
+from .driver import Linter, lint_paths
+from .findings import Finding
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+DEFAULT_CACHE = os.path.join(_HERE, ".factcache.json")
+
+
+def main(argv: "Optional[list]" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cephlint",
+        description="AST invariant checker for the async EC store")
+    ap.add_argument("paths", nargs="*", default=["ceph_tpu"],
+                    help="files/directories to lint (default: ceph_tpu)")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--checks", default="",
+                    help="comma-separated subset of checks to run")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the shipped empty one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into --baseline and "
+                         "exit 0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file fact cache")
+    ap.add_argument("--cache", default=DEFAULT_CACHE,
+                    help="fact cache path")
+    ap.add_argument("--lockdep-dump", default="",
+                    help="JSON from 'lockdep dump --format=json' on a "
+                         "daemon admin socket; observed runtime edges "
+                         "are unioned into the static lock graph")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKERS:
+            print(f"{c.name:18s} {c.description}")
+        return 0
+
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()] \
+        or None
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"cephlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    lockdep_dump = None
+    if args.lockdep_dump:
+        try:
+            with open(args.lockdep_dump) as f:
+                lockdep_dump = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cephlint: --lockdep-dump: {e}", file=sys.stderr)
+            return 2
+
+    cache = None if args.no_cache else args.cache
+    try:
+        if args.write_baseline:
+            linter = Linter(checks=checks, cache_path=cache)
+            from .checkers import ReportContext
+            findings = linter.run(args.paths,
+                                  ReportContext(lockdep_dump=lockdep_dump))
+            baseline_mod.write(args.baseline, findings)
+            print(f"cephlint: wrote {len(findings)} baseline entr"
+                  f"{'y' if len(findings) == 1 else 'ies'} to "
+                  f"{args.baseline}")
+            return 0
+        baseline_path = None if args.no_baseline else args.baseline
+        findings, suppressed = lint_paths(
+            args.paths, checks=checks, baseline_path=baseline_path,
+            cache_path=cache, lockdep_dump=lockdep_dump)
+    except ValueError as e:
+        print(f"cephlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": VERSION,
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+            "baseline_suppressed": suppressed,
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = f"cephlint: {len(findings)} finding" \
+               f"{'' if len(findings) == 1 else 's'}"
+        if suppressed:
+            tail += f" ({suppressed} baseline-suppressed)"
+        print(tail)
+    return 1 if findings else 0

@@ -1,0 +1,173 @@
+"""Driver — file discovery, per-file fact cache, lint orchestration.
+
+The cache keys each file's collected facts on (content sha1, cephlint
+version, checker set), so re-running after editing one file re-parses
+ONE file; the whole-tree report phase over cached facts is milliseconds.
+Cache lives beside the baseline (tools/cephlint/.factcache.json by
+default, overridable/disablable) and is safe to delete at any time.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import baseline as baseline_mod
+from . import pragmas as pragmas_mod
+from .checkers import ALL_CHECKERS, CHECKERS, Module, ReportContext
+from .findings import Finding
+
+_CACHE_SCHEMA = 1
+
+
+def discover(paths: "Sequence[str]") -> "List[str]":
+    """Python files under ``paths`` (files taken verbatim), sorted,
+    deduplicated, excluding caches/hidden dirs."""
+    out: "Set[str]" = set()
+    for p in paths:
+        if os.path.isfile(p):
+            out.add(os.path.normpath(p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if not d.startswith(".") and
+                       d != "__pycache__"]
+            for f in files:
+                if f.endswith(".py"):
+                    out.add(os.path.normpath(os.path.join(root, f)))
+    return sorted(out)
+
+
+class Linter:
+    def __init__(self, checks: "Optional[Iterable[str]]" = None,
+                 cache_path: "Optional[str]" = None) -> None:
+        names = list(checks) if checks is not None \
+            else [c.name for c in ALL_CHECKERS]
+        unknown = [n for n in names if n not in CHECKERS]
+        if unknown:
+            raise ValueError(f"unknown check(s): {', '.join(unknown)} "
+                             f"(known: {', '.join(sorted(CHECKERS))})")
+        self.checkers = [CHECKERS[n]() for n in names]
+        self.cache_path = cache_path
+        self._cache: "Dict[str, dict]" = {}
+        self._cache_dirty = False
+        if cache_path and os.path.exists(cache_path):
+            try:
+                with open(cache_path) as f:
+                    data = json.load(f)
+                if data.get("schema") == _CACHE_SCHEMA:
+                    self._cache = data.get("files", {})
+            except (OSError, ValueError):
+                self._cache = {}
+        # per-file parse errors surface as findings, not crashes
+        self.errors: "List[Finding]" = []
+
+    # --- per-file phase -------------------------------------------------------
+
+    def _collect_file(self, path: str) -> "Optional[dict]":
+        """-> {"sha": ..., "facts": {check: facts}, "pragmas": [...],
+        "file_pragmas": [...]} or None on unreadable file."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            self.errors.append(Finding(
+                check="parse-error", path=path, line=0,
+                message=f"unreadable: {e}"))
+            return None
+        sha = hashlib.sha1(
+            (f"v{_CACHE_SCHEMA}:" + source).encode()).hexdigest()
+        cached = self._cache.get(path)
+        want = {c.name for c in self.checkers}
+        if cached is not None and cached.get("sha") == sha and \
+                want <= set(cached.get("facts", {})):
+            return cached
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.errors.append(Finding(
+                check="parse-error", path=path, line=e.lineno or 0,
+                message=f"syntax error: {e.msg}"))
+            return None
+        module = Module(path=path, tree=tree,
+                        lines=source.splitlines())
+        facts = {}
+        for checker in self.checkers:
+            facts[checker.name] = checker.collect(module)
+        per_line, file_wide = pragmas_mod.extract(source)
+        entry = {"sha": sha, "facts": facts,
+                 "pragmas": {str(k): sorted(v)
+                             for k, v in per_line.items()},
+                 "file_pragmas": sorted(file_wide)}
+        if cached is not None and cached.get("sha") == sha:
+            # extend a cache entry produced by a narrower --checks run
+            entry["facts"] = {**cached.get("facts", {}), **facts}
+        self._cache[path] = entry
+        self._cache_dirty = True
+        return entry
+
+    def _save_cache(self) -> None:
+        if not self.cache_path or not self._cache_dirty:
+            return
+        try:
+            with open(self.cache_path, "w") as f:
+                json.dump({"schema": _CACHE_SCHEMA, "files": self._cache},
+                          f)
+        except OSError:
+            pass                      # cache is an optimization only
+
+    # --- whole-tree phase -----------------------------------------------------
+
+    def run(self, paths: "Sequence[str]",
+            ctx: "Optional[ReportContext]" = None
+            ) -> "List[Finding]":
+        ctx = ctx or ReportContext()
+        files = discover(paths)
+        entries: "Dict[str, dict]" = {}
+        for path in files:
+            entry = self._collect_file(path)
+            if entry is not None:
+                entries[path] = entry
+        # drop cache rows for files that no longer exist on this scan's
+        # roots is NOT done: the cache may serve multiple roots
+        self._save_cache()
+
+        findings: "List[Finding]" = list(self.errors)
+        for checker in self.checkers:
+            facts = {p: e["facts"][checker.name]
+                     for p, e in entries.items()}
+            findings.extend(checker.report(facts, ctx))
+
+        # pragma suppression
+        kept: "List[Finding]" = []
+        for f in findings:
+            entry = entries.get(f.path)
+            if entry is not None:
+                per_line = {int(k): set(v)
+                            for k, v in entry["pragmas"].items()}
+                file_wide = set(entry["file_pragmas"])
+                if pragmas_mod.suppressed(f.check, f.line, per_line,
+                                          file_wide):
+                    continue
+            kept.append(f)
+        kept.sort(key=Finding.sort_key)
+        return kept
+
+
+def lint_paths(paths: "Sequence[str]",
+               checks: "Optional[Iterable[str]]" = None,
+               baseline_path: "Optional[str]" = None,
+               cache_path: "Optional[str]" = None,
+               lockdep_dump: "Optional[dict]" = None
+               ) -> "Tuple[List[Finding], int]":
+    """Convenience one-call API (tests, chaos_check --lint, check.sh):
+    -> (non-baselined findings, baseline-suppressed count)."""
+    linter = Linter(checks=checks, cache_path=cache_path)
+    findings = linter.run(paths, ReportContext(lockdep_dump=lockdep_dump))
+    if baseline_path and os.path.exists(baseline_path):
+        bl = baseline_mod.load(baseline_path)
+        return baseline_mod.apply(findings, bl)
+    return findings, 0

@@ -1,0 +1,40 @@
+"""Finding — one checker hit, with a line-stable fingerprint."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    check: str          # checker id ("blocking-call", "lock-order", ...)
+    path: str           # repo-relative posix path
+    line: int           # 1-based
+    message: str
+    context: str = ""   # stripped source line (fingerprint anchor)
+    col: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity: moving code around a file must not
+        invalidate a baseline entry, so the anchor is the source text of
+        the offending line, not its position."""
+        return f"{self.check}|{self.path}|{self.context}"
+
+    def to_json(self) -> dict:
+        out = {"check": self.check, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message,
+               "context": self.context}
+        if self.extra:
+            out["extra"] = self.extra
+        return out
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        body = f"{loc}: [{self.check}] {self.message}"
+        if self.context:
+            body += f"\n    {self.context}"
+        return body
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.check, self.message)

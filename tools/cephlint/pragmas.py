@@ -1,0 +1,79 @@
+"""``# cephlint: disable=<check>[,<check>...]`` pragma extraction.
+
+Scoping rules (pylint-style, line-granular — the whole point is that a
+pragma covers ONE intentional construct, not a file):
+
+- a pragma sharing a line with code disables the named checks for that
+  line,
+- a pragma on a line of its own disables the named checks for the next
+  non-blank, non-comment line (so a long statement can carry the pragma
+  above itself),
+- ``# cephlint: disable-file=<check>`` anywhere in the file disables the
+  check for the whole file; reserved for generated/vendored files —
+  hand-written code should use line pragmas.
+
+Because findings for a multi-line statement are reported at the
+statement's FIRST line, a pragma must sit on (or above) that line.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, List, Set, Tuple
+
+_PRAGMA_RE = re.compile(
+    r"#\s*cephlint:\s*(disable(?:-file)?)\s*=\s*([\w\-, ]+)")
+
+
+def extract(source: str) -> "Tuple[Dict[int, Set[str]], Set[str]]":
+    """-> (line -> disabled checks, file-wide disabled checks).
+
+    Tokenizes rather than regexing raw lines so a pragma-looking string
+    LITERAL (e.g. in this very test suite) is not honored as a pragma.
+    """
+    per_line: "Dict[int, Set[str]]" = {}
+    file_wide: "Set[str]" = set()
+    # (line, is_own_line) for standalone pragmas awaiting their target
+    pending: "List[Set[str]]" = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_wide
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            checks = {c.strip() for c in m.group(2).split(",") if c.strip()}
+            if m.group(1) == "disable-file":
+                file_wide |= checks
+                continue
+            lineno = tok.start[0]
+            before = lines[lineno - 1][: tok.start[1]].strip() \
+                if lineno - 1 < len(lines) else ""
+            if before:
+                # trailing pragma: covers its own line
+                per_line.setdefault(lineno, set()).update(checks)
+            else:
+                # standalone pragma: covers the next code line
+                pending.append(checks)
+        elif tok.type in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                          tokenize.DEDENT):
+            continue
+        elif pending:
+            for checks in pending:
+                per_line.setdefault(tok.start[0], set()).update(checks)
+            pending = []
+    return per_line, file_wide
+
+
+def suppressed(check: str, line: int,
+               per_line: "Dict[int, Set[str]]",
+               file_wide: "Set[str]") -> bool:
+    if check in file_wide or "all" in file_wide:
+        return True
+    disabled = per_line.get(line, ())
+    return check in disabled or "all" in disabled

@@ -307,7 +307,33 @@ def main(argv=None) -> int:
                          "+ group-commit round: async+tcp transport, "
                          "block store, socket kills mid-cork, crashes "
                          "mid-group-commit — same no-lost/no-dup gate")
+    ap.add_argument("--lint", action="store_true",
+                    help="cephlint preflight: refuse to start chaos on "
+                         "a tree with non-baselined static-invariant "
+                         "findings (a fire-and-forget task or blocked "
+                         "event loop makes chaos verdicts unreadable)")
     args = ap.parse_args(argv)
+    if args.lint:
+        from tools.cephlint import lint_paths
+        from tools.cephlint.cli import DEFAULT_BASELINE
+        tree = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "ceph_tpu")
+        # baseline fingerprints carry repo-relative paths: scan the
+        # same shape whenever the repo root is the cwd
+        rel = os.path.relpath(tree)
+        if not rel.startswith(".."):
+            tree = rel
+        findings, _sup = lint_paths([tree],
+                                    baseline_path=DEFAULT_BASELINE)
+        if findings:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            print(f"chaos_check: --lint preflight FAILED "
+                  f"({len(findings)} cephlint finding(s)); fix or "
+                  f"baseline them before trusting a chaos verdict",
+                  file=sys.stderr)
+            return 2
+        print("chaos_check: cephlint preflight clean")
     try:
         rc = asyncio.new_event_loop().run_until_complete(
             run_chaos(args))
