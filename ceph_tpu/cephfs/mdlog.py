@@ -105,6 +105,10 @@ class MDLog:
         try:
             await self._apply(steps)
         except Exception:
+            # poison latch, set-once and only ever cleared by replay
+            # via open(); transact callers are serialized by the MDS
+            # op lock, so no competing writer exists to race
+            # cephlint: disable=await-atomicity
             self.damaged = True
             raise
         await self.meta.omap_rm(MDLOG_OID, [key])

@@ -76,7 +76,8 @@ class MDSDaemon(Dispatcher):
     async def init(self) -> None:
         replayed = await self.fs.mount()
         await self.ms.bind(self.addr)
-        self.addr = self.ms.listen_addr
+        # init() runs once, before any op can observe the daemon
+        self.addr = self.ms.listen_addr  # cephlint: disable=await-atomicity
         if replayed:
             dout("mds", 1, f"mds.0 replayed {replayed} journal records")
 

@@ -327,6 +327,11 @@ class Objecter(Dispatcher):
                     # (expired/stale generation) — structured field, not
                     # substring matching: a caps denial mentioning
                     # 'ticket' must not burn a renew+retry
+                    # concurrent ops may each renew: every renewal
+                    # yields an equally-fresh ticket, last write wins,
+                    # and a reader that grabbed the older one just
+                    # triggers one more renew+retry
+                    # cephlint: disable=await-atomicity
                     self.ticket = await self.ticket_renewer()
                     renewed = True
                     continue

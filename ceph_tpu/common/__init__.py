@@ -5,7 +5,7 @@ Rebuild of reference src/common + src/log (SURVEY.md §2.5, §5): the layer-0/1
 primitives every daemon sits on.
 """
 
-from .buffer import BufferList  # noqa: F401
+from .buffer import BufferFrozenError, BufferList  # noqa: F401
 from .config import Config, ConfigObserver  # noqa: F401
 from .options import (LEVEL_ADVANCED, LEVEL_BASIC, LEVEL_DEV,  # noqa: F401
                       OPTIONS, Option)

@@ -29,15 +29,64 @@ import numpy as np
 from ..ops import crc32c as crcmod
 
 
-class _Raw:
-    """One backing store + its crc cache (the buffer::raw analog)."""
+class BufferFrozenError(RuntimeError):
+    """Mutation attempted on a buffer that crossed a handoff boundary."""
 
-    __slots__ = ("data", "crc_cache")
+
+def _unlock(arr: np.ndarray) -> None:
+    """Re-enable writability on ``arr``, unlocking frozen ndarray bases
+    first (adoption freezes the donor's base, and numpy only lets a
+    view go writable when its base is).  Raises ValueError at a root
+    that can never be writable (``np.frombuffer(bytes)``)."""
+    if arr.flags.writeable:
+        return
+    if isinstance(arr.base, np.ndarray):
+        _unlock(arr.base)
+    arr.flags.writeable = True
+
+
+class _Raw:
+    """One backing store + its crc cache (the buffer::raw analog).
+
+    The backing array is **read-only from construction**: raws are
+    shared freely (substr/append alias them, the crc cache memoizes
+    over their bytes), so in-place mutation through any alias corrupts
+    every holder and poisons cached crcs.  numpy enforces it — a write
+    through ``view()``/``to_array()`` raises at the faulting line.
+    ``mutable_view()`` is the one escape hatch: it re-arms writability
+    and invalidates the crc cache, and it stops working once the
+    buffer crosses an ownership boundary (``frozen_at`` set by
+    sanitizer freeze-on-handoff)."""
+
+    __slots__ = ("data", "crc_cache", "frozen_at")
 
     def __init__(self, data: np.ndarray) -> None:
-        self.data = data                       # 1-D uint8, immutable by convention
+        data.flags.writeable = False           # 1-D uint8, immutable
+        self.data = data
         self.crc_cache: "dict[tuple[int, int], tuple[int, int]]" = {}
         # maps (off, len) -> (seed, crc)
+        self.frozen_at: "Optional[str]" = None   # handoff boundary name
+
+    def freeze(self, boundary: str) -> None:
+        """Seal the raw across an ownership handoff: even
+        ``mutable_view()`` refuses from here on."""
+        if self.frozen_at is None:
+            self.frozen_at = boundary
+
+    def mutable_view(self) -> np.ndarray:
+        """Deliberate in-place mutation: re-enables writability and
+        drops every cached crc (they describe the old bytes).  Raises
+        ``BufferFrozenError`` after a handoff — the bytes may be
+        sitting in a corked messenger queue or an unsynced WAL batch.
+        Raises ``ValueError`` when the backing store can never be
+        writable (constructed over ``bytes``)."""
+        if self.frozen_at is not None:
+            raise BufferFrozenError(
+                f"buffer was handed off at {self.frozen_at!r}; "
+                f"mutating it now would corrupt the consumer's copy")
+        self.crc_cache.clear()
+        _unlock(self.data)                     # ValueError if unowned
+        return self.data
 
     def crc(self, off: int, length: int, seed: int) -> int:
         key = (off, length)
@@ -84,6 +133,16 @@ class BufferList:
     @staticmethod
     def _as_array(data) -> np.ndarray:
         if isinstance(data, np.ndarray):
+            # adoption freezes the CALLER'S array too — the whole base
+            # chain, since handing in a view (arr[10:20]) must not
+            # leave the donor a writable alias through its root: a
+            # BufferList shares the backing store zero-copy, so the
+            # donor mutating it afterwards would corrupt every reader
+            # and poison the crc cache
+            base = data
+            while isinstance(base, np.ndarray):
+                base.flags.writeable = False
+                base = base.base
             arr = data.reshape(-1).view(np.uint8) if data.dtype != np.uint8 \
                 else data.reshape(-1)
             return arr
@@ -203,6 +262,37 @@ class BufferList:
     def invalidate_crc(self) -> None:
         for s in self._segs:
             s.raw.crc_cache.clear()
+
+    # --- mutation control -----------------------------------------------------
+
+    def freeze(self, boundary: str = "frozen") -> "BufferList":
+        """Seal every backing store across an ownership handoff (called
+        by sanitizer freeze-on-handoff at the messenger send and
+        queue_transaction boundaries): later ``mutable_view()`` calls
+        raise ``BufferFrozenError`` naming ``boundary``."""
+        for s in self._segs:
+            s.raw.freeze(boundary)
+        return self
+
+    def frozen_at(self) -> "Optional[str]":
+        """First handoff boundary any segment crossed, or None."""
+        for s in self._segs:
+            if s.raw.frozen_at is not None:
+                return s.raw.frozen_at
+        return None
+
+    def mutable_view(self) -> np.ndarray:
+        """Writable alias of a single-segment list's bytes — THE
+        sanctioned in-place mutation path (crc caches invalidated,
+        refused after a handoff).  Multi-segment lists must
+        ``rebuild()`` first; the partial-segment case returns a
+        writable window into the raw."""
+        if len(self._segs) != 1:
+            raise ValueError(
+                f"mutable_view() needs one segment, have "
+                f"{len(self._segs)} (rebuild() first)")
+        s = self._segs[0]
+        return s.raw.mutable_view()[s.off:s.off + s.len]
 
     # --- comparison / repr ---------------------------------------------------
 

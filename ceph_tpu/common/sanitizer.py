@@ -1,0 +1,205 @@
+"""cephsan runtime — seeded interleaving fuzzer + buffer freeze-on-handoff.
+
+The write path is concurrent end to end (sharded PG queues, WAL group
+commit off the event loop, messenger corking) and every real bug that
+concurrency introduced was an *interleaving* bug found by thrash luck.
+This module makes that luck reproducible, the way ThreadSanitizer makes
+races reproducible and Ceph's lockdep makes deadlocks deterministic:
+
+- **InterleavingLoop** — an event-loop shim that permutes the order of
+  ready callbacks/task wakeups with a seeded RNG at every loop
+  iteration.  Any ordering it produces is a legal asyncio schedule
+  (asyncio promises FIFO per ``call_soon`` but tasks make no cross-task
+  ordering promise at await points); a bug it surfaces is a real bug.
+  The permutation sequence is a pure function of the seed and the
+  workload, so a failing schedule REPLAYS exactly: re-run with the
+  printed seed and the same interleaving happens again.
+- **freeze-on-handoff** — once a ``BufferList`` (or bare ndarray
+  payload) crosses an ownership boundary — the messenger send queue or
+  ``ObjectStore.queue_transaction`` — its backing numpy arrays flip
+  ``writeable=False`` and the raws record the boundary, so a later
+  mutation raises *at the faulting line* instead of corrupting a frame
+  that is still sitting in a corked out-queue or an unsynced WAL batch.
+  This is the tripwire ROADMAP item 1 (zero-copy bufferlists threaded
+  messenger→encode→store) needs in place BEFORE the refactor.
+
+Activation (all off by default; zero hot-path cost when off):
+
+- ``install(seed)``            — process-wide: event-loop policy swapped
+  so every ``asyncio.new_event_loop()`` returns a seeded
+  ``InterleavingLoop`` (per-loop seeds derived deterministically from
+  the base seed), freeze-on-handoff armed.
+- ``install_from_env()``       — reads ``CEPHSAN_SEED`` (int) and
+  ``CEPHSAN_FREEZE`` (default on when a seed is set); called by
+  tests/conftest.py so ``CEPHSAN_SEED=7 pytest -m cephsan`` replays a
+  CI failure with zero test edits.
+- ``tools/cephsan`` sweeps the concurrency suites over a seed set and
+  prints the reproduce line for any failing seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+from typing import Any, Optional
+
+import numpy as np
+
+# --- state -------------------------------------------------------------------
+
+_freeze = False          # freeze-on-handoff armed?
+_base_seed: "Optional[int]" = None
+_prev_policy: "Optional[asyncio.AbstractEventLoopPolicy]" = None
+
+
+def freeze_enabled() -> bool:
+    return _freeze
+
+
+def enable_freeze(on: bool = True) -> None:
+    global _freeze
+    _freeze = on
+
+
+def seed() -> "Optional[int]":
+    """The installed base seed, or None when the fuzzer is off."""
+    return _base_seed
+
+
+def enabled() -> bool:
+    return _base_seed is not None
+
+
+# --- the interleaving loop ---------------------------------------------------
+
+
+class InterleavingLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop that shuffles the ready queue each iteration.
+
+    Every handle parked in ``_ready`` at the top of ``_run_once`` is a
+    callback asyncio was about to run in FIFO order; running them in
+    any other order is an equally legal schedule (they were all
+    runnable *now*).  A seeded shuffle therefore explores interleavings
+    the production FIFO policy never produces — the schedules where
+    check-then-act races and iterate-while-mutate bugs live — while
+    staying fully deterministic for a given seed + workload.
+    """
+
+    def __init__(self, seed: int) -> None:
+        super().__init__()
+        self.cephsan_seed = seed
+        self._cephsan_rng = random.Random(seed)
+        self.cephsan_shuffles = 0      # telemetry: permuted iterations
+
+    def _run_once(self) -> None:
+        ready = self._ready
+        if len(ready) > 1:
+            items = list(ready)
+            ready.clear()
+            self._cephsan_rng.shuffle(items)
+            ready.extend(items)
+            self.cephsan_shuffles += 1
+        super()._run_once()
+
+
+class InterleavingPolicy(asyncio.DefaultEventLoopPolicy):
+    """Policy handing out ``InterleavingLoop``s with per-loop seeds
+    derived deterministically from the base seed, so multi-loop
+    programs (chaos_check's two rounds, module-scoped test loops)
+    replay too."""
+
+    def __init__(self, seed: int) -> None:
+        super().__init__()
+        self.base_seed = seed
+        self._loops_created = 0
+
+    def new_event_loop(self) -> InterleavingLoop:
+        self._loops_created += 1
+        derived = (self.base_seed * 1_000_003 + self._loops_created) \
+            & 0x7FFFFFFF
+        return InterleavingLoop(derived)
+
+
+def install(seed: int, freeze: bool = True) -> None:
+    """Arm the sanitizer process-wide.  Idempotent for the same seed."""
+    global _base_seed, _prev_policy
+    if _prev_policy is None:
+        _prev_policy = asyncio.get_event_loop_policy()
+    _base_seed = int(seed)
+    asyncio.set_event_loop_policy(InterleavingPolicy(_base_seed))
+    enable_freeze(freeze)
+
+
+def uninstall() -> None:
+    """Restore the pre-install policy and disarm freezing (test hook)."""
+    global _base_seed, _prev_policy
+    if _prev_policy is not None:
+        asyncio.set_event_loop_policy(_prev_policy)
+        _prev_policy = None
+    _base_seed = None
+    enable_freeze(False)
+
+
+def install_from_env() -> "Optional[int]":
+    """``CEPHSAN_SEED=<int>`` arms the fuzzer (and freezing, unless
+    ``CEPHSAN_FREEZE=0``).  Returns the seed, or None when unset."""
+    raw = os.environ.get("CEPHSAN_SEED", "")
+    if not raw:
+        return None
+    s = int(raw)
+    install(s, freeze=os.environ.get("CEPHSAN_FREEZE", "1") != "0")
+    return s
+
+
+# --- freeze-on-handoff -------------------------------------------------------
+
+_MAX_WALK_DEPTH = 4      # payload containers are shallow (ops lists, kv)
+
+
+def _freeze_array(arr: np.ndarray) -> None:
+    # reducing permissions is always allowed; a view of a writable base
+    # stays independently frozen (the base may still be writable — the
+    # BufferList constructor freezes bases at adoption, this handles
+    # arrays that never went through a BufferList)
+    arr.flags.writeable = False
+
+
+def _walk(obj: Any, boundary: str, depth: int) -> None:
+    if obj is None or depth > _MAX_WALK_DEPTH:
+        return
+    from .buffer import BufferList
+    if isinstance(obj, BufferList):
+        obj.freeze(boundary)
+        return
+    if isinstance(obj, np.ndarray):
+        _freeze_array(obj)
+        return
+    if isinstance(obj, (bytes, bytearray, str, int, float, bool)):
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _walk(v, boundary, depth + 1)
+        return
+    if isinstance(obj, (list, tuple)):
+        for v in obj:
+            _walk(v, boundary, depth + 1)
+
+
+def handoff(payload: Any, boundary: str) -> Any:
+    """Mark ``payload`` as having crossed an ownership boundary.
+
+    No-op unless freezing is armed.  Walks the payload (Message data,
+    Transaction ops, raw arrays, shallow containers of them) freezing
+    every numpy backing store it finds; BufferList raws additionally
+    record ``boundary`` so ``mutable_view()`` after a handoff raises a
+    message naming where ownership moved.  Returns the payload, so call
+    sites can wrap in-line."""
+    if not _freeze:
+        return payload
+    _walk(payload, boundary, 0)
+    if not isinstance(payload, np.ndarray):
+        # Message / Transaction duck-typing (no imports up the stack)
+        _walk(getattr(payload, "data", None), boundary, 0)
+        _walk(getattr(payload, "ops", None), boundary, 0)
+    return payload

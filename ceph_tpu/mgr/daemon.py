@@ -90,7 +90,8 @@ class HttpModule(MgrModule):
         # fire-and-forget task would let port readers race the bind)
         self._server = await asyncio.start_server(
             self._handle, "127.0.0.1", self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        # serve() is awaited once at init; no reader exists yet
+        self.port = self._server.sockets[0].getsockname()[1]  # cephlint: disable=await-atomicity
         dout("mgr", 1, f"{self.name} on 127.0.0.1:{self.port}")
 
     def shutdown(self) -> None:
@@ -303,7 +304,8 @@ class MgrDaemon(Dispatcher):
 
     async def init(self) -> None:
         await self.ms.bind(self.addr)
-        self.addr = self.ms.listen_addr
+        # init() runs once, before any op can observe the daemon
+        self.addr = self.ms.listen_addr  # cephlint: disable=await-atomicity
         from ..common.log import attach_debug_options
         attach_debug_options(self.config)
         self.clog.start()

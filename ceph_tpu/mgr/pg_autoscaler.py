@@ -88,14 +88,19 @@ class PgAutoscalerModule(MgrModule):
             key = (rec["pool"], rec["recommended"])
             if key in self._asked:
                 continue
+            # reserve BEFORE the mon round-trip: overlapping ticks (or
+            # an operator-triggered apply racing the tick loop) must
+            # collapse to one proposal per (pool, target), not spam
+            # paxos with duplicates; a failed ask un-reserves below
+            self._asked.add(key)
             try:
                 await self.mgr.mon_command({
                     "prefix": "osd pool set", "name": rec["pool"],
                     "key": "pg_num", "value": rec["recommended"]})
-                self._asked.add(key)
                 applied.append(rec)
                 dout("mgr", 1, f"pg_autoscaler: {rec['pool']} pg_num "
                                f"{rec['pg_num']} -> {rec['recommended']}")
             except Exception as e:  # noqa: BLE001 — retried next tick
+                self._asked.discard(key)
                 dout("mgr", 0, f"pg_autoscaler apply failed: {e}")
         return applied

@@ -96,6 +96,9 @@ class MonClient(Dispatcher):
                     # else keep hunting/retrying
                     last_err = f"mon.{rank}: EAGAIN"
                     if "leader" in out and int(out["leader"]) != rank:
+                        # advisory hint only: a stale write costs one
+                        # extra hunt step on the next attempt
+                        # cephlint: disable=await-atomicity
                         self.leader_guess = int(out["leader"])
                         redirected = True
                         break

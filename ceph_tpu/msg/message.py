@@ -20,6 +20,8 @@ from typing import Any, Dict, Optional, Type
 
 import numpy as np
 
+from ..common.buffer import BufferList
+
 
 class MessageError(Exception):
     pass
@@ -44,11 +46,20 @@ class Message:
     COMPAT_VERSION = 1   # oldest decoder this encoding supports
 
     def __init__(self, fields: "Optional[dict]" = None,
-                 data: "bytes | np.ndarray" = b"") -> None:
+                 data: "bytes | np.ndarray | BufferList" = b"") -> None:
         self.fields: "Dict[str, Any]" = dict(fields or {})
-        if isinstance(data, np.ndarray):
-            data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
-        self.data: bytes = bytes(data)
+        if isinstance(data, BufferList):
+            # zero-copy data path (ROADMAP item 1's on-ramp): the list
+            # is shared, not copied — bytes materialize once, at frame
+            # build.  The messenger's freeze-on-handoff seals the
+            # backing stores at send, so a sender mutating its arrays
+            # after send_message raises instead of corrupting a frame
+            # still parked in the corked out-queue.
+            self.data: "bytes | BufferList" = data
+        else:
+            if isinstance(data, np.ndarray):
+                data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
+            self.data = bytes(data)
         self.priority = 127
         # filled by the messenger on receive:
         self.from_name: str = ""
@@ -60,6 +71,8 @@ class Message:
         return self.fields.get(key, default)
 
     def data_array(self) -> np.ndarray:
+        if isinstance(self.data, BufferList):
+            return self.data.to_array()
         return np.frombuffer(self.data, dtype=np.uint8)
 
     # --- wire ----------------------------------------------------------------
@@ -72,7 +85,9 @@ class Message:
             "prio": self.priority,
             "fields": self.fields,
         }).encode()
-        return header, self.data
+        data = self.data.to_bytes() if isinstance(self.data, BufferList) \
+            else self.data
+        return header, data
 
     def __repr__(self) -> str:
         return (f"{type(self).__name__}({self.fields}, "

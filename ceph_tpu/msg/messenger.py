@@ -29,6 +29,7 @@ import random
 import struct
 from typing import Dict, List, Optional, Tuple
 
+from ..common import sanitizer
 from ..common.throttle import Throttle
 from ..common.log import dout
 from ..ops import crc32c as crcmod
@@ -212,6 +213,7 @@ class Connection:
             if self.policy.lossy:
                 raise ConnectionError(f"connection to {self.peer_addr} closed")
             return
+        sanitizer.handoff(msg, "messenger.send")
         header, data = msg.encode()
         self.out_seq += 1
         seq = self.out_seq
@@ -379,6 +381,9 @@ class Connection:
                 self.messenger._apply_sockopts(writer)
             except OSError:
                 if self.policy.lossy:
+                    # idempotent latch: every writer only ever sets
+                    # True, and the loop re-checks it each pass
+                    # cephlint: disable=await-atomicity
                     self.closed = True
                     self.messenger._drop_connection(self)
                     return
@@ -559,6 +564,7 @@ class _LocalConnection:
     async def send_message(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_addr} closed")
+        sanitizer.handoff(msg, "messenger.send")
         if self.peer.stopped:
             # lossless reconnect: the peer may have restarted and
             # re-registered at the same address (daemon revive) — swap to

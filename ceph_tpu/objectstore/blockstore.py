@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..common import sanitizer
 from .store import NotFound, ObjectStore, StoreError
 from .types import Collection, ObjectId
 
@@ -445,6 +446,7 @@ class BlockStore(ObjectStore):
         metadata), durability happens on the group committer — every
         record queued while an fsync pair is in flight folds into the
         next one.  Returns once THIS transaction is durable."""
+        sanitizer.handoff(txn, "objectstore.queue_transaction")
         if not self.group_commit:
             self.apply_transaction(txn)
             return

@@ -13,6 +13,7 @@ from typing import Callable, Iterable, List, Optional
 
 import numpy as np
 
+from ..common import sanitizer
 from .transaction import (OP_CLONE, OP_MKCOLL, OP_OMAP_CLEAR,
                           OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
                           OP_RMATTR, OP_RMCOLL, OP_SETATTR, OP_TOUCH,
@@ -139,6 +140,7 @@ class ObjectStore:
         a WAL group-commit pipeline that coalesces all transactions
         queued during the in-flight fsync into one append+fsync pair
         run off the event loop."""
+        sanitizer.handoff(txn, "objectstore.queue_transaction")
         self.apply_transaction(txn)
 
     def _apply_op(self, op: dict) -> None:

@@ -1317,6 +1317,12 @@ class OSDDaemon(Dispatcher):
                 "reason": reason, "tid": msg.get("tid"),
                 "epoch": self.osdmap.epoch}))
         except (ConnectionError, OSError):
+            # re-fetch after the send await: the record set may have
+            # been released (and even re-registered) while the send was
+            # parked — popping through the pre-await snapshot could
+            # judge emptiness against a stale dict and drop a live
+            # registration
+            recs = self.backoffs.get(pgid, {})
             recs.pop(bid, None)
             if not recs:
                 self.backoffs.pop(pgid, None)

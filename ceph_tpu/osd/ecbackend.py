@@ -50,6 +50,7 @@ from .messages import (EIO, ENOENT, ESTALE, MECSubOpRead, MECSubOpReadReply,
                        MOSDPGPushReply, MPGInfo, MPGLog, MPGLogAck, MPGQuery,
                        MPGRewind, MPGRewindAck, pack_buffers, unpack_buffers)
 from .pglog import LogEntry, PGLog, Version, ZERO, ver
+from .scheduler import StartGateChain
 
 NONE_OSD = -1
 HINFO_KEY = "hinfo_key"      # reference ECUtil.h (xattr carrying HashInfo)
@@ -306,6 +307,10 @@ class ECBackend:
         # attempt must WAIT on it, not re-enqueue the mutation (a
         # second enqueue would double-apply an append)
         self.inflight_reqids: "Dict[str, Op]" = {}
+        # local-staging start-order chain (_local_sub_write): each op's
+        # store staging runs before its successor's, on ANY legal
+        # schedule, while durability waits still overlap
+        self._local_stage_chain = StartGateChain()
         # peering request/reply correlation (MPGInfo / MPGRewindAck / ...)
         self.pending_queries: "Dict[int, asyncio.Future]" = {}
         self.peering = False
@@ -696,6 +701,12 @@ class ECBackend:
             if f is not None and not f.done():
                 f.set_result(version)
         if reqid:
+            # the completed-map check at the top and this insert are
+            # bridged by the inflight_reqids reservation (taken
+            # synchronously before the first await): a racing retry
+            # rides the in-flight future instead of re-running, so the
+            # check-then-insert can never double-apply
+            # cephlint: disable=await-atomicity
             self.completed_reqids[reqid] = version
             while len(self.completed_reqids) > 4096:
                 self.completed_reqids.pop(
@@ -731,6 +742,16 @@ class ECBackend:
             async with self._lock:
                 if self.peering:
                     continue
+                if reqid and reqid in self.completed_reqids:
+                    # a retry that passed submit_transaction's dedup
+                    # check while its reqid was still unpublished (the
+                    # first attempt was drained by an interval change;
+                    # peering republished the auth log's reqids while
+                    # this op was parked here): the mutation is already
+                    # authoritative — ack its version, never apply it
+                    # a second time
+                    op.on_commit.set_result(self.completed_reqids[reqid])
+                    return op
                 self._prepare_plan(op)
                 self.waiting_state.append(op)
                 self.tid_to_op[op.tid] = op
@@ -1172,6 +1193,17 @@ class ECBackend:
                          "delete" if op.delete else "modify",
                          prior_version=op.oi.version, rollback=rollback,
                          reqid=op.reqid)
+        # reserve the version in the log NOW, synchronously (we still
+        # hold the pipeline lock): local staging runs as a spawned task
+        # and task first-steps are not ordered by spawn order, so the
+        # next op's version assignment (head+1 at encode) must see this
+        # head advance — or two ops mint the same eversion and the
+        # later pg_log.add silently rejects one entry while its data
+        # and ack survive (cephsan seed 12: o6 acked+readable at (2,4),
+        # displaced from every log by o0@(2,4)).  handle_sub_write's
+        # `version > head` guard skips the duplicate local add.
+        if entry.version > self.pg_log.head:
+            self.pg_log.add(entry)
 
         # log trimming: once the log exceeds osd_max_pg_log_entries,
         # trim down to osd_min_pg_log_entries (never past the rollback
@@ -1238,20 +1270,31 @@ class ECBackend:
                     self.peer_missing.setdefault(shard, {})[op.oid] = \
                         op.version
         for shard, msg in local_msgs:
-            # own task per local shard: staging still happens in
-            # creation order (handle_sub_write is synchronous up to its
-            # durability await), but the fsync wait no longer
-            # head-of-line blocks this PG's pipeline — the next op's
-            # encode can join the device batch and its sub-write can
-            # join the store's group commit while we wait
-            self._spawn(self._local_sub_write(op, shard, msg),
+            # own task per local shard: staging happens in creation
+            # order via the start-gate chain in _local_sub_write (task
+            # first-steps alone make no such promise), but the fsync
+            # wait no longer head-of-line blocks this PG's pipeline —
+            # the next op's encode can join the device batch and its
+            # sub-write can join the store's group commit while we wait
+            prev, gate = self._local_stage_chain.link()
+            self._spawn(self._local_sub_write(op, shard, msg, prev, gate),
                         "local_sub_write")
         self._check_commit_queue()
 
     async def _local_sub_write(self, op: Op, shard: int,
-                               msg: MECSubOpWrite) -> None:
+                               msg: MECSubOpWrite,
+                               prev: "Optional[asyncio.Future]",
+                               gate: "asyncio.Future") -> None:
         """Apply the primary's own shard (reference: the OSD calls
-        handle_sub_write on itself after fanning out)."""
+        handle_sub_write on itself after fanning out).
+
+        StartGateChain: without it a later op's staging could run
+        before an earlier one's and the last store apply would win —
+        leaving the primary's shard with the OLDER ObjectInfo/hinfo
+        attrs for the object.  enter() falls without suspension into
+        handle_sub_write's synchronous staging segment; only the
+        durability waits overlap."""
+        await StartGateChain.enter(prev, gate)
         try:
             reply = await self.handle_sub_write(msg)
             if not reply.get("committed", True):
@@ -1505,8 +1548,18 @@ class ECBackend:
         except Exception:
             if not entries or self.pg_log.head == entries[-1].version:
                 # nothing interleaved past us: roll the in-memory log
-                # back so it never claims an entry no data backs
-                self.pg_log = PGLog.from_dict(log_snapshot)
+                # back so it never claims an entry no data backs.  On
+                # the primary's own shard the snapshot may already
+                # CONTAIN these entries (the encode path reserves its
+                # version in the log synchronously), so drop them
+                # explicitly after the restore.
+                restored = PGLog.from_dict(log_snapshot)
+                mine = {e.version for e in entries}
+                restored.entries = [e for e in restored.entries
+                                    if e.version not in mine]
+                restored.head = (restored.entries[-1].version
+                                 if restored.entries else restored.tail)
+                self.pg_log = restored
                 self.log_gap_from = gap_snapshot
             else:
                 # a later sub-write advanced the log during our
@@ -2983,6 +3036,21 @@ class ECBackend:
         loop = asyncio.get_event_loop()
         self.degraded = {oid: loop.create_future() for oid in to_recover}
 
+        # Republish reqid dedup state from the elected auth log: an
+        # entry applied under a first attempt the interval change
+        # drained was never client-acked, so commit never inserted its
+        # reqid — yet it IS authoritative state now.  Without this, a
+        # client retry re-applies the mutation (append double-apply:
+        # cephsan's interleaving sweep reproduced got == want+A on the
+        # replicated thrasher, seed 7).  Deliberately AFTER log
+        # adoption: every up shard now reports complete_to=auth_head,
+        # so an entry acked via this map has commit-grade election
+        # durability (later peers keep it; at worst per-object unfound
+        # until holders revive — never silent rollback).
+        for e in auth_entries:
+            if e.reqid:
+                self.completed_reqids[e.reqid] = e.version
+
         # ---- ACTIVATE before data recovery (reference PeeringState
         # Active/{Activating,Recovering} + recovery_reservation.rst):
         # the metadata work — log adoption, rewinds, missing sets — is
@@ -3041,6 +3109,10 @@ class ECBackend:
                 finally:
                     if not fut.done():
                         fut.set_result(None)
+                    # the `claimed` set (checked+added before any
+                    # await) guarantees exactly one worker owns this
+                    # oid; nothing else removes degraded entries
+                    # cephlint: disable=await-atomicity
                     self.degraded.pop(oid, None)
                 if sleep_s:
                     await asyncio.sleep(sleep_s)

@@ -217,6 +217,53 @@ class _Slot:
         self.sched._release()
 
 
+# --- start-order chaining -----------------------------------------------------
+
+class StartGateChain:
+    """Orders task FIRST-STEPS in spawn order.
+
+    Spawn order alone does NOT order task first-steps (asyncio promises
+    call_soon FIFO, not cross-task wakeup order — cephsan's
+    interleaving fuzzer, seed 1, started same-shard items 3,1,0,2).
+    The chain restores it: the spawner calls ``link()`` synchronously
+    (reserving this task's place), and the task's FIRST statement is
+    ``await StartGateChain.enter(prev, gate)`` — await the
+    predecessor's gate, release our own, and fall WITHOUT suspension
+    into the body's first segment (awaiting a done future does not
+    yield to the loop).  So task N's first synchronous segment always
+    runs before task N+1's, on any legal schedule, while later awaits
+    (durability waits, say) still overlap freely.
+
+    Users: ``ShardedOpWQ._run`` (per-shard op start order) and
+    ``ECBackend._local_sub_write`` (primary store-staging order)."""
+
+    __slots__ = ("_tail",)
+
+    def __init__(self) -> None:
+        self._tail: "Optional[asyncio.Future]" = None
+
+    def link(self) -> "Tuple[Optional[asyncio.Future], asyncio.Future]":
+        """Reserve the next place in the chain; synchronous — call at
+        spawn, BEFORE the task exists."""
+        prev = self._tail
+        gate = asyncio.get_event_loop().create_future()
+        self._tail = gate
+        return prev, gate
+
+    @staticmethod
+    async def enter(prev: "Optional[asyncio.Future]",
+                    gate: "asyncio.Future") -> None:
+        """Wait for the predecessor, then open our gate.  The gate
+        opens even when the wait is cancelled (pre-start cancellation
+        must unchain, not wedge every successor)."""
+        try:
+            if prev is not None:
+                await prev
+        finally:
+            if not gate.done():
+                gate.set_result(None)
+
+
 # --- sharded op work queue ---------------------------------------------------
 
 class _OpShard:
@@ -224,7 +271,8 @@ class _OpShard:
     scheduler instance (the reference gives every shard its own mClock
     queue and thread set)."""
 
-    __slots__ = ("scheduler", "queue", "pump", "started", "enqueued")
+    __slots__ = ("scheduler", "queue", "pump", "started", "enqueued",
+                 "start_chain")
 
     def __init__(self, scheduler) -> None:
         self.scheduler = scheduler
@@ -234,6 +282,9 @@ class _OpShard:
         self.pump: "Optional[asyncio.Task]" = None
         self.started = 0
         self.enqueued = 0
+        # each item's first segment runs before its successor's, on
+        # ANY legal schedule (see StartGateChain)
+        self.start_chain = StartGateChain()
 
 
 class ShardedOpWQ:
@@ -304,10 +355,12 @@ class ShardedOpWQ:
             # later same-PG op can never reach the PG pipeline first
             await shard.scheduler._acquire(klass)
             shard.started += 1
-            self._task_factory(self._run(shard, fn), name)
+            prev, gate = shard.start_chain.link()
+            self._task_factory(self._run(shard, fn, prev, gate), name)
 
-    async def _run(self, shard: _OpShard, fn) -> None:
+    async def _run(self, shard: _OpShard, fn, prev, gate) -> None:
         try:
+            await StartGateChain.enter(prev, gate)
             await fn()
         finally:
             shard.scheduler._release()

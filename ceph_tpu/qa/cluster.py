@@ -65,8 +65,9 @@ class MiniCluster:
         self.mons: "Dict[int, object]" = {}
         self.osds: "Dict[int, OSDDaemon]" = {}
         self.clients: "List[RadosClient]" = []
+        self._client_seq = 0
         self._killed_pg_nums: "Dict[int, Dict[int, int]]" = {}
-        self._admin: "Optional[RadosClient]" = None
+        self._admin_task: "Optional[asyncio.Task]" = None
         self._tcp = self.config.get("ms_type") == "async+tcp"
         if not self.mon_addrs:
             # static mode: one shared map, pre-populated
@@ -115,6 +116,9 @@ class MiniCluster:
                 await mon.init()
             await self.wait_for_leader()
             for i in range(self.n_osds):
+                # start() is single-shot harness setup; nothing reads
+                # the daemon maps until it returns
+                # cephlint: disable=await-atomicity
                 self.osds[i] = OSDDaemon(
                     i, store=self._make_store(i),
                     config=self.config, mon_addrs=self.mon_addrs,
@@ -266,12 +270,27 @@ class MiniCluster:
                        "stripe_unit": stripe_unit}})
 
     async def _admin_client(self) -> RadosClient:
-        if self._admin is None:
-            self._admin = await self.client(name="client.admin")
-        return self._admin
+        # single-flight, reserved BEFORE any await: concurrent callers
+        # (tests gather pool creates) share ONE admin client instead of
+        # each racing the None-check into its own connect.  A FAILED
+        # connect (mon quorum mid-election, say) is not cached — the
+        # next caller retries instead of re-raising the stale error
+        # forever.
+        if self._admin_task is not None and self._admin_task.done() and \
+                (self._admin_task.cancelled()
+                 or self._admin_task.exception() is not None):
+            self._admin_task = None
+        if self._admin_task is None:
+            self._admin_task = asyncio.ensure_future(
+                self.client(name="client.admin"))
+        return await asyncio.shield(self._admin_task)
 
     async def client(self, name: str = "") -> RadosClient:
-        idx = len(self.clients)
+        # monotonic id taken synchronously — len(self.clients) read
+        # across the connect await gave two concurrent clients the same
+        # idx, hence the same local messenger address (registry clash)
+        idx = self._client_seq
+        self._client_seq += 1
         name = name or f"client.{idx}"
         c = RadosClient(self.osdmap if not self.mon_addrs else None,
                         name=name, config=self.config,

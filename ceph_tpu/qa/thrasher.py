@@ -67,6 +67,10 @@ class Workload:
                 # the error surfaced.  Drop the object from the content
                 # ledger (we can no longer assert its bytes); run_thrash
                 # still smoke-reads it after healing via ``dropped``.
+                # Workload.run is the ledger's ONLY mutator (one task);
+                # concurrent readers (corruptor, verifier) tolerate
+                # entries vanishing between looks
+                # cephlint: disable=await-atomicity
                 self.committed.pop(oid, None)
                 self.dropped.add(oid)
                 await asyncio.sleep(0.02)
@@ -144,7 +148,8 @@ class Thrasher:
                 new = pool.pg_num * 2
                 dout("qa", 5, f"thrasher: pg_num {pool.pg_num}->{new}")
                 await self.cluster.set_pg_num(self.split_pool, new)
-                self.splits += 1
+                # single thrasher task: no competing writer
+                self.splits += 1  # cephlint: disable=await-atomicity
                 continue
             if down and (len(live) <= self.min_live
                          or self.rng.random() < 0.5):

@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Repo gate: static invariants first (fast, fails early), then the
-# tier-1 test suite.  Nonzero exit on any non-baselined cephlint
-# finding or any test failure — wire this straight into CI.
+# cephsan interleaving sweep (fixed seeds + one fresh, seeds printed
+# on failure), then the tier-1 test suite.  Nonzero exit on any
+# non-baselined cephlint finding or any test failure — wire this
+# straight into CI.
 #
-#   ./check.sh            # lint + tier-1 tests
-#   ./check.sh --lint     # lint only (pre-commit speed)
+#   ./check.sh               # lint + sanitizer sweep + tier-1 tests
+#   ./check.sh --lint        # lint only (pre-commit speed)
+#   ./check.sh --sanitize    # lint + sanitizer sweep only
 set -o pipefail
 
 cd "$(dirname "$0")"
@@ -30,6 +33,20 @@ if [ "$lint_rc" -ne 0 ]; then
 fi
 
 if [ "$1" = "--lint" ]; then
+    exit 0
+fi
+
+echo "== cephsan interleaving sweep (tools/cephsan) =="
+# fixed regression seeds + one fresh seed per run; a failing seed
+# prints its exact CEPHSAN_SEED=... reproduce line
+python -m tools.cephsan
+san_rc=$?
+if [ "$san_rc" -ne 0 ]; then
+    echo "cephsan gate FAILED (exit $san_rc)"
+    exit "$san_rc"
+fi
+
+if [ "$1" = "--sanitize" ]; then
     exit 0
 fi
 

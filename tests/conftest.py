@@ -26,3 +26,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ceph_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
 
 honor_jax_platforms_env()
+
+# cephsan: CEPHSAN_SEED=<n> arms the seeded interleaving fuzzer (and
+# freeze-on-handoff) for the whole run — every asyncio.new_event_loop()
+# a fixture makes becomes a deterministic InterleavingLoop, so a CI
+# failure's printed seed replays exactly with zero test edits.
+from ceph_tpu.common import sanitizer  # noqa: E402
+
+_CEPHSAN_SEED = sanitizer.install_from_env()
+
+
+def pytest_report_header(config):
+    if _CEPHSAN_SEED is not None:
+        return (f"cephsan: interleaving seed {_CEPHSAN_SEED}, "
+                f"freeze-on-handoff "
+                f"{'on' if sanitizer.freeze_enabled() else 'off'}")
+    return None

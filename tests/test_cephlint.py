@@ -1,6 +1,6 @@
 """cephlint — the AST invariant checker (tools/cephlint).
 
-Each of the six checkers must fire on a seeded violation, pragmas and
+Each of the nine checkers must fire on a seeded violation, pragmas and
 the baseline must silence them, and — the tier-1 gate — the real tree
 must scan clean with the shipped (empty) baseline.
 """
@@ -39,7 +39,7 @@ def names(findings):
     return sorted({f.check for f in findings})
 
 
-# ------------------------------------------------ the six checkers fire
+# ------------------------------------------------ the checkers fire
 
 
 def test_blocking_call_fires_and_executor_is_exempt(tmp_path):
@@ -249,6 +249,157 @@ def test_kernel_purity(tmp_path):
     assert kernels == {"in kernel jitted", "in kernel pallas_kernel"}
 
 
+def test_await_atomicity_check_then_act_across_await(tmp_path):
+    p = write(tmp_path, "atom.py", """
+        from ceph_tpu.common.lockdep import DepLock
+
+        class D:
+            def __init__(self):
+                self.lk = DepLock("t.lk")
+                self.inflight = {}
+
+            async def bad(self, rid):
+                cur = self.inflight.get(rid)
+                if cur is None:
+                    await self.work()
+                    self.inflight[rid] = 1          # BAD: check-then-act
+
+            async def locked_span_ok(self, rid):
+                async with self.lk:
+                    cur = self.inflight.get(rid)
+                    await self.work()
+                    self.inflight[rid] = 1          # lock spans both
+
+            async def bad_two_lock_sections(self, rid):
+                async with self.lk:
+                    cur = self.inflight.get(rid)
+                await self.work()
+                async with self.lk:
+                    self.inflight[rid] = cur        # BAD: two sections
+
+            async def revalidated_ok(self, rid):
+                cur = self.inflight.get(rid)
+                await self.work()
+                if self.inflight.get(rid) is None:  # re-checked
+                    self.inflight[rid] = 1
+
+            async def guard_clause_ok(self, rid):
+                cur = self.inflight.get(rid)
+                if cur is not None:
+                    return await self.work()
+                self.inflight[rid] = 1              # no await on path
+
+            async def sibling_branch_ok(self, op, rid):
+                if op == "a":
+                    cur = self.inflight.get(rid)
+                    await self.work()
+                elif op == "b":
+                    self.inflight[rid] = 1          # exclusive arm
+
+            async def awaited_rpc_ok(self, oid):
+                if oid in self.inflight:
+                    await self.io.remove(oid)       # RPC, not list.remove
+
+            async def work(self):
+                pass
+    """)
+    found = run_checks([p], checks=["await-atomicity"])
+    assert len(found) == 2, found
+    assert all("DepLock" in f.message for f in found)
+    ctx = " | ".join(f.context for f in found)
+    assert "check-then-act" in ctx and "two sections" in ctx
+
+
+def test_iter_mutate_across_await(tmp_path):
+    p = write(tmp_path, "iter.py", """
+        class D:
+            async def bad(self):
+                for k, v in self.tbl.items():
+                    await self.push(v)
+                    del self.tbl[k]                 # BAD
+
+            async def bad_async_for(self, aiter):
+                async for k in self.tbl:
+                    self.tbl.pop(k)                 # BAD (each step awaits)
+
+            async def snapshot_ok(self):
+                for k in list(self.tbl):
+                    await self.push(k)
+                    self.tbl.pop(k)
+
+            async def no_await_ok(self):
+                out = []
+                for k in self.tbl:
+                    out.append(k)
+
+            async def push(self, v):
+                pass
+    """)
+    found = run_checks([p], checks=["iter-mutate-across-await"])
+    assert len(found) == 2, found
+    assert all("snapshot" in f.message for f in found)
+
+
+def test_buffer_aliasing_writes_and_bypass(tmp_path):
+    p = write(tmp_path, "alias.py", """
+        import numpy as np
+
+        def bad(bl, seg):
+            a = bl.to_array()
+            a[0] = 1                                # BAD
+            b = a
+            b[1:3] = 0                              # BAD (alias)
+            bl.to_u32()[2] = 7                      # BAD (direct)
+            a.fill(0)                               # BAD (in-place)
+            a.flags.writeable = True                # BAD (bypass)
+            seg.raw.data[0] = 9                     # BAD (raw poke)
+
+        def ok(bl, arr):
+            c = bl.to_array().copy()
+            c[0] = 1                                # copy
+            mv = bl.mutable_view()
+            mv[0] = 2                               # escape hatch
+            arr2 = arr.view(np.uint32)
+            arr2[0] = 3                             # numpy dtype view
+            a = bl.to_array()
+            a = np.zeros(4)
+            a[0] = 4                                # rebound
+    """)
+    found = run_checks([p], checks=["buffer-aliasing"])
+    assert len(found) == 6, found
+    assert all("mutable_view" in f.message for f in found)
+    # the owner file is exempt: same violations inside common/buffer.py
+    d = tmp_path / "common"
+    d.mkdir()
+    exempt = write(tmp_path, "common/buffer.py", """
+        def rebuild(self):
+            a = self.to_array()
+            a[0] = 1
+    """)
+    assert run_checks([exempt], checks=["buffer-aliasing"]) == []
+
+
+def test_sanitizer_checkers_honor_pragmas(tmp_path):
+    p = write(tmp_path, "prag.py", """
+        class D:
+            async def latch(self):
+                if not self.done:
+                    await self.work()
+                    # idempotent latch
+                    self.done = True  # cephlint: disable=await-atomicity
+
+            async def work(self):
+                pass
+
+        def poke(bl):
+            a = bl.to_array()
+            # cephlint: disable=buffer-aliasing
+            a[0] = 1
+    """)
+    assert run_checks([p], checks=["await-atomicity",
+                                   "buffer-aliasing"]) == []
+
+
 # ------------------------------------------------ pragmas and baseline
 
 
@@ -379,7 +530,9 @@ def test_cli_json_format_and_exit_codes(tmp_path):
         capture_output=True, text=True)
     assert r.returncode == 0
     for check in ("blocking-call", "fire-and-forget", "lock-order",
-                  "msg-symmetry", "options", "kernel-purity"):
+                  "msg-symmetry", "options", "kernel-purity",
+                  "await-atomicity", "iter-mutate-across-await",
+                  "buffer-aliasing"):
         assert check in r.stdout
 
 
@@ -394,7 +547,7 @@ def test_parse_error_is_a_finding_not_a_crash(tmp_path):
 
 def test_repo_scans_clean_with_empty_baseline():
     """THE acceptance gate: cephlint over ceph_tpu, empty baseline,
-    zero findings — every invariant the six checkers encode holds on
+    zero findings — every invariant the nine checkers encode holds on
     the real tree (violations are either fixed or carry a scoped,
     justified pragma)."""
     found = run_checks([REPO_TREE])

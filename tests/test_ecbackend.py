@@ -166,6 +166,122 @@ class TestRoundTrip:
                 assert (await io.stat("obj"))["size"] == 150
         loop.run_until_complete(go())
 
+    def test_reqid_dedup_survives_interval_change(self, loop):
+        """The cephsan double-apply class (seed 7, replicated thrasher):
+        an append applied on the primary whose replication fails is
+        never client-acked, so commit never inserts its reqid — but the
+        entry IS in the primary's log, and peering elects it
+        authoritative (k=1).  The client's retry used to re-apply it
+        (got == want + A).  Peering must republish the auth log's
+        reqids so the retry dedups instead."""
+        async def go():
+            async with MiniCluster(6) as cluster:
+                cluster.create_replicated_pool("rep", size=3, pg_num=4,
+                                               stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("rep")
+                base = payload(100, 42)
+                await io.write_full("obj", base)
+                pool = cluster.osdmap.pool_by_name("rep")
+                pg = cluster.osdmap.object_to_pg(pool.pool_id, "obj")
+                _up, acting = cluster.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                be = cluster.osds[acting[0]]._get_backend(
+                    (pool.pool_id, pg))
+                from ceph_tpu.osd.ecbackend import ClientOp
+
+                # attempt 1: both replica sends fail -> durable 1 <
+                # min_size 2 -> the op FAILS to the client, with the
+                # entry already applied to the primary's log + store
+                real_send = be.send
+                async def failing_send(osd, msg):
+                    if msg.TYPE == "ec_sub_write":
+                        raise ConnectionError("replica down (test)")
+                    return await real_send(osd, msg)
+                be.send = failing_send
+                with pytest.raises(Exception):
+                    await be.submit_transaction(
+                        "obj", [ClientOp("append", data=b"x" * 50)],
+                        reqid="c:retry")
+                be.send = real_send
+                entry = be.pg_log.entries[-1]
+                assert entry.reqid == "c:retry"   # applied, unacked
+                assert "c:retry" not in be.inflight_reqids
+
+                # interval change: re-peer.  The primary's own head is
+                # elected authoritative (k=1) and its reqids republished
+                await be.peer(force=True)
+                assert be.completed_reqids.get("c:retry") == entry.version
+
+                # the client retry must dedup, not double-apply
+                v = await be.submit_transaction(
+                    "obj", [ClientOp("append", data=b"x" * 50)],
+                    reqid="c:retry")
+                assert v == entry.version
+                got = await io.read("obj")
+                assert got == base + b"x" * 50
+        loop.run_until_complete(go())
+
+    def test_version_reserved_synchronously_at_encode(self, loop):
+        """The eversion a write mints must land in pg_log.head at
+        encode time — not when the spawned local staging task happens
+        to run.  Task first-steps are unordered, so a head that lags
+        lets the next op read the same head and mint a duplicate
+        version; the later log add is then silently rejected and that
+        op's entry vanishes from every shard's log while its data and
+        ack survive (cephsan seed 12).  Staging is stalled completely
+        here, so the versions are distinct ONLY if encode itself
+        reserves them."""
+        async def go():
+            async with make_cluster() as cluster:
+                client = await cluster.client()
+                io = client.io_ctx("ecpool")
+                # stripe-aligned object: aligned appends need no
+                # RMW reads, so encode runs inside enqueue
+                await io.write_full("obj", payload(1536, 1))
+                pool = cluster.osdmap.pool_by_name("ecpool")
+                pg = cluster.osdmap.object_to_pg(pool.pool_id, "obj")
+                _up, acting = cluster.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                be = cluster.osds[acting[0]]._get_backend(
+                    (pool.pool_id, pg))
+                from ceph_tpu.osd.ecbackend import ClientOp
+                # stall every local staging task: versions minted from
+                # here on cannot ride the staging-side log add
+                stalled = []
+                real_spawn = be._spawn
+                def stalling_spawn(coro, name=""):
+                    if name == "local_sub_write":
+                        stalled.append(coro)
+                        return
+                    return real_spawn(coro, name)
+                be._spawn = stalling_spawn
+                ops = []
+                for i in range(3):
+                    op = await be.enqueue_transaction(
+                        "obj", [ClientOp("append",
+                                         data=bytes([i]) * 1536)])
+                    assert op.version != (0, 0)     # encoded inline
+                    assert be.pg_log.head >= op.version
+                    ops.append(op)
+                versions = [op.version for op in ops]
+                assert len(set(versions)) == len(versions), versions
+                # contiguous minting: no holes for the shard-side
+                # log-gap detector to trip on
+                vs = sorted(v[1] for v in versions)
+                assert vs == list(range(vs[0], vs[0] + len(vs))), versions
+                # release the staging chain; everything still commits
+                be._spawn = real_spawn
+                for coro in stalled:
+                    real_spawn(coro, "local_sub_write")
+                await asyncio.gather(*(op.on_commit for op in ops))
+                logged = [e.version for e in be.pg_log.entries]
+                assert len(set(logged)) == len(logged), logged
+                got = await io.read("obj")
+                assert got == payload(1536, 1) + b"".join(
+                    bytes([i]) * 1536 for i in range(3))
+        loop.run_until_complete(go())
+
     def test_write_ordering_pipelined(self, loop):
         """Overlapping in-flight writes must commit in submission order
         (the three-waitlist pipeline invariant)."""

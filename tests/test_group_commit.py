@@ -18,6 +18,9 @@ from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.objectstore.transaction import Transaction
 from ceph_tpu.objectstore.types import Collection, ObjectId
 
+# replayed under seeded interleavings by tools/cephsan / check.sh
+pytestmark = pytest.mark.cephsan
+
 
 @pytest.fixture(scope="module")
 def loop():
@@ -136,7 +139,12 @@ def test_sync_apply_drains_queued_records_in_order(tmp_path, loop):
         # would resurrect the OLD bytes over the new ones
         fut = asyncio.ensure_future(
             bs.queue_transaction(_txn("obj", b"old" * 1000)))
-        await asyncio.sleep(0)          # let it stage
+        # wait until the record is actually staged: staging happens in
+        # the task's first segment, but ONE sleep(0) only guarantees
+        # that under FIFO wakeups — a permuted (cephsan) schedule can
+        # resume us first
+        while not bs._gc_queue and not fut.done():
+            await asyncio.sleep(0)
         bs.apply_transaction(_txn("obj", b"new" * 1000))
         await fut
         os.close(bs.fd)

@@ -1,0 +1,347 @@
+"""cephsan runtime — seeded interleaving loop + freeze-on-handoff.
+
+The contracts that make a sanitizer trustworthy: the fuzzer is
+DETERMINISTIC (same seed ⇒ same schedule, else a printed seed is
+worthless), it really PERMUTES (else it's a no-op), and the freeze
+tripwire RAISES AT THE FAULTING LINE once a buffer crosses a handoff
+boundary.  Plus the static half: each new cephlint checker fires on a
+seeded violation, a pragma silences it, and the repo scans clean
+(covered by test_cephlint's repo gate, re-asserted here for the three
+new checkers by name).
+"""
+
+import asyncio
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")  # repo root: tools/ is not installed
+
+from ceph_tpu.common import sanitizer
+from ceph_tpu.common.buffer import BufferFrozenError, BufferList
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_reset():
+    """Each test leaves the process-global sanitizer state as found
+    (including a session-wide CEPHSAN_SEED install from conftest)."""
+    was_seed, was_freeze = sanitizer.seed(), sanitizer.freeze_enabled()
+    yield
+    sanitizer.uninstall()
+    if was_seed is not None:
+        sanitizer.install(was_seed, was_freeze)
+    else:
+        sanitizer.enable_freeze(was_freeze)
+
+
+def _schedule(seed, workers=6, steps=4):
+    """Run a deterministic workload on a seeded loop; return the
+    observed execution order."""
+    loop = sanitizer.InterleavingLoop(seed)
+    out = []
+
+    async def worker(i):
+        for k in range(steps):
+            await asyncio.sleep(0)
+            out.append((i, k))
+
+    async def main():
+        await asyncio.gather(*(worker(i) for i in range(workers)))
+
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()
+    return out
+
+
+# ------------------------------------------------ interleaving loop
+
+
+def test_same_seed_same_schedule():
+    assert _schedule(42) == _schedule(42)
+    assert _schedule(7) == _schedule(7)
+
+
+def test_different_seeds_differ_and_fifo_is_left_behind():
+    runs = {tuple(_schedule(s)) for s in (1, 2, 3, 4)}
+    assert len(runs) > 1, "schedules identical across seeds"
+    # plain FIFO loop order is not the only thing the fuzzer produces
+    loop = asyncio.new_event_loop()
+    out = []
+
+    async def worker(i):
+        for k in range(4):
+            await asyncio.sleep(0)
+            out.append((i, k))
+
+    async def main():
+        await asyncio.gather(*(worker(i) for i in range(6)))
+
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()
+    assert any(r != tuple(out) for r in runs)
+
+
+def test_shuffles_are_counted():
+    loop = sanitizer.InterleavingLoop(5)
+    try:
+        async def main():
+            await asyncio.gather(*(asyncio.sleep(0) for _ in range(8)))
+        loop.run_until_complete(main())
+        assert loop.cephsan_shuffles > 0
+    finally:
+        loop.close()
+
+
+def test_policy_installs_derived_seeds_and_uninstalls():
+    sanitizer.install(99)
+    try:
+        assert sanitizer.enabled() and sanitizer.seed() == 99
+        l1 = asyncio.new_event_loop()
+        l2 = asyncio.new_event_loop()
+        try:
+            assert isinstance(l1, sanitizer.InterleavingLoop)
+            assert isinstance(l2, sanitizer.InterleavingLoop)
+            # per-loop seeds derive deterministically and differ
+            assert l1.cephsan_seed != l2.cephsan_seed
+        finally:
+            l1.close()
+            l2.close()
+    finally:
+        sanitizer.uninstall()
+    assert not sanitizer.enabled()
+    l3 = asyncio.new_event_loop()
+    try:
+        assert not isinstance(l3, sanitizer.InterleavingLoop)
+    finally:
+        l3.close()
+
+
+def test_install_from_env_round_trip(monkeypatch):
+    monkeypatch.setenv("CEPHSAN_SEED", "123")
+    monkeypatch.setenv("CEPHSAN_FREEZE", "0")
+    assert sanitizer.install_from_env() == 123
+    assert sanitizer.enabled() and not sanitizer.freeze_enabled()
+    sanitizer.uninstall()
+    monkeypatch.delenv("CEPHSAN_SEED")
+    assert sanitizer.install_from_env() is None
+
+
+def test_seeded_ordering_contract_on_sharded_wq():
+    """The bug seed 1 found, pinned forever: same-shard items must
+    START in enqueue order on a permuted schedule (the WQ's start-gate
+    chain, not call_soon FIFO luck, enforces it)."""
+    from ceph_tpu.osd.scheduler import CLIENT, FifoScheduler, ShardedOpWQ
+    loop = sanitizer.InterleavingLoop(1)
+    started = []
+
+    async def main():
+        wq = ShardedOpWQ(1, lambda: FifoScheduler(8))
+
+        def make(i):
+            async def work():
+                started.append(i)
+                await asyncio.sleep(0)
+            return work
+
+        for i in range(12):
+            wq.enqueue((1, 0), CLIENT, make(i))
+        await wq.drain()
+        await asyncio.sleep(0.01)
+
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()
+    assert started == sorted(started), started
+
+
+# ------------------------------------------------ freeze-on-handoff
+
+
+def test_raw_backing_stores_are_immutable_from_construction():
+    donor = np.arange(32, dtype=np.uint8)
+    bl = BufferList(donor)
+    with pytest.raises(ValueError):
+        donor[0] = 1                     # donor's alias froze at adoption
+    with pytest.raises(ValueError):
+        bl.to_array()[0] = 1
+    with pytest.raises(ValueError):
+        bl.to_u32()[0] = 1
+
+
+def test_mutable_view_is_the_escape_hatch_and_invalidates_crc():
+    bl = BufferList(np.arange(64, dtype=np.uint8))
+    c0 = bl.crc32c()
+    mv = bl.mutable_view()
+    mv[0] = 255
+    assert bl.crc32c() != c0             # cache dropped, crc honest
+    assert bl.to_bytes()[0] == 255
+    # bytes-backed raws can never be unlocked
+    with pytest.raises(ValueError):
+        BufferList(b"abcd").mutable_view()
+
+
+def test_handoff_seals_mutable_view_with_boundary_name():
+    sanitizer.enable_freeze(True)
+    bl = BufferList(np.zeros(16, dtype=np.uint8))
+    sanitizer.handoff(bl, "messenger.send")
+    assert bl.frozen_at() == "messenger.send"
+    with pytest.raises(BufferFrozenError, match="messenger.send"):
+        bl.mutable_view()
+
+
+def test_handoff_noop_when_disarmed():
+    sanitizer.enable_freeze(False)
+    bl = BufferList(np.zeros(16, dtype=np.uint8))
+    sanitizer.handoff(bl, "messenger.send")
+    assert bl.frozen_at() is None
+    bl.mutable_view()[0] = 1             # hatch still open
+
+
+def test_post_send_mutation_raises_through_the_messenger():
+    """End to end: a Message carrying a BufferList zero-copy, sent over
+    the local transport, seals the sender's buffer — the post-send
+    write raises instead of corrupting the (potentially still corked)
+    frame."""
+    sanitizer.enable_freeze(True)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        from ceph_tpu.common.config import Config
+        from ceph_tpu.msg.message import MPing
+        from ceph_tpu.msg.messenger import Messenger
+
+        cfg = Config()
+        cfg.set("ms_type", "async+local")
+        got = []
+
+        class Sink:
+            async def ms_dispatch(self, conn, msg):
+                got.append(bytes(msg.data))
+                return True
+
+            def ms_handle_reset(self, conn):
+                pass
+
+        a = Messenger.create("cephsan-a", cfg)
+        b = Messenger.create("cephsan-b", cfg)
+        b.add_dispatcher(Sink())
+        await a.bind("local:cephsan-a")
+        await b.bind("local:cephsan-b")
+        payload = BufferList(np.full(8, 7, dtype=np.uint8))
+        conn = a.get_connection("local:cephsan-b")
+        await conn.send_message(MPing({}, data=payload))
+        assert got == [b"\x07" * 8]
+        assert payload.frozen_at() == "messenger.send"
+        with pytest.raises(BufferFrozenError):
+            payload.mutable_view()
+        await a.shutdown()
+        await b.shutdown()
+
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+
+def test_handoff_at_queue_transaction_boundary():
+    sanitizer.enable_freeze(True)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        from ceph_tpu.objectstore.memstore import MemStore
+        from ceph_tpu.objectstore.transaction import Transaction
+        from ceph_tpu.objectstore.types import Collection, ObjectId
+
+        store = MemStore()
+        store.mkfs()
+        store.mount()
+        cid = Collection(1, 0, 0)
+        t = Transaction()
+        t.create_collection(cid)
+        t.write(cid, ObjectId("o"), 0, b"x" * 16)
+        await store.queue_transaction(t)
+        assert bytes(store.read(cid, ObjectId("o"))) == b"x" * 16
+        # future zero-copy txns will carry arrays on their ops; the
+        # boundary walker must seal any ndarray it finds riding them
+        stray = np.ones(4, dtype=np.uint8)
+        t2 = Transaction()
+        t2.ops.append({"op": "touch", "cid": cid.key(),
+                       "oid": ObjectId("o").key(), "payload": stray})
+        sanitizer.handoff(t2, "objectstore.queue_transaction")
+        assert not stray.flags.writeable
+        store.umount()
+
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------ static front (names)
+
+
+def test_new_checkers_are_registered_and_repo_scans_clean():
+    from tools.cephlint import lint_paths
+    from tools.cephlint.checkers import CHECKERS
+
+    for name in ("await-atomicity", "iter-mutate-across-await",
+                 "buffer-aliasing"):
+        assert name in CHECKERS, name
+    found, _sup = lint_paths(
+        ["ceph_tpu"],
+        checks=["await-atomicity", "iter-mutate-across-await",
+                "buffer-aliasing"],
+        cache_path=None)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+# ------------------------------------------------ the reproduce line
+
+
+def test_env_seed_reproduces_inside_pytest():
+    """The replay workflow end to end: CEPHSAN_SEED in the environment
+    arms the policy inside a fresh pytest process (via conftest), and
+    the header names the seed."""
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-p", "no:cacheprovider",
+         "--collect-only", "tests/test_sanitizer.py"],
+        env={**__import__("os").environ, "CEPHSAN_SEED": "31337",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "cephsan: interleaving seed 31337" in r.stdout
+
+
+def test_cephsan_runner_replays_an_explicit_seed(tmp_path):
+    """tools/cephsan sweeps an explicit seed list over a tiny suite and
+    reports green/failing seeds with the reproduce line."""
+    suite = tmp_path / "test_tiny.py"
+    suite.write_text(textwrap.dedent("""
+        import asyncio, pytest
+        # outside tests/: no conftest, so arm from the env ourselves
+        from ceph_tpu.common import sanitizer
+        sanitizer.install_from_env()
+        pytestmark = pytest.mark.cephsan
+
+        def test_loops_are_seeded():
+            assert sanitizer.enabled() and sanitizer.seed() == 5
+            loop = asyncio.new_event_loop()
+            try:
+                assert isinstance(loop, sanitizer.InterleavingLoop)
+            finally:
+                loop.close()
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.cephsan", "--seed-list", "5",
+         "--fresh", "0", "--suites", str(suite)],
+        capture_output=True, text=True, cwd=".")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "seed 5: ok" in r.stdout

@@ -14,6 +14,9 @@ import pytest
 from ceph_tpu.qa.cluster import MiniCluster
 from ceph_tpu.qa.thrasher import run_thrash
 
+# replayed under seeded interleavings by tools/cephsan / check.sh
+pytestmark = pytest.mark.cephsan
+
 
 @pytest.fixture(scope="module")
 def loop():

@@ -4,9 +4,9 @@ Reference: the Ceph tree pairs every runtime belt with a compile-time
 suspender — lockdep.cc has static clang-tidy passes, the options table
 has consistency unit tests, messages are versioned encodables checked
 at build time.  This package is that compile-time half for the asyncio
-rebuild: six checkers tuned to the invariants the runtime machinery
-(common/lockdep.py, common/crash.py, the frozen-schema tests) enforces
-after the fact.
+rebuild: nine checkers tuned to the invariants the runtime machinery
+(common/lockdep.py, common/crash.py, common/sanitizer.py, the
+frozen-schema tests) enforces after the fact.
 
 Architecture (see README.md beside this file):
 

@@ -1,6 +1,8 @@
-"""Checker registry — the six invariants, by check id."""
+"""Checker registry — the nine invariants, by check id."""
 
 from .base import Checker, Module, ReportContext  # noqa: F401
+from .aliasing import BufferAliasChecker
+from .atomicity import AwaitAtomicityChecker, IterMutateChecker
 from .blocking import BlockingCallChecker
 from .kernels import KernelPurityChecker
 from .locks import LockOrderChecker
@@ -10,6 +12,7 @@ from .tasks import FireAndForgetChecker
 
 ALL_CHECKERS = (BlockingCallChecker, FireAndForgetChecker,
                 LockOrderChecker, MsgSymmetryChecker, OptionsChecker,
-                KernelPurityChecker)
+                KernelPurityChecker, AwaitAtomicityChecker,
+                IterMutateChecker, BufferAliasChecker)
 
 CHECKERS = {c.name: c for c in ALL_CHECKERS}

@@ -1,0 +1,200 @@
+"""buffer-aliasing — writes into BufferList backing stores.
+
+``BufferList`` raws are shared zero-copy: ``substr``/``append`` alias
+them, crc caches memoize over their bytes, and ROADMAP item 1 threads
+them messenger→encode→store with no intermediate copies.  The arrays
+handed out by ``view()``, ``to_array()``, and ``to_u32()`` are windows
+onto those shared stores — writing through one corrupts every aliased
+reader and poisons cached crcs.  The runtime half enforces this with
+``writeable=False`` (common/buffer.py constructs raws read-only); this
+checker catches the violation before it runs — and catches the
+tempting bypass (``.flags.writeable = True``) that would defeat the
+runtime guard silently.
+
+Flagged, everywhere except ``common/buffer.py`` itself:
+
+- subscript stores / in-place ops through a name bound to a
+  ``.view()`` / ``.to_array()`` / ``.to_u32()`` result (one level of
+  ``b = a`` aliasing is tracked; ``.copy()`` breaks the taint),
+- the same stores directly on the call result
+  (``bl.to_array()[0] = x``),
+- numpy in-place methods (``fill``/``sort``/``put``/...) on such names,
+- ``<name>.flags.writeable = True`` on such names (use
+  ``mutable_view()``, which invalidates the crc cache and refuses
+  after a handoff, instead of un-freezing behind the sanitizer's back),
+- subscript stores into a raw reached by attribute path
+  (``seg.raw.data[...] = x``).
+
+``mutable_view()`` results are deliberately NOT tainted: that is the
+sanctioned escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, dotted
+
+_TAINT_CALLS = {"view", "to_array", "to_u32"}
+_INPLACE = {"fill", "sort", "put", "partition", "byteswap", "resize",
+            "setfield"}
+_EXEMPT_SUFFIX = "common/buffer.py"
+
+
+class BufferAliasChecker(Checker):
+    name = "buffer-aliasing"
+    description = ("write into a BufferList backing array obtained "
+                   "via view()/to_array()/to_u32()")
+
+    # --- collect --------------------------------------------------------------
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        # each function body is its own taint scope; module level too
+        scopes: "List[List[ast.stmt]]" = [module.tree.body]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            self._scan_scope(body, module, hits)
+        return {"hits": hits}
+
+    @staticmethod
+    def _is_taint_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr in _TAINT_CALLS and
+                not node.args and not node.keywords)
+
+    def _scan_scope(self, body: "List[ast.stmt]", module: Module,
+                    hits: "List[dict]") -> None:
+        tainted: "Dict[str, int]" = {}    # name -> taint line
+
+        def taint_name(expr: ast.AST) -> "Optional[int]":
+            """Line the taint came from, if ``expr`` is hazardous."""
+            if self._is_taint_call(expr):
+                return expr.lineno
+            if isinstance(expr, ast.Name) and expr.id in tainted:
+                return tainted[expr.id]
+            return None
+
+        def check_store_target(tgt: ast.AST) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    check_store_target(el)
+                return
+            if not isinstance(tgt, ast.Subscript):
+                return
+            src = taint_name(tgt.value)
+            if src is not None:
+                hits.append(self._hit(tgt, module, src,
+                                      "subscript store"))
+            elif dotted(tgt.value).endswith(".raw.data"):
+                hits.append(self._hit(tgt, module, tgt.lineno,
+                                      "raw backing store write"))
+
+        for stmt in self._flatten(body):
+            if isinstance(stmt, ast.Assign):
+                src = taint_name(stmt.value)
+                for tgt in stmt.targets:
+                    check_store_target(tgt)
+                    if isinstance(tgt, ast.Name):
+                        if src is not None:
+                            tainted[tgt.id] = src
+                        else:
+                            tainted.pop(tgt.id, None)
+                    # writeable-flag bypass: t.flags.writeable = True
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "writeable" and \
+                            isinstance(tgt.value, ast.Attribute) and \
+                            tgt.value.attr == "flags":
+                        src2 = taint_name(tgt.value.value)
+                        if src2 is not None and \
+                                isinstance(stmt.value, ast.Constant) and \
+                                stmt.value.value is True:
+                            hits.append(self._hit(
+                                tgt, module, src2,
+                                "writeable-flag bypass"))
+            elif isinstance(stmt, ast.AugAssign):
+                check_store_target(stmt.target)
+            elif isinstance(stmt, ast.Delete):
+                for tgt in stmt.targets:
+                    check_store_target(tgt)
+            # in-place numpy methods on tainted names, in this
+            # statement's own expressions (nested statements are their
+            # own _flatten entries; nested defs/lambdas other scopes)
+            for expr in self._header_exprs(stmt):
+                stack: "List[ast.AST]" = [expr]
+                while stack:
+                    node = stack.pop()
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        continue
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _INPLACE:
+                        src = taint_name(node.func.value)
+                        if src is not None:
+                            hits.append(self._hit(
+                                node, module, src,
+                                f"in-place .{node.func.attr}()"))
+                    stack.extend(ast.iter_child_nodes(node))
+
+    _BODY_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+    @classmethod
+    def _flatten(cls, body: "List[ast.stmt]"):
+        """Statements of one scope in source order, recursing through
+        compound-statement bodies but never into nested functions."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue                  # separate scope entry
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    yield from cls._flatten(sub)
+            for handler in getattr(stmt, "handlers", ()):
+                yield from cls._flatten(handler.body)
+
+    @classmethod
+    def _header_exprs(cls, stmt: ast.stmt):
+        for field, value in ast.iter_fields(stmt):
+            if field in cls._BODY_FIELDS:
+                continue
+            if isinstance(value, ast.expr):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    @staticmethod
+    def _hit(node: ast.AST, module: Module, taint_line: int,
+             what: str) -> dict:
+        return {"line": node.lineno, "taint_line": taint_line,
+                "what": what, "context": module.context(node.lineno)}
+
+    # --- report ---------------------------------------------------------------
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            if path.replace("\\", "/").endswith(_EXEMPT_SUFFIX):
+                continue                  # the owner may touch its raws
+            for h in f.get("hits", ()):
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    context=h["context"],
+                    message=f"{h['what']} into a BufferList backing "
+                            f"array (view obtained at line "
+                            f"{h['taint_line']}): these stores are "
+                            f"shared zero-copy and crc-cached — use "
+                            f"mutable_view() (invalidates the cache, "
+                            f"refuses after handoff) or .copy() the "
+                            f"bytes first"))
+        return out

@@ -1,0 +1,523 @@
+"""await-atomicity / iter-mutate-across-await — interleaving hazards.
+
+The runtime half of cephsan (common/sanitizer.py) permutes task wakeup
+order under a seed and catches these classes when they RUN; these two
+checkers catch them without running, the way cephlint's lock-order
+checker fronts runtime lockdep.
+
+**await-atomicity** — the PR-4 retry-dedup bug class: a coroutine reads
+a shared ``self`` attribute, suspends at an ``await`` (or an ``async
+with`` acquire, or an ``async for`` step), and later mutates the same
+attribute.  Between the read and the write any other task on the loop
+can run — including another instance of the same handler — so the
+check-then-act is not atomic.  Flagged unless one lexical ``async with
+<DepLock>`` block covers BOTH the read and the mutation (holding a
+DepLock across the span restores atomicity against every other holder
+of that lock class).  Fixes, in preference order: hold a DepLock across
+the span; re-validate the read after the last await; collapse the
+read-modify-write to before the first await.  Benign cases (the await
+cannot interleave with a competing writer by construction) carry a
+line pragma with the invariant spelled out.
+
+**iter-mutate-across-await** — container mutation inside an (async)
+iteration over that same container when the loop body suspends: the
+suspension lets other tasks observe the container mid-iteration, and
+the in-body mutation makes even the single-task schedule corrupt
+(dict-changed-size at best, silently skipped elements at worst).
+Iterate a snapshot (``list(self.x)``/``dict(self.x)`` — which the
+checker recognizes and exempts) or collect mutations and apply them
+after the loop.
+
+Both checkers are lexical, like lock-order: a mutation hidden behind a
+method call is invisible (trade recall for near-zero false positives);
+the seeded interleaving fuzzer is the half that catches those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, const_str, dotted, \
+    terminal_attr
+
+# in-place container mutators (list/set/dict/deque surface)
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert", "remove",
+             "discard", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault"}
+# wrappers that take a snapshot of the iterated container
+_SNAPSHOTS = {"list", "tuple", "dict", "set", "sorted", "frozenset"}
+
+
+def _self_attr(node: ast.AST) -> "Optional[str]":
+    """'X' when ``node`` is exactly ``self.X``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _collect_deplock_defs(tree: ast.Module) -> "List[dict]":
+    """attr -> DepLock class assignments, same shape the lock-order
+    checker extracts (``self.x = DepLock("cls")``)."""
+    defs: "List[dict]" = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                terminal_attr(node.value.func) == "DepLock":
+            cls = const_str(node.value.args[0]) if node.value.args else None
+            for tgt in node.targets:
+                attr = terminal_attr(tgt)
+                if attr and cls:
+                    defs.append({"attr": attr, "cls": cls})
+    return defs
+
+
+class _FnScan:
+    """Ordered event stream for one coroutine: reads/mutations of
+    ``self.*`` attrs, suspension points, and the stack of enclosing
+    ``async with`` blocks (by per-function block id + attr name)."""
+
+    def __init__(self, module: Module) -> None:
+        self.module = module
+        self.events: "List[dict]" = []   # kind, attr?, line, locks
+        self._with_stack: "List[Tuple[int, str]]" = []
+        self._with_count = 0
+        # (if-visit id, branch index) stack: events in sibling branches
+        # of one if/elif chain are mutually exclusive and never pair
+        self._branch_stack: "List[Tuple[int, int]]" = []
+        self._branch_count = 0
+
+    # --- event emission -------------------------------------------------------
+
+    def _emit(self, kind: str, line: int, attr: "Optional[str]" = None
+              ) -> None:
+        self.events.append({
+            "kind": kind, "attr": attr, "line": line,
+            "context": self.module.context(line),
+            "locks": [list(e) for e in self._with_stack],
+            "branch": [list(b) for b in self._branch_stack]})
+
+    # --- expression scan (reads + mutator calls) ------------------------------
+
+    def _expr(self, node: "Optional[ast.AST]") -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                      # other execution context
+        if isinstance(node, ast.Await):
+            # an AWAITED call is an RPC/coroutine, never an in-place
+            # container mutation (list.append/dict.pop return
+            # synchronously) — so `await self.io.remove(oid)` is a
+            # read of self.io, not a mutation, despite the name
+            inner = node.value
+            if isinstance(inner, ast.Call):
+                self._expr(inner.func if not (
+                    isinstance(inner.func, ast.Attribute) and
+                    inner.func.attr in _MUTATORS) else inner.func.value)
+                for a in inner.args:
+                    self._expr(a)
+                for kw in inner.keywords:
+                    self._expr(kw.value)
+            else:
+                self._expr(inner)       # args evaluated pre-suspension
+            self._emit("suspend", node.lineno)
+            return
+        if isinstance(node, ast.Call):
+            attr = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+            if attr is not None:
+                # self.X.append(...): a mutation of X, not a read
+                self._emit("mutate", node.lineno, attr)
+            else:
+                self._expr(node.func)
+            for a in node.args:
+                self._expr(a)
+            for kw in node.keywords:
+                self._expr(kw.value)
+            return
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._emit("read", node.lineno, attr)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    def _target(self, node: ast.AST) -> None:
+        """Assignment/delete target: emit mutations, never reads."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                self._target(el)
+            return
+        attr = _self_attr(node)
+        if attr is not None:            # self.X = ...
+            self._emit("mutate", node.lineno, attr)
+            return
+        if isinstance(node, ast.Subscript):
+            base = _self_attr(node.value)
+            if base is not None:        # self.X[k] = ...
+                self._emit("mutate", node.lineno, base)
+            else:
+                self._expr(node.value)
+            self._expr(node.slice)
+            return
+        if isinstance(node, ast.Attribute):
+            self._expr(node.value)      # x.y = ...: reads x
+            return
+        # Name/Starred: local store, no event
+
+    # --- statement walk -------------------------------------------------------
+
+    def _has_suspend(self, stmts: "List[ast.stmt]") -> bool:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Await, ast.AsyncFor,
+                                     ast.AsyncWith)):
+                    return True
+        return False
+
+    def body(self, stmts: "List[ast.stmt]") -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    @staticmethod
+    def _terminates(stmts: "List[ast.stmt]") -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    def _branch(self, stmts: "List[ast.stmt]") -> None:
+        """An if/except branch.  When the branch TERMINATES (ends in
+        return/raise/continue/break), its events cannot connect code
+        before the branch to code after it: a guard clause's
+        ``return await ...`` must not count as a suspension between a
+        read above and a mutation below (the fall-through path never
+        suspends).  Keep events up to the branch's last mutation (real
+        read→await→mutate races wholly inside the branch still pair),
+        drop the trailing reads/suspends that would leak."""
+        if not stmts:
+            return
+        mark = len(self.events)
+        self.body(stmts)
+        if not self._terminates(stmts):
+            return
+        last_mutate = None
+        for i in range(len(self.events) - 1, mark - 1, -1):
+            if self.events[i]["kind"] == "mutate":
+                last_mutate = i
+                break
+        del self.events[mark if last_mutate is None else last_mutate + 1:]
+
+    def _loop_body(self, node) -> None:
+        """Loop bodies that suspend get visited twice: the second pass
+        models the next iteration, so a mutate-at-the-bottom /
+        read-at-the-top pair still spans an await."""
+        suspends = self._has_suspend(node.body)
+        self.body(node.body)
+        if suspends:
+            self._emit("suspend", node.lineno)
+            self.body(node.body)
+        self.body(node.orelse)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                      # scanned as its own function
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            for tgt in stmt.targets:
+                self._target(tgt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            # x += v is a single un-suspendable step (unless v awaits,
+            # handled by _expr); the target is mutate-only
+            self._expr(stmt.value)
+            self._target(stmt.target)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._expr(stmt.value)
+            if stmt.value is not None:
+                self._target(stmt.target)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._target(tgt)
+            return
+        if isinstance(stmt, ast.AsyncWith):
+            self._emit("suspend", stmt.lineno)     # the acquire awaits
+            entered = []
+            for item in stmt.items:
+                self._expr(item.context_expr)
+                attr = terminal_attr(item.context_expr)
+                if attr:
+                    self._with_count += 1
+                    entry = (self._with_count, attr)
+                    self._with_stack.append(entry)
+                    entered.append(entry)
+            self.body(stmt.body)
+            for entry in entered:
+                self._with_stack.remove(entry)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            self.body(stmt.body)
+            return
+        if isinstance(stmt, ast.AsyncFor):
+            self._expr(stmt.iter)
+            self._emit("suspend", stmt.lineno)     # each step awaits
+            self._target(stmt.target)
+            self._loop_body(stmt)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter)
+            self._target(stmt.target)
+            self._loop_body(stmt)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            self._loop_body(stmt)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            self._branch_count += 1
+            bid = self._branch_count
+            for idx, stmts in enumerate((stmt.body, stmt.orelse)):
+                self._branch_stack.append((bid, idx))
+                try:
+                    self._branch(stmts)
+                finally:
+                    self._branch_stack.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            self.body(stmt.body)
+            for handler in stmt.handlers:
+                self._branch(handler.body)
+            self.body(stmt.orelse)
+            self.body(stmt.finalbody)
+            return
+        # Expr / Return / Raise / Assert / Global / Pass / ...
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+
+class AwaitAtomicityChecker(Checker):
+    name = "await-atomicity"
+    description = ("read of a shared self attribute split from its "
+                   "mutation by an await with no DepLock held across "
+                   "both")
+
+    def collect(self, module: Module) -> dict:
+        fns: "List[dict]" = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            scan = _FnScan(module)
+            scan.body(node.body)
+            if scan.events:
+                fns.append({"fn": node.name, "line": node.lineno,
+                            "events": scan.events})
+        return {"fns": fns,
+                "defs": _collect_deplock_defs(module.tree)}
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        deplock_attrs: "Set[str]" = set()
+        for f in facts.values():
+            for d in f.get("defs", ()):
+                deplock_attrs.add(d["attr"])
+
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for fn in f.get("fns", ()):
+                out.extend(self._scan_fn(path, fn, deplock_attrs))
+        return out
+
+    @staticmethod
+    def _branches_compatible(a: dict, b: dict) -> bool:
+        """False when the two events sit in different branches of the
+        same if/elif visit — mutually exclusive on any single pass."""
+        for (ida, idxa), (idb, idxb) in zip(a["branch"], b["branch"]):
+            if ida != idb:
+                return True       # diverged into different ifs: fine
+            if idxa != idxb:
+                return False      # same if, different arm
+        return True
+
+    def _scan_fn(self, path: str, fn: dict,
+                 deplock_attrs: "Set[str]") -> "List[Finding]":
+        events = fn["events"]
+        flagged: "Set[str]" = set()
+        out: "List[Finding]" = []
+        for im, m in enumerate(events):
+            if m["kind"] != "mutate" or m["attr"] in flagged:
+                continue
+            m_locks = {tuple(e) for e in m["locks"]
+                       if e[1] in deplock_attrs}
+            best: "Optional[dict]" = None
+            suspended = False
+            # walk backwards: nearest read of the same attr with a
+            # suspension in between and no shared DepLock block
+            for ev in reversed(events[:im]):
+                if ev["kind"] == "suspend":
+                    suspended = True
+                    continue
+                if ev["kind"] == "mutate" and ev["attr"] == m["attr"] \
+                        and self._branches_compatible(ev, m):
+                    break     # closer write: that pair was the candidate
+                if ev["kind"] != "read" or ev["attr"] != m["attr"]:
+                    continue
+                if not self._branches_compatible(ev, m):
+                    continue  # sibling if/else branches: exclusive
+                if not suspended:
+                    # a same-attr read with NO suspension before the
+                    # mutation = the value was (re)validated after the
+                    # last await — the recommended fix shape; stop
+                    break
+                if ev["line"] > m["line"]:
+                    # cross-iteration artifact of the loop-body double
+                    # visit: a read BELOW the mutation in source pairs
+                    # with the next iteration's mutate — but that shape
+                    # (mutate-then-read, e.g. `self.x += 1; v = self.x`
+                    # or `ev.clear(); await ev.wait()`) is atomic per
+                    # iteration; only read-above-mutate spans an await
+                    continue
+                r_locks = {tuple(e) for e in ev["locks"]
+                           if e[1] in deplock_attrs}
+                if r_locks & m_locks:
+                    break     # same async-with DepLock block spans both
+                best = ev
+                break
+            if best is None:
+                continue
+            flagged.add(m["attr"])
+            out.append(Finding(
+                check=self.name, path=path, line=m["line"],
+                context=m["context"],
+                message=f"self.{m['attr']} is read at line "
+                        f"{best['line']} and mutated here with an "
+                        f"await between them and no DepLock held "
+                        f"across both (in {fn['fn']!r}): another task "
+                        f"can interleave at the suspension — hold a "
+                        f"DepLock across the span, re-validate after "
+                        f"the await, or pragma with the invariant "
+                        f"that makes it safe"))
+        return out
+
+
+class IterMutateChecker(Checker):
+    name = "iter-mutate-across-await"
+    description = ("container mutated inside an async iteration over "
+                   "it whose body suspends")
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for loop in self._loops(node):
+                hit = self._check_loop(loop, module)
+                if hit:
+                    hits.append(hit)
+        return {"hits": hits}
+
+    @staticmethod
+    def _loops(fn: ast.AsyncFunctionDef):
+        stack: "List[ast.AST]" = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _iter_base(it: ast.expr) -> "Optional[str]":
+        """Dotted base of the iterated container, None when the loop
+        iterates a snapshot or something unnameable."""
+        if isinstance(it, ast.Call):
+            if isinstance(it.func, ast.Name) and \
+                    it.func.id in _SNAPSHOTS:
+                return None                     # list(self.x): snapshot
+            if isinstance(it.func, ast.Attribute) and \
+                    it.func.attr in ("items", "keys", "values") and \
+                    not it.args and not it.keywords:
+                it = it.func.value
+            else:
+                return None
+        if isinstance(it, (ast.Attribute, ast.Name)):
+            return dotted(it)
+        return None
+
+    def _check_loop(self, loop, module: Module) -> "Optional[dict]":
+        base = self._iter_base(loop.iter)
+        if base is None:
+            return None
+        suspends = isinstance(loop, ast.AsyncFor)
+        mutation: "Optional[ast.AST]" = None
+        stack: "List[ast.AST]" = list(loop.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                suspends = True
+            if isinstance(node, ast.Await) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr in _MUTATORS:
+                # awaited "mutator" = RPC (await self.io.remove(oid)),
+                # not a container mutation: skip the call node itself
+                stack.append(node.value.func.value)
+                stack.extend(node.value.args)
+                stack.extend(kw.value for kw in node.value.keywords)
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            dotted(tgt.value) == base:
+                        mutation = mutation or tgt
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            dotted(tgt.value) == base:
+                        mutation = mutation or tgt
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    dotted(node.func.value) == base:
+                mutation = mutation or node
+            stack.extend(ast.iter_child_nodes(node))
+        if mutation is None or not suspends:
+            return None
+        return {"line": mutation.lineno, "base": base,
+                "loop_line": loop.lineno,
+                "async_for": isinstance(loop, ast.AsyncFor),
+                "context": module.context(mutation.lineno)}
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for h in f.get("hits", ()):
+                how = "an async for" if h["async_for"] else \
+                    "an iteration whose body awaits"
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    context=h["context"],
+                    message=f"{h['base']} is mutated inside {how} "
+                            f"over it (loop at line {h['loop_line']}): "
+                            f"other tasks observe the container "
+                            f"mid-iteration and the iterator itself "
+                            f"can invalidate — iterate a snapshot "
+                            f"(list({h['base']})) or apply mutations "
+                            f"after the loop"))
+        return out

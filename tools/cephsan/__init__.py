@@ -1,0 +1,15 @@
+"""cephsan — seed-sweep runner for the interleaving sanitizer.
+
+The runtime half lives in ``ceph_tpu/common/sanitizer.py`` (seeded
+event-loop shim + freeze-on-handoff); the static half is three cephlint
+checkers (await-atomicity, iter-mutate-across-await, buffer-aliasing).
+This package is the harness that sweeps the concurrency suites over a
+seed set and prints an exact reproduce line for any failing seed.
+
+    python -m tools.cephsan                  # fixed seeds + one fresh
+    python -m tools.cephsan --seeds 25       # acceptance sweep
+    python -m tools.cephsan --seed-list 7,23 # replay specific seeds
+    CEPHSAN_SEED=7 pytest -m cephsan tests/  # what a failure prints
+"""
+
+from .cli import FIXED_SEEDS, main  # noqa: F401

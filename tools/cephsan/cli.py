@@ -1,0 +1,127 @@
+"""cephsan CLI — sweep the concurrency suites over interleaving seeds.
+
+Each seed is one pytest run of the ``cephsan``-marked suites with
+``CEPHSAN_SEED=<seed>`` (and freeze-on-handoff armed) in the
+environment; tests/conftest.py installs the seeded event-loop policy
+from that, so every fixture loop replays the same schedule.  A failing
+seed prints the exact reproduce line — the whole point: thrash luck
+becomes a number you can paste.
+
+Exit codes: 0 = every seed green, 1 = at least one failing seed,
+2 = harness error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+# The CI seed set (check.sh): small, fixed, fast to replay.  The
+# acceptance bar for the sanitizer itself is the 25-seed sweep
+# (--seeds 25); these three are the regression canary — seeds that
+# found real bugs stay in the set so the bug class stays dead.
+# Seed 1 found the ShardedOpWQ start-order bug (task first-steps are
+# not ordered by spawn order).
+FIXED_SEEDS = (1, 7, 23)
+
+DEFAULT_SUITES = ("tests/test_thrash.py", "tests/test_sharded_wq.py",
+                  "tests/test_group_commit.py")
+
+
+def _fresh_seed() -> int:
+    """A seed nobody has tried before: time-and-pid mixed, bounded so
+    reproduce lines stay short.  Printed before the run — a CI failure
+    on a fresh seed is fully replayable from the log."""
+    return (int(time.time() * 1000) ^ (os.getpid() << 12)) % 1_000_000
+
+
+def run_seed(seed: int, suites: "List[str]", freeze: bool,
+             pytest_args: "List[str]", tail: int = 40) -> bool:
+    env = dict(os.environ)
+    env["CEPHSAN_SEED"] = str(seed)
+    env["CEPHSAN_FREEZE"] = "1" if freeze else "0"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pytest", "-q", "-m", "cephsan",
+           "-p", "no:cacheprovider", "-p", "no:randomly",
+           *suites, *pytest_args]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    dt = time.monotonic() - t0
+    ok = proc.returncode == 0
+    status = "ok" if ok else f"FAIL (exit {proc.returncode})"
+    print(f"cephsan: seed {seed}: {status} [{dt:.1f}s]")
+    if not ok:
+        lines = (proc.stdout + proc.stderr).splitlines()
+        for line in lines[-tail:]:
+            print(f"    {line}")
+        print(f"cephsan: reproduce with:\n"
+              f"    CEPHSAN_SEED={seed} CEPHSAN_FREEZE="
+              f"{'1' if freeze else '0'} python -m pytest -m cephsan "
+              f"{' '.join(suites)}")
+    return ok
+
+
+def main(argv: "Optional[List[str]]" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cephsan",
+        description="seeded interleaving sweep over the concurrency "
+                    "suites")
+    ap.add_argument("--seeds", type=int, default=0, metavar="N",
+                    help="sweep seeds 1..N (the acceptance bar is 25)")
+    ap.add_argument("--seed-list", default="",
+                    help="comma-separated explicit seeds (replay mode)")
+    ap.add_argument("--fresh", type=int, default=1, metavar="K",
+                    help="additionally run K fresh (time-derived) "
+                         "seeds, printed before the run (default 1; "
+                         "0 for fully deterministic CI)")
+    ap.add_argument("--no-freeze", action="store_true",
+                    help="disable freeze-on-handoff (schedule fuzzing "
+                         "only)")
+    ap.add_argument("--suites", nargs="*", default=list(DEFAULT_SUITES),
+                    help="test files/dirs (cephsan-marked tests run)")
+    ap.add_argument("--keep-going", action="store_true",
+                    help="run every seed even after a failure")
+    ap.add_argument("--pytest-args", default="",
+                    help="extra args passed through to pytest")
+    args = ap.parse_args(argv)
+
+    if args.seed_list:
+        try:
+            seeds = [int(s) for s in args.seed_list.split(",") if s.strip()]
+        except ValueError as e:
+            print(f"cephsan: bad --seed-list: {e}", file=sys.stderr)
+            return 2
+    elif args.seeds > 0:
+        seeds = list(range(1, args.seeds + 1))
+    else:
+        seeds = list(FIXED_SEEDS)
+    seeds += [_fresh_seed() for _ in range(max(0, args.fresh))]
+
+    missing = [s for s in args.suites if not os.path.exists(s)]
+    if missing:
+        print(f"cephsan: no such suite: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    extra = args.pytest_args.split() if args.pytest_args else []
+    freeze = not args.no_freeze
+    print(f"cephsan: sweeping {len(seeds)} seed(s) "
+          f"{seeds if len(seeds) <= 12 else seeds[:12] + ['...']} "
+          f"freeze={'on' if freeze else 'off'} over "
+          f"{len(args.suites)} suite(s)")
+    failed: "List[int]" = []
+    for seed in seeds:
+        if not run_seed(seed, args.suites, freeze, extra):
+            failed.append(seed)
+            if not args.keep_going:
+                break
+    if failed:
+        print(f"cephsan: {len(failed)} failing seed(s): "
+              f"{','.join(map(str, failed))}")
+        return 1
+    print(f"cephsan: all {len(seeds)} seed(s) green")
+    return 0

@@ -244,8 +244,11 @@ async def run_chaos(args) -> int:
         for o in cluster.osds.values():
             for k in cork:
                 cork[k] += o.ms.cork_stats[k]
+        from ceph_tpu.common import sanitizer as _san
         report = {
             "ok": not failures,
+            "sanitizer": {"enabled": _san.enabled(), "seed": _san.seed(),
+                          "freeze": _san.freeze_enabled()},
             "acked": wl.acked, "failed_ops": wl.failed,
             "objects": len(wl.committed), "kills": th.kills,
             "splits": th.splits, "corruptions": stats["corruptions"],
@@ -312,7 +315,23 @@ def main(argv=None) -> int:
                          "a tree with non-baselined static-invariant "
                          "findings (a fire-and-forget task or blocked "
                          "event loop makes chaos verdicts unreadable)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="run under cephsan: seeded interleaving loop "
+                         "(wakeup order permuted deterministically; "
+                         "composes with --pipeline-pass, whose second "
+                         "round gets its own derived seed) + "
+                         "freeze-on-handoff on BufferList payloads")
+    ap.add_argument("--sanitize-seed", type=int, default=0,
+                    help="interleaving seed (default: derived from "
+                         "--seed; printed either way for replay)")
     args = ap.parse_args(argv)
+    if args.sanitize:
+        from ceph_tpu.common import sanitizer
+        san_seed = args.sanitize_seed or (args.seed * 7919 + 1)
+        sanitizer.install(san_seed, freeze=True)
+        print(f"chaos_check: cephsan armed, interleaving seed "
+              f"{san_seed} (replay: --sanitize --sanitize-seed "
+              f"{san_seed})")
     if args.lint:
         from tools.cephlint import lint_paths
         from tools.cephlint.cli import DEFAULT_BASELINE
