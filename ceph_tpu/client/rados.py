@@ -185,7 +185,7 @@ class IoCtx:
                     pid, "", [{"op": "pgls"}], pg=pg)
                 lens = [o["dlen"] for o in outs if o.get("op") == "pgls"]
                 for buf in unpack_buffers(lens, blob):
-                    names.update(json.loads(buf.decode()))
+                    names.update(json.loads(bytes(buf).decode()))
         return sorted(names)
 
     async def cache_flush(self, oid: str) -> int:
@@ -223,7 +223,7 @@ class IoCtx:
             op["snap"] = snap     # read AT a pool snapshot
         outs, blob = await self._submit(oid, [op])
         lens = [o["dlen"] for o in outs if o.get("op") == "read"]
-        return b"".join(unpack_buffers(lens, blob))
+        return b"".join(bytes(b) for b in unpack_buffers(lens, blob))
 
     async def pool_mksnap(self, snap: str) -> int:
         """Create a pool snapshot ('osd pool mksnap'): O(metadata) — COW
@@ -283,12 +283,12 @@ class IoCtx:
         lens = [o["dlen"] for o in outs if o.get("op") == "omap_get"]
         raw = unpack_buffers(lens, blob)[0]
         return {k: bytes.fromhex(v)
-                for k, v in json.loads(raw.decode()).items()}
+                for k, v in json.loads(bytes(raw).decode()).items()}
 
     async def omap_keys(self, oid: str) -> "list[str]":
         outs, blob = await self._submit(oid, [{"op": "omap_keys"}])
         lens = [o["dlen"] for o in outs if o.get("op") == "omap_keys"]
-        return json.loads(unpack_buffers(lens, blob)[0].decode())
+        return json.loads(bytes(unpack_buffers(lens, blob)[0]).decode())
 
     async def omap_rm(self, oid: str, keys: "list[str]") -> None:
         await self._submit(oid, [{"op": "omap_rm", "keys": list(keys)}])
@@ -332,10 +332,10 @@ class IoCtx:
             oid, [{"op": "call", "cls": cls, "method": method,
                    "dlen": len(data)}], bytes(data))
         lens = [o["dlen"] for o in outs if o.get("op") == "call"]
-        return unpack_buffers(lens, blob)[0] if lens else b""
+        return bytes(unpack_buffers(lens, blob)[0]) if lens else b""
 
     async def getxattr(self, oid: str, name: str) -> bytes:
         outs, blob = await self._submit(
             oid, [{"op": "getxattr", "name": name}])
         lens = [o["dlen"] for o in outs if o.get("op") == "getxattr"]
-        return unpack_buffers(lens, blob)[0]
+        return bytes(unpack_buffers(lens, blob)[0])

@@ -22,11 +22,85 @@ length is 4-byte aligned and contiguous.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Iterable, List, Optional
 
 import numpy as np
 
 from ..ops import crc32c as crcmod
+
+# Process-wide copy/crc accounting (ROADMAP item 1's honesty meter).
+# ``bytes_copied`` counts every byte a BufferList materializes into a
+# fresh contiguous buffer (to_bytes / rebuild / rebuild_aligned /
+# multi-segment to_array) — the copies the zero-copy wire path exists
+# to eliminate; tests/test_wire.py asserts the client->OSD->store bulk
+# write path leaves it untouched.  ``crc_cache_hits``/``misses`` count
+# per-raw cached-crc lookups (the FLAG_NOCRC/resend fast path).
+STATS = {"bytes_copied": 0, "copy_calls": 0,
+         "crc_cache_hits": 0, "crc_cache_misses": 0}
+
+
+def note_copy(n: int) -> None:
+    """Record a bulk-buffer materialization of ``n`` bytes."""
+    if n > 0:
+        STATS["bytes_copied"] += int(n)
+        STATS["copy_calls"] += 1
+
+
+def buffer_views(data) -> "List[memoryview]":
+    """Zero-copy memoryview segments of any payload currency
+    (BufferList / ndarray / bytes-like) — the scatter-gather shape
+    store backends and the messenger consume."""
+    if isinstance(data, BufferList):
+        return data.iovecs()
+    if isinstance(data, np.ndarray):
+        if data.dtype != np.uint8 or not data.flags.c_contiguous:
+            data = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+        return [memoryview(data)] if data.size else []
+    return [memoryview(data)] if len(data) else []
+
+
+def buffer_length(data) -> int:
+    if isinstance(data, np.ndarray):
+        return int(data.size) * data.itemsize
+    return len(data)
+
+
+def as_u8_array(data) -> np.ndarray:
+    """Contiguous uint8 array over any payload currency, zero-copy
+    where possible: single-segment BufferList -> its backing view,
+    bytes-likes -> ``np.frombuffer`` (no copy), uint8 ndarray ->
+    itself.  Only multi-segment lists and exotic dtypes materialize."""
+    if isinstance(data, BufferList):
+        return data.to_array()
+    if isinstance(data, np.ndarray):
+        if data.dtype == np.uint8 and data.ndim == 1 \
+                and data.flags.c_contiguous:
+            return data
+        return np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    if len(data) == 0:
+        return np.zeros(0, dtype=np.uint8)
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def concat_u8(parts, length: "Optional[int]" = None) -> np.ndarray:
+    """Concatenate buffers (BufferList / ndarray / bytes) into one
+    uint8 array, truncated or zero-padded to ``length`` when given.
+    A single exact-fit buffer passes through as a view (no copy) —
+    the aligned full-chunk read common case."""
+    arrs = [as_u8_array(p) for p in parts]
+    total = sum(a.size for a in arrs)
+    n = total if length is None else int(length)
+    if len(arrs) == 1 and arrs[0].size == n:
+        return arrs[0]
+    out = np.zeros(n, dtype=np.uint8)
+    pos = 0
+    for a in arrs:
+        if pos >= n:
+            break
+        take = min(a.size, n - pos)
+        out[pos:pos + take] = a[:take]
+        pos += take
+    return out
 
 
 class BufferFrozenError(RuntimeError):
@@ -92,8 +166,10 @@ class _Raw:
         key = (off, length)
         hit = self.crc_cache.get(key)
         if hit is not None and hit[0] == seed:
+            STATS["crc_cache_hits"] += 1
             return hit[1]
         if hit is not None:
+            STATS["crc_cache_hits"] += 1
             # Cached under a different seed: the crc register update is
             # linear over GF(2), so crc(data, s2) = crc(data, s1) ^
             # A(len)·(s1^s2) with A the zero-shift operator — the same
@@ -102,6 +178,7 @@ class _Raw:
             s1, c1 = hit
             out = c1 ^ crcmod.crc32c_combine(s1 ^ seed, 0, length)
         else:
+            STATS["crc_cache_misses"] += 1
             out = crcmod.crc32c(self.data[off:off + length], seed)
         self.crc_cache[key] = (seed, out)
         return out
@@ -184,7 +261,11 @@ class BufferList:
     # --- access -------------------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        note_copy(self._len)
         return b"".join(s.view().tobytes() for s in self._segs)
+
+    def __bytes__(self) -> bytes:
+        return self.to_bytes()
 
     def to_array(self) -> np.ndarray:
         """Contiguous uint8 copy-free when single-segment."""
@@ -192,7 +273,36 @@ class BufferList:
             return np.zeros(0, dtype=np.uint8)
         if len(self._segs) == 1:
             return self._segs[0].view()
+        note_copy(self._len)
         return np.concatenate([s.view() for s in self._segs])
+
+    def iovecs(self) -> "List[memoryview]":
+        """Zero-copy scatter-gather list of the segments' bytes — the
+        writev currency: the messenger hands these straight to the
+        transport instead of materializing one contiguous frame."""
+        return [memoryview(s.view()) for s in self._segs]
+
+    def __getitem__(self, key):
+        """``bl[a:b]`` is a zero-copy ``substr`` (shares backing
+        stores); an int index returns that byte.  Lets receivers slice
+        ``msg.data`` exactly like the bytes it used to be without
+        materializing anything."""
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._len)
+            if step != 1:
+                raise ValueError("BufferList slices must be contiguous")
+            return self.substr(start, max(0, stop - start))
+        if isinstance(key, (int, np.integer)):
+            idx = int(key)
+            if idx < 0:
+                idx += self._len
+            if not 0 <= idx < self._len:
+                raise IndexError(idx)
+            for s in self._segs:
+                if idx < s.len:
+                    return int(s.raw.data[s.off + idx])
+                idx -= s.len
+        raise TypeError(f"bad BufferList index {key!r}")
 
     def to_u32(self) -> np.ndarray:
         """Packed uint32 view for the device path; requires 4-byte length."""
@@ -228,6 +338,7 @@ class BufferList:
     def rebuild(self) -> "BufferList":
         """Coalesce into a single contiguous buffer, in place."""
         if len(self._segs) > 1:
+            note_copy(self._len)
             arr = np.concatenate([s.view() for s in self._segs])
             self._segs = [_Segment(_Raw(arr), 0, arr.size)]
         return self
@@ -236,6 +347,7 @@ class BufferList:
         """Single contiguous buffer whose base address is ``align``-aligned
         (reference rebuild_aligned; SIMD_ALIGN=32 there, 512 for TPU tiles
         here — callers choose)."""
+        note_copy(self._len)
         arr = np.concatenate([s.view() for s in self._segs]) if self._segs \
             else np.zeros(0, dtype=np.uint8)
         if arr.size and arr.ctypes.data % align:

@@ -176,6 +176,38 @@ class PerfCounters:
                     c.buckets = [0] * 64
 
 
+class ExternalCounters:
+    """A perf group whose values live in an external module-level dict
+    (process-wide stats like ``common.buffer.STATS``), snapshotted at
+    dump time.  Duck-types the PerfCounters surface the collection and
+    the mgr exporter consume.  Counters are monotonic (u64_counter)
+    except under ``perf reset``, which zeroes the shared dict."""
+
+    def __init__(self, name: str, source: dict,
+                 descriptions: "Optional[dict]" = None,
+                 unit: str = "") -> None:
+        self.name = name
+        self._source = source
+        self._desc = dict(descriptions or {})
+        self._unit = unit
+
+    def dump(self) -> dict:
+        return {k: int(v) for k, v in self._source.items()}
+
+    def schema(self) -> dict:
+        return {k: {"type": U64_COUNTER,
+                    "description": self._desc.get(k, ""),
+                    "unit": self._unit}
+                for k in self._source}
+
+    def histogram_dump(self) -> dict:
+        return {}
+
+    def reset(self) -> None:
+        for k in self._source:
+            self._source[k] = 0
+
+
 class PerfCountersBuilder:
     """Reference builder pattern: declare, then create_perf_counters()."""
 

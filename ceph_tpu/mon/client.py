@@ -221,7 +221,7 @@ class MonClient(Dispatcher):
                 fut.set_result(msg)
             return True
         if msg.TYPE == "osd_map":
-            incoming = json.loads(msg.data.decode())
+            incoming = json.loads(bytes(msg.data).decode())
             if int(incoming.get("epoch", 0)) > self.osdmap.epoch:
                 self.osdmap.load_dict(incoming)
                 self._map_event.set()

@@ -6,8 +6,10 @@ header/payload/data split.  Kept:
 - a type registry (wire type string -> class) with HEAD_VERSION /
   COMPAT_VERSION checks: a receiver rejects messages whose compat version
   exceeds what it speaks (the feature-gating analog),
-- the payload split: ``fields`` (small JSON-able header values) vs
-  ``data`` (bulk bytes — shard chunks, transactions — shipped raw).
+- the payload split: ``fields`` (small header values, encoded by the
+  FIELDS-driven flat binary codec in ``msg.wire``) vs ``data`` (bulk
+  bytes — shard chunks, transactions — shipped as zero-copy
+  ``BufferList`` segments).
 
 Concrete subclasses live beside their subsystems (osd/mon/client modules)
 and are one-liner declarations.
@@ -15,12 +17,12 @@ and are one-liner declarations.
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, Optional, Type
 
 import numpy as np
 
 from ..common.buffer import BufferList
+from . import wire
 
 
 class MessageError(Exception):
@@ -77,37 +79,47 @@ class Message:
 
     # --- wire ----------------------------------------------------------------
 
-    def encode(self) -> "tuple[bytes, bytes]":
-        header = json.dumps({
-            "type": self.TYPE,
-            "v": self.HEAD_VERSION,
-            "compat": self.COMPAT_VERSION,
-            "prio": self.priority,
-            "fields": self.fields,
-        }).encode()
-        data = self.data.to_bytes() if isinstance(self.data, BufferList) \
-            else self.data
-        return header, data
+    def encode(self) -> "tuple[bytes, bytes | BufferList]":
+        """-> (header bytes, data).  The header is the FIELDS-driven
+        flat binary encoding (msg/wire.py); ``data`` passes through
+        un-materialized — a BufferList stays a BufferList so the frame
+        builder can export it as iovecs instead of concatenating."""
+        try:
+            header = wire.encode_header(type(self), self.fields,
+                                        self.priority)
+        except wire.WireError as e:
+            raise MessageError(f"cannot encode {self.TYPE}: {e}")
+        return header, self.data
 
     def __repr__(self) -> str:
         return (f"{type(self).__name__}({self.fields}, "
                 f"data={len(self.data)}B)")
 
 
-def decode_message(header: bytes, data: bytes,
+def decode_message(header, data: "bytes | BufferList" = b"",
                    from_name: str = "") -> Message:
+    """Decode one frame body.  ``data`` may be a BufferList (the
+    zero-copy receive path: local-transport handoff or a view over the
+    socket read buffer) and is stored as-is — bulk bytes are never
+    materialized here."""
     try:
-        h = json.loads(header.decode())
-    except (ValueError, UnicodeDecodeError) as e:
+        wire_type, head_v, compat_v, prio, state = \
+            wire.decode_header(header)
+    except wire.WireError as e:
         raise MessageError(f"bad message header: {e}")
-    cls = _REGISTRY.get(h.get("type", ""))
+    cls = _REGISTRY.get(wire_type)
     if cls is None:
-        raise MessageError(f"unknown message type {h.get('type')!r}")
-    if h.get("compat", 1) > cls.HEAD_VERSION:
+        raise MessageError(f"unknown message type {wire_type!r}")
+    if compat_v > cls.HEAD_VERSION:
         raise MessageError(
-            f"{h['type']}: peer compat v{h['compat']} > our v{cls.HEAD_VERSION}")
-    msg = cls(h.get("fields", {}), data)
-    msg.priority = h.get("prio", 127)
+            f"{wire_type}: peer compat v{compat_v} > our "
+            f"v{cls.HEAD_VERSION}")
+    try:
+        fields = wire.decode_fields(cls, state)
+    except wire.WireError as e:
+        raise MessageError(f"bad {wire_type} payload: {e}")
+    msg = cls(fields, data)
+    msg.priority = prio
     msg.from_name = from_name
     return msg
 

@@ -1,0 +1,455 @@
+"""wire — FIELDS-driven flat binary message codec (the msgr2 frame body).
+
+Reference: msgr2's payload is a flat, struct-packed encoding driven by
+each message's declared schema (src/messages/*.h encode_payload /
+decode_payload over DENC), not a dict serializer.  PR 5's cephlint
+already treats ``Message.FIELDS`` as the canonical schema for all
+registered messages; this module turns that same declaration into the
+on-wire layout, replacing ``json.dumps`` header bodies on the hot path.
+
+Layout of one encoded header (little-endian throughout):
+
+    u8   tlen, tlen x TYPE bytes      -- wire type string
+    u8   head_version                 -- sender's HEAD_VERSION
+    u8   compat_version               -- sender's COMPAT_VERSION
+    u8   priority
+    u32  req_bitmap                   -- bit i set => required field i
+                                         (FIELDS declaration order) is
+                                         present, packed positionally
+    u16  n_optional                   -- TLV-encoded declared-optional
+                                         fields: (u16 index, value)
+    u16  n_named                      -- TLV fallback for fields outside
+                                         the schema: (u16 len, name,
+                                         value) -- version-skew escape
+    [required values] [optional TLVs] [named TLVs]
+
+Values use a self-delimiting tag encoding (``_enc_value``): None /
+bool / int64 / big-int / float64 / str / bytes / list / dict.  Dict
+keys coerce to ``str`` exactly like ``json.dumps`` did, so decoded
+fields are bit-identical to the JSON era ones (tuples come back as
+lists, int keys as strings) and no receiver notices the format change.
+
+Version-skew contract (HEAD_VERSION / COMPAT_VERSION preserved from
+the JSON header): a decoder rejects a frame whose ``compat_version``
+exceeds the HEAD_VERSION it speaks; new message revisions may only
+APPEND optional fields to FIELDS, so optional indices from a newer
+peer that this build doesn't know are skipped, not errors.
+
+``WIRE_SPECS`` below is the hand-written spec table for the data-path
+messages — the single place a reviewer reads the hot wire layout.
+cephlint's msg-symmetry checker cross-checks every entry against the
+class's FIELDS declaration, so drift is a lint error, and
+``check_specs()`` enforces the same at test time.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+
+class WireError(Exception):
+    """Malformed or unencodable wire payload."""
+
+
+# --- hand spec table ---------------------------------------------------------
+
+# (required fields in FIELDS order, optional fields in FIELDS order)
+# for the client/EC data-path messages.  MUST mirror each class's
+# FIELDS declaration — cephlint msg-symmetry reports any drift, and
+# check_specs() raises on it (tests/test_wire.py runs both).
+WIRE_SPECS: "Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]" = {
+    "osd_op": (("tid", "pool", "pg", "oid", "ops", "map_epoch"),
+               ("reqid", "trace_id", "ticket", "internal")),
+    "osd_op_reply": (("tid", "result", "outs"), ("retry_auth",)),
+    "ec_sub_write": (("pgid", "shard", "from_osd", "tid", "epoch",
+                      "at_version", "trim_to", "roll_forward_to",
+                      "log_entries", "txn", "lens"), ("trace",)),
+    "ec_sub_write_reply": (("pgid", "shard", "from_osd", "tid",
+                            "committed", "applied"),
+                           ("error", "missing")),
+    "ec_sub_read": (("pgid", "shard", "from_osd", "tid", "to_read",
+                     "attrs_to_read"), ("trace",)),
+    "ec_sub_read_reply": (("pgid", "shard", "from_osd", "tid",
+                           "buffers_read", "lens", "attrs_read",
+                           "errors"), ("omap_read",)),
+}
+
+
+class WireSpec:
+    """Per-message-class wire schema derived from FIELDS."""
+
+    __slots__ = ("wire_type", "required", "optional", "req_index",
+                 "opt_index", "full_mask")
+
+    def __init__(self, wire_type: str,
+                 fields: "Tuple[str, ...]") -> None:
+        required: "List[str]" = []
+        optional: "List[str]" = []
+        seen = set()
+        for f in fields:
+            name = f[:-1] if f.endswith("?") else f
+            if not name or name in seen:
+                raise WireError(
+                    f"{wire_type}: FIELDS entry {f!r} is empty or "
+                    f"duplicated — not wire-derivable")
+            seen.add(name)
+            (optional if f.endswith("?") else required).append(name)
+        if len(required) > 32:
+            raise WireError(
+                f"{wire_type}: {len(required)} required fields exceed "
+                f"the 32-bit presence bitmap")
+        self.wire_type = wire_type
+        self.required = tuple(required)
+        self.optional = tuple(optional)
+        self.req_index = {n: i for i, n in enumerate(required)}
+        self.opt_index = {n: i for i, n in enumerate(optional)}
+        self.full_mask = (1 << len(required)) - 1
+
+
+_SPEC_CACHE: "Dict[type, WireSpec]" = {}
+
+
+def spec_for(cls) -> WireSpec:
+    """The class's wire spec (cached).  WIRE_SPECS entries are
+    authoritative for the data-path types; everything else derives
+    straight from FIELDS."""
+    spec = _SPEC_CACHE.get(cls)
+    if spec is None:
+        hand = WIRE_SPECS.get(cls.TYPE)
+        if hand is not None:
+            spec = WireSpec(cls.TYPE,
+                            tuple(hand[0]) + tuple(f + "?"
+                                                   for f in hand[1]))
+        else:
+            # no FIELDS (QA-local classes): every field rides the
+            # named-TLV fallback.  Registered ceph_tpu messages always
+            # declare FIELDS — cephlint enforces it.
+            spec = WireSpec(cls.TYPE, tuple(getattr(cls, "FIELDS", ())))
+        _SPEC_CACHE[cls] = spec
+    return spec
+
+
+def check_specs(registry: "Dict[str, type]") -> None:
+    """Assert WIRE_SPECS matches the registered classes' FIELDS —
+    the runtime half of the cephlint drift gate."""
+    for wire_type, (req, opt) in sorted(WIRE_SPECS.items()):
+        cls = registry.get(wire_type)
+        if cls is None:
+            raise WireError(f"WIRE_SPECS names unregistered message "
+                            f"type {wire_type!r}")
+        derived = WireSpec(wire_type, tuple(cls.FIELDS))
+        if derived.required != tuple(req) or \
+                derived.optional != tuple(opt):
+            raise WireError(
+                f"WIRE_SPECS[{wire_type!r}] drifted from "
+                f"{cls.__name__}.FIELDS: table "
+                f"({req}, {opt}) vs declared "
+                f"({derived.required}, {derived.optional})")
+
+
+# --- value codec -------------------------------------------------------------
+
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+_T_NONE = 0x4E        # 'N'
+_T_TRUE = 0x54        # 'T'
+_T_FALSE = 0x46       # 'F'
+_T_INT = 0x69         # 'i'  <q
+_T_BIGINT = 0x49      # 'I'  u32 len + ascii decimal
+_T_FLOAT = 0x66       # 'f'  <d
+_T_STR = 0x73         # 's'  u32 len + utf8
+_T_BYTES = 0x62       # 'b'  u32 len + raw
+_T_LIST = 0x6C        # 'l'  u32 count + values
+_T_DICT = 0x64        # 'd'  u32 count + (str key, value) pairs
+
+# value-nesting cap, both directions: far above anything a real message
+# carries, far below the interpreter recursion limit — a crafted
+# nested-list frame must fail as WireError (clean session drop), not
+# RecursionError (which would escape the MessageError contract)
+_MAX_DEPTH = 100
+
+
+def _key_bytes(k: str) -> bytes:
+    raw = k.encode()
+    if len(raw) > 0xFFFF:
+        raise WireError(f"dict key / field name too long "
+                        f"({len(raw)} bytes > u16)")
+    return raw
+
+
+def _enc_key(k) -> str:
+    # json.dumps key coercion, reproduced so decode output is
+    # indistinguishable from the JSON era
+    if isinstance(k, str):
+        return k
+    if k is True:
+        return "true"
+    if k is False:
+        return "false"
+    if k is None:
+        return "null"
+    if isinstance(k, (int, np.integer)):
+        return str(int(k))
+    if isinstance(k, float):
+        return repr(k)
+    raise WireError(f"unencodable dict key {k!r}")
+
+
+def _enc_value(out: bytearray, v: Any, depth: int = 0,
+               _pI64=_I64.pack, _pF64=_F64.pack, _pU16=_U16.pack,
+               _pU32=_U32.pack) -> None:
+    # exact-type dispatch first: this runs ~100x per message on the
+    # hot path, and type() checks beat isinstance chains for the
+    # overwhelmingly common int/str/list/dict cases (np scalars and
+    # subclasses fall through to the general chain below)
+    if depth > _MAX_DEPTH:
+        raise WireError("value nesting too deep")
+    t = type(v)
+    if t is int:
+        if _I64_MIN <= v <= _I64_MAX:
+            out.append(_T_INT)
+            out += _pI64(v)
+        else:
+            raw = str(v).encode()
+            out.append(_T_BIGINT)
+            out += _pU32(len(raw))
+            out += raw
+    elif t is str:
+        raw = v.encode()
+        out.append(_T_STR)
+        out += _pU32(len(raw))
+        out += raw
+    elif t is list or t is tuple:
+        out.append(_T_LIST)
+        out += _pU32(len(v))
+        for item in v:
+            _enc_value(out, item, depth + 1)
+    elif t is dict:
+        out.append(_T_DICT)
+        out += _pU32(len(v))
+        for k, item in v.items():
+            raw = _key_bytes(k if type(k) is str else _enc_key(k))
+            out += _pU16(len(raw))
+            out += raw
+            _enc_value(out, item, depth + 1)
+    elif v is None:
+        out.append(_T_NONE)
+    elif v is True:
+        out.append(_T_TRUE)
+    elif v is False:
+        out.append(_T_FALSE)
+    elif t is float:
+        out.append(_T_FLOAT)
+        out += _pF64(v)
+    elif isinstance(v, (int, np.integer)):
+        _enc_value(out, int(v))
+    elif isinstance(v, (float, np.floating)):
+        out.append(_T_FLOAT)
+        out += _pF64(float(v))
+    elif isinstance(v, str):
+        _enc_value(out, str(v))
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        raw = bytes(v)
+        out.append(_T_BYTES)
+        out += _pU32(len(raw))
+        out += raw
+    elif isinstance(v, (list, tuple)):
+        out.append(_T_LIST)
+        out += _pU32(len(v))
+        for item in v:
+            _enc_value(out, item, depth + 1)
+    elif isinstance(v, dict):
+        out.append(_T_DICT)
+        out += _pU32(len(v))
+        for k, item in v.items():
+            raw = _key_bytes(_enc_key(k))
+            out += _pU16(len(raw))
+            out += raw
+            _enc_value(out, item, depth + 1)
+    else:
+        raise WireError(f"unencodable field value of type "
+                        f"{type(v).__name__}: {v!r}")
+
+
+def _dec_value(buf, pos: int, depth: int = 0) -> "Tuple[Any, int]":
+    if depth > _MAX_DEPTH:
+        raise WireError("value nesting too deep")
+    try:
+        tag = buf[pos]
+    except IndexError:
+        raise WireError("truncated value")
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    try:
+        if tag == _T_INT:
+            return _I64.unpack_from(buf, pos)[0], pos + 8
+        if tag == _T_FLOAT:
+            return _F64.unpack_from(buf, pos)[0], pos + 8
+        if tag in (_T_BIGINT, _T_STR, _T_BYTES):
+            n, = _U32.unpack_from(buf, pos)
+            pos += 4
+            raw = bytes(buf[pos:pos + n])
+            if len(raw) != n:
+                raise WireError("truncated blob")
+            pos += n
+            if tag == _T_BYTES:
+                return raw, pos
+            if tag == _T_BIGINT:
+                return int(raw.decode()), pos
+            return raw.decode(), pos
+        if tag == _T_LIST:
+            n, = _U32.unpack_from(buf, pos)
+            pos += 4
+            out: "List[Any]" = []
+            for _ in range(n):
+                v, pos = _dec_value(buf, pos, depth + 1)
+                out.append(v)
+            return out, pos
+        if tag == _T_DICT:
+            n, = _U32.unpack_from(buf, pos)
+            pos += 4
+            d: "Dict[str, Any]" = {}
+            for _ in range(n):
+                klen, = _U16.unpack_from(buf, pos)
+                pos += 2
+                k = bytes(buf[pos:pos + klen]).decode()
+                pos += klen
+                v, pos = _dec_value(buf, pos, depth + 1)
+                d[k] = v
+            return d, pos
+    except (struct.error, UnicodeDecodeError, ValueError) as e:
+        raise WireError(f"bad value encoding: {e}")
+    raise WireError(f"unknown value tag 0x{tag:02x}")
+
+
+# --- header codec ------------------------------------------------------------
+
+_FIXED = struct.Struct("<BBBIHH")  # head_v, compat_v, prio, bitmap,
+#                                    n_optional, n_named
+
+
+def encode_header(cls, fields: "Dict[str, Any]",
+                  priority: int = 127) -> bytes:
+    """One message's header bytes: TYPE + versions + FIELDS-packed
+    payload (the json.dumps replacement)."""
+    spec = spec_for(cls)
+    out = bytearray()
+    tname = cls.TYPE.encode()
+    if len(tname) > 255:
+        raise WireError(f"wire type too long: {cls.TYPE!r}")
+    out.append(len(tname))
+    out += tname
+    bitmap = 0
+    req_vals = bytearray()
+    opt_vals = bytearray()
+    named_vals = bytearray()
+    n_opt = n_named = 0
+    for name, idx in spec.req_index.items():
+        if name in fields:
+            bitmap |= 1 << idx
+    for name, v in fields.items():
+        idx = spec.req_index.get(name)
+        if idx is not None:
+            continue        # packed positionally below
+        oidx = spec.opt_index.get(name)
+        if oidx is not None:
+            opt_vals += _U16.pack(oidx)
+            _enc_value(opt_vals, v)
+            n_opt += 1
+        else:
+            raw = _key_bytes(name)
+            named_vals += _U16.pack(len(raw))
+            named_vals += raw
+            _enc_value(named_vals, v)
+            n_named += 1
+    for idx, name in enumerate(spec.required):
+        if bitmap & (1 << idx):
+            _enc_value(req_vals, fields[name])
+    out += _FIXED.pack(cls.HEAD_VERSION & 0xFF,
+                       cls.COMPAT_VERSION & 0xFF,
+                       max(0, min(255, int(priority))),
+                       bitmap, n_opt, n_named)
+    out += req_vals
+    out += opt_vals
+    out += named_vals
+    return bytes(out)
+
+
+def decode_header(header) -> "Tuple[str, int, int, int, Dict[str, Any]]":
+    """-> (wire_type, head_version, compat_version, priority, fields).
+
+    The registry lookup and compat check stay in message.decode_message
+    — this parses the envelope for ANY type, so an unknown-type frame
+    still yields its type string for the error message."""
+    try:
+        tlen = header[0]
+        traw = bytes(header[1:1 + tlen])
+        if len(traw) != tlen:
+            raise WireError("truncated wire type")
+        wire_type = traw.decode()
+        pos = 1 + tlen
+        head_v, compat_v, prio, bitmap, n_opt, n_named = \
+            _FIXED.unpack_from(header, pos)
+        pos += _FIXED.size
+    except (IndexError, struct.error, UnicodeDecodeError) as e:
+        raise WireError(f"truncated wire header: {e}")
+    return wire_type, head_v, compat_v, prio, (
+        header, pos, bitmap, n_opt, n_named)
+
+
+def decode_fields(cls, state) -> "Dict[str, Any]":
+    """Finish decoding the field payload for a resolved class (the
+    second half of decode_header, split so the type/compat checks run
+    before any payload parsing)."""
+    header, pos, bitmap, n_opt, n_named = state
+    spec = spec_for(cls)
+    if bitmap & ~spec.full_mask:
+        raise WireError(
+            f"{spec.wire_type}: presence bitmap 0x{bitmap:x} names "
+            f"required fields this build does not declare")
+    fields: "Dict[str, Any]" = {}
+    for idx, name in enumerate(spec.required):
+        if bitmap & (1 << idx):
+            v, pos = _dec_value(header, pos)
+            fields[name] = v
+    for _ in range(n_opt):
+        try:
+            oidx, = _U16.unpack_from(header, pos)
+        except struct.error:
+            raise WireError("truncated optional TLV")
+        pos += 2
+        v, pos = _dec_value(header, pos)
+        if oidx < len(spec.optional):
+            fields[spec.optional[oidx]] = v
+        # else: appended by a newer revision — skipped, per the
+        # append-only optional-fields contract
+    for _ in range(n_named):
+        try:
+            nlen, = _U16.unpack_from(header, pos)
+        except struct.error:
+            raise WireError("truncated named TLV")
+        pos += 2
+        try:
+            name = bytes(header[pos:pos + nlen]).decode()
+        except UnicodeDecodeError as e:
+            raise WireError(f"bad named-TLV field name: {e}")
+        pos += nlen
+        v, pos = _dec_value(header, pos)
+        fields[name] = v
+    if pos != len(header):
+        raise WireError(
+            f"{spec.wire_type}: {len(header) - pos} trailing bytes "
+            f"after the last field")
+    return fields

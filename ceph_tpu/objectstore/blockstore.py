@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..common import sanitizer
+from ..common.buffer import BufferList, buffer_length
 from .store import NotFound, ObjectStore, StoreError
 from .types import Collection, ObjectId
 
@@ -689,22 +690,32 @@ class BlockStore(ObjectStore):
     def _touch(self, cid, oid) -> None:
         self._get(cid, oid, create=True)
 
-    def _write(self, cid, oid, off: int, data: bytes) -> None:
+    def _write(self, cid, oid, off: int, data) -> None:
+        """WAL-store data write, zero-copy: full aligned blocks pwrite
+        straight from the payload's backing segments (BufferList view /
+        ndarray slice — no staging buffer); only partial blocks
+        read-modify-write through a bounce buffer, which is inherent."""
         o = self._get(cid, oid, create=True)
-        data = bytes(data)
+        if not isinstance(data, BufferList):
+            data = BufferList(data) if buffer_length(data) else BufferList()
         end = off + len(data)
         pos = off
         while pos < end:
             blk = pos // AU
             boff = pos % AU
             n = min(AU - boff, end - pos)
+            chunk = data[pos - off: pos - off + n]
             if boff == 0 and n == AU:
-                block = data[pos - off: pos - off + AU]
+                block = chunk.to_array() if chunk.get_num_buffers() == 1 \
+                    else chunk.to_bytes()
             else:
                 old = o.blocks.get(blk)
                 base = bytearray(self._read_lba(old) if old is not None
                                  else b"\0" * AU)
-                base[boff:boff + n] = data[pos - off: pos - off + n]
+                bpos = boff
+                for mv in chunk.iovecs():
+                    base[bpos:bpos + len(mv)] = mv
+                    bpos += len(mv)
                 block = bytes(base)
             self._write_block(o, blk, block)
             pos += n

@@ -213,11 +213,13 @@ class FileStore(ObjectStore):
     def _touch(self, cid, oid) -> None:
         self._ensure_obj(cid.key(), oid.key())
 
-    def _write(self, cid, oid, off: int, data: bytes) -> None:
+    def _write(self, cid, oid, off: int, data) -> None:
         c, o = cid.key(), oid.key()
         pool = cid.pool
         size = self._ensure_obj(c, o)
         pos = off
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)       # BufferList / ndarray payloads
         remaining = memoryview(data)
         while len(remaining):
             blk, in_blk = divmod(pos, BLOCK)
@@ -281,7 +283,7 @@ class FileStore(ObjectStore):
         self._conn().execute(
             "INSERT INTO attrs (cid, oid, name, value) VALUES (?, ?, ?, ?) "
             "ON CONFLICT (cid, oid, name) DO UPDATE SET value=excluded.value",
-            (cid.key(), oid.key(), name, sqlite3.Binary(value)))
+            (cid.key(), oid.key(), name, sqlite3.Binary(bytes(value))))
 
     def _rmattr(self, cid, oid, name: str) -> None:
         self._obj_size(cid.key(), oid.key())

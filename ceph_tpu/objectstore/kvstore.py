@@ -189,8 +189,10 @@ class KVStore(ObjectStore):
         raw = self._get(self._d(cid, oid, blk))
         return bytearray(raw) if raw is not None else bytearray()
 
-    def _write(self, cid, oid, off: int, data: bytes) -> None:
+    def _write(self, cid, oid, off: int, data) -> None:
         onode = self._ensure(cid, oid)
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)       # BufferList / ndarray payloads
         pos, end = off, off + len(data)
         while pos < end:
             blk, boff = divmod(pos, BLOCK)
@@ -249,9 +251,9 @@ class KVStore(ObjectStore):
                 if val is not None:
                     self._put(dprefix + key[len(prefix):], val)
 
-    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
+    def _setattr(self, cid, oid, name: str, value) -> None:
         self._ensure(cid, oid)
-        self._put(self._a(cid, oid, name), value)
+        self._put(self._a(cid, oid, name), bytes(value))
 
     def _rmattr(self, cid, oid, name: str) -> None:
         self._del(self._a(cid, oid, name))

@@ -10,6 +10,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..common.buffer import buffer_length, buffer_views
 from .store import NotFound, ObjectStore, StoreError
 from .types import Collection, ObjectId
 
@@ -36,6 +37,7 @@ class MemStore(ObjectStore):
         self._colls: "Dict[Collection, Dict[ObjectId, _Obj]]" = {}
         self._mounted = False
         self._undo: "Optional[list]" = None
+        self._saved: "Optional[set]" = None
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -52,17 +54,29 @@ class MemStore(ObjectStore):
 
     def _txn_begin(self) -> None:
         self._undo = []
+        self._saved = set()
 
     def _txn_commit(self) -> None:
         self._undo = None
+        self._saved = None
 
     def _txn_rollback(self) -> None:
         assert self._undo is not None
         for action in reversed(self._undo):
             action()
         self._undo = None
+        self._saved = None
 
     def _save_obj(self, cid: Collection, oid: ObjectId) -> None:
+        # one rollback snapshot per object PER TXN: the first snapshot
+        # is the pre-txn state rollback needs; re-copying on every op
+        # of a multi-op transaction (touch + omap + writes on the same
+        # object) is pure waste — the PG meta object's omap alone holds
+        # one key per log entry, so a per-op copy is O(log length)
+        key = (cid, oid)
+        if key in self._saved:
+            return
+        self._saved.add(key)
         coll = self._colls.get(cid)
         if coll is None:
             return
@@ -126,12 +140,17 @@ class MemStore(ObjectStore):
     def _touch(self, cid, oid) -> None:
         self._mutate(cid, oid, create=True)
 
-    def _write(self, cid, oid, off: int, data: bytes) -> None:
+    def _write(self, cid, oid, off: int, data) -> None:
+        # consumes BufferList/ndarray segments directly: ONE copy, into
+        # the store's own bytearray (the medium) — never a staging copy
         obj = self._mutate(cid, oid, create=True)
-        end = off + len(data)
+        end = off + buffer_length(data)
         if len(obj.data) < end:
             obj.data.extend(b"\x00" * (end - len(obj.data)))
-        obj.data[off:end] = data
+        pos = off
+        for mv in buffer_views(data):
+            obj.data[pos:pos + len(mv)] = mv
+            pos += len(mv)
 
     def _zero(self, cid, oid, off: int, length: int) -> None:
         self._write(cid, oid, off, b"\x00" * length)
@@ -157,8 +176,8 @@ class MemStore(ObjectStore):
         self._save_obj(cid, dst)
         coll[dst] = coll[src].copy()
 
-    def _setattr(self, cid, oid, name: str, value: bytes) -> None:
-        self._mutate(cid, oid, create=True).attrs[name] = value
+    def _setattr(self, cid, oid, name: str, value) -> None:
+        self._mutate(cid, oid, create=True).attrs[name] = bytes(value)
 
     def _rmattr(self, cid, oid, name: str) -> None:
         obj = self._mutate(cid, oid)

@@ -154,7 +154,10 @@ class ObjectStore:
         if kind == OP_TOUCH:
             return self._touch(cid, oid)
         if kind == OP_WRITE:
-            return self._write(cid, oid, op["off"], Transaction.op_bytes(op))
+            # the payload buffer flows through un-materialized; each
+            # backend copies once, into its own medium
+            return self._write(cid, oid, op["off"],
+                               Transaction.op_buffer(op))
         if kind == OP_ZERO:
             return self._zero(cid, oid, op["off"], op["len"])
         if kind == OP_TRUNCATE:
@@ -174,8 +177,7 @@ class ObjectStore:
         if kind == OP_RMATTR:
             return self._rmattr(cid, oid, op["name"])
         if kind == OP_OMAP_SETKEYS:
-            return self._omap_set(cid, oid, {
-                k: bytes.fromhex(v) for k, v in op["kv"].items()})
+            return self._omap_set(cid, oid, dict(op["kv"]))
         if kind == OP_OMAP_RMKEYS:
             return self._omap_rm(cid, oid, op["keys"])
         if kind == OP_OMAP_CLEAR:

@@ -1,9 +1,15 @@
 """Transaction — ordered atomic mutation batch (src/os/ObjectStore.h:768's
 Transaction, the ops the OSD data path actually uses).
 
-Serializable: ECSubWrite ships a per-shard transaction over the wire
-(reference ECMsgTypes.h:23-38), so every op encodes to plain JSON-able
-structures (buffers as bytes, hex-packed).
+Zero-copy discipline (ROADMAP item 1): write/setattr payloads stay the
+caller's buffers — ``BufferList`` segments, numpy views, or bytes — all
+the way into the backend's block/bytearray write.  The old hex-in-JSON
+packing copied AND doubled every payload on every store apply; it
+survives only in ``encode()``/``decode()``, the offline tool/QA
+serialization format (objectstore_tool, test fixtures), never on the
+data path — ECSubWrite ships shard transactions as (offset, length)
+tables over the message's BufferList data segment instead
+(reference ECMsgTypes.h:23-38).
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ..common.buffer import BufferList
 from .types import Collection, ObjectId
 
 # Op codes (names after the reference's Transaction::Op enum).
@@ -70,8 +77,10 @@ class Transaction:
 
     def write(self, cid: Collection, oid: ObjectId, off: int,
               data) -> "Transaction":
+        # payload stays the caller's buffer (BufferList / ndarray /
+        # bytes) — materialized only by the backend's medium write
         self.ops.append({"op": OP_WRITE, "cid": cid.key(), "oid": oid.key(),
-                         "off": int(off), "data": _b2h(data)})
+                         "off": int(off), "data": data})
         return self
 
     def zero(self, cid: Collection, oid: ObjectId, off: int,
@@ -109,7 +118,7 @@ class Transaction:
     def setattr(self, cid: Collection, oid: ObjectId, name: str,
                 value) -> "Transaction":
         self.ops.append({"op": OP_SETATTR, "cid": cid.key(),
-                         "oid": oid.key(), "name": name, "value": _b2h(value)})
+                         "oid": oid.key(), "name": name, "value": value})
         return self
 
     def rmattr(self, cid: Collection, oid: ObjectId,
@@ -122,7 +131,7 @@ class Transaction:
                      kv: "dict[str, bytes]") -> "Transaction":
         self.ops.append({"op": OP_OMAP_SETKEYS, "cid": cid.key(),
                          "oid": oid.key(),
-                         "kv": {k: _b2h(v) for k, v in kv.items()}})
+                         "kv": {k: bytes(v) for k, v in kv.items()}})
         return self
 
     def omap_rmkeys(self, cid: Collection, oid: ObjectId,
@@ -143,14 +152,48 @@ class Transaction:
         return self
 
     def encode(self) -> bytes:
-        return json.dumps(self.ops).encode()
+        """Offline serialization (objectstore_tool / QA fixtures):
+        buffers hex-pack here, and ONLY here — the data path never
+        encodes transactions to JSON."""
+        out = []
+        for op in self.ops:
+            rec = dict(op)
+            if "data" in rec:
+                rec["data"] = _b2h(rec["data"])
+            if "value" in rec:
+                rec["value"] = _b2h(rec["value"])
+            if "kv" in rec:
+                rec["kv"] = {k: _b2h(v) for k, v in rec["kv"].items()}
+            out.append(rec)
+        return json.dumps(out).encode()
 
     @classmethod
     def decode(cls, payload: bytes) -> "Transaction":
         t = cls()
-        t.ops = json.loads(payload.decode())
+        for rec in json.loads(bytes(payload).decode()):
+            if "data" in rec:
+                rec["data"] = _h2b(rec["data"])
+            if "value" in rec:
+                rec["value"] = _h2b(rec["value"])
+            if "kv" in rec:
+                rec["kv"] = {k: _h2b(v) for k, v in rec["kv"].items()}
+            t.ops.append(rec)
         return t
 
     @staticmethod
+    def op_buffer(op: dict) -> "BufferList | bytes | np.ndarray":
+        """The op's payload buffer, un-materialized."""
+        buf = op.get("data")
+        if buf is None:
+            buf = op.get("value")
+        return b"" if buf is None else buf
+
+    @staticmethod
     def op_bytes(op: dict) -> bytes:
-        return _h2b(op.get("data") or op.get("value") or "")
+        """Materialized payload bytes (attr values, tool paths)."""
+        buf = Transaction.op_buffer(op)
+        if isinstance(buf, BufferList):
+            return buf.to_bytes()
+        if isinstance(buf, np.ndarray):
+            return np.ascontiguousarray(buf, dtype=np.uint8).tobytes()
+        return bytes(buf)

@@ -23,7 +23,9 @@ import numpy as np
 
 from ..common.config import Config
 from ..common.log import dout
-from ..common.perf_counters import (PerfCounters, PerfCountersBuilder,
+from ..common import buffer as buffer_mod
+from ..common.perf_counters import (ExternalCounters, PerfCounters,
+                                    PerfCountersBuilder,
                                     PerfCountersCollection)
 from ..ec.registry import factory_from_profile
 from ..msg.message import Message
@@ -178,6 +180,19 @@ class OSDDaemon(Dispatcher):
         from ..ops.profiler import KernelProfiler
         self.profiler = KernelProfiler()
         self.perf_coll.add(self.profiler.counters)
+        # zero-copy honesty meter (PR 7): every byte a BufferList
+        # materializes (to_bytes / rebuild / multi-segment to_array)
+        # plus the crc segment-cache hit rate.  Process-wide: co-hosted
+        # daemons report the same numbers, like the encode service.
+        self.perf_coll.add(ExternalCounters(
+            "buffer", buffer_mod.STATS,
+            {"bytes_copied": "bulk bytes materialized into fresh "
+                             "contiguous buffers (the copies the "
+                             "zero-copy wire path eliminates)",
+             "copy_calls": "materialization events",
+             "crc_cache_hits": "per-raw cached crc32c lookups served",
+             "crc_cache_misses": "crc32c computed fresh"},
+            unit="bytes"))
         self.encode_service.profiler = self.profiler
         # cephx ticket validation (rotating secrets arrive from the mon
         # at boot / lazily on unknown generations; static-mode harnesses
@@ -477,8 +492,7 @@ class OSDDaemon(Dispatcher):
                 kv = self.store.omap_get(c, ObjectId(PGMETA_OID))
             except NotFound:
                 kv = {}
-            pg_log = (PGLog.from_dict(json.loads(kv["pglog"].decode()))
-                      if "pglog" in kv else PGLog())
+            pg_log = PGLog.from_omap(kv) or PGLog()
             try:
                 missing_raw = (json.loads(kv["missing"].decode())
                                if "missing" in kv else {})
@@ -555,7 +569,9 @@ class OSDDaemon(Dispatcher):
 
             def meta_kv(pg: int) -> "Dict[str, bytes]":
                 return {
-                    "pglog": json.dumps(fresh.to_dict()).encode(),
+                    # fresh empty log -> constant-size pgmeta record,
+                    # no per-entry keys (PGLog incremental layout)
+                    "pgmeta": json.dumps(fresh.meta_dict()).encode(),
                     "missing": json.dumps(
                         by_pg.get(pg, {})).encode(),
                     "gap_from": json.dumps(None).encode(),
@@ -565,10 +581,24 @@ class OSDDaemon(Dispatcher):
                     # consulted where it is correct
                     "reqids": json.dumps(reqids).encode(),
                 }
+
+            def clear_stale_log(coll, have: "Dict[str, bytes]") -> None:
+                # the fresh log replaces whatever was persisted: stale
+                # per-entry keys (or the legacy blob) must not linger
+                # for from_omap to resurrect
+                stale = [k for k in have if PGLog.is_log_key(k)]
+                if stale:
+                    t.omap_rmkeys(coll, ObjectId(PGMETA_OID), stale)
             t.touch(c, ObjectId(PGMETA_OID))
+            clear_stale_log(c, kv)
             t.omap_setkeys(c, ObjectId(PGMETA_OID), meta_kv(c.pg))
             for dst in touched:
                 t.touch(dst, ObjectId(PGMETA_OID))
+                try:
+                    clear_stale_log(dst, self.store.omap_get(
+                        dst, ObjectId(PGMETA_OID)))
+                except NotFound:
+                    pass
                 t.omap_setkeys(dst, ObjectId(PGMETA_OID),
                                meta_kv(dst.pg))
             self.store.apply_transaction(t)
@@ -1207,6 +1237,7 @@ class OSDDaemon(Dispatcher):
         where cls methods run under the PG lock.  Replayed calls (client
         retries) return the cached result instead of re-executing."""
         from ..cls import ClsContext, registry
+        payload = bytes(payload)   # cls methods take materialized bytes
         fn, _flags = registry().lookup(cls, method)
         key = f"{reqid}/{cls}.{method}" if reqid else ""
         if key and key in be.completed_cls:
@@ -1938,7 +1969,7 @@ class OSDDaemon(Dispatcher):
                     payload = msg.data[doff:doff + dlen]
                     doff += dlen
                     kv = {k: bytes.fromhex(v) for k, v in
-                          json.loads(payload.decode()).items()}
+                          json.loads(bytes(payload).decode()).items()}
                     mutations.append(ClientOp("omap_set", kv=kv))
                 elif name == "omap_rm":
                     mutations.append(ClientOp(

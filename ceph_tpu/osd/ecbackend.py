@@ -35,9 +35,11 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
 
 import numpy as np
 
+from ..common.buffer import (BufferList, as_u8_array, buffer_length,
+                             concat_u8)
 from ..common.log import dout
 from ..ec.interface import ErasureCodeError, ErasureCodeInterface
-from ..objectstore.store import NotFound, ObjectStore
+from ..objectstore.store import NotFound, ObjectStore, StoreError
 from ..ops import profiler as profiler_mod
 from ..objectstore.transaction import Transaction
 from ..objectstore.types import Collection, NO_GEN, ObjectId
@@ -415,9 +417,9 @@ class ECBackend:
                     kv = self.store.omap_get(c, ObjectId(PGMETA_OID))
                 except NotFound:
                     continue
-                if "pglog" in kv:
-                    self.pg_log = PGLog.from_dict(
-                        json.loads(kv["pglog"].decode()))
+                loaded = PGLog.from_omap(kv)
+                if loaded is not None:
+                    self.pg_log = loaded
                     # seed retry dedup from the persisted log: a client
                     # whose ack died with the old primary must get its
                     # committed version back, not a second apply
@@ -447,23 +449,55 @@ class ECBackend:
                 return
 
     def _pg_meta_txn(self, t: Transaction, cid: Collection) -> None:
-        t.touch(cid, ObjectId(PGMETA_OID))
-        t.omap_setkeys(cid, ObjectId(PGMETA_OID), {
-            "pglog": json.dumps(self.pg_log.to_dict()).encode(),
+        """Persist PG metadata: constant-size head/missing records plus
+        the log DELTA — one omap key per entry (PGLog.persist_delta),
+        so the per-op write path no longer re-serializes the whole log
+        (the old single-blob scheme was O(log length) per sub-write
+        and dominated the saturated host profile)."""
+        meta_oid = ObjectId(PGMETA_OID)
+        t.touch(cid, meta_oid)
+        set_kv, rm_keys, full = self.pg_log.persist_delta()
+        if full:
+            # wholesale replacement (fresh/adopted/loaded log): clear
+            # every on-disk log key the new set doesn't cover, plus
+            # the legacy whole-log blob
+            try:
+                old = self.store.omap_get(cid, meta_oid)
+            except (NotFound, StoreError):
+                old = {}
+            rm_keys = [k for k in old
+                       if PGLog.is_log_key(k) and k not in set_kv]
+        if rm_keys:
+            t.omap_rmkeys(cid, meta_oid, rm_keys)
+        t.omap_setkeys(cid, meta_oid, {
+            "pgmeta": json.dumps(self.pg_log.meta_dict()).encode(),
             "missing": json.dumps({o: list(v) for o, v in
                                    self.local_missing.items()}).encode(),
             "gap_from": json.dumps(
                 list(self.log_gap_from) if self.log_gap_from
                 else None).encode(),
-            "peered_epoch": json.dumps(self.peered_epoch).encode()})
+            "peered_epoch": json.dumps(self.peered_epoch).encode(),
+            **set_kv})
+
+    def _apply_pg_meta(self, t: Transaction, cid: Collection) -> None:
+        """Append the PG meta ops and apply the transaction.  On a
+        failed apply the log's consumed persist_delta() would be lost
+        (built into a transaction that never landed), so re-arm a
+        wholesale rewrite before re-raising — the next successful
+        persist writes every entry key again."""
+        self._pg_meta_txn(t, cid)
+        try:
+            self.store.apply_transaction(t)
+        except BaseException:
+            self.pg_log.mark_full_rewrite()
+            raise
 
     def _persist_pg_meta(self, shard: int) -> None:
         cid = self.coll(shard)
         t = Transaction()
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
-        self._pg_meta_txn(t, cid)
-        self.store.apply_transaction(t)
+        self._apply_pg_meta(t, cid)
 
     # ------------------------------------------------------------- hit sets
 
@@ -789,17 +823,21 @@ class ECBackend:
         op.oi = self._projected_oi(op.oid)
         size = op.oi.size
         for cop in op.ops:
+            # write payloads stay the client's buffers (BufferList
+            # views over the received frame / bytes) — materialized
+            # only by the stripe assembly, and not even there on the
+            # aligned full-stripe fast path
             if cop.op == "write":
-                op.writes.append((cop.off, bytes(cop.data)))
-                size = max(size, cop.off + len(cop.data))
+                op.writes.append((cop.off, cop.data))
+                size = max(size, cop.off + buffer_length(cop.data))
             elif cop.op == "append":
-                op.writes.append((size, bytes(cop.data)))
-                size += len(cop.data)
+                op.writes.append((size, cop.data))
+                size += buffer_length(cop.data)
             elif cop.op == "write_full":
-                op.truncate_to = len(cop.data)
-                op.writes = [(0, bytes(cop.data))]
+                op.truncate_to = buffer_length(cop.data)
+                op.writes = [(0, cop.data)]
                 op.rewrite = True
-                size = len(cop.data)
+                size = buffer_length(cop.data)
             elif cop.op == "truncate":
                 op.truncate_to = cop.off
                 size = cop.off
@@ -829,7 +867,7 @@ class ECBackend:
                                 invalidates_cache=True)
         else:
             op.plan = get_write_plan(
-                self.sinfo, [(o, len(d)) for o, d in op.writes],
+                self.sinfo, [(o, buffer_length(d)) for o, d in op.writes],
                 op.oi.size, op.truncate_to)
         # projections carry the snap lineage: a pipelined successor
         # must see this op's COW as done (or it would re-clone over the
@@ -970,9 +1008,23 @@ class ECBackend:
 
     def _materialize_stripes(self, op: Op) -> "Dict[int, np.ndarray]":
         """Merge old RMW stripes with new write payloads into full
-        stripe-aligned buffers per will_write extent."""
+        stripe-aligned buffers per will_write extent.
+
+        Fast path (the bulk-write common case — aligned full-stripe
+        writes): a single payload exactly covering the extent with no
+        RMW reads is used AS the stripe buffer, zero-copy — a
+        single-segment BufferList's array view goes straight into the
+        encode (split_to_shards is a reshape, not a copy).  Only
+        genuine read-modify-write merges stage through a fresh
+        buffer, which is inherent to RMW."""
+        writes = [(woff, as_u8_array(wdata)) for woff, wdata in op.writes]
         out: "Dict[int, np.ndarray]" = {}
         for off, length in op.plan.will_write:
+            if not op.read_data and len(writes) == 1 \
+                    and writes[0][0] == off \
+                    and writes[0][1].size == length:
+                out[off] = writes[0][1]
+                continue
             buf = np.zeros(length, dtype=np.uint8)
             for ooff, odata in op.read_data.items():
                 lo, hi = max(off, ooff), min(off + length,
@@ -980,9 +1032,10 @@ class ECBackend:
                 if hi > lo:
                     buf[lo - off:hi - off] = odata[lo - ooff:hi - ooff]
             out[off] = buf
-        for woff, wdata in op.writes:
-            arr = np.frombuffer(wdata, dtype=np.uint8)
+        for woff, arr in writes:
             for off, buf in out.items():
+                if buf is arr:
+                    continue        # fast-path extent: already the payload
                 lo, hi = max(off, woff), min(off + buf.size,
                                              woff + arr.size)
                 if hi > lo:
@@ -1083,9 +1136,7 @@ class ECBackend:
                     # handle for plane-sharing shard servers (reference
                     # fan-out seam ECBackend.cc:2074-2084)
                     try:
-                        arr8 = (np.frombuffer(bytes(buf), np.uint8)
-                                if not isinstance(buf, np.ndarray)
-                                else buf.reshape(-1))
+                        arr8 = as_u8_array(buf)
                         shards_k = self.sinfo.split_to_shards(arr8)
                         # off-loop: the crc fetch inside encode() blocks
                         # on the device; other PG pipelines keep running
@@ -1161,8 +1212,10 @@ class ECBackend:
                 else:
                     hinfo.invalidate()
                 for shard, chunk in shards.items():
-                    shard_txns[shard]["writes"].append(
-                        (chunk_off, bytes(chunk.tobytes())))
+                    # chunk rides as the device-encode output array —
+                    # pack_buffers adopts it into the sub-write's
+                    # BufferList data segment without a bytes round-trip
+                    shard_txns[shard]["writes"].append((chunk_off, chunk))
                 self.extent_cache.present_rmw_update(op.oid, off, buf)
                 op.pinned.append((off, int(np.size(buf))))
             if not stripes and (op.truncate_to is not None or op.writes):
@@ -1514,8 +1567,10 @@ class ECBackend:
 
         # snapshot the in-memory log: if the store apply fails below, the
         # log must not claim the entry was applied (a log ahead of the
-        # data would let peering elect a head no shard's bytes back)
-        log_snapshot = self.pg_log.to_dict()
+        # data would let peering elect a head no shard's bytes back).
+        # clone() shares entry objects — O(n) pointers, not a per-op
+        # full-log serialization
+        log_snapshot = self.pg_log.clone()
         gap_snapshot = self.log_gap_from
         for e in entries:
             if e.version > self.pg_log.head:
@@ -1553,7 +1608,7 @@ class ECBackend:
                 # CONTAIN these entries (the encode path reserves its
                 # version in the log synchronously), so drop them
                 # explicitly after the restore.
-                restored = PGLog.from_dict(log_snapshot)
+                restored = log_snapshot
                 mine = {e.version for e in entries}
                 restored.entries = [e for e in restored.entries
                                     if e.version not in mine]
@@ -1566,7 +1621,11 @@ class ECBackend:
                 # durability wait: a snapshot restore would wipe ITS
                 # entry too.  Leave the log and record our objects
                 # missing on this shard — peering repairs them, the
-                # committed=False reply keeps the primary honest.
+                # committed=False reply keeps the primary honest.  The
+                # kept log's persist delta died with this txn, so the
+                # next persist must rewrite wholesale (the snapshot
+                # branch gets this for free: clones are _dirty_full).
+                self.pg_log.mark_full_rewrite()
                 for e in entries:
                     self.local_missing[e.oid] = tuple(e.version)
             raise
@@ -2129,8 +2188,9 @@ class ECBackend:
             parts = [by_off[o] for o in sorted(by_off)
                      if coff <= o < coff + clen]
             if parts:
-                buf = b"".join(parts)[:clen].ljust(clen, b"\0")
-                shards[shard] = np.frombuffer(buf, dtype=np.uint8)
+                # received BufferList slices stack straight into the
+                # decode input; a single exact-fit chunk is a view
+                shards[shard] = concat_u8(parts, clen)
         missing = sum(1 for s in range(self.k) if s not in shards)
         bm, gm = profiler_mod.decode_cost(
             len(shards), missing, clen)
@@ -2222,9 +2282,8 @@ class ECBackend:
             # helpers served sub-chunk repair planes, not whole chunks:
             # hand the partial buffers plus the true chunk size to the
             # codec's repair decode (clay reads ~1/q of each helper)
-            arrs = {s: np.frombuffer(
-                b"".join(bo[o] for o in sorted(bo)), dtype=np.uint8)
-                for s, bo in shard_bufs.items()}
+            arrs = {s: concat_u8([bo[o] for o in sorted(bo)])
+                    for s, bo in shard_bufs.items()}
             bm, gm = profiler_mod.decode_cost(
                 len(arrs), len(rop.missing_on), full_size)
             with self.profiler.measure("decode", bm, gm):
@@ -2232,11 +2291,9 @@ class ECBackend:
                                         sorted(rop.missing_on),
                                         chunk_size=full_size)
         else:
-            arrs = {}
-            for shard, by_off in shard_bufs.items():
-                buf = b"".join(by_off[o] for o in sorted(by_off))
-                arrs[shard] = np.frombuffer(buf.ljust(csize, b"\0"),
-                                            dtype=np.uint8)
+            arrs = {shard: concat_u8([by_off[o] for o in sorted(by_off)],
+                                     csize)
+                    for shard, by_off in shard_bufs.items()}
             if (self._mesh_usable() and csize % 4 == 0
                     and len(arrs) >= self.k):
                 # recovery decode on the mesh: all-gather survivors
@@ -2300,9 +2357,8 @@ class ECBackend:
         if csize == 0:
             return
         full_size = max(read.sizes.get(oid, {}).values(), default=csize)
-        arrs = {s: np.frombuffer(
-            b"".join(bo[o] for o in sorted(bo)), dtype=np.uint8)
-            for s, bo in shard_bufs.items()}
+        arrs = {s: concat_u8([bo[o] for o in sorted(bo)])
+                for s, bo in shard_bufs.items()}
         if 0 < csize < full_size and len(
                 {a.size for a in arrs.values()}) == 1:
             # helpers served sub-chunk repair planes (clay): pass the
@@ -2311,10 +2367,8 @@ class ECBackend:
                                     sorted(missing_on),
                                     chunk_size=full_size)
         else:
-            arrs = {s: np.frombuffer(
-                b"".join(bo[o] for o in sorted(bo))
-                .ljust(csize, b"\0"), dtype=np.uint8)
-                for s, bo in shard_bufs.items()}
+            arrs = {s: concat_u8([bo[o] for o in sorted(bo)], csize)
+                    for s, bo in shard_bufs.items()}
             decoded = ecutil.decode(self.sinfo, self.codec, arrs,
                                     sorted(missing_on))
         cid = self.coll(self.my_shard)
@@ -2409,8 +2463,7 @@ class ECBackend:
         # push must not (the head may still be absent here)
         if int(msg.get("gen", NO_GEN)) == NO_GEN:
             self.local_missing.pop(msg["oid"], None)
-        self._pg_meta_txn(t, cid)
-        self.store.apply_transaction(t)
+        self._apply_pg_meta(t, cid)
         return MOSDPGPushReply({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -2550,8 +2603,7 @@ class ECBackend:
                 self.completed_reqids[e.reqid] = e.version
         self.local_missing = missing
         self.log_gap_from = None
-        self._pg_meta_txn(t, cid)
-        self.store.apply_transaction(t)
+        self._apply_pg_meta(t, cid)
         return MPGLogAck({
             "pgid": list(self.pgid), "shard": shard,
             "from_osd": self.whoami, "tid": int(msg["tid"]),
@@ -2625,8 +2677,7 @@ class ECBackend:
                 newer = [e.version for e in self.pg_log.entries
                          if e.oid == oid]
                 self.local_missing[oid] = max(newer) if newer else to
-        self._pg_meta_txn(t, cid)
-        self.store.apply_transaction(t)
+        self._apply_pg_meta(t, cid)
 
     def _rollback_entry(self, t: Transaction, cid: Collection, shard: int,
                         e: LogEntry) -> None:

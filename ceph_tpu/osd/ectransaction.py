@@ -50,10 +50,11 @@ def get_write_plan(sinfo: StripeInfo, writes: "Iterable[Extent]",
     """Plan RMW for a set of logical write extents on an object of
     ``orig_size`` bytes.
 
-    A stripe needs reading iff the union of writes covers it only
-    partially AND it intersects existing data ([0, orig_size) rounded out
-    to stripes).  Head/tail-only in practice, but computed per overlapped
-    stripe so multi-extent ops plan correctly.
+    A stripe needs reading iff it holds existing data that SURVIVES the
+    op (below both orig_size and any truncate_to — a truncating rewrite
+    like write_full discards every old byte and reads nothing) and the
+    writes don't cover all of it.  Head/tail-only in practice, but
+    computed per overlapped stripe so multi-extent ops plan correctly.
     """
     sw = sinfo.stripe_width
     writes = _merge_extents(writes)
@@ -65,20 +66,29 @@ def get_write_plan(sinfo: StripeInfo, writes: "Iterable[Extent]",
     if truncate_to is not None and truncate_to < orig_size:
         plan.invalidates_cache = True
 
-    aligned_orig = sinfo.logical_to_next_stripe_offset(orig_size)
+    # old bytes at/above truncate_to never reach the final object state
+    # (whether the truncate conceptually runs before or after the
+    # writes), so only [0, old_hi) can force an RMW read
+    old_hi = orig_size if truncate_to is None \
+        else min(orig_size, truncate_to)
+    aligned_orig = sinfo.logical_to_next_stripe_offset(old_hi)
     to_read: "list[Extent]" = []
     will_write: "list[Extent]" = []
     for off, length in writes:
         start, span = sinfo.offset_len_to_stripe_bounds(off, length)
         will_write.append((start, span))
         for stripe_off in range(start, start + span, sw):
-            covered = _covered_in(writes, stripe_off, sw)
-            if covered >= sw:
-                continue  # full-stripe write: pure encode, no read
-            if stripe_off < aligned_orig:
-                # partial stripe with existing data: read it (clamped to
-                # existing stripes; bytes past orig_size decode as zeros)
-                to_read.append((stripe_off, sw))
+            if stripe_off >= aligned_orig:
+                continue  # no surviving old data this far out
+            # surviving old bytes in this stripe: [stripe_off,
+            # stripe_off + old_win); read only if the writes leave any
+            # of them uncovered
+            old_win = min(sw, old_hi - stripe_off)
+            if _covered_in(writes, stripe_off, old_win) >= old_win:
+                continue  # every surviving old byte is overwritten
+            # partial stripe with existing data: read it (clamped to
+            # existing stripes; bytes past orig_size decode as zeros)
+            to_read.append((stripe_off, sw))
     plan.to_read = _merge_extents(to_read)
     plan.will_write = _merge_extents(will_write)
     return plan

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.buffer import BufferList, buffer_length
 from ..msg.message import Message, register_message
 
 # Wire errno values carried in MOSDOpReply.result — fixed Linux numbers
@@ -28,12 +29,26 @@ from ..msg.message import Message, register_message
 EIO, ENOENT, ESTALE, EACCES, EFBIG = 5, 2, 116, 13, 27
 
 
-def pack_buffers(bufs: "List[bytes]") -> "Tuple[List[int], bytes]":
-    """Pack buffers into one data segment; returns (lengths, blob)."""
-    return [len(b) for b in bufs], b"".join(bytes(b) for b in bufs)
+def pack_buffers(bufs) -> "Tuple[List[int], BufferList]":
+    """Pack buffers into one data segment; returns (lengths, blob).
+
+    Zero-copy: each buffer (ndarray encode output, BufferList slice,
+    bytes) is ADOPTED as a segment of the message's BufferList data —
+    no concatenation.  The frame builder exports the segments as
+    iovecs, so shard chunks go device-output -> socket buffer with no
+    intermediate materialization."""
+    lens: "List[int]" = []
+    bl = BufferList()
+    for b in bufs:
+        lens.append(buffer_length(b))
+        bl.append(b)
+    return lens, bl
 
 
-def unpack_buffers(lengths: "List[int]", blob: bytes) -> "List[bytes]":
+def unpack_buffers(lengths: "List[int]", blob) -> "List":
+    """Inverse: slice ``blob`` back into per-buffer views.  A
+    BufferList blob yields zero-copy ``substr`` slices (the receive
+    path); a bytes blob yields bytes slices (offline/QA fixtures)."""
     out, off = [], 0
     for n in lengths:
         out.append(blob[off:off + n])

@@ -134,6 +134,16 @@ class OSDMap:
         self.crush = CrushMap()
         self.pg_temp: "Dict[str, List[int]]" = {}  # "pool.pg" -> acting
         self.next_pool_id = 1
+        # placement cache: (pool, pg) -> up set.  CRUSH straw2 costs
+        # ~0.7ms per PG in Python and the data path asks for the same
+        # mapping on every send — cached until anything that feeds the
+        # computation (epoch/states/weights/pools/crush) changes; every
+        # mutator calls _placement_reset(), remote updates arrive only
+        # through load_dict()
+        self._pcache: "Dict[Tuple[int, int], List[int]]" = {}
+
+    def _placement_reset(self) -> None:
+        self._pcache.clear()
 
     # --- lookup ---------------------------------------------------------------
 
@@ -180,6 +190,9 @@ class OSDMap:
         return out
 
     def pg_to_raw_up(self, pool_id: int, pg: int) -> "List[int]":
+        hit = self._pcache.get((pool_id, pg))
+        if hit is not None:
+            return list(hit)
         pool = self.get_pool(pool_id)
         raw = self.crush.do_rule(pool.crush_rule,
                                  self._pg_seed(pool_id, pg),
@@ -192,6 +205,7 @@ class OSDMap:
         # selection (first non-hole) gives the same answer either way.
         up = [o if self.is_up(o) else NONE_OSD for o in raw]
         up += [NONE_OSD] * (pool.size - len(up))
+        self._pcache[(pool_id, pg)] = list(up)
         return up
 
     def pg_to_up_acting_osds(self, pool_id: int,
@@ -223,12 +237,14 @@ class OSDMap:
 
     def bump(self) -> None:
         self.epoch += 1
+        self._placement_reset()
 
     def add_osd(self, osd_id: int, weight: float = 1.0,
                 host: "Optional[str]" = None,
                 device_class: "Optional[str]" = None) -> None:
         if osd_id in self.osds:
             raise KeyError(f"osd.{osd_id} exists")
+        self._placement_reset()
         self.osds[osd_id] = OsdInfo(osd_id)
         hostname = host or f"host{osd_id}"
         try:
@@ -238,25 +254,30 @@ class OSDMap:
         self.crush.add_device(osd_id, weight, hostname, device_class)
 
     def mark_up(self, osd_id: int, addr: str) -> None:
+        self._placement_reset()
         info = self.osds[osd_id]
         info.up = True
         info.addr = addr
         info.up_from = self.epoch + 1
 
     def mark_down(self, osd_id: int) -> None:
+        self._placement_reset()
         info = self.osds[osd_id]
         info.up = False
         info.down_at = self.epoch + 1
 
     def mark_out(self, osd_id: int) -> None:
+        self._placement_reset()
         self.osds[osd_id].in_cluster = False
 
     def mark_in(self, osd_id: int) -> None:
+        self._placement_reset()
         self.osds[osd_id].in_cluster = True
 
     def create_pool(self, name: str, **kwargs) -> Pool:
         if self.pool_by_name(name) is not None:
             raise KeyError(f"pool {name!r} exists")
+        self._placement_reset()
         pool = Pool(self.next_pool_id, name, **kwargs)
         self.pools[pool.pool_id] = pool
         self.next_pool_id += 1
@@ -296,6 +317,7 @@ class OSDMap:
         holder of this OSDMap instance (Objecter, OSD backends) sees the
         new epoch (the reference swaps a shared OSDMapRef similarly)."""
         m = OSDMap.from_dict(d)
+        self._placement_reset()
         self.epoch = m.epoch
         self.fsid = m.fsid
         self.osds = m.osds

@@ -15,6 +15,8 @@ lexicographically.
 
 from __future__ import annotations
 
+import json
+from bisect import bisect_right
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -85,6 +87,15 @@ class PGLog:
         self.head: Version = ZERO
         self.can_rollback_to: Version = ZERO
         self.rollback_info_trimmed_to: Version = ZERO
+        # incremental-persistence dirty state (reference
+        # PGLog::_write_log_and_missing writes one omap key PER ENTRY,
+        # not the whole log): appends and removals since the last
+        # persist_delta(); _dirty_full forces a wholesale rewrite
+        # (fresh/adopted/loaded logs, whose on-disk keys are unknown
+        # or wrong)
+        self._dirty_new: "List[LogEntry]" = []
+        self._dirty_rm: "List[Version]" = []
+        self._dirty_full = True
 
     # --- append / trim -------------------------------------------------------
 
@@ -94,30 +105,43 @@ class PGLog:
                 f"log add: {entry.version} <= head {self.head}")
         self.entries.append(entry)
         self.head = entry.version
+        self._dirty_new.append(entry)
+
+    # entries are version-sorted by construction (add() refuses
+    # versions <= head), so the window scans below are bisect slices —
+    # these run per SUB-WRITE, and an O(log-length) pass per sub-write
+    # was a visible slice of the saturated host profile
+
+    def _upper(self, v: Version) -> int:
+        """Index of the first entry with version > v."""
+        return bisect_right(self.entries, v, key=lambda e: e.version)
 
     def roll_forward_to(self, v: Version) -> "List[LogEntry]":
         """Advance the no-rollback point; returns entries whose rollback
         state (old-generation objects) can now be reaped."""
-        reaped = [e for e in self.entries
-                  if self.can_rollback_to < e.version <= v]
-        if v > self.can_rollback_to:
-            self.can_rollback_to = v
+        if v <= self.can_rollback_to:
+            return []
+        reaped = self.entries[self._upper(self.can_rollback_to):
+                              self._upper(v)]
+        self.can_rollback_to = v
         return reaped
 
     def trim_to(self, v: Version) -> "List[LogEntry]":
         """Drop entries <= v (reference PGLog::trim); v must not pass
         can_rollback_to."""
         v = min(v, self.can_rollback_to)
-        dropped = [e for e in self.entries if e.version <= v]
-        self.entries = [e for e in self.entries if e.version > v]
+        cut = self._upper(v)
+        dropped = self.entries[:cut]
+        self.entries = self.entries[cut:]
         if v > self.tail:
             self.tail = v
+        self._dirty_rm.extend(e.version for e in dropped)
         return dropped
 
     # --- divergence (peering) ------------------------------------------------
 
     def entries_after(self, v: Version) -> "List[LogEntry]":
-        return [e for e in self.entries if e.version > v]
+        return self.entries[self._upper(v):]
 
     def rewind_divergent(self, to: Version) -> "List[LogEntry]":
         """Drop entries newer than ``to`` (authoritative head); returns the
@@ -132,6 +156,7 @@ class PGLog:
         div = [e for e in self.entries if e.version > to]
         self.entries = [e for e in self.entries if e.version <= to]
         self.head = to
+        self._dirty_rm.extend(e.version for e in div)
         return list(reversed(div))
 
     # --- missing-set computation ---------------------------------------------
@@ -158,4 +183,99 @@ class PGLog:
         log.head = ver(d.get("head", ZERO))
         log.can_rollback_to = ver(d.get("crt", ZERO))
         log.entries = [LogEntry.from_dict(e) for e in d.get("entries", [])]
+        return log
+
+    def clone(self) -> "PGLog":
+        """Cheap structural snapshot for failure-path restore: shares
+        the (never-mutated-in-place) LogEntry objects, copies the list
+        and heads.  O(entries) pointer copies instead of the full
+        to_dict/from_dict serialization round-trip; the clone is
+        _dirty_full, so adopting it after a store failure rewrites its
+        on-disk keys wholesale."""
+        out = PGLog()
+        out.entries = list(self.entries)
+        out.tail = self.tail
+        out.head = self.head
+        out.can_rollback_to = self.can_rollback_to
+        out.rollback_info_trimmed_to = self.rollback_info_trimmed_to
+        return out
+
+    # --- incremental omap persistence ----------------------------------------
+    #
+    # On-disk layout at the PG meta object (reference PGLog's
+    # log.%v omap keys): one "log.<epoch>.<v>" key per entry
+    # (zero-padded so lexicographic omap order == version order) plus
+    # a constant-size "pgmeta" head/tail/crt record.  The write path
+    # persists only the DELTA per op — the old whole-log-as-one-JSON-
+    # blob scheme re-serialized O(log length) entries on every
+    # sub-write and dominated the saturated host profile.
+
+    @staticmethod
+    def entry_key(v: Version) -> str:
+        return f"log.{v[0]:010d}.{v[1]:012d}"
+
+    @staticmethod
+    def is_log_key(key: str) -> bool:
+        """True for any on-disk log key this class has ever written:
+        the per-entry ``log.*`` layout or the legacy whole-log
+        ``pglog`` blob.  The single place the key layout is spelled —
+        every stale-key sweep must use it."""
+        return key.startswith("log.") or key == "pglog"
+
+    def mark_full_rewrite(self) -> None:
+        """Re-arm a wholesale on-disk rewrite.  Callers MUST invoke
+        this when a transaction built from persist_delta() fails to
+        apply: the delta was consumed at build time, so without the
+        full rewrite those keys would silently never reach disk and a
+        restart would rebuild a log with holes."""
+        self._dirty_full = True
+
+    def meta_dict(self) -> dict:
+        return {"tail": list(self.tail), "head": list(self.head),
+                "crt": list(self.can_rollback_to)}
+
+    def persist_delta(self) -> "Tuple[Dict[str, bytes], List[str], bool]":
+        """-> (omap keys to set, omap keys to remove, full_rewrite).
+
+        full_rewrite=True means the caller must also clear every
+        on-disk ``log.*`` key not in the set (the in-memory log was
+        wholesale-replaced and stale keys may linger).  Consumes the
+        dirty state: each mutation is returned exactly once."""
+        if self._dirty_full:
+            kv = {self.entry_key(e.version):
+                  json.dumps(e.to_dict()).encode()
+                  for e in self.entries}
+            self._dirty_full = False
+            self._dirty_new, self._dirty_rm = [], []
+            return kv, [], True
+        added = {self.entry_key(e.version):
+                 json.dumps(e.to_dict()).encode()
+                 for e in self._dirty_new}
+        removed = {self.entry_key(v) for v in self._dirty_rm}
+        # an entry appended AND removed between flushes was never on
+        # disk (add() refuses versions <= head, so its key cannot
+        # predate this window): skip both the set and the remove
+        kv = {k: b for k, b in added.items() if k not in removed}
+        rm = sorted(removed - set(added))
+        self._dirty_new, self._dirty_rm = [], []
+        return kv, rm, False
+
+    @classmethod
+    def from_omap(cls, kv: "Dict[str, bytes]") -> "Optional[PGLog]":
+        """Rebuild from the PG meta object's omap, or None when no log
+        was ever persisted there.  Understands both the per-entry
+        layout and the legacy whole-log "pglog" blob (upgraded on the
+        next persist — from_omap leaves _dirty_full set)."""
+        if "pglog" in kv:
+            return cls.from_dict(json.loads(bytes(kv["pglog"]).decode()))
+        if "pgmeta" not in kv:
+            return None
+        log = cls()
+        meta = json.loads(bytes(kv["pgmeta"]).decode())
+        log.tail = ver(meta.get("tail", ZERO))
+        log.head = ver(meta.get("head", ZERO))
+        log.can_rollback_to = ver(meta.get("crt", ZERO))
+        log.entries = [
+            LogEntry.from_dict(json.loads(bytes(kv[k]).decode()))
+            for k in sorted(k for k in kv if k.startswith("log."))]
         return log

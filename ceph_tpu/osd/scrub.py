@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..common.buffer import concat_u8
 from ..common.log import dout
 from ..objectstore.types import ObjectId
 from ..ops import crc32c as crcmod
@@ -260,8 +261,7 @@ async def _rebuild_hinfo(backend, oid: str, present: "Dict[int, dict]",
     by_shard = read.complete.get(oid, {})
     csize = max((sum(len(b) for b in off.values())
                  for off in by_shard.values()), default=0)
-    arrs = {s: np.frombuffer(b"".join(off[o] for o in sorted(off))
-                             .ljust(csize, b"\0"), dtype=np.uint8)
+    arrs = {s: concat_u8([off[o] for o in sorted(off)], csize)
             for s, off in by_shard.items()}
     expect, bad = _consistent_reconstruction(backend, arrs)
     if expect is None:

@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Repo gate: static invariants first (fast, fails early), then the
 # cephsan interleaving sweep (fixed seeds + one fresh, seeds printed
-# on failure), then the tier-1 test suite.  Nonzero exit on any
-# non-baselined cephlint finding or any test failure — wire this
-# straight into CI.
+# on failure; suites include the wire-path tests — corked writev
+# bursts of frozen BufferList frames under permuted schedules), then
+# a loadgen open-loop smoke row, then the tier-1 test suite.  Nonzero
+# exit on any non-baselined cephlint finding or any test failure —
+# wire this straight into CI.
 #
-#   ./check.sh               # lint + sanitizer sweep + tier-1 tests
+#   ./check.sh               # lint + sweep + loadgen smoke + tier-1
 #   ./check.sh --lint        # lint only (pre-commit speed)
 #   ./check.sh --sanitize    # lint + sanitizer sweep only
 set -o pipefail
@@ -48,6 +50,18 @@ fi
 
 if [ "$1" = "--sanitize" ]; then
     exit 0
+fi
+
+echo "== loadgen smoke (tools/loadgen.py) =="
+# one open-loop row over the binary wire path: nonzero exit when any
+# op fails or the generator goes closed-loop-bound (sched lag), i.e.
+# the offered rate stopped being honest
+env JAX_PLATFORMS=cpu python tools/loadgen.py --smoke \
+    -o osd_ec_batch_min_device_bytes=1000000000000
+lg_rc=$?
+if [ "$lg_rc" -ne 0 ]; then
+    echo "loadgen smoke FAILED (exit $lg_rc)"
+    exit "$lg_rc"
 fi
 
 echo "== tier-1 tests =="

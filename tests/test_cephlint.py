@@ -194,6 +194,71 @@ def test_msg_symmetry_schema_drift(tmp_path):
     assert "'opt'" not in msgs                # optional, never required
 
 
+def test_msg_symmetry_wire_schema(tmp_path):
+    """PR 7: FIELDS doubles as the wire layout — non-derivable schemas
+    and WIRE_SPECS drift are lint errors."""
+    p = write(tmp_path, "wiremsgs.py", """
+        from ceph_tpu.msg.message import Message, register_message
+
+        def register_message(cls):      # local shadow: no global registry
+            return cls
+
+        @register_message
+        class MDup(Message):
+            TYPE = "t_dup"
+            FIELDS = ("tid", "tid", "x")
+
+        @register_message
+        class MWide(Message):
+            TYPE = "t_wide"
+            FIELDS = tuple(f"f{i}" for i in range(40))
+
+        @register_message
+        class MGood(Message):
+            TYPE = "t_good"
+            FIELDS = ("tid", "pg", "opt?")
+
+        WIRE_SPECS = {
+            "t_good": (("tid",), ("opt", "pg")),     # drifted
+            "t_ghost": (("a",), ()),                 # unregistered
+        }
+
+        def use(ms, msg):
+            ms.send(MDup({"tid": 1, "x": 2}))
+            ms.send(MGood({"tid": 1, "pg": 2}))
+            if msg.TYPE == "t_wide":
+                return msg.get("f0")
+    """)
+    found = run_checks([p], checks=["msg-symmetry"])
+    msgs = " | ".join(f.message for f in found)
+    assert "MDup.FIELDS is not wire-derivable" in msgs
+    # dynamic FIELDS (the tuple() comprehension) is not a literal ->
+    # reported as "declares no FIELDS", same as schemaless
+    assert "MWide" in msgs
+    assert "WIRE_SPECS['t_good'] drifted" in msgs
+    assert "t_ghost" in msgs and "no registered message" in msgs
+
+
+def test_msg_symmetry_wire_bitmap_overflow(tmp_path):
+    p = write(tmp_path, "widemsg.py", """
+        from ceph_tpu.msg.message import Message, register_message
+
+        def register_message(cls):
+            return cls
+
+        @register_message
+        class MWide(Message):
+            TYPE = "t_wide"
+            FIELDS = (%s)
+
+        def use(ms):
+            ms.send(MWide({}))
+    """ % ", ".join(f'"f{i}"' for i in range(33)))
+    found = run_checks([p], checks=["msg-symmetry"])
+    msgs = " | ".join(f.message for f in found)
+    assert "presence bitmap holds 32" in msgs
+
+
 def test_options_checker_both_directions(tmp_path):
     p = write(tmp_path, "opts.py", """
         from ceph_tpu.common.options import Option

@@ -177,6 +177,40 @@ class TestWritePlan:
         assert plan.invalidates_cache
         assert plan.projected_size == 2000
 
+    def test_truncating_rewrite_reads_nothing(self):
+        # write_full of a half-stripe object: the write covers every
+        # byte the truncate keeps, so there is NO old data to merge —
+        # the rewrite must not pay a k-shard RMW read round (this was
+        # the dominant per-op cost in the saturated host profile)
+        plan = get_write_plan(self.SI, [(0, 2000)], orig_size=2000,
+                              truncate_to=2000)
+        assert plan.to_read == []
+        assert plan.will_write == [(0, 4096)]
+        assert plan.projected_size == 2000
+
+    def test_truncate_discards_tail_no_read(self):
+        # old data lives in stripes 0-1; truncating to 1000 discards
+        # everything past the write, so stripe 1 isn't read and
+        # stripe 0's surviving bytes are fully covered
+        plan = get_write_plan(self.SI, [(0, 1000)], orig_size=8192,
+                              truncate_to=1000)
+        assert plan.to_read == []
+        assert plan.will_write == [(0, 4096)]
+
+    def test_truncate_keeps_uncovered_old_bytes_still_reads(self):
+        # truncate keeps [0, 3000) but the write only covers [0, 1000):
+        # bytes 1000-2999 survive un-overwritten -> stripe 0 must read
+        plan = get_write_plan(self.SI, [(0, 1000)], orig_size=8192,
+                              truncate_to=3000)
+        assert plan.to_read == [(0, 4096)]
+
+    def test_extending_truncate_unchanged(self):
+        # truncate UP past orig: surviving old data is [0, orig) as
+        # before — the partial overwrite still reads its stripe
+        plan = get_write_plan(self.SI, [(1000, 100)], orig_size=4096,
+                              truncate_to=16384)
+        assert plan.to_read == [(0, 4096)]
+
 
 class TestExtentCache:
     def test_rmw_pipeline(self):

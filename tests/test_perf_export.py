@@ -289,6 +289,10 @@ REQUIRED_PERF_COUNTERS = {
                "kernel_decode_gf_mults", "kernel_crc32c_gf_mults",
                "kernel_encode_gbs", "kernel_decode_gbs",
                "kernel_crc32c_gbs", "kernel_encode_queue_lat"},
+    # zero-copy accounting (PR 7): BufferList materialization + crc
+    # segment-cache hit rate (process-wide, snapshotted per daemon)
+    "buffer": {"bytes_copied", "copy_calls",
+               "crc_cache_hits", "crc_cache_misses"},
 }
 
 REQUIRED_PROM_SERIES = {
@@ -311,6 +315,9 @@ REQUIRED_PROM_SERIES = {
     "ceph_osd_shard_queue_depth_bucket",
     "ceph_osd_wal_group_commit_batch_bucket",
     "ceph_ms_cork_flush_frames_bucket",
+    # zero-copy wire path (PR 7): copy accounting + crc cache counters
+    "ceph_bytes_copied", "ceph_copy_calls",
+    "ceph_crc_cache_hits", "ceph_crc_cache_misses",
 }
 
 

@@ -1,0 +1,137 @@
+"""PGLog incremental omap persistence (PR 7).
+
+The write path persists one ``log.<epoch>.<v>`` omap key per entry via
+``persist_delta()`` dirty-tracking instead of re-serializing the whole
+log per sub-write.  Pinned here:
+- delta/full round-trips through ``from_omap`` reproduce the log,
+- an entry appended AND removed inside one window never touches disk,
+- ``persist_delta()`` consumes the dirty state, so a failed store
+  apply MUST re-arm a wholesale rewrite (``mark_full_rewrite``) or the
+  lost keys would silently never reach disk,
+- the legacy whole-log ``pglog`` blob still loads (and upgrades),
+- the bisect-sliced window helpers match their O(n) predecessors.
+"""
+
+from ceph_tpu.osd.pglog import LogEntry, PGLog
+
+
+def entry(v, oid="obj"):
+    return LogEntry((1, v), f"{oid}{v}", "modify", prior_version=(0, 0))
+
+
+def apply_delta(disk: dict, log: PGLog) -> dict:
+    """What _pg_meta_txn persists, reduced to a dict 'store'."""
+    set_kv, rm_keys, full = log.persist_delta()
+    if full:
+        for k in [k for k in disk if PGLog.is_log_key(k)]:
+            del disk[k]
+    for k in rm_keys:
+        disk.pop(k, None)
+    disk.update(set_kv)
+    import json
+    disk["pgmeta"] = json.dumps(log.meta_dict()).encode()
+    return disk
+
+
+class TestIncrementalPersist:
+    def test_delta_round_trip(self):
+        log = PGLog()
+        disk: dict = {}
+        for v in range(1, 6):
+            log.add(entry(v))
+        apply_delta(disk, log)              # full (fresh log)
+        log.add(entry(6))
+        log.roll_forward_to((1, 3))
+        log.trim_to((1, 2))
+        apply_delta(disk, log)              # delta: +log.6, -log.1..2
+        got = PGLog.from_omap(disk)
+        assert [e.version for e in got.entries] == \
+            [e.version for e in log.entries]
+        assert got.head == log.head and got.tail == log.tail
+        assert got.can_rollback_to == log.can_rollback_to
+
+    def test_add_and_trim_same_window_never_hits_disk(self):
+        log = PGLog()
+        log.add(entry(1))
+        apply_delta({}, log)
+        log.add(entry(2))
+        log.roll_forward_to((1, 2))
+        log.trim_to((1, 2))
+        set_kv, rm_keys, full = log.persist_delta()
+        assert not full
+        # entry 2 (added + trimmed this window) never touches disk;
+        # entry 1 was persisted before, so its key IS removed
+        assert set_kv == {}
+        assert rm_keys == [PGLog.entry_key((1, 1))]
+
+    def test_failed_apply_rearms_full_rewrite(self):
+        """persist_delta() consumed at transaction build + the apply
+        fails: without mark_full_rewrite the delta keys are lost
+        forever and a restart rebuilds a log with holes."""
+        log = PGLog()
+        disk: dict = {}
+        log.add(entry(1))
+        apply_delta(disk, log)
+        log.add(entry(2))
+        set_kv, _rm, full = log.persist_delta()   # consumed...
+        assert not full and set_kv                # ...but never applied
+        log.mark_full_rewrite()                   # the failure path
+        apply_delta(disk, log)
+        got = PGLog.from_omap(disk)
+        assert [e.version for e in got.entries] == [(1, 1), (1, 2)]
+
+    def test_without_rearm_the_hole_is_real(self):
+        # the negative control: dropping the delta without re-arming
+        # produces exactly the silent hole the fix exists to prevent
+        log = PGLog()
+        disk: dict = {}
+        log.add(entry(1))
+        apply_delta(disk, log)
+        log.add(entry(2))
+        log.persist_delta()                       # consumed, not applied
+        apply_delta(disk, log)                    # next op persists
+        got = PGLog.from_omap(disk)
+        assert (1, 2) not in [e.version for e in got.entries]
+
+    def test_legacy_blob_loads(self):
+        import json
+        log = PGLog()
+        for v in range(1, 4):
+            log.add(entry(v))
+        disk = {"pglog": json.dumps(log.to_dict()).encode()}
+        got = PGLog.from_omap(disk)
+        assert [e.version for e in got.entries] == \
+            [(1, 1), (1, 2), (1, 3)]
+        # upgraded on the next persist: from_omap leaves _dirty_full
+        set_kv, _rm, full = got.persist_delta()
+        assert full and len(set_kv) == 3
+
+    def test_clone_is_full_dirty(self):
+        log = PGLog()
+        log.add(entry(1))
+        log.persist_delta()
+        clone = log.clone()
+        _kv, _rm, full = clone.persist_delta()
+        assert full
+
+
+class TestBisectWindows:
+    def test_windows_match_linear_scans(self):
+        log = PGLog()
+        for v in range(1, 10):
+            log.add(entry(v))
+        assert [e.version for e in log.entries_after((1, 4))] == \
+            [(1, v) for v in range(5, 10)]
+        reaped = log.roll_forward_to((1, 6))
+        assert [e.version for e in reaped] == [(1, v) for v in
+                                               range(1, 7)]
+        assert log.roll_forward_to((1, 6)) == []       # idempotent
+        dropped = log.trim_to((1, 3))
+        assert [e.version for e in dropped] == [(1, 1), (1, 2), (1, 3)]
+        assert log.tail == (1, 3)
+        # trim clamps at can_rollback_to
+        dropped = log.trim_to((1, 99))
+        assert [e.version for e in dropped] == [(1, v) for v in
+                                                range(4, 7)]
+        assert [e.version for e in log.entries] == [(1, 7), (1, 8),
+                                                    (1, 9)]

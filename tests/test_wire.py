@@ -1,0 +1,418 @@
+"""Wire codec (msg/wire.py) + zero-copy threading tests.
+
+The FIELDS-driven flat binary codec replaced json.dumps headers (PR 7):
+- every registered message round-trips decode(encode(m)) bit-faithfully
+  (fields, data, priority),
+- HEAD_VERSION/COMPAT_VERSION skew is rejected with MessageError, and
+  append-only optional fields from a NEWER peer are skipped, not errors,
+- truncated / bit-flipped frames fail with MessageError only (the
+  dispatcher drops the session; CrashHandler never sees codec noise),
+- bulk data crosses client -> messenger -> encode -> store with ZERO
+  BufferList materializations (buffer.STATS["bytes_copied"]),
+- re-framing the same payload (client retry / resend) hits the per-raw
+  cached crc32c instead of a fresh full-buffer pass.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+# replayed under seeded interleavings by tools/cephsan / check.sh: the
+# TCP tests drive corked writev bursts of frozen BufferList frames and
+# the zero-copy client->OSD->store path under permuted schedules
+pytestmark = pytest.mark.cephsan
+
+from ceph_tpu.common import Config
+from ceph_tpu.common import buffer as buffer_mod
+from ceph_tpu.common.buffer import BufferList
+from ceph_tpu.msg import message as message_mod
+from ceph_tpu.msg import wire
+from ceph_tpu.msg.message import Message, MessageError, decode_message
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.qa.cluster import MiniCluster
+
+# pull in every subsystem that registers message types: the round-trip
+# test runs over the FULL registry
+import ceph_tpu.cephfs.mds        # noqa: F401
+import ceph_tpu.mgr.daemon       # noqa: F401
+import ceph_tpu.mon.messages     # noqa: F401
+import ceph_tpu.osd.messages     # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def loop():
+    lp = asyncio.new_event_loop()
+    asyncio.set_event_loop(lp)
+    yield lp
+    lp.close()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config(read_env=False)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+async def wait_for(cond, timeout=10.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError
+        await asyncio.sleep(0.01)
+
+
+# deterministic per-type sample values covering every codec tag
+_SAMPLES = (0, 1, -7, 2**40, 2**70, 1.5, True, False, None,
+            "name", "unié", b"\x00\xffbin",
+            [1, "two", [3]], {"k": 1, "nested": {"x": [False, None]}})
+
+
+def _sample(i):
+    return _SAMPLES[i % len(_SAMPLES)]
+
+
+def synth_fields(cls) -> dict:
+    """One value per declared field (optionals: every other one)."""
+    out = {}
+    for i, f in enumerate(getattr(cls, "FIELDS", ())):
+        name = f.rstrip("?")
+        if f.endswith("?") and i % 2:
+            continue
+        out[name] = _sample(i)
+    return out
+
+
+class TestCodecRoundTrip:
+    def test_all_registered_types_round_trip(self):
+        """decode(encode(m)) over the full registry: fields, data and
+        priority preserved for every message type."""
+        assert len(message_mod._REGISTRY) >= 35
+        payload = BufferList(b"\x01\x02bulk\xfe")
+        for wtype, cls in sorted(message_mod._REGISTRY.items()):
+            m = cls(synth_fields(cls), payload)
+            m.priority = 196
+            header, data = m.encode()
+            got = decode_message(header, data, from_name="peer")
+            assert type(got) is cls, wtype
+            assert got.fields == m.fields, wtype
+            assert got.priority == 196, wtype
+            assert bytes(got.data) == bytes(payload), wtype
+            assert got.from_name == "peer"
+
+    def test_json_era_shape_preserved(self):
+        """Decoded values are indistinguishable from the json.dumps
+        era: tuples come back lists, non-str dict keys come back as
+        their JSON string coercions."""
+        class MShape(Message):
+            TYPE = "ping"      # reuse a registered type's identity
+            FIELDS = ()
+
+        fields = {"t": (1, 2, (3,)),
+                  "d": {2: "a", True: "b", None: "c", 2.5: "d"}}
+        header = wire.encode_header(message_mod.MPing, fields)
+        got = decode_message(header)
+        assert got.fields["t"] == [1, 2, [3]]
+        assert got.fields["d"] == {"2": "a", "true": "b",
+                                   "null": "c", "2.5": "d"}
+
+    def test_spec_table_matches_fields(self):
+        """The WIRE_SPECS hand table must derive exactly from FIELDS
+        (same contract cephlint's msg-symmetry checker enforces)."""
+        wire.check_specs(message_mod._REGISTRY)
+
+    def test_unencodable_value_is_message_error(self):
+        m = message_mod.MPing({"bad": object()})
+        with pytest.raises(MessageError):
+            m.encode()
+
+    def test_oversized_key_is_message_error(self):
+        # a >u16 dict key / field name must fail as MessageError, not
+        # leak struct.error past encode()'s WireError wrapper
+        with pytest.raises(MessageError):
+            message_mod.MPing({"d": {"k" * 70000: 1}}).encode()
+        with pytest.raises(MessageError):
+            message_mod.MPing({"n" * 70000: 1}).encode()
+
+    def test_deep_nesting_is_message_error_both_ways(self):
+        # encode: locally-built pathological nesting
+        deep = 1
+        for _ in range(300):
+            deep = [deep]
+        with pytest.raises(MessageError):
+            message_mod.MPing({"v": deep}).encode()
+        # decode: a crafted frame of nested list tags must be a clean
+        # WireError->MessageError, never RecursionError escaping into
+        # the session task — patch an empty ping header to claim one
+        # named TLV and append a nested-list bomb as its value
+        payload = bytearray()
+        payload += b"\x01\x00" + b"v"     # name len=1, 'v'
+        payload += bytes([0x6C, 1, 0, 0, 0]) * 100000  # nested lists
+        hdr = bytearray(wire.encode_header(message_mod.MPing, {}))
+        # patch n_named from 0 to 1 and append the bomb
+        tlen = hdr[0]
+        fixed_off = 1 + tlen
+        n_named_off = fixed_off + 1 + 1 + 1 + 4 + 2
+        hdr[n_named_off:n_named_off + 2] = (1).to_bytes(2, "little")
+        with pytest.raises(MessageError):
+            decode_message(bytes(hdr) + bytes(payload))
+
+    def test_bad_utf8_field_name_is_message_error(self):
+        hdr = bytearray(wire.encode_header(message_mod.MPing, {}))
+        tlen = hdr[0]
+        n_named_off = 1 + tlen + 1 + 1 + 1 + 4 + 2
+        hdr[n_named_off:n_named_off + 2] = (1).to_bytes(2, "little")
+        payload = b"\x02\x00" + b"\xff\xfe" + bytes([0x4E])  # None val
+        with pytest.raises(MessageError):
+            decode_message(bytes(hdr) + payload)
+
+
+class TestVersionSkew:
+    def test_newer_compat_rejected(self):
+        class MPingV9(Message):
+            TYPE = "ping"
+            FIELDS = ()
+            HEAD_VERSION = 9
+            COMPAT_VERSION = 9
+
+        header = wire.encode_header(MPingV9, {})
+        with pytest.raises(MessageError, match="compat"):
+            decode_message(header)
+
+    def test_unknown_type_rejected(self):
+        class MGhost(Message):
+            TYPE = "no_such_type"
+            FIELDS = ("a",)
+
+        header = wire.encode_header(MGhost, {"a": 1})
+        with pytest.raises(MessageError, match="unknown message type"):
+            decode_message(header)
+
+    def test_appended_optional_from_newer_peer_skipped(self):
+        """Append-only optional evolution: a newer peer's extra
+        optional field indexes past our spec and is silently dropped;
+        everything this build declares still decodes.  (The stub's
+        TYPE must sit OUTSIDE WIRE_SPECS — spec_for prefers the hand
+        table by TYPE, so a data-path stub would push the extra field
+        into the named-TLV fallback instead.)"""
+        class MNewerPing(Message):
+            TYPE = "ping"
+            FIELDS = ("new_hint?",)
+
+        header = wire.encode_header(MNewerPing, {"new_hint": "future"})
+        got = decode_message(header)
+        assert type(got) is message_mod.MPing
+        assert got.fields == {}
+
+    def test_unknown_required_bitmap_rejected(self):
+        """A REQUIRED field this build doesn't know cannot be skipped
+        (positional packing) — that's what COMPAT_VERSION gates, and
+        the decoder refuses the bitmap outright."""
+        class MWiderPing(Message):
+            TYPE = "ping"
+            FIELDS = ("extra_req",)
+
+        header = wire.encode_header(MWiderPing, {"extra_req": 3})
+        with pytest.raises(MessageError, match="bitmap"):
+            decode_message(header)
+
+
+class TestCorruptFrames:
+    def _headers(self):
+        out = []
+        for wtype in ("osd_op", "ec_sub_write", "osd_op_reply", "ping"):
+            cls = message_mod._REGISTRY[wtype]
+            out.append(wire.encode_header(cls, synth_fields(cls)))
+        return out
+
+    def test_truncation_never_escapes_message_error(self):
+        for header in self._headers():
+            for n in range(len(header)):
+                try:
+                    decode_message(header[:n])
+                except MessageError:
+                    continue
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    pytest.fail(f"truncated@{n}: {type(e).__name__}: {e}")
+
+    def test_bit_flips_never_escape_message_error(self):
+        """Every single-byte corruption either decodes to SOME message
+        (a flipped value byte is indistinguishable from data — the
+        frame crc catches it a layer below) or raises MessageError;
+        nothing else may escape into the dispatcher."""
+        for header in self._headers():
+            for i in range(len(header)):
+                mut = bytearray(header)
+                mut[i] ^= 0xA5
+                try:
+                    decode_message(bytes(mut))
+                except MessageError:
+                    continue
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    pytest.fail(f"flip@{i}: {type(e).__name__}: {e}")
+
+    def test_corrupt_frame_drops_session_not_daemon(self):
+        """Garbage on the wire kills THAT session; the messenger keeps
+        serving new sessions and no crash dump is taken."""
+        from ceph_tpu.msg.messenger import _FRAME_HDR, MAGIC
+        from ceph_tpu.msg.message import register_message
+
+        received = []
+
+        class Coll(Dispatcher):
+            async def ms_dispatch(self, conn, msg):
+                received.append(msg)
+                return True
+
+        async def main():
+            cfg = make_config()
+            server = Messenger.create("osd.0", cfg)
+            server.add_dispatcher(Coll())
+            await server.bind("127.0.0.1:0")
+            host, port = server.listen_addr.split(":")
+
+            # raw socket: banner, then a frame whose body is noise
+            reader, writer = await asyncio.open_connection(host,
+                                                           int(port))
+            import json as json_mod
+            banner = json_mod.dumps(
+                {"type": "__banner", "name": "evil.1", "in_seq": 0,
+                 "secure": False, "salt": "00" * 8, "compress": "",
+                 "auth": None}).encode()
+            hdr = _FRAME_HDR.pack(MAGIC, 8, 1, 0, len(banner), 0)
+            import ceph_tpu.ops.crc32c as crcmod
+            crc = crcmod.crc32c(hdr + banner)
+            writer.write(hdr + banner +
+                         crc.to_bytes(4, "little"))
+            await writer.drain()
+            await asyncio.sleep(0.1)
+            noise = b"\x13\x37" * 10
+            hdr = _FRAME_HDR.pack(MAGIC, 0, 2, 0, len(noise), 0)
+            crc = crcmod.crc32c(hdr + noise)
+            writer.write(hdr + noise + crc.to_bytes(4, "little"))
+            await writer.drain()
+            # session must die (server closes), daemon must not
+            try:
+                eof = await asyncio.wait_for(reader.read(), 5.0)
+            except (ConnectionError, asyncio.TimeoutError):
+                eof = b""
+            del eof
+            writer.close()
+
+            # a well-formed client still gets through afterwards
+            client = Messenger.create("client.1", cfg)
+            conn = client.get_connection(server.listen_addr)
+            await conn.send_message(message_mod.MPing({}))
+            await wait_for(lambda: received)
+            assert received[0].TYPE == "ping"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(main())
+        assert not received[0].from_name == "evil.1"
+
+
+class TestZeroCopyWritePath:
+    def test_client_to_store_bulk_write_copies_nothing(self, loop):
+        """The acceptance gate: a stripe-aligned client write crosses
+        messenger -> EC encode -> objectstore with bytes_copied == 0.
+        Only the store's own medium write touches the payload bytes."""
+        async def go():
+            cluster = MiniCluster(4)
+            cluster.create_ec_pool(
+                "zc", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                pg_num=2, stripe_unit=512)
+            async with cluster:
+                client = await cluster.client()
+                io = client.io_ctx("zc")
+                data = bytes(range(256)) * 16          # 4096 = 4 stripes
+                await io.write_full("warm", data)      # jit + map warm
+                before = dict(buffer_mod.STATS)
+                await io.write_full("obj-zc", data)
+                after = dict(buffer_mod.STATS)
+                copied = after["bytes_copied"] - before["bytes_copied"]
+                assert copied == 0, (
+                    f"write path materialized {copied} bytes "
+                    f"({after['copy_calls'] - before['copy_calls']} "
+                    f"copies) — zero-copy regression")
+                # and the bytes actually landed
+                assert await io.read("obj-zc") == data
+        loop.run_until_complete(go())
+
+
+class TestCrcResendCache:
+    def test_reframing_same_payload_hits_crc_cache(self):
+        """A client retry re-frames the SAME BufferList: the second
+        frame's data crc must come from the per-raw cache (seed-combine
+        path), not a fresh full-buffer pass."""
+        async def main():
+            cfg = make_config()
+            server = Messenger.create("osd.0", cfg)
+
+            class Sink(Dispatcher):
+                async def ms_dispatch(self, conn, msg):
+                    return True
+
+            server.add_dispatcher(Sink())
+            await server.bind("127.0.0.1:0")
+            client = Messenger.create("client.1", cfg)
+            conn = client.get_connection(server.listen_addr)
+
+            payload = BufferList(np.arange(8192, dtype=np.uint8) % 251)
+            await conn.send_message(message_mod.MPing({}, payload))
+            mid = dict(buffer_mod.STATS)
+            await conn.send_message(message_mod.MPing({}, payload))
+            end = dict(buffer_mod.STATS)
+            assert end["crc_cache_hits"] > mid["crc_cache_hits"], \
+                "resend did not hit the cached segment crc"
+            assert end["crc_cache_misses"] == mid["crc_cache_misses"], \
+                "resend recomputed a segment crc from scratch"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(main())
+
+    def test_one_way_flow_acks_converge(self):
+        """Coalesced acks must converge on a ONE-WAY flow: a sender
+        that never receives data frames back still gets every message
+        acked (the deferred ack task re-checks in_seq after its drain —
+        a delivery racing the in-flight __ack may not be skipped
+        forever, or the sender's unacked list grows until reconnect)."""
+        async def main():
+            cfg = make_config()
+            server = Messenger.create("osd.0", cfg)
+
+            class Sink(Dispatcher):
+                async def ms_dispatch(self, conn, msg):
+                    return True
+
+            server.add_dispatcher(Sink())
+            await server.bind("127.0.0.1:0")
+            client = Messenger.create("client.1", cfg)
+            conn = client.get_connection(server.listen_addr)
+            for i in range(20):
+                await conn.send_message(message_mod.MPing({"i": i}))
+            await wait_for(lambda: not conn.unacked)
+            await client.shutdown()
+            await server.shutdown()
+
+        run(main())
+
+    def test_bufferlist_crc_cache_unit(self):
+        bl = BufferList(b"x" * 4096)
+        h0, m0 = (buffer_mod.STATS["crc_cache_hits"],
+                  buffer_mod.STATS["crc_cache_misses"])
+        c1 = bl.crc32c(0)
+        c2 = bl.crc32c(0)
+        assert c1 == c2
+        assert buffer_mod.STATS["crc_cache_misses"] == m0 + 1
+        assert buffer_mod.STATS["crc_cache_hits"] == h0 + 1
+        # different seed: served by the GF(2) combine, still a hit
+        c3 = bl.crc32c(123)
+        assert buffer_mod.STATS["crc_cache_hits"] == h0 + 2
+        assert c3 == bl.crc32c(123)

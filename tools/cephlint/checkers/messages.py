@@ -24,7 +24,15 @@ Checked, tree-wide:
   (a ``msg.TYPE == "x"`` / ``t != "x": return`` dispatch branch, the
   codebase's universal handler idiom),
 - dead fields: a declared field neither written at any construction
-  site nor read at any resolved read site.
+  site nor read at any resolved read site,
+- wire schema (PR 7): FIELDS doubles as the flat binary wire layout
+  (``msg/wire.py`` packs required fields positionally under a presence
+  bitmap and optional fields as indexed TLVs), so every registered
+  message's FIELDS must be wire-derivable — no duplicate names, no
+  empty names, at most 32 required fields — and any hand-written
+  ``WIRE_SPECS`` table entry that drifts from the class's FIELDS
+  declaration is a lint error (the table exists for reviewers; FIELDS
+  stays authoritative).
 
 Reads the checker cannot type (no TYPE test in scope) are skipped, not
 guessed — this checker trades recall for zero false positives on the
@@ -62,6 +70,7 @@ class MsgSymmetryChecker(Checker):
         classes: "List[dict]" = []
         constructs: "List[dict]" = []
         reads: "List[dict]" = []
+        wire_specs: "List[dict]" = []
 
         for node in ast.walk(module.tree):
             if isinstance(node, ast.ClassDef):
@@ -70,8 +79,39 @@ class MsgSymmetryChecker(Checker):
                 self._collect_construct(node, constructs, module)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._collect_reads(node, reads, module)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._collect_wire_specs(node, wire_specs)
         return {"classes": classes, "constructs": constructs,
-                "reads": reads}
+                "reads": reads, "wire_specs": wire_specs}
+
+    @staticmethod
+    def _collect_wire_specs(node, wire_specs: "List[dict]") -> None:
+        """``WIRE_SPECS = {"type": ((req...), (opt...)), ...}`` hand
+        tables (msg/wire.py keeps one for the data-path messages)."""
+        if isinstance(node, ast.Assign):
+            if len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name) or \
+                    node.targets[0].id != "WIRE_SPECS":
+                return
+            value = node.value
+        else:
+            if not isinstance(node.target, ast.Name) or \
+                    node.target.id != "WIRE_SPECS":
+                return
+            value = node.value
+        if not isinstance(value, ast.Dict):
+            return
+        for k, v in zip(value.keys, value.values):
+            wtype = const_str(k)
+            if wtype is None:
+                continue
+            req = opt = None
+            if isinstance(v, (ast.Tuple, ast.List)) and len(v.elts) == 2:
+                req = _parse_fields(v.elts[0])
+                opt = _parse_fields(v.elts[1])
+            wire_specs.append({"type": wtype, "req": req, "opt": opt,
+                               "line": v.lineno if hasattr(v, "lineno")
+                               else node.lineno})
 
     @staticmethod
     def _collect_class(node: ast.ClassDef, classes: "List[dict]") -> None:
@@ -232,12 +272,33 @@ class MsgSymmetryChecker(Checker):
                     context=f"class {name}",
                     message=f"registered message {name} declares no "
                             f"FIELDS schema (the encode/decode contract "
-                            f"cephlint checks against)"))
+                            f"cephlint checks against, and the wire "
+                            f"codec's packing layout)"))
                 continue
             required = {f.rstrip("?") for f in c["fields"]
                         if not f.endswith("?")}
             declared = {f.rstrip("?") for f in c["fields"]}
             schemas[name] = (declared, required)
+            # wire-derivability: FIELDS is ALSO the flat binary layout
+            # (msg/wire.py) — duplicate/empty names make the positional
+            # packing ambiguous, >32 required overflows the presence
+            # bitmap
+            names_in_order = [f.rstrip("?") for f in c["fields"]]
+            if len(set(names_in_order)) != len(names_in_order) or \
+                    "" in names_in_order:
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=f"class {name}",
+                    message=f"{name}.FIELDS is not wire-derivable: "
+                            f"duplicate or empty field names break the "
+                            f"positional wire packing"))
+            elif len(required) > 32:
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=f"class {name}",
+                    message=f"{name}.FIELDS declares {len(required)} "
+                            f"required fields — the wire presence "
+                            f"bitmap holds 32; mark some optional"))
 
         used: "Dict[str, Set[str]]" = {n: set() for n in schemas}
         has_dynamic: "Set[str]" = set()
@@ -282,6 +343,51 @@ class MsgSymmetryChecker(Checker):
                         message=f"{name} decoded field {r['key']!r} is "
                                 f"not in its FIELDS schema — no encode "
                                 f"site can be setting it"))
+
+        # WIRE_SPECS hand tables vs the declared FIELDS they mirror:
+        # the table is a readable copy for reviewers, FIELDS is the
+        # authority — any drift (missing/misordered/re-classified
+        # field, unknown type) is an error, same contract
+        # wire.check_specs() enforces at test time
+        fields_by_type: "Dict[str, Tuple[str, dict]]" = {
+            c["type"]: (path, c)
+            for path, f in facts.items() for c in f.get("classes", ())
+            if c["type"] and c["fields"] is not None}
+        for path, f in facts.items():
+            for ws in f.get("wire_specs", ()):
+                if ws["req"] is None or ws["opt"] is None:
+                    out.append(Finding(
+                        check=self.name, path=path, line=ws["line"],
+                        context=f"WIRE_SPECS[{ws['type']!r}]",
+                        message=f"WIRE_SPECS entry {ws['type']!r} is "
+                                f"not a literal (required, optional) "
+                                f"string-tuple pair — cephlint cannot "
+                                f"hold it against FIELDS"))
+                    continue
+                hit = fields_by_type.get(ws["type"])
+                if hit is None:
+                    out.append(Finding(
+                        check=self.name, path=path, line=ws["line"],
+                        context=f"WIRE_SPECS[{ws['type']!r}]",
+                        message=f"WIRE_SPECS names {ws['type']!r} but "
+                                f"no registered message declares that "
+                                f"TYPE with a FIELDS schema"))
+                    continue
+                _cpath, c = hit
+                want_req = [x for x in c["fields"]
+                            if not x.endswith("?")]
+                want_opt = [x[:-1] for x in c["fields"]
+                            if x.endswith("?")]
+                if list(ws["req"]) != want_req or \
+                        list(ws["opt"]) != want_opt:
+                    out.append(Finding(
+                        check=self.name, path=path, line=ws["line"],
+                        context=f"WIRE_SPECS[{ws['type']!r}]",
+                        message=f"WIRE_SPECS[{ws['type']!r}] drifted "
+                                f"from {c['name']}.FIELDS: table says "
+                                f"({list(ws['req'])}, "
+                                f"{list(ws['opt'])}), declaration "
+                                f"derives ({want_req}, {want_opt})"))
 
         for name, (declared, _required) in sorted(schemas.items()):
             if name in has_dynamic:

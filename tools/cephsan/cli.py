@@ -29,7 +29,7 @@ from typing import List, Optional
 FIXED_SEEDS = (1, 7, 23)
 
 DEFAULT_SUITES = ("tests/test_thrash.py", "tests/test_sharded_wq.py",
-                  "tests/test_group_commit.py")
+                  "tests/test_group_commit.py", "tests/test_wire.py")
 
 
 def _fresh_seed() -> int:

@@ -60,6 +60,9 @@ def _merged_histograms(osds) -> dict:
 
 async def run(args) -> dict:
     cfg = Config()
+    for kv in getattr(args, "opt", []):
+        key, _, val = kv.partition("=")
+        cfg.set(key.strip(), val.strip())
     async with MiniCluster(n_osds=args.osds, config=cfg,
                            store=args.store) as c:
         c.create_ec_pool(
@@ -173,6 +176,8 @@ async def run(args) -> dict:
         print(perf_histogram.format_histograms(hists), file=sys.stderr)
         return {
             "metric": "osd_write_path",
+            "opts": dict(kv.partition("=")[::2]
+                         for kv in getattr(args, "opt", [])),
             "seconds": round(elapsed, 3),
             "ops": totals["ops"],
             "op_per_s": round(totals["ops"] / elapsed, 1),
@@ -206,6 +211,12 @@ def main() -> None:
                    help="objectstore backend: mem (default) or block "
                         "(raw-block WAL store — real fsyncs, real "
                         "group commit)")
+    p.add_argument("-o", "--opt", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="config override, daemon-style (e.g. -o "
+                        "osd_ec_batch_min_device_bytes=1000000000000 "
+                        "keeps small encodes on the host GF path when "
+                        "no accelerator is attached)")
     args = p.parse_args()
     print(json.dumps(asyncio.run(run(args))))
 
