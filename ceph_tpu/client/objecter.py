@@ -266,7 +266,7 @@ class Objecter(Dispatcher):
                         f"op on {oid} blocked by osd backoff "
                         f"({rec.reason}) for {parked:.1f}s")
                 continue        # re-target: the map may have moved it
-            fut = asyncio.get_event_loop().create_future()
+            fut = asyncio.get_running_loop().create_future()
             self._inflight[tid] = fut
             fields = {"tid": tid, "pool": tgt_pool, "pg": tgt_pg,
                       "oid": oid, "ops": ops, "reqid": reqid,

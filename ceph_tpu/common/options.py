@@ -346,6 +346,20 @@ OPTIONS: "dict[str, Option]" = _opts(
                 "osd_op_num_threads_per_shard analog; total concurrency "
                 "= osd_op_num_shards x this)",
            services=("osd",)),
+    Option("osd_op_batch_max", int, 32, LEVEL_ADVANCED, min=1,
+           desc="max client ops drained per shard wakeup AND max ops "
+                "coalesced into one batched sub-write per PG (one wire "
+                "frame / one store transaction / one pg-log persist per "
+                "shard per batch; 1 = the per-op pre-batching behavior)",
+           services=("osd",)),
+    Option("osd_op_batch_window_us", float, 0.0, LEVEL_ADVANCED, min=0,
+           desc="extra microseconds a shard pump waits for more ops "
+                "when its queue already has depth (>1 queued) before "
+                "cutting the dequeue burst — the msgr cork window "
+                "applied to op dispatch (0 = one event-loop yield, "
+                "coalescing whatever is already runnable; qd1 never "
+                "waits)",
+           services=("osd",)),
     Option("osd_mclock_scheduler_client_res", float, 50.0, LEVEL_ADVANCED,
            min=0, desc="mclock: client reservation (ops/s)"),
     Option("osd_mclock_scheduler_client_wgt", float, 2.0, LEVEL_ADVANCED,

@@ -83,10 +83,17 @@ class Message:
         """-> (header bytes, data).  The header is the FIELDS-driven
         flat binary encoding (msg/wire.py); ``data`` passes through
         un-materialized — a BufferList stays a BufferList so the frame
-        builder can export it as iovecs instead of concatenating."""
+        builder can export it as iovecs instead of concatenating.
+
+        ``self.compat_version`` (instance attribute, defaults to the
+        class constant) lets a frame whose CONTENT requires newer
+        decode semantics — e.g. a batched sub-write vector — advertise
+        the higher floor, so an older decoder rejects it instead of
+        silently misapplying the fields it does understand."""
         try:
-            header = wire.encode_header(type(self), self.fields,
-                                        self.priority)
+            header = wire.encode_header(
+                type(self), self.fields, self.priority,
+                compat=getattr(self, "compat_version", None))
         except wire.WireError as e:
             raise MessageError(f"cannot encode {self.TYPE}: {e}")
         return header, self.data

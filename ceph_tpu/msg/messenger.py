@@ -36,6 +36,7 @@ from ..common.buffer import BufferList
 from ..common.throttle import Throttle
 from ..common.log import dout
 from ..ops import crc32c as crcmod
+from . import wire
 from .message import Message, MessageError, decode_message
 
 MAGIC = 0x43545032  # "CTP2"
@@ -275,7 +276,7 @@ class Connection:
             return
         self._out_q.append(frame)
         if self._flush_done is None:
-            self._flush_done = asyncio.get_event_loop().create_future()
+            self._flush_done = asyncio.get_running_loop().create_future()
         done = self._flush_done
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.ensure_future(self._flush_loop())
@@ -657,7 +658,7 @@ class _LocalConnection:
             # behind it; await our own delivery so failures still reach
             # the sender (the write path's commit gate depends on send
             # errors surfacing, not being logged away)
-            fut = asyncio.get_event_loop().create_future()
+            fut = asyncio.get_running_loop().create_future()
             self._backlog.append((msg, fut))
             await fut
             return
@@ -729,17 +730,27 @@ class _LocalConnection:
             self.peer = new
             self.peer_name = new.name
             self._reverse = None
-        # re-encode/decode the header: no shared mutable state between
-        # daemons.  The DATA segment is shared zero-copy — BufferList
-        # raws are immutable from construction (and freeze-on-handoff
-        # seals them at this send when the sanitizer is armed), so the
-        # receiver aliases the sender's bytes safely; this is the same
-        # ownership contract a wire transfer enforces physically.
-        header, data = msg.encode()
+        # Structured isolation copy: no shared mutable state between
+        # daemons, with EXACTLY the codec round-trip's coercions
+        # (wire.copy_value — tuples->lists, int keys->str) and the
+        # codec's error surface, but no byte assembly/parsing — the
+        # full encode+decode per local delivery was a top slice of the
+        # saturated single-process profile.  The DATA segment is
+        # shared zero-copy — BufferList raws are immutable from
+        # construction (and freeze-on-handoff seals them at this send
+        # when the sanitizer is armed), so the receiver aliases the
+        # sender's bytes safely; this is the same ownership contract a
+        # wire transfer enforces physically.
+        try:
+            fields = wire.copy_fields(msg.fields)
+        except wire.WireError as e:
+            raise MessageError(f"cannot encode {msg.TYPE}: {e}")
+        data = msg.data
         if not isinstance(data, BufferList):
             data = BufferList(data) if data else BufferList()
-        peer_msg = decode_message(header, data,
-                                  from_name=self.messenger.name)
+        peer_msg = type(msg)(fields, data)
+        peer_msg.priority = msg.priority
+        peer_msg.from_name = self.messenger.name
         await self.peer._deliver(self._get_reverse(), peer_msg)
 
     def mark_down(self) -> None:

@@ -64,12 +64,15 @@ WIRE_SPECS: "Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]" = {
     "osd_op": (("tid", "pool", "pg", "oid", "ops", "map_epoch"),
                ("reqid", "trace_id", "ticket", "internal")),
     "osd_op_reply": (("tid", "result", "outs"), ("retry_auth",)),
+    # optionals are APPEND-ONLY (the version-skew contract): "batch" /
+    # "tids" (batched sub-write dispatch) ride behind the older ones
     "ec_sub_write": (("pgid", "shard", "from_osd", "tid", "epoch",
                       "at_version", "trim_to", "roll_forward_to",
-                      "log_entries", "txn", "lens"), ("trace",)),
+                      "log_entries", "txn", "lens"),
+                     ("trace", "batch")),
     "ec_sub_write_reply": (("pgid", "shard", "from_osd", "tid",
                             "committed", "applied"),
-                           ("error", "missing")),
+                           ("error", "missing", "tids")),
     "ec_sub_read": (("pgid", "shard", "from_osd", "tid", "to_read",
                      "attrs_to_read"), ("trace",)),
     "ec_sub_read_reply": (("pgid", "shard", "from_osd", "tid",
@@ -334,6 +337,65 @@ def _dec_value(buf, pos: int, depth: int = 0) -> "Tuple[Any, int]":
     raise WireError(f"unknown value tag 0x{tag:02x}")
 
 
+def copy_value(v: Any, depth: int = 0) -> Any:
+    """Structured deep copy with EXACTLY the codec round-trip's
+    coercions — what ``_dec_value(_enc_value(v))`` returns, without
+    byte assembly or parsing: tuples come back lists, np scalars come
+    back Python numbers, bytes views materialize, dict keys coerce via
+    ``_enc_key``.  Raises WireError on values the wire codec would
+    refuse, so the local transport (whose per-delivery isolation copy
+    runs through here instead of a full encode+decode) keeps one
+    error surface with tcp."""
+    if depth > _MAX_DEPTH:
+        raise WireError("value nesting too deep")
+    t = type(v)
+    if t is int or t is str or t is float:
+        return v
+    if t is list or t is tuple:
+        return [copy_value(i, depth + 1) for i in v]
+    if t is dict:
+        out = {}
+        for k, item in v.items():
+            key = k if type(k) is str else _enc_key(k)
+            # same byte-length guard as the codec's _key_bytes (one
+            # error surface with tcp); the cheap char-count test skips
+            # the utf-8 encode for every plausible key (utf-8 is at
+            # most 4 bytes per char)
+            if len(key) > 0x3FFF and len(key.encode()) > 0xFFFF:
+                raise WireError(f"dict key / field name too long "
+                                f"({len(key.encode())} bytes > u16)")
+            out[key] = copy_value(item, depth + 1)
+        return out
+    if v is None or v is True or v is False:
+        return v
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    if isinstance(v, str):
+        return str(v)
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return bytes(v)
+    if isinstance(v, (list, tuple)):
+        return [copy_value(i, depth + 1) for i in v]
+    if isinstance(v, dict):
+        out = {}
+        for k, item in v.items():
+            key = _enc_key(k) if type(k) is not str else k
+            if len(key) > 0x3FFF and len(key.encode()) > 0xFFFF:
+                raise WireError(f"dict key / field name too long "
+                                f"({len(key.encode())} bytes > u16)")
+            out[key] = copy_value(item, depth + 1)
+        return out
+    raise WireError(f"unencodable field value of type "
+                    f"{type(v).__name__}: {v!r}")
+
+
+def copy_fields(fields: "Dict[str, Any]") -> "Dict[str, Any]":
+    """Per-field ``copy_value`` over a message's fields dict."""
+    return {name: copy_value(v) for name, v in fields.items()}
+
+
 # --- header codec ------------------------------------------------------------
 
 _FIXED = struct.Struct("<BBBIHH")  # head_v, compat_v, prio, bitmap,
@@ -341,9 +403,13 @@ _FIXED = struct.Struct("<BBBIHH")  # head_v, compat_v, prio, bitmap,
 
 
 def encode_header(cls, fields: "Dict[str, Any]",
-                  priority: int = 127) -> bytes:
+                  priority: int = 127,
+                  compat: "Optional[int]" = None) -> bytes:
     """One message's header bytes: TYPE + versions + FIELDS-packed
-    payload (the json.dumps replacement)."""
+    payload (the json.dumps replacement).  ``compat`` overrides the
+    class COMPAT_VERSION for frames whose content requires newer
+    decode semantics (decoders reject compat above their
+    HEAD_VERSION)."""
     spec = spec_for(cls)
     out = bytearray()
     tname = cls.TYPE.encode()
@@ -378,7 +444,8 @@ def encode_header(cls, fields: "Dict[str, Any]",
         if bitmap & (1 << idx):
             _enc_value(req_vals, fields[name])
     out += _FIXED.pack(cls.HEAD_VERSION & 0xFF,
-                       cls.COMPAT_VERSION & 0xFF,
+                       (cls.COMPAT_VERSION if compat is None
+                        else compat) & 0xFF,
                        max(0, min(255, int(priority))),
                        bitmap, n_opt, n_named)
     out += req_vals

@@ -38,6 +38,9 @@ class MemStore(ObjectStore):
         self._mounted = False
         self._undo: "Optional[list]" = None
         self._saved: "Optional[set]" = None
+        # (cid, oid) -> omap keys with an individual undo recorded
+        # this txn (the per-key fast path below)
+        self._omap_saved: "Optional[dict]" = None
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -55,10 +58,12 @@ class MemStore(ObjectStore):
     def _txn_begin(self) -> None:
         self._undo = []
         self._saved = set()
+        self._omap_saved = {}
 
     def _txn_commit(self) -> None:
         self._undo = None
         self._saved = None
+        self._omap_saved = None
 
     def _txn_rollback(self) -> None:
         assert self._undo is not None
@@ -66,6 +71,7 @@ class MemStore(ObjectStore):
             action()
         self._undo = None
         self._saved = None
+        self._omap_saved = None
 
     def _save_obj(self, cid: Collection, oid: ObjectId) -> None:
         # one rollback snapshot per object PER TXN: the first snapshot
@@ -138,6 +144,14 @@ class MemStore(ObjectStore):
         self._undo.append(lambda: self._colls.__setitem__(cid, prev))
 
     def _touch(self, cid, oid) -> None:
+        # touch on an EXISTING object mutates nothing — recording a
+        # whole-object rollback snapshot for it copied the PG meta
+        # object's entire per-entry log omap once per write-path
+        # transaction (O(log length), a top slice of the saturated
+        # profile)
+        coll = self._coll(cid)
+        if oid in coll:
+            return
         self._mutate(cid, oid, create=True)
 
     def _write(self, cid, oid, off: int, data) -> None:
@@ -183,11 +197,52 @@ class MemStore(ObjectStore):
         obj = self._mutate(cid, oid)
         obj.attrs.pop(name, None)
 
+    def _omap_mutate(self, cid, oid, keys, create: bool) -> _Obj:
+        """Per-KEY omap undo: mutating k keys of an N-key omap costs
+        O(k), not the O(N) whole-object snapshot — the PG meta object
+        holds one omap key per log entry, so the whole-object path
+        made every write-path transaction pay O(log length).
+
+        Composes with _save_obj: once a whole-object snapshot exists
+        (``_saved``), per-key undos are unnecessary; if per-key undos
+        were recorded FIRST, rollback replays the (later-appended)
+        whole snapshot first and the per-key undos then restore the
+        earlier-mutated keys on top — reversed-order replay keeps both
+        paths consistent."""
+        coll = self._colls.get(cid)
+        obj = coll.get(oid) if coll is not None else None
+        if obj is None:
+            # object created by this txn: the whole-object path's
+            # snapshot=None restore (pop) undoes everything
+            return self._mutate(cid, oid, create=create)
+        key = (cid, oid)
+        if key in self._saved:
+            return obj
+        seen = self._omap_saved.setdefault(key, set())
+        for k in keys:
+            if k in seen:
+                continue
+            seen.add(k)
+            old = obj.omap.get(k)
+
+            def undo(coll=coll, oid=oid, k=k, old=old):
+                cur = coll.get(oid)
+                if cur is None:
+                    return
+                if old is None:
+                    cur.omap.pop(k, None)
+                else:
+                    cur.omap[k] = old
+
+            self._undo.append(undo)
+        return obj
+
     def _omap_set(self, cid, oid, kv) -> None:
-        self._mutate(cid, oid, create=True).omap.update(kv)
+        self._omap_mutate(cid, oid, kv.keys(), create=True).omap \
+            .update(kv)
 
     def _omap_rm(self, cid, oid, keys) -> None:
-        obj = self._mutate(cid, oid)
+        obj = self._omap_mutate(cid, oid, keys, create=False)
         for k in keys:
             obj.omap.pop(k, None)
 

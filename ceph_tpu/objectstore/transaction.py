@@ -151,6 +151,23 @@ class Transaction:
         self.ops.extend(other.ops)
         return self
 
+    def merge(self, other: "Transaction") -> "Transaction":
+        """Fold another staging onto this one (batched sub-write
+        dispatch: per-op stagings become ONE atomic store apply per
+        shard per batch).  Ordered concatenation — op order within and
+        across the merged stagings is preserved — except redundant
+        collection creates collapse (every op of a batch targets the
+        same shard collection; backends reject duplicate mkcoll)."""
+        have_colls = {op["cid"] for op in self.ops
+                      if op["op"] == OP_MKCOLL}
+        for op in other.ops:
+            if op["op"] == OP_MKCOLL:
+                if op["cid"] in have_colls:
+                    continue
+                have_colls.add(op["cid"])
+            self.ops.append(op)
+        return self
+
     def encode(self) -> bytes:
         """Offline serialization (objectstore_tool / QA fixtures):
         buffers hex-pack here, and ONLY here — the data path never

@@ -15,6 +15,7 @@ generation):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional
 
 NO_SHARD = -1
@@ -37,7 +38,12 @@ class ObjectId:
     def key(self) -> str:
         return f"{self.name}.{self.shard}.{self.generation}"
 
+    # cached: store backends re-parse the same handful of hot keys on
+    # every transaction op (two parses per _apply_op was a visible
+    # slice of the saturated write profile); ids are frozen, so
+    # sharing instances is safe
     @classmethod
+    @lru_cache(maxsize=4096)
     def from_key(cls, key: str) -> "ObjectId":
         name, shard, gen = key.rsplit(".", 2)
         return cls(name, int(shard), int(gen))
@@ -53,6 +59,7 @@ class Collection:
         return f"{self.pool}.{self.pg}.{self.shard}"
 
     @classmethod
+    @lru_cache(maxsize=1024)
     def from_key(cls, key: str) -> "Collection":
         pool, pg, shard = key.split(".")
         return cls(int(pool), int(pg), int(shard))

@@ -44,7 +44,7 @@ from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDBackoff, MOSDOp,
                        MOSDOpReply, MOSDPGPush, MOSDPGPushReply, MOSDPing,
                        MOSDPingReply, MWatchNotify, pack_buffers,
-                       unpack_buffers)
+                       sub_write_tids, unpack_buffers)
 from .osdmap import OSDMap
 from ..common.throttle import Throttle
 
@@ -57,6 +57,12 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("op_r", "client reads")
           .add_u64_counter("subop_w", "ec sub writes served")
           .add_u64_counter("subop_r", "ec sub reads served")
+          # batched sub-write dispatch: frames built per fan-out (one
+          # per shard per PG-batch — frames/op < 1 once batches exceed
+          # the shard count is the wire-amortization proof)
+          .add_u64_counter("subop_w_frames",
+                           "ec sub-write frames built (one per shard "
+                           "per batch)")
           .add_u64_counter("tier_promote", "cache-tier promotions")
           .add_u64_counter("tier_flush", "cache-tier flushes to base")
           .add_u64_counter("tier_evict", "cache-tier evictions")
@@ -90,6 +96,15 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_histogram("osd_shard_queue_depth",
                          "op work-queue depth at enqueue (per shard)",
                          "ops")
+          # batched sub-write dispatch (scheduler batch dequeue ->
+          # per-PG coalesce -> one sub-write/shard): ops per issued
+          # PG-batch and txns per shard-side batched apply
+          .add_histogram("osd_op_batch_size",
+                         "client ops coalesced per batched sub-write "
+                         "issue (per PG-batch)", "ops")
+          .add_histogram("osd_subwrite_batch_txns",
+                         "transactions applied per batched sub-write "
+                         "(shard side)", "txns")
           .add_histogram("osd_wal_group_commit_batch",
                          "transactions folded per WAL group commit",
                          "txns")
@@ -1549,13 +1564,17 @@ class OSDDaemon(Dispatcher):
                 # one): a straggler sub-write from a primary that
                 # planned before a pg_num split would land the object
                 # in a collection reads no longer consult.  Rejecting
-                # makes the primary fail the op; the client retries
-                # against the post-split placement.
-                await conn.send_message(MECSubOpWriteReply({
-                    "pgid": list(pgid_m), "shard": msg["shard"],
-                    "from_osd": self.whoami, "tid": msg["tid"],
-                    "committed": False, "applied": False,
-                    "error": f"wrong pg for {wrong} (pg_num split)"}))
+                # makes the primary fail the op(s); the clients retry
+                # against the post-split placement.  Batched frames
+                # reject wholesale — the apply would have been one
+                # atomic transaction.
+                rej = {"pgid": list(pgid_m), "shard": msg["shard"],
+                       "from_osd": self.whoami, "tid": msg["tid"],
+                       "committed": False, "applied": False,
+                       "error": f"wrong pg for {wrong} (pg_num split)"}
+                if msg.get("batch"):
+                    rej["tids"] = sub_write_tids(msg)
+                await conn.send_message(MECSubOpWriteReply(rej))
                 return True
             be = self._get_backend(pgid_m)
             self.perf.inc("subop_w")
@@ -1649,19 +1668,29 @@ class OSDDaemon(Dispatcher):
         try:
             reply = await be.handle_sub_write(msg)
         except Exception as e:  # noqa: BLE001 — failed apply: this
-            # shard misses the write; a committed:False reply makes
-            # the primary fail the op promptly (a silent drop would
-            # wedge the strictly-ordered commit queue behind it)
+            # shard misses the write(s); a committed:False reply makes
+            # the primary fail the op(s) promptly (a silent drop would
+            # wedge the strictly-ordered commit queue behind them).
+            # The batch applied as one atomic transaction, so EVERY
+            # carried entry's object is missing here — one reply acks
+            # them all via tids.
             dout("osd", 0, f"sub_write apply failed: "
                            f"{type(e).__name__}: {e}")
             for entry in msg.get("log_entries", []):
                 be.local_missing[entry["oid"]] = tuple(
                     entry["version"])
-            reply = MECSubOpWriteReply({
-                "pgid": list(msg["pgid"]), "shard": msg["shard"],
-                "from_osd": self.whoami, "tid": msg["tid"],
-                "committed": False, "applied": False,
-                "error": f"apply failed: {type(e).__name__}"})
+            # missing=True: same contract as a failed LOCAL apply — the
+            # primary records these objects missing on this shard and
+            # the durable count decides each ack (peering repairs us),
+            # instead of hard-failing ops that other shards hold safely
+            failed = {"pgid": list(msg["pgid"]), "shard": msg["shard"],
+                      "from_osd": self.whoami, "tid": msg["tid"],
+                      "committed": False, "applied": False,
+                      "missing": True,
+                      "error": f"apply failed: {type(e).__name__}"}
+            if msg.get("batch"):
+                failed["tids"] = sub_write_tids(msg)
+            reply = MECSubOpWriteReply(failed)
         if span:
             span.finish("committed" if reply.get("committed")
                         else "rejected")

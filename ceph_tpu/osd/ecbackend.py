@@ -5,11 +5,21 @@ pipeline keeps the reference's three ordered waitlists drained by a
 ``check_ops`` loop (ECBackend.cc:1865-2156):
 
     waiting_state  -> try_state_to_reads   (plan RMW, launch stripe reads)
-    waiting_reads  -> try_reads_to_commit  (encode, fan out sub-writes)
+    waiting_reads  -> issue pump           (collect the READY RUN, encode
+                                            it as one device batch, fan
+                                            out ONE sub-write per shard)
     waiting_commit -> try_finish_rmw       (all shards committed -> reply)
 
 so writes to a PG commit strictly in submission order even when RMW reads
-for a later op finish before an earlier op's.  Reads are asynchronous
+for a later op finish before an earlier op's.  Batched sub-write dispatch
+(this PR's shape; reference: MOSDECSubOpWrite carries an ECSubWrite
+*vector*): admissions only append, and a spawned issue pump drains runs
+of ready ops — up to ``osd_op_batch_max``, distinct oids, barriers alone
+— into one wire frame / one handle_sub_write task / one merged store
+transaction / one pg-log persist per shard per batch, with one reply
+completing every rider.  While a batch's encode + fan-out holds the
+pipeline lock, the next batch accumulates behind it (the WAL group
+committer's self-clocking window, applied to dispatch).  Reads are asynchronous
 with shard selection via ``minimum_to_decode``
 (get_min_avail_to_read_shards, ECBackend.cc:1594-1631), per-shard crc32c
 verification on full-chunk reads (handle_sub_read, ECBackend.cc:1080-1093),
@@ -55,6 +65,13 @@ from .pglog import LogEntry, PGLog, Version, ZERO, ver
 from .scheduler import StartGateChain
 
 NONE_OSD = -1
+# issue-pump admission-drain bound: how long a pump pass yields while
+# writers are parked behind the admission locks (they land one per
+# event-loop pass), so they join the forming batch instead of forcing
+# singleton issues.  A bound, not a window: with no admissions pending
+# the pump never waits, and a writer stuck past it (degraded wait)
+# only costs the next pass this much again.
+_ADMISSION_DRAIN_S = 0.0005
 HINFO_KEY = "hinfo_key"      # reference ECUtil.h (xattr carrying HashInfo)
 OI_KEY = "_"                 # reference OI_ATTR (object_info_t xattr)
 PGMETA_OID = "_pgmeta_"      # per-collection pg metadata object
@@ -67,6 +84,11 @@ def _fallback_spawn(coro, context: str = "") -> "asyncio.Task":
 
 class ECError(Exception):
     pass
+
+
+class _MeshPayloadGone(Exception):
+    """A device-mesh payload handle was evicted before the shard could
+    fetch it — the sub-write (whole batch) degrades to missing."""
 
 
 class NotActive(ECError):
@@ -154,6 +176,25 @@ class Op:
     # stage marks land on it so dump_historic_ops shows the breakdown
     tracked: "Any" = None
     on_commit: "asyncio.Future" = None          # type: ignore[assignment]
+
+
+class _WritePrep:
+    """Per-op staging context for a batched sub-write issue: the
+    synchronous planning output (_prep_sub_write) that the encode phase
+    and the per-shard message builder consume."""
+
+    __slots__ = ("op", "shard_txns", "entry", "hinfo", "is_append",
+                 "new_oi", "stripe_items", "use_mesh")
+
+    def __init__(self, op: "Op") -> None:
+        self.op = op
+        self.shard_txns: "Dict[int, dict]" = {}
+        self.entry: "Optional[LogEntry]" = None
+        self.hinfo = None
+        self.is_append = False
+        self.new_oi: "Optional[ObjectInfo]" = None
+        self.stripe_items: "List[Tuple[int, np.ndarray]]" = []
+        self.use_mesh = False
 
 
 @dataclass
@@ -309,10 +350,26 @@ class ECBackend:
         # attempt must WAIT on it, not re-enqueue the mutation (a
         # second enqueue would double-apply an append)
         self.inflight_reqids: "Dict[str, Op]" = {}
-        # local-staging start-order chain (_local_sub_write): each op's
-        # store staging runs before its successor's, on ANY legal
-        # schedule, while durability waits still overlap
+        # local-staging start-order chain (_local_sub_write): each
+        # batch's store staging runs before its successor's, on ANY
+        # legal schedule, while durability waits still overlap
         self._local_stage_chain = StartGateChain()
+        # batched issue pump: admissions append to waiting_state and
+        # kick; the pump collects READY RUNS off the pipeline head and
+        # issues each as one batched sub-write per shard.  Group-commit
+        # shape (the WAL committer's, applied to dispatch): while one
+        # batch's encode + fan-out holds the pipeline lock, the next
+        # batch accumulates behind it.
+        self._pump_task: "Optional[asyncio.Task]" = None
+        self._pump_wanted = False
+        # writers between submit entry and waiting_state (parked on the
+        # admission locks): the pump's batching window lingers while
+        # any are en route, so they join THIS batch instead of forcing
+        # a singleton issue each (admissions drain one per loop pass
+        # through the cls_lock -> pipeline-lock chain; without the
+        # linger the pump's FIFO re-acquire alternates with them and
+        # every batch degenerates to size 1)
+        self._admissions_pending = 0
         # peering request/reply correlation (MPGInfo / MPGRewindAck / ...)
         self.pending_queries: "Dict[int, asyncio.Future]" = {}
         self.peering = False
@@ -705,23 +762,31 @@ class ECBackend:
             # reserve SYNCHRONOUSLY, before the first await: two
             # attempts interleaving their degraded/cls waits must
             # still collapse to one enqueue
-            fut = asyncio.get_event_loop().create_future()
+            fut = asyncio.get_running_loop().create_future()
             self.inflight_reqids[reqid] = fut
         try:
-            # degraded-object wait happens BEFORE taking cls_lock:
-            # parking under the lock would serialize every write to the
-            # PG behind one object's recovery (enqueue re-checks under
-            # the admission loop for the rare re-degrade race)
-            await self._wait_degraded(oid, trace_id)
-            # brief cls_lock hold for the ENQUEUE only: object-class
-            # executions hold it across their reads + enqueue, so a
-            # plain write can never slip between a cls method's read and
-            # its buffered-write admission (lost-update window)
-            async with self.cls_lock:
-                op = await self.enqueue_transaction(oid, ops,
-                                                    trace_id=trace_id,
-                                                    tracked=tracked,
-                                                    reqid=reqid)
+            # announce the admission to the issue pump's batching
+            # window BEFORE the first park: a writer queued behind the
+            # admission locks joins the forming batch instead of
+            # forcing a singleton issue
+            self._admissions_pending += 1
+            try:
+                # degraded-object wait happens BEFORE taking cls_lock:
+                # parking under the lock would serialize every write to
+                # the PG behind one object's recovery (enqueue re-checks
+                # under the admission loop for the rare re-degrade race)
+                await self._wait_degraded(oid, trace_id)
+                # brief cls_lock hold for the ENQUEUE only: object-class
+                # executions hold it across their reads + enqueue, so a
+                # plain write can never slip between a cls method's read
+                # and its buffered-write admission (lost-update window)
+                async with self.cls_lock:
+                    op = await self.enqueue_transaction(oid, ops,
+                                                        trace_id=trace_id,
+                                                        tracked=tracked,
+                                                        reqid=reqid)
+            finally:
+                self._admissions_pending -= 1
             version = await op.on_commit
         except BaseException as e:
             if reqid:
@@ -761,7 +826,7 @@ class ECBackend:
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
                 trace_id=trace_id, tracked=tracked, reqid=reqid,
                 admitted_at=time.monotonic())
-        op.on_commit = asyncio.get_event_loop().create_future()
+        op.on_commit = asyncio.get_running_loop().create_future()
         self._hit_set_track(oid)
         # peering drains + blocks the pipeline (reference: client ops are
         # requeued until the PG is Active again).  The peering check must
@@ -789,7 +854,11 @@ class ECBackend:
                 self._prepare_plan(op)
                 self.waiting_state.append(op)
                 self.tid_to_op[op.tid] = op
-                await self._check_ops()
+                # admission only APPENDS; the issue pump (spawned, not
+                # inline) collects the ready run — so a burst of
+                # admissions lands in waiting_state before the pump's
+                # first pass and issues as ONE batched sub-write
+                self._kick_issue()
                 break
         return op
 
@@ -890,18 +959,120 @@ class ECBackend:
 
     # --- pipeline stage 1: RMW reads -----------------------------------------
 
+    def _kick_issue(self) -> None:
+        """Schedule an issue-pump pass (synchronous, idempotent): one
+        pump task per backend drains the pipeline; kicks while it runs
+        fold into one extra pass."""
+        if self._pump_task is not None and not self._pump_task.done():
+            self._pump_wanted = True
+            return
+        self._pump_wanted = False
+        self._pump_task = self._spawn(self._issue_pump(), "issue_pump")
+
+    async def _issue_pump(self) -> None:
+        """The pipeline drain task.  Holds the lock across each pass
+        (encode + fan-out included, exactly like the old inline issue),
+        so admissions arriving mid-batch park behind it and form the
+        NEXT batch — the group-commit self-clock.
+
+        The admission-drain linger: admissions drain one per event-loop
+        pass (each holds cls_lock while waiting on the pipeline lock),
+        so before each pass the pump yields while writers are still en
+        route — bounded by _ADMISSION_DRAIN_S so a parked writer
+        (degraded wait, backoff) can never stall issue.  qd1 pays
+        nothing: no pending admissions, no wait.  (The configurable
+        osd_op_batch_window_us is the SCHEDULER's dequeue window; this
+        linger is an implementation bound, not a tunable.)"""
+        while True:
+            if self._admissions_pending > 0:
+                # writers en route (parked behind the admission locks)
+                # drain one per event-loop pass — give them a bounded
+                # beat to land in waiting_state and join THIS batch
+                # instead of forcing singleton issues
+                deadline = time.monotonic() + _ADMISSION_DRAIN_S
+                while self._admissions_pending > 0 \
+                        and time.monotonic() < deadline:
+                    await asyncio.sleep(0)
+            async with self._lock:
+                if not self.peering:
+                    await self._check_ops()
+            if not self._pump_wanted:
+                return
+            self._pump_wanted = False
+
     async def _check_ops(self) -> None:
         """Drain the pipeline in order (reference check_ops
-        ECBackend.cc:2151).  Caller holds self._lock."""
+        ECBackend.cc:2151), issuing ready runs as BATCHED sub-writes.
+        Caller holds self._lock."""
         progressed = True
         while progressed:
             progressed = False
-            if self.waiting_state and self._state_head_ready():
+            # drain waiting_state FULLY before collecting, so a run of
+            # admissions becomes one batch instead of head-at-a-time
+            # singletons
+            while self.waiting_state and self._state_head_ready():
                 await self._try_state_to_reads()
                 progressed = True
-            if self.waiting_reads and not self.waiting_reads[0].reads_pending:
-                await self._try_reads_to_commit()
+            before = len(self.waiting_reads)
+            batch = self._collect_ready_batch()
+            if batch:
+                await self._issue_sub_writes(batch)
                 progressed = True
+            elif len(self.waiting_reads) != before:
+                # the collector popped only dedup'd retries (acked from
+                # completed_reqids, nothing to issue) — that still
+                # unblocks the state queue's head (a barrier waits for
+                # waiting_reads to empty), so loop again or a parked
+                # delete/truncate would hang until an unrelated kick
+                progressed = True
+
+    def _collect_ready_batch(self) -> "List[Op]":
+        """Pop the ready run off the head of waiting_reads: consecutive
+        ops with their RMW reads done, pairwise-distinct oids, up to
+        osd_op_batch_max — the unit one batched sub-write per shard
+        carries.  FIFO strictly preserved: the run never skips past a
+        reads-pending head, so commit order stays admission order.
+
+        Constraints that end a run early:
+        - barrier ops (delete / cache-invalidating truncate) issue
+          alone (they already reached here alone — _state_head_ready
+          drains the pipeline first — but never share a batch),
+        - same-oid ops issue in separate batches, so each op's
+          hinfo/object-info staging reads its predecessor's applied
+          state exactly as the per-op path did,
+        - the device-mesh plane keeps its per-op handle protocol.
+
+        Per-op reqid dedup runs HERE, at batch build (not after): an
+        op whose mutation became authoritative while it waited (e.g.
+        peering republished the auth log's reqids after the admission
+        re-check) is acked with its committed version and never
+        applied a second time — a batch mixing fresh ops and retries
+        double-applies nothing."""
+        limit = max(1, int(self.opt("osd_op_batch_max", 32)))
+        out: "List[Op]" = []
+        oids: "Set[str]" = set()
+        while self.waiting_reads and len(out) < limit:
+            op = self.waiting_reads[0]
+            if op.reads_pending:
+                break
+            if op.reqid and op.reqid in self.completed_reqids:
+                self.waiting_reads.pop(0)
+                self.tid_to_op.pop(op.tid, None)
+                self._unproject(op)
+                if not op.on_commit.done():
+                    op.on_commit.set_result(
+                        self.completed_reqids[op.reqid])
+                continue
+            barrier = op.delete or (op.plan is not None
+                                    and op.plan.invalidates_cache)
+            if out and (barrier or op.oid in oids
+                        or self._mesh_usable()):
+                break
+            out.append(self.waiting_reads.pop(0))
+            oids.add(op.oid)
+            if barrier or self._mesh_usable():
+                break
+        return out
 
     def _state_head_ready(self) -> bool:
         """Truncates/deletes are pipeline barriers: they must wait for
@@ -921,10 +1092,6 @@ class ECBackend:
                 o.oid == op.oid for o in self.waiting_reads):
             return False
         return True
-
-    async def _kick(self) -> None:
-        async with self._lock:
-            await self._check_ops()
 
     async def _try_state_to_reads(self) -> None:
         op = self.waiting_state.pop(0)
@@ -968,8 +1135,7 @@ class ECBackend:
             data = self._reconstruct_extent(shard_bufs, off, length)
             op.read_data[off] = np.frombuffer(data, dtype=np.uint8)
         op.reads_pending = False
-        async with self._lock:
-            await self._check_ops()
+        self._kick_issue()
 
     def _fail_op(self, op: Op, err: Exception) -> None:
         self._release_mesh_handles(op)
@@ -996,15 +1162,6 @@ class ECBackend:
         self._check_commit_queue()
 
     # --- pipeline stage 2: encode + fan out ----------------------------------
-
-    async def _try_reads_to_commit(self) -> None:
-        op = self.waiting_reads.pop(0)
-        # op joins waiting_commit inside _issue_sub_writes only AFTER the
-        # (possibly awaited, batched-device) encode completes: an op
-        # sitting in waiting_commit with an empty pending_commits set
-        # would look fully-acked to a concurrently-running
-        # _check_commit_queue and be completed before any shard was sent
-        await self._issue_sub_writes(op)
 
     def _materialize_stripes(self, op: Op) -> "Dict[int, np.ndarray]":
         """Merge old RMW stripes with new write payloads into full
@@ -1042,43 +1199,133 @@ class ECBackend:
                     buf[lo - off:hi - off] = arr[lo - woff:hi - woff]
         return out
 
-    async def _issue_sub_writes(self, op: Op) -> None:
-        """Encode and fan out (reference try_reads_to_commit
-        ECBackend.cc:1939 -> ECTransaction::generate_transactions
-        ECTransaction.cc:97 -> encode_and_write :25)."""
+    async def _issue_sub_writes(self, ops: "List[Op]") -> None:
+        """Encode a ready PG-batch and fan it out as ONE batched
+        sub-write per shard (reference try_reads_to_commit
+        ECBackend.cc:1939 -> generate_transactions ECTransaction.cc:97,
+        with MOSDECSubOpWrite carrying the whole ECSubWrite vector).
+
+        Caller holds self._lock; ``ops`` is a ready run in admission
+        order (distinct oids, barriers alone — _collect_ready_batch).
+        The batch is the amortization unit: one wire frame, one
+        handle_sub_write task, one merged store transaction, and one
+        pg-log persist per shard per batch; every op's encode rides
+        one gathered device submission."""
         acting = self.get_acting()
-        op.acting = list(acting)
-        op.version = (self.last_epoch, self.pg_log.head[1] + 1)
-        # stage telemetry: pipeline wait ends, the encode stage starts
         t_encode = time.monotonic()
-        self._stage_hinc("op_w_queue_lat", t_encode - op.admitted_at)
-        if op.tracked is not None:
-            op.tracked.mark("encode_start")
+        base_v = self.pg_log.head[1]
+        for i, op in enumerate(ops):
+            op.acting = list(acting)
+            # contiguous eversion range reserved for the WHOLE batch up
+            # front: version minting happens only under the pipeline
+            # lock, so nothing can interleave between these (cephsan
+            # seed 12's single-op invariant, extended batch-wide); the
+            # log entries themselves are added post-encode, still under
+            # the same lock hold
+            op.version = (self.last_epoch, base_v + 1 + i)
+            self._stage_hinc("op_w_queue_lat", t_encode - op.admitted_at)
+            if op.tracked is not None:
+                op.tracked.mark("encode_start")
+        preps = [self._prep_sub_write(op) for op in ops]
+
+        # --- encode phase: one gathered submission for the batch ----------
+        if preps[0].use_mesh:
+            # device-mesh plane keeps its per-op handle protocol
+            # (_collect_ready_batch caps mesh batches at one op)
+            if not await self._mesh_encode(preps[0]):
+                return
+        else:
+            jobs = [(prep, off, buf) for prep in preps
+                    for off, buf in prep.stripe_items]
+            enc_results = None
+            if self.encode_service is not None and jobs:
+                # every stripe of every op in the batch rides one
+                # gathered submission — the PG-batch hands the cross-PG
+                # EncodeService one multi-stripe device batch instead
+                # of N singletons
+                try:
+                    gathered = await asyncio.gather(*(
+                        self.encode_service.encode(
+                            self.sinfo, self.codec, buf,
+                            with_crc=prep.is_append)
+                        for prep, _off, buf in jobs))
+                except Exception as e:  # noqa: BLE001 — fail the batch
+                    # cleanly: the store apply is all-or-nothing per
+                    # batch, so a failed encode fails every rider (no
+                    # entries were reserved yet; clients retry)
+                    for op in ops:
+                        self._fail_op(op, ECError(
+                            f"batched encode failed for {op.oid}: {e}"))
+                    return
+                enc_results = {(id(prep), off): res for (prep, off, _b),
+                               res in zip(jobs, gathered)}
+            for prep in preps:
+                self._finish_prep(prep, enc_results)
+
+        # --- commit-stage entry: atomic w.r.t. the event loop --------------
+        # Reserve the batch's log entries and enter waiting_commit with
+        # the full pending sets BEFORE any send awaits: an op sitting
+        # in waiting_commit with an empty pending set would look
+        # fully-acked to a concurrent _check_commit_queue.
+        for prep in preps:
+            if prep.entry.version > self.pg_log.head:
+                self.pg_log.add(prep.entry)
+        # log trimming: once the log exceeds osd_max_pg_log_entries,
+        # trim down to osd_min_pg_log_entries (never past the rollback
+        # horizon — trim_to clamps); the point rides every sub-write
+        trim_to = self.pg_log.tail
+        maxe = self.opt("osd_max_pg_log_entries", 10000)
+        mine = self.opt("osd_min_pg_log_entries", 250)
+        if len(self.pg_log.entries) > maxe:
+            keep_from = max(0, len(self.pg_log.entries) - mine)
+            trim_to = self.pg_log.entries[keep_from - 1].version \
+                if keep_from else self.pg_log.tail
+        now = time.monotonic()
+        for op in ops:
+            op.sent_at = now
+            if not op.delete:
+                self._stage_hinc("op_w_encode_lat", now - t_encode)
+            if op.tracked is not None:
+                op.tracked.mark("encoded")
+                op.tracked.mark("subops_sent")
+            op.pending_commits = {
+                s for s in range(self.k + self.m)
+                if s < len(acting) and acting[s] != NONE_OSD}
+            self.waiting_commit.append(op)
+        if self.perf is not None:
+            self.perf.hinc("osd_op_batch_size", len(ops))
+        await self._send_sub_writes(ops, preps, acting, trim_to)
+        self._check_commit_queue()
+
+    def _prep_sub_write(self, op: Op) -> "_WritePrep":
+        """Synchronous planning half of the issue: digest the op into
+        per-shard transaction skeletons + encode jobs.  No awaits —
+        every op of a batch plans against the same pipeline snapshot."""
+        prep = _WritePrep(op)
         if op.delete or op.plan.invalidates_cache:
             # barrier op (pipeline drained, see _state_head_ready): drop
             # cached pre-truncate/pre-delete stripes
             self.extent_cache.invalidate(op.oid)
-
         # pool-snapshot COW: first mutation after a newer pool snap
         # clones every shard's chunk to the snap generation (negative
         # gens: the rollback machinery reaps only its own version gens)
         snap_clone = 0
         if self.pool_snap_seq > op.oi.snap_seq and op.oi.version != ZERO:
             snap_clone = self.pool_snap_seq
-        shard_txns: "Dict[int, dict]" = {}
         if op.delete:
             rollback = {"clone_gen": op.version[1]}
             for shard in range(self.k + self.m):
-                shard_txns[shard] = {"delete": True, "gen": op.version[1]}
+                prep.shard_txns[shard] = {"delete": True,
+                                          "gen": op.version[1]}
                 if snap_clone:
-                    shard_txns[shard]["snap_clone"] = snap_clone
+                    prep.shard_txns[shard]["snap_clone"] = snap_clone
         else:
             stripes = self._materialize_stripes(op)
             born = (op.oi.born_seq if op.oi.version != ZERO
                     else self.pool_snap_seq)
-            new_oi = ObjectInfo(op.plan.projected_size, op.version,
-                                max(op.oi.snap_seq, self.pool_snap_seq),
-                                born)
+            prep.new_oi = ObjectInfo(
+                op.plan.projected_size, op.version,
+                max(op.oi.snap_seq, self.pool_snap_seq), born)
             hinfo = (ecutil.HashInfo(self.k + self.m) if op.rewrite
                      else self._get_hinfo(op.oid))
             # crc chain: a full rewrite starts fresh; a pure
@@ -1093,7 +1340,8 @@ class ECBackend:
                                .aligned_logical_offset_to_chunk_offset(o)
                                == hinfo.total_chunk_size
                                for o in stripes))
-            is_append = op.rewrite or extends
+            prep.hinfo = hinfo
+            prep.is_append = op.rewrite or extends
             # rollback: truncating back to the old size only undoes a
             # pure extension; any write that REPLACES existing bytes
             # (write_full included) needs a generation clone — and for a
@@ -1101,247 +1349,245 @@ class ECBackend:
             rollback = ({"append_from": op.oi.size} if extends
                         else {"clone_gen": op.version[1]})
             for shard in range(self.k + self.m):
-                shard_txns[shard] = {"writes": [],
-                                     "oi": new_oi.encode().hex(),
-                                     "rollback": rollback}
+                prep.shard_txns[shard] = {"writes": [],
+                                          "oi": prep.new_oi.encode().hex(),
+                                          "rollback": rollback}
                 if snap_clone:
-                    shard_txns[shard]["snap_clone"] = snap_clone
-            use_mesh = self._mesh_usable()
-            stripe_items = sorted(stripes.items())
-            enc_results = None
-            if not use_mesh and self.encode_service is not None \
-                    and len(stripe_items) > 1:
-                # multi-stripe op: submit every stripe's encode in one
-                # shot so they ride ONE batched device launch instead
-                # of len(stripes) serial awaits (a 4 MiB write is 8
-                # stripes — serial submission capped its own batch at 1)
-                try:
-                    gathered = await asyncio.gather(*(
-                        self.encode_service.encode(
-                            self.sinfo, self.codec, buf,
-                            with_crc=is_append)
-                        for _off, buf in stripe_items))
-                except Exception as e:  # noqa: BLE001
-                    self._fail_op(op, ECError(
-                        f"batched encode failed for {op.oid}: {e}"))
-                    return
-                enc_results = {o: r for (o, _b), r in
-                               zip(stripe_items, gathered)}
-            for off, buf in stripe_items:
-                crcs = None
-                if use_mesh:
-                    # device-mesh plane: ring-encode + per-shard crc as
-                    # XLA collectives; chunk bytes stay on the sharded
-                    # device array, the sub-write message carries only a
-                    # handle for plane-sharing shard servers (reference
-                    # fan-out seam ECBackend.cc:2074-2084)
-                    try:
-                        arr8 = as_u8_array(buf)
-                        shards_k = self.sinfo.split_to_shards(arr8)
-                        # off-loop: the crc fetch inside encode() blocks
-                        # on the device; other PG pipelines keep running
-                        handle, crcs_b = await asyncio.get_event_loop() \
-                            .run_in_executor(None, self.mesh_plane.encode,
-                                             self.codec, shards_k[None])
-                        op.mesh_handles.append(handle)
-                        chunk_off = self.sinfo \
-                            .aligned_logical_offset_to_chunk_offset(off)
-                        Wb = int(shards_k.shape[1])
-                        if is_append:
-                            hinfo.append_crcs(chunk_off, crcs_b[0], Wb)
-                        else:
-                            hinfo.invalidate()
-                        for shard in range(self.k + self.m):
-                            tgt = (acting[shard] if shard < len(acting)
-                                   else NONE_OSD)
-                            if tgt == NONE_OSD:
-                                continue  # hole: no txn will be sent
-                            if self.mesh_plane.shares(tgt):
-                                shard_txns[shard].setdefault(
-                                    "mesh_writes", []).append(
-                                    [chunk_off, handle, 0, Wb])
-                            else:
-                                # cross-host: inline bytes ride the
-                                # messenger exactly as before
-                                shard_txns[shard]["writes"].append(
-                                    (chunk_off,
-                                     self.mesh_plane.take(handle, 0,
-                                                          shard)))
-                    except Exception as e:  # noqa: BLE001 — fail cleanly
-                        # mirror the encode_service contract: the client
-                        # gets the error and pipeline state is unwound
-                        # (a raised exception here would leak an
-                        # unresolved on_commit future forever)
-                        self._fail_op(op, ECError(
-                            f"mesh encode failed for {op.oid}: {e}"))
-                        return
-                    self.extent_cache.present_rmw_update(op.oid, off, buf)
-                    op.pinned.append((off, int(np.size(buf))))
-                    continue
-                if enc_results is not None:
-                    allc, crcs = enc_results[off]
-                    shards = {s: allc[s] for s in range(self.k + self.m)}
-                elif self.encode_service is not None:
-                    # daemon-wide batched device encode: this op's stripes
-                    # ride one (B, k, W) launch with every other PG's
-                    # pending sub-writes, crc32c fused on device.  A
-                    # failed batch fails THIS op cleanly (client gets the
-                    # error, pipeline state unwound) instead of leaking a
-                    # hung on_commit future out of the queues.
-                    try:
-                        allc, crcs = await self.encode_service.encode(
-                            self.sinfo, self.codec, buf,
-                            with_crc=is_append)
-                    except Exception as e:  # noqa: BLE001
-                        self._fail_op(op, ECError(
-                            f"batched encode failed for {op.oid}: {e}"))
-                        return
-                    shards = {s: allc[s] for s in range(self.k + self.m)}
+                    prep.shard_txns[shard]["snap_clone"] = snap_clone
+            prep.stripe_items = sorted(stripes.items())
+            prep.use_mesh = self._mesh_usable()
+        prep.entry = LogEntry(op.version, op.oid,
+                              "delete" if op.delete else "modify",
+                              prior_version=op.oi.version,
+                              rollback=rollback, reqid=op.reqid)
+        return prep
+
+    def _finish_prep(self, prep: "_WritePrep",
+                     enc_results: "Optional[dict]") -> None:
+        """Apply encode outputs (or run the host encode) and finish the
+        per-shard transactions: hinfo chaining, write tables, extent
+        cache pins, truncate/attr/omap tails.  Synchronous."""
+        op = prep.op
+        if op.delete:
+            return
+        hinfo = prep.hinfo
+        for off, buf in prep.stripe_items:
+            crcs = None
+            if enc_results is not None:
+                allc, crcs = enc_results[(id(prep), off)]
+                shards = {s: allc[s] for s in range(self.k + self.m)}
+            else:
+                shards = ecutil.encode(self.sinfo, self.codec, buf)
+            chunk_off = \
+                self.sinfo.aligned_logical_offset_to_chunk_offset(off)
+            if prep.is_append:
+                if crcs is not None:
+                    hinfo.append_crcs(chunk_off, crcs, allc.shape[1])
                 else:
-                    shards = ecutil.encode(self.sinfo, self.codec, buf)
-                chunk_off = \
-                    self.sinfo.aligned_logical_offset_to_chunk_offset(off)
-                if is_append:
-                    if crcs is not None:
-                        hinfo.append_crcs(chunk_off, crcs,
-                                          allc.shape[1])
-                    else:
-                        hinfo.append(chunk_off,
-                                     {s: np.asarray(c) for s, c in
-                                      shards.items()})
+                    hinfo.append(chunk_off,
+                                 {s: np.asarray(c) for s, c in
+                                  shards.items()})
+            else:
+                hinfo.invalidate()
+            for shard, chunk in shards.items():
+                # chunk rides as the device-encode output array —
+                # pack_buffers adopts it into the sub-write's
+                # BufferList data segment without a bytes round-trip
+                prep.shard_txns[shard]["writes"].append((chunk_off,
+                                                         chunk))
+            self.extent_cache.present_rmw_update(op.oid, off, buf)
+            op.pinned.append((off, int(np.size(buf))))
+        self._finish_txn_tail(prep)
+
+    def _finish_txn_tail(self, prep: "_WritePrep") -> None:
+        op = prep.op
+        hinfo = prep.hinfo
+        if not prep.stripe_items and (op.truncate_to is not None
+                                      or op.writes):
+            # a bare truncate breaks the chain; pure xattr/omap ops
+            # leave the data (and its hashes) untouched
+            hinfo.invalidate()
+        if op.truncate_to is not None:
+            ct = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                self.sinfo.logical_to_next_stripe_offset(op.truncate_to))
+            for st in prep.shard_txns.values():
+                st["truncate"] = ct
+        hhex = hinfo.encode().hex()
+        for st in prep.shard_txns.values():
+            st["hinfo"] = hhex
+        for name, value in op.attr_sets.items():
+            for st in prep.shard_txns.values():
+                st.setdefault("attrs", {})[name] = value.hex()
+        if op.omap_sets:
+            kvhex = {k: v.hex() for k, v in op.omap_sets.items()}
+            for st in prep.shard_txns.values():
+                st["omap_set"] = kvhex
+        if op.omap_rms:
+            for st in prep.shard_txns.values():
+                st["omap_rm"] = list(op.omap_rms)
+
+    async def _mesh_encode(self, prep: "_WritePrep") -> bool:
+        """Device-mesh encode path (pool flag device_mesh): ring-encode
+        + per-shard crc as XLA collectives; chunk bytes stay on the
+        sharded device array, the sub-write carries only a handle for
+        plane-sharing shard servers (reference fan-out seam
+        ECBackend.cc:2074-2084).  Per-op (mesh batches are the device
+        batch).  Returns False after failing the op cleanly."""
+        op = prep.op
+        acting = op.acting
+        hinfo = prep.hinfo
+        for off, buf in prep.stripe_items:
+            try:
+                arr8 = as_u8_array(buf)
+                shards_k = self.sinfo.split_to_shards(arr8)
+                # off-loop: the crc fetch inside encode() blocks on the
+                # device; other PG pipelines keep running
+                handle, crcs_b = await asyncio.get_event_loop() \
+                    .run_in_executor(None, self.mesh_plane.encode,
+                                     self.codec, shards_k[None])
+                op.mesh_handles.append(handle)
+                chunk_off = self.sinfo \
+                    .aligned_logical_offset_to_chunk_offset(off)
+                Wb = int(shards_k.shape[1])
+                if prep.is_append:
+                    hinfo.append_crcs(chunk_off, crcs_b[0], Wb)
                 else:
                     hinfo.invalidate()
-                for shard, chunk in shards.items():
-                    # chunk rides as the device-encode output array —
-                    # pack_buffers adopts it into the sub-write's
-                    # BufferList data segment without a bytes round-trip
-                    shard_txns[shard]["writes"].append((chunk_off, chunk))
-                self.extent_cache.present_rmw_update(op.oid, off, buf)
-                op.pinned.append((off, int(np.size(buf))))
-            if not stripes and (op.truncate_to is not None or op.writes):
-                # a bare truncate breaks the chain; pure xattr/omap ops
-                # leave the data (and its hashes) untouched
-                hinfo.invalidate()
-            if op.truncate_to is not None:
-                ct = self.sinfo.aligned_logical_offset_to_chunk_offset(
-                    self.sinfo.logical_to_next_stripe_offset(
-                        op.truncate_to))
-                for st in shard_txns.values():
-                    st["truncate"] = ct
-            hhex = hinfo.encode().hex()
-            for st in shard_txns.values():
-                st["hinfo"] = hhex
-            for name, value in op.attr_sets.items():
-                for st in shard_txns.values():
-                    st.setdefault("attrs", {})[name] = value.hex()
-            if op.omap_sets:
-                kvhex = {k: v.hex() for k, v in op.omap_sets.items()}
-                for st in shard_txns.values():
-                    st["omap_set"] = kvhex
-            if op.omap_rms:
-                for st in shard_txns.values():
-                    st["omap_rm"] = list(op.omap_rms)
+                for shard in range(self.k + self.m):
+                    tgt = (acting[shard] if shard < len(acting)
+                           else NONE_OSD)
+                    if tgt == NONE_OSD:
+                        continue  # hole: no txn will be sent
+                    if self.mesh_plane.shares(tgt):
+                        prep.shard_txns[shard].setdefault(
+                            "mesh_writes", []).append(
+                            [chunk_off, handle, 0, Wb])
+                    else:
+                        # cross-host: inline bytes ride the
+                        # messenger exactly as before
+                        prep.shard_txns[shard]["writes"].append(
+                            (chunk_off,
+                             self.mesh_plane.take(handle, 0, shard)))
+            except Exception as e:  # noqa: BLE001 — fail cleanly
+                # mirror the encode_service contract: the client gets
+                # the error and pipeline state is unwound (a raised
+                # exception here would leak an unresolved on_commit
+                # future forever)
+                self._fail_op(op, ECError(
+                    f"mesh encode failed for {op.oid}: {e}"))
+                return False
+            self.extent_cache.present_rmw_update(op.oid, off, buf)
+            op.pinned.append((off, int(np.size(buf))))
+        self._finish_txn_tail(prep)
+        return True
 
-        entry = LogEntry(op.version, op.oid,
-                         "delete" if op.delete else "modify",
-                         prior_version=op.oi.version, rollback=rollback,
-                         reqid=op.reqid)
-        # reserve the version in the log NOW, synchronously (we still
-        # hold the pipeline lock): local staging runs as a spawned task
-        # and task first-steps are not ordered by spawn order, so the
-        # next op's version assignment (head+1 at encode) must see this
-        # head advance — or two ops mint the same eversion and the
-        # later pg_log.add silently rejects one entry while its data
-        # and ack survive (cephsan seed 12: o6 acked+readable at (2,4),
-        # displaced from every log by o0@(2,4)).  handle_sub_write's
-        # `version > head` guard skips the duplicate local add.
-        if entry.version > self.pg_log.head:
-            self.pg_log.add(entry)
-
-        # log trimming: once the log exceeds osd_max_pg_log_entries,
-        # trim down to osd_min_pg_log_entries (never past the rollback
-        # horizon — trim_to clamps); the point rides every sub-write
-        trim_to = self.pg_log.tail
-        maxe = self.opt("osd_max_pg_log_entries", 10000)
-        mine = self.opt("osd_min_pg_log_entries", 250)
-        if len(self.pg_log.entries) > maxe:
-            keep_from = max(0, len(self.pg_log.entries) - mine)
-            trim_to = self.pg_log.entries[keep_from - 1].version \
-                if keep_from else self.pg_log.tail
-
-        # encode done — now (atomically w.r.t. the event loop) enter the
-        # commit stage with the full pending set before any send awaits
-        op.sent_at = time.monotonic()
-        if not op.delete:
-            self._stage_hinc("op_w_encode_lat", op.sent_at - t_encode)
-        if op.tracked is not None:
-            op.tracked.mark("encoded")
-            op.tracked.mark("subops_sent")
-        op.pending_commits = {s for s in range(self.k + self.m)
-                              if s < len(acting) and acting[s] != NONE_OSD}
-        self.waiting_commit.append(op)
-        # fan out remotes first, then apply locally (reference sends
-        # MOSDECSubOpWrite then calls handle_sub_write on itself)
-        local_msgs = []
-        for shard in sorted(op.pending_commits):
-            txn = shard_txns.get(shard, {"writes": []})
-            bufs = [d for _, d in txn.get("writes", [])]
-            lens, blob = pack_buffers(bufs)
-            wire_txn = dict(txn)
-            wire_txn["writes"] = [[o, len(d)]
-                                  for o, d in txn.get("writes", [])]
+    async def _send_sub_writes(self, ops: "List[Op]",
+                               preps: "List[_WritePrep]", acting,
+                               trim_to: Version) -> None:
+        """Build ONE MECSubOpWrite per shard carrying the whole batch
+        and fan out: remotes first, then the local shards as ordered
+        tasks (reference sends MOSDECSubOpWrite then calls
+        handle_sub_write on itself).  A batch of one is wired exactly
+        as the legacy single-op frame."""
+        shards_wanted = sorted({s for op in ops
+                                for s in op.pending_commits})
+        local_msgs: "List[Tuple[int, MECSubOpWrite, List[Op]]]" = []
+        for shard in shards_wanted:
+            subs: "List[Tuple[Op, dict]]" = []
+            entries_l: "List[dict]" = []
+            all_bufs: "List" = []
+            for prep in preps:
+                op = prep.op
+                if shard not in op.pending_commits:
+                    continue
+                txn = prep.shard_txns.get(shard, {"writes": []})
+                wire_txn = dict(txn)
+                wire_txn["writes"] = [
+                    [o, buffer_length(d)]
+                    for o, d in txn.get("writes", [])]
+                subs.append((op, wire_txn))
+                entries_l.append(prep.entry.to_dict())
+                all_bufs.extend(d for _o, d in txn.get("writes", []))
+            if not subs:
+                continue
+            lens, blob = pack_buffers(all_bufs)
             fields = {
                 "pgid": list(self.pgid), "shard": shard,
-                "from_osd": self.whoami, "tid": op.tid,
+                "from_osd": self.whoami, "tid": subs[0][0].tid,
                 "epoch": self.last_epoch,
-                "at_version": list(op.version),
+                "at_version": list(subs[-1][0].version),
                 "trim_to": list(trim_to),
                 "roll_forward_to": list(self.pg_log.can_rollback_to),
-                "log_entries": [entry.to_dict()],
-                "txn": wire_txn, "lens": lens}
-            if op.trace_id:
+                "log_entries": entries_l,
+                "txn": subs[0][1] if len(subs) == 1 else {"writes": []},
+                "lens": lens}
+            if len(subs) > 1:
+                # per-op vector; write payloads consume the shared data
+                # segments in order (lens stays the flat global table)
+                fields["batch"] = [{"tid": o.tid,
+                                    "at_version": list(o.version),
+                                    "txn": wt} for o, wt in subs]
+            traced = next((o for o, _wt in subs if o.trace_id), None)
+            if traced is not None:
                 # child span per EC sub-write crossing the messenger
-                # (reference ECBackend.cc:2063-2068 ZTracer child)
-                fields["trace"] = {"id": op.trace_id, "span": "sub_write"}
+                # (reference ECBackend.cc:2063-2068 ZTracer child);
+                # a batch rides its first traced op's span
+                fields["trace"] = {"id": traced.trace_id,
+                                   "span": "sub_write"}
             msg = MECSubOpWrite(fields, blob)
+            if len(subs) > 1:
+                # semantics-bearing content: a decoder that would skip
+                # the 'batch' optional (pre-v2) must reject the frame
+                # outright instead of applying the empty top-level txn
+                # and adopting every entry (log-ahead-of-data)
+                msg.compat_version = 2
+            if self.perf is not None:
+                # frames/op < 1 once batches exceed the shard count:
+                # the wire-amortization half of the batching story
+                self.perf.inc("subop_w_frames")
+            batch_ops = [o for o, _wt in subs]
             if acting[shard] == self.whoami:
-                local_msgs.append((shard, msg))
+                local_msgs.append((shard, msg, batch_ops))
             else:
                 try:
                     await self.send(acting[shard], msg)
                 except (ConnectionError, OSError, ECError) as e:
-                    # shard unreachable: the write is NOT durable there.
-                    # Never count it committed (that would let decode mix
-                    # in a stale chunk later) — record the object missing
-                    # on that shard so reads avoid it and peering repairs
-                    # it (reference: unacked shards are resolved by map
-                    # change + re-peering, PeeringState.h:654-1240).
+                    # shard unreachable: the write is NOT durable there
+                    # — for ANY op of the batch (one frame carried them
+                    # all).  Never count them committed (that would let
+                    # decode mix in a stale chunk later) — record each
+                    # object missing on that shard so reads avoid it
+                    # and peering repairs it (reference: unacked shards
+                    # are resolved by map change + re-peering).
                     dout("osd", 1, f"sub_write to shard {shard} "
                                    f"(osd.{acting[shard]}) failed: {e}")
-                    op.failed_shards.add(shard)
-                    op.pending_commits.discard(shard)
-                    self.peer_missing.setdefault(shard, {})[op.oid] = \
-                        op.version
-        for shard, msg in local_msgs:
+                    for op in batch_ops:
+                        op.failed_shards.add(shard)
+                        op.pending_commits.discard(shard)
+                        self.peer_missing.setdefault(
+                            shard, {})[op.oid] = op.version
+        for shard, msg, batch_ops in local_msgs:
             # own task per local shard: staging happens in creation
             # order via the start-gate chain in _local_sub_write (task
             # first-steps alone make no such promise), but the fsync
             # wait no longer head-of-line blocks this PG's pipeline —
-            # the next op's encode can join the device batch and its
+            # the next batch's encode can join the device batch and its
             # sub-write can join the store's group commit while we wait
             prev, gate = self._local_stage_chain.link()
-            self._spawn(self._local_sub_write(op, shard, msg, prev, gate),
+            self._spawn(self._local_sub_write(batch_ops, shard, msg,
+                                              prev, gate),
                         "local_sub_write")
-        self._check_commit_queue()
 
-    async def _local_sub_write(self, op: Op, shard: int,
+    async def _local_sub_write(self, ops: "List[Op]", shard: int,
                                msg: MECSubOpWrite,
                                prev: "Optional[asyncio.Future]",
                                gate: "asyncio.Future") -> None:
         """Apply the primary's own shard (reference: the OSD calls
-        handle_sub_write on itself after fanning out).
+        handle_sub_write on itself after fanning out).  One task per
+        BATCH per local shard; the store apply is one atomic
+        transaction, so the verdict below holds for every op of it.
 
-        StartGateChain: without it a later op's staging could run
+        StartGateChain: without it a later batch's staging could run
         before an earlier one's and the last store apply would win —
         leaving the primary's shard with the OLDER ObjectInfo/hinfo
         attrs for the object.  enter() falls without suspension into
@@ -1352,30 +1598,35 @@ class ECBackend:
             reply = await self.handle_sub_write(msg)
             if not reply.get("committed", True):
                 if reply.get("missing"):
-                    op.failed_shards.add(shard)
-                    op.pending_commits.discard(shard)
-                    self.peer_missing.setdefault(shard, {})[op.oid] \
-                        = op.version
-                    self.local_missing[op.oid] = op.version
+                    for op in ops:
+                        op.failed_shards.add(shard)
+                        op.pending_commits.discard(shard)
+                        self.peer_missing.setdefault(
+                            shard, {})[op.oid] = op.version
+                        self.local_missing[op.oid] = op.version
                     self._check_commit_queue()
                     return
-                self._fail_op(op, ECError(
-                    f"write {op.oid}: local shard {shard} rejected "
-                    f"stale interval"))
+                for op in ops:
+                    self._fail_op(op, ECError(
+                        f"write {op.oid}: local shard {shard} rejected "
+                        f"stale interval"))
                 return
         except Exception as e:  # noqa: BLE001 — failed local apply
-            # = this shard missed the write: record it missing and
-            # let peering repair, exactly like a failed remote send
+            # = this shard missed the whole batch (the apply is one
+            # atomic transaction): record every op missing and let
+            # peering repair, exactly like a failed remote send
             dout("osd", 0, f"local sub_write shard {shard} failed: "
                            f"{type(e).__name__}: {e}")
-            op.failed_shards.add(shard)
-            op.pending_commits.discard(shard)
-            self.peer_missing.setdefault(shard, {})[op.oid] = \
-                op.version
-            self.local_missing[op.oid] = op.version
+            for op in ops:
+                op.failed_shards.add(shard)
+                op.pending_commits.discard(shard)
+                self.peer_missing.setdefault(shard, {})[op.oid] = \
+                    op.version
+                self.local_missing[op.oid] = op.version
             self._check_commit_queue()
             return
-        self._sub_write_committed(op, shard)
+        for op in ops:
+            self._sub_write_committed(op, shard)
 
     # --- pipeline stage 3: commit --------------------------------------------
 
@@ -1446,40 +1697,61 @@ class ECBackend:
             op.on_commit.set_result(op.version)
         if self.waiting_state:
             # a drained pipeline may unblock a barrier op at the head
-            self._spawn(self._kick(), "pipeline_kick")
+            self._kick_issue()
 
     def handle_sub_write_reply(self, msg: MECSubOpWriteReply) -> None:
-        op = self.tid_to_op.get(int(msg["tid"]))
-        if op is None:
-            return
+        # one reply acks EVERY op the (possibly batched) sub-write
+        # carried — the shard's store apply was one atomic transaction,
+        # so the verdict holds for all of them
+        tids = [int(t) for t in (msg.get("tids") or [msg["tid"]])]
+        shard = int(msg["shard"])
         if not msg.get("committed", True):
             if msg.get("missing"):
                 # shard couldn't fetch its mesh payload (evicted
-                # handle): same contract as a dropped send — record
-                # missing, let the durable count decide the ack
-                shard = int(msg["shard"])
-                op.failed_shards.add(shard)
-                op.pending_commits.discard(shard)
-                self.peer_missing.setdefault(shard, {})[op.oid] = \
-                    op.version
+                # handle) or failed the batch apply: same contract as
+                # a dropped send — record missing, let the durable
+                # count decide the ack
+                for tid in tids:
+                    op = self.tid_to_op.get(tid)
+                    if op is None:
+                        continue
+                    op.failed_shards.add(shard)
+                    op.pending_commits.discard(shard)
+                    self.peer_missing.setdefault(shard, {})[op.oid] = \
+                        op.version
                 self._check_commit_queue()
                 return
             # shard rejected us as a deposed primary (or as the wrong
-            # pg after a split): never ack this op.  NotActive -> the
+            # pg after a split): never ack these ops.  NotActive -> the
             # client sees ESTALE and retries against the current
             # primary/placement instead of surfacing a hard error.
-            self._fail_op(op, NotActive(
-                f"write {op.oid} v{op.version}: shard {msg['shard']} "
-                f"rejected stale interval"))
+            for tid in tids:
+                op = self.tid_to_op.get(tid)
+                if op is not None:
+                    self._fail_op(op, NotActive(
+                        f"write {op.oid} v{op.version}: shard {shard} "
+                        f"rejected stale interval"))
             return
-        self._sub_write_committed(op, int(msg["shard"]))
+        for tid in tids:
+            op = self.tid_to_op.get(tid)
+            if op is not None:
+                self._sub_write_committed(op, shard)
 
     # ------------------------------------------------------------ shard side
 
     async def handle_sub_write(self, msg: MECSubOpWrite
                                ) -> MECSubOpWriteReply:
-        """Apply a per-shard transaction + log entries atomically
-        (reference handle_sub_write ECBackend.cc:915).
+        """Apply a (possibly batched) per-shard transaction vector +
+        log entries atomically (reference handle_sub_write
+        ECBackend.cc:915, over the message's whole ECSubWrite vector).
+
+        A batch stages every op into ONE merged store transaction, adds
+        every log entry under ONE snapshot, and pays ONE pg-meta
+        persist + ONE queue_transaction — the per-batch amortization
+        the primary's coalescing buys.  The apply is all-or-nothing:
+        a mid-batch store failure rolls back every entry of the batch
+        (snapshot restore below), and the single reply's verdict holds
+        for every carried tid.
 
         Async since the WAL group-commit change: the store APPLY is
         still synchronous (everything up to the final await runs
@@ -1488,6 +1760,17 @@ class ECBackend:
         committed=True reply still means exactly what it meant before:
         the transaction is on stable storage."""
         shard = int(msg["shard"])
+        batch = msg.get("batch")
+        tids = [int(s["tid"]) for s in batch] if batch else None
+
+        def _reply(verdict: dict) -> MECSubOpWriteReply:
+            rep = {"pgid": list(self.pgid), "shard": shard,
+                   "from_osd": self.whoami, "tid": int(msg["tid"]),
+                   **verdict}
+            if tids:
+                rep["tids"] = tids
+            return MECSubOpWriteReply(rep)
+
         if int(msg.get("epoch", 1 << 62)) < self.peered_epoch:
             # a NEWER primary has already peered us: this sub-write is
             # from a deposed interval and must not be applied — applying
@@ -1498,78 +1781,46 @@ class ECBackend:
                  f"sub_write epoch {msg.get('epoch')} < peered "
                  f"{self.peered_epoch}: rejecting deposed primary "
                  f"osd.{msg.get('from_osd')}")
-            return MECSubOpWriteReply({
-                "pgid": list(self.pgid), "shard": shard,
-                "from_osd": self.whoami, "tid": int(msg["tid"]),
-                "committed": False, "applied": False,
-                "error": "stale interval"})
+            return _reply({"committed": False, "applied": False,
+                           "error": "stale interval"})
         cid = self.coll(shard)
-        txn = dict(msg["txn"])
+        entries = [LogEntry.from_dict(e) for e in msg["log_entries"]]
+        # sub i's transaction pairs with log_entries[i]; the legacy
+        # single form is a vector of one
+        sub_txns = ([s["txn"] for s in batch] if batch
+                    else [msg["txn"]])
+        if self.perf is not None:
+            self.perf.hinc("osd_subwrite_batch_txns", len(sub_txns))
         bufs = unpack_buffers(list(msg.get("lens", [])), msg.data)
         t = Transaction()
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
-        entries = [LogEntry.from_dict(e) for e in msg["log_entries"]]
-        oid = entries[0].oid if entries else ""
-        sid = ObjectId(oid, shard)
+        bufi = 0
+        for i, sub_txn in enumerate(sub_txns):
+            oid = entries[i].oid if i < len(entries) else ""
+            sub_t = Transaction()
+            try:
+                bufi = self._stage_sub_txn(sub_t, cid, shard,
+                                           dict(sub_txn), oid, bufs,
+                                           bufi)
+            except _MeshPayloadGone as e:
+                # an evicted mesh handle degrades the WHOLE batch to
+                # the dropped-payload contract (the apply would have
+                # been one atomic transaction): reply missing=True, the
+                # primary records every object missing on this shard
+                # and the durable count decides each ack
+                dout("osd", 1, f"mesh handle {e} gone on shard "
+                               f"{shard}: degrading to missing")
+                return _reply({"committed": False, "applied": False,
+                               "missing": True,
+                               "error": "mesh handle evicted"})
+            t.merge(sub_t)
 
-        rollback = txn.get("rollback", {})
-        if txn.get("snap_clone") and self.store.exists(cid, sid):
-            # COW for a pool snapshot: preserve the pre-write chunk at
-            # the snap generation (gen -(snapid+2); NO_GEN is -1)
-            t.clone(cid, sid,
-                    sid.with_gen(-(int(txn["snap_clone"]) + 2)))
-        if txn.get("delete"):
-            # keep a rollback copy at generation until roll_forward reaps
-            if self.store.exists(cid, sid):
-                t.clone(cid, sid, sid.with_gen(int(txn.get("gen", 0))))
-                t.remove(cid, sid)
-        else:
-            if "clone_gen" in rollback and self.store.exists(cid, sid):
-                t.clone(cid, sid, sid.with_gen(int(rollback["clone_gen"])))
-            t.touch(cid, sid)
-            for i, (choff, _dlen) in enumerate(txn.get("writes", [])):
-                t.write(cid, sid, int(choff), bufs[i])
-            for mw in txn.get("mesh_writes", []):
-                # chunk bytes come off the shared device-mesh plane (our
-                # position's slice is device-local); an evicted handle
-                # degrades to the dropped-payload contract: reply
-                # missing=True, the primary records the object missing
-                # on this shard and the durable count decides the ack
-                choff, h, idx, ln = (int(x) for x in mw)
-                try:
-                    if self.mesh_plane is None:
-                        raise KeyError("no mesh plane attached")
-                    data = self.mesh_plane.take(h, idx, shard)
-                except KeyError:
-                    dout("osd", 1, f"mesh handle {h} gone on shard "
-                                   f"{shard}: degrading to missing")
-                    return MECSubOpWriteReply({
-                        "pgid": list(self.pgid), "shard": shard,
-                        "from_osd": self.whoami, "tid": int(msg["tid"]),
-                        "committed": False, "applied": False,
-                        "missing": True, "error": "mesh handle evicted"})
-                t.write(cid, sid, choff, data[:ln])
-            if "truncate" in txn:
-                t.truncate(cid, sid, int(txn["truncate"]))
-            if txn.get("oi"):
-                t.setattr(cid, sid, OI_KEY, bytes.fromhex(txn["oi"]))
-            if txn.get("hinfo"):
-                t.setattr(cid, sid, HINFO_KEY, bytes.fromhex(txn["hinfo"]))
-            for name, hexval in txn.get("attrs", {}).items():
-                t.setattr(cid, sid, name, bytes.fromhex(hexval))
-            if txn.get("omap_set"):
-                t.omap_setkeys(cid, sid, {
-                    k: bytes.fromhex(v)
-                    for k, v in txn["omap_set"].items()})
-            if txn.get("omap_rm"):
-                t.omap_rmkeys(cid, sid, list(txn["omap_rm"]))
-
-        # snapshot the in-memory log: if the store apply fails below, the
-        # log must not claim the entry was applied (a log ahead of the
-        # data would let peering elect a head no shard's bytes back).
-        # clone() shares entry objects — O(n) pointers, not a per-op
-        # full-log serialization
+        # snapshot the in-memory log ONCE for the batch: if the store
+        # apply fails below, the log must not claim ANY of these
+        # entries was applied (a log ahead of the data would let
+        # peering elect a head no shard's bytes back).  clone() shares
+        # entry objects — O(n) pointers, not a per-op serialization
         log_snapshot = self.pg_log.clone()
         gap_snapshot = self.log_gap_from
         for e in entries:
@@ -1603,10 +1854,12 @@ class ECBackend:
         except Exception:
             if not entries or self.pg_log.head == entries[-1].version:
                 # nothing interleaved past us: roll the in-memory log
-                # back so it never claims an entry no data backs.  On
-                # the primary's own shard the snapshot may already
-                # CONTAIN these entries (the encode path reserves its
-                # version in the log synchronously), so drop them
+                # back so it never claims an entry no data backs — ALL
+                # entries of the batch (the apply was one atomic
+                # transaction; none of its writes landed).  On the
+                # primary's own shard the snapshot may already CONTAIN
+                # these entries (the encode path reserves the batch's
+                # versions in the log synchronously), so drop them
                 # explicitly after the restore.
                 restored = log_snapshot
                 mine = {e.version for e in entries}
@@ -1629,10 +1882,68 @@ class ECBackend:
                 for e in entries:
                     self.local_missing[e.oid] = tuple(e.version)
             raise
-        return MECSubOpWriteReply({
-            "pgid": list(self.pgid), "shard": shard,
-            "from_osd": self.whoami, "tid": int(msg["tid"]),
-            "committed": True, "applied": True})
+        return _reply({"committed": True, "applied": True})
+
+    def _stage_sub_txn(self, t: Transaction, cid: Collection,
+                       shard: int, txn: dict, oid: str, bufs,
+                       bufi: int) -> int:
+        """Stage ONE op's shard transaction into ``t`` (the staging
+        body handle_sub_write runs per vector element).  ``bufs`` is
+        the message's global payload table; ``bufi`` the next unused
+        index — returns the advanced index.  Raises _MeshPayloadGone
+        when a device-mesh handle was evicted."""
+        sid = ObjectId(oid, shard)
+        rollback = txn.get("rollback", {})
+        if txn.get("snap_clone") and self.store.exists(cid, sid):
+            # COW for a pool snapshot: preserve the pre-write chunk at
+            # the snap generation (gen -(snapid+2); NO_GEN is -1)
+            t.clone(cid, sid,
+                    sid.with_gen(-(int(txn["snap_clone"]) + 2)))
+        if txn.get("delete"):
+            # keep a rollback copy at generation until roll_forward reaps
+            if self.store.exists(cid, sid):
+                t.clone(cid, sid, sid.with_gen(int(txn.get("gen", 0))))
+                t.remove(cid, sid)
+            return bufi
+        if "clone_gen" in rollback and self.store.exists(cid, sid):
+            t.clone(cid, sid, sid.with_gen(int(rollback["clone_gen"])))
+        if not txn.get("writes") and not txn.get("mesh_writes"):
+            # data writes create the object themselves on every
+            # backend; the explicit touch is only needed for
+            # metadata-only subs (truncate/attr/omap) — one fewer
+            # store op per op per shard on the hot path
+            t.touch(cid, sid)
+        for choff, _dlen in txn.get("writes", []):
+            t.write(cid, sid, int(choff), bufs[bufi])
+            bufi += 1
+        for mw in txn.get("mesh_writes", []):
+            # chunk bytes come off the shared device-mesh plane (our
+            # position's slice is device-local); an evicted handle
+            # degrades to the dropped-payload contract (caller replies
+            # missing=True)
+            choff, h, idx, ln = (int(x) for x in mw)
+            try:
+                if self.mesh_plane is None:
+                    raise KeyError("no mesh plane attached")
+                data = self.mesh_plane.take(h, idx, shard)
+            except KeyError:
+                raise _MeshPayloadGone(h)
+            t.write(cid, sid, choff, data[:ln])
+        if "truncate" in txn:
+            t.truncate(cid, sid, int(txn["truncate"]))
+        if txn.get("oi"):
+            t.setattr(cid, sid, OI_KEY, bytes.fromhex(txn["oi"]))
+        if txn.get("hinfo"):
+            t.setattr(cid, sid, HINFO_KEY, bytes.fromhex(txn["hinfo"]))
+        for name, hexval in txn.get("attrs", {}).items():
+            t.setattr(cid, sid, name, bytes.fromhex(hexval))
+        if txn.get("omap_set"):
+            t.omap_setkeys(cid, sid, {
+                k: bytes.fromhex(v)
+                for k, v in txn["omap_set"].items()})
+        if txn.get("omap_rm"):
+            t.omap_rmkeys(cid, sid, list(txn["omap_rm"]))
+        return bufi
 
     def handle_sub_read(self, msg: MECSubOpRead) -> MECSubOpReadReply:
         """Serve chunk extents with crc verification on whole-shard reads

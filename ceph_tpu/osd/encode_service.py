@@ -115,7 +115,7 @@ class EncodeService:
         # requests batch by (coding matrix, chunk width): any codec
         # instance with the same matrix shares the compiled device step
         key = (matrix.tobytes(), W)
-        fut: "asyncio.Future" = asyncio.get_event_loop().create_future()
+        fut: "asyncio.Future" = asyncio.get_running_loop().create_future()
         self._pending.setdefault(key, []).append(
             _Request(shards, with_crc, fut))
         self._codecs[key] = codec

@@ -95,20 +95,49 @@ class MECSubOpWrite(Message):
     trim_to, roll_forward_to, log_entries=[...], txn (encoded shard
     transaction dict with write payloads hex-free: offsets into data),
     lens (write-payload lengths indexing ``data``), epoch.
+
+    BATCHED form (one frame per shard per PG-batch, the reference's
+    ECSubWrite *vector* inside one MOSDECSubOpWrite): ``batch`` is a
+    list of per-op ``{tid, at_version, txn}`` dicts in admission
+    order, pairing 1:1 with ``log_entries`` (sub i's entry is
+    log_entries[i]); their write payloads consume the shared ``data``
+    segments in order (``lens`` stays the flat global table), and the
+    top-level tid/at_version are the first op's tid and the last op's
+    version.  A batch of one is wired EXACTLY as the legacy single
+    form (no ``batch`` field, compat 1).  Multi-op frames encode with
+    compat_version 2: ``batch`` is semantics-BEARING (the top-level
+    txn is empty and log_entries span every sub), so a v1 decoder
+    must REJECT the frame, not skip the optional and misapply what it
+    does understand.
     """
     TYPE = "ec_sub_write"
+    HEAD_VERSION = 2     # v2: the batched ECSubWrite vector
+    COMPAT_VERSION = 1   # single-op frames decode everywhere
     FIELDS = ("pgid", "shard", "from_osd", "tid", "epoch", "at_version",
               "trim_to", "roll_forward_to", "log_entries", "txn", "lens",
-              "trace?")        # child span crossing the messenger
+              "trace?",        # child span crossing the messenger
+              "batch?")        # per-op [{tid, at_version, txn}] vector
 
 
 @register_message
 class MECSubOpWriteReply(Message):
     """fields: pgid, shard, from_osd, tid, committed, applied;
-    error (errno) and missing (divergent-object hint) on failure."""
+    error (errno) and missing (divergent-object hint) on failure.
+    ``tids`` (batched sub-writes): every op tid this one reply acks —
+    the store apply was one atomic transaction, so committed/applied/
+    error verdicts hold for all of them."""
     TYPE = "ec_sub_write_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "committed", "applied",
-              "error?", "missing?")
+              "error?", "missing?", "tids?")
+
+
+def sub_write_tids(msg) -> "List[int]":
+    """Every op tid a (possibly batched) MECSubOpWrite carries, in
+    batch order — the tids its one reply must ack."""
+    batch = msg.get("batch")
+    if batch:
+        return [int(s["tid"]) for s in batch]
+    return [int(msg["tid"])]
 
 
 @register_message

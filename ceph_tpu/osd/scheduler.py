@@ -104,7 +104,7 @@ class MClockScheduler:
             if c.res else float("inf")
         c.p_tag = max(c.p_tag + 1.0 / c.wgt, now)
         c.l_tag = max(c.l_tag + (1.0 / c.lim if c.lim else 0.0), now)
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         fut._mclock = (c.r_tag, c.p_tag, c.l_tag)  # type: ignore[attr-defined]
         c.queue.append(fut)
         self._dispatch()
@@ -246,7 +246,7 @@ class StartGateChain:
         """Reserve the next place in the chain; synchronous — call at
         spawn, BEFORE the task exists."""
         prev = self._tail
-        gate = asyncio.get_event_loop().create_future()
+        gate = asyncio.get_running_loop().create_future()
         self._tail = gate
         return prev, gate
 
@@ -272,7 +272,7 @@ class _OpShard:
     queue and thread set)."""
 
     __slots__ = ("scheduler", "queue", "pump", "started", "enqueued",
-                 "start_chain")
+                 "start_chain", "bursts", "burst_ops", "max_burst")
 
     def __init__(self, scheduler) -> None:
         self.scheduler = scheduler
@@ -285,6 +285,10 @@ class _OpShard:
         # each item's first segment runs before its successor's, on
         # ANY legal schedule (see StartGateChain)
         self.start_chain = StartGateChain()
+        # batch-dequeue accounting: wakeup bursts and their sizes
+        self.bursts = 0
+        self.burst_ops = 0
+        self.max_burst = 0
 
 
 class ShardedOpWQ:
@@ -300,14 +304,22 @@ class ShardedOpWQ:
     - distinct PGs run concurrently, up to slots-per-shard in one shard
       and fully independently across shards,
     - mClock QoS (client vs recovery vs scrub) applies per shard, as in
-      the reference.
+      the reference,
+    - dequeue is BATCHED: one wakeup drains up to ``osd_op_batch_max``
+      ready ops in a burst (after an optional
+      ``osd_op_batch_window_us`` linger when the queue has depth), so
+      a loaded shard hands its PG pipelines whole runs of ops in one
+      event-loop pass — the admissions the ECBackend issue pump then
+      coalesces into batched sub-writes.
 
     The item itself runs as a task (spawned via ``task_factory``, so the
     daemon's crash guard wraps it) and releases its slot on completion.
     """
 
     def __init__(self, num_shards: int, scheduler_factory,
-                 task_factory=None, on_enqueue=None) -> None:
+                 task_factory=None, on_enqueue=None,
+                 batch_max: int = 32, batch_window_s: float = 0.0,
+                 on_batch=None) -> None:
         self.num_shards = max(1, int(num_shards))
         self.shards = [_OpShard(scheduler_factory())
                        for _ in range(self.num_shards)]
@@ -316,13 +328,26 @@ class ShardedOpWQ:
             lambda coro, _name: asyncio.ensure_future(coro))
         # on_enqueue(queue_depth): perf-histogram hook
         self._on_enqueue = on_enqueue
+        # batch dequeue: a shard wakeup drains up to batch_max ready
+        # ops in one burst (each still charged individually on the
+        # shard's scheduler, FIFO preserved); with queue depth (>1
+        # queued) the pump lingers batch_window_s for stragglers first
+        # — the msgr cork window applied to op dispatch
+        self.batch_max = max(1, int(batch_max))
+        self.batch_window_s = max(0.0, float(batch_window_s))
+        # on_batch(burst_size): perf-histogram hook per wakeup burst
+        self._on_batch = on_batch
 
     @classmethod
     def from_config(cls, config, task_factory=None,
-                    on_enqueue=None) -> "ShardedOpWQ":
+                    on_enqueue=None, on_batch=None) -> "ShardedOpWQ":
         return cls(int(config.get("osd_op_num_shards")),
                    lambda: MClockScheduler.from_config(config),
-                   task_factory=task_factory, on_enqueue=on_enqueue)
+                   task_factory=task_factory, on_enqueue=on_enqueue,
+                   batch_max=int(config.get("osd_op_batch_max")),
+                   batch_window_s=float(
+                       config.get("osd_op_batch_window_us")) / 1e6,
+                   on_batch=on_batch)
 
     def shard_of(self, pgid: "Tuple[int, int]") -> int:
         # stable across processes (hash() is salted): cheap mix of the
@@ -350,13 +375,37 @@ class ShardedOpWQ:
 
     async def _pump(self, shard: _OpShard) -> None:
         while shard.queue:
-            klass, fn, name = shard.queue.popleft()
-            # acquire BEFORE starting: items start strictly FIFO, so a
-            # later same-PG op can never reach the PG pipeline first
-            await shard.scheduler._acquire(klass)
-            shard.started += 1
-            prev, gate = shard.start_chain.link()
-            self._task_factory(self._run(shard, fn, prev, gate), name)
+            # adaptive dequeue window: with depth already queued, more
+            # arrivals are typically microseconds away — linger once so
+            # the burst (and the PG batches the backend builds from it)
+            # is as full as the load allows.  Depth of exactly 1 never
+            # waits: qd1 latency is untouched.
+            if 1 < len(shard.queue) < self.batch_max:
+                if self.batch_window_s > 0:
+                    await asyncio.sleep(self.batch_window_s)
+                else:
+                    # one event-loop yield: coalesce whatever is
+                    # already runnable (the ms_cork_flush_us=0 analog)
+                    await asyncio.sleep(0)
+            burst = 0
+            while shard.queue and burst < self.batch_max:
+                klass, fn, name = shard.queue.popleft()
+                # acquire BEFORE starting: items start strictly FIFO,
+                # so a later same-PG op can never reach the PG
+                # pipeline first.  Each op is charged individually on
+                # the shard scheduler — batching amortizes host work,
+                # never mClock accounting.
+                await shard.scheduler._acquire(klass)
+                shard.started += 1
+                prev, gate = shard.start_chain.link()
+                self._task_factory(self._run(shard, fn, prev, gate),
+                                   name)
+                burst += 1
+            shard.bursts += 1
+            shard.burst_ops += burst
+            shard.max_burst = max(shard.max_burst, burst)
+            if self._on_batch is not None:
+                self._on_batch(burst)
 
     async def _run(self, shard: _OpShard, fn, prev, gate) -> None:
         try:
@@ -371,8 +420,12 @@ class ShardedOpWQ:
     def dump(self) -> dict:
         return {
             "num_shards": self.num_shards,
+            "batch_max": self.batch_max,
             "shards": [{"queued": len(s.queue), "enqueued": s.enqueued,
-                        "started": s.started,
+                        "started": s.started, "bursts": s.bursts,
+                        "avg_burst": round(s.burst_ops / s.bursts, 2)
+                        if s.bursts else 0.0,
+                        "max_burst": s.max_burst,
                         "sched": dict(s.scheduler.stats)}
                        for s in self.shards]}
 

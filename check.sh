@@ -54,9 +54,13 @@ fi
 
 echo "== loadgen smoke (tools/loadgen.py) =="
 # one open-loop row over the binary wire path: nonzero exit when any
-# op fails or the generator goes closed-loop-bound (sched lag), i.e.
-# the offered rate stopped being honest
+# op fails, the generator goes closed-loop-bound (sched lag), or the
+# post-batching knee regresses — 600 op/s offered sits ABOVE the
+# pre-batching full-config knee (~500, PR 7 LOADGEN.json), and the
+# batched write path must still serve >= 400 of it in the smoke's
+# small 3-osd shape (the pre-batching path collapses earlier)
 env JAX_PLATFORMS=cpu python tools/loadgen.py --smoke \
+    --rates 600 --min-achieved 400 --objects 512 \
     -o osd_ec_batch_min_device_bytes=1000000000000
 lg_rc=$?
 if [ "$lg_rc" -ne 0 ]; then

@@ -1,0 +1,435 @@
+"""Batched sub-write dispatch — coalescing, dedup, and rollback tests.
+
+The batching contract (reference: one MOSDECSubOpWrite carries a whole
+ECSubWrite vector): a ready run of admitted ops issues as ONE sub-write
+per shard — one wire frame, one handle_sub_write apply, one merged
+store transaction, one pg-log persist — acknowledged by one reply that
+completes every rider.  These tests pin the three invariants the perf
+must not cost:
+
+- per-op reqid dedup filters AT BATCH BUILD (a batch mixing fresh ops
+  and retries double-applies nothing, including across a pg split),
+- a mid-batch store failure rolls back ALL entries of the batch on the
+  failing shard (all-or-nothing apply, log snapshot restore),
+- batched frames/replies are wire-faithful (batch vector + tids fan-in,
+  legacy single form byte-compatible).
+
+Marked cephsan: tools/cephsan replays these under seeded interleavings
+(batch formation is schedule-dependent; correctness must not be).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import sanitizer
+from ceph_tpu.common.config import Config
+from ceph_tpu.msg.message import decode_message
+from ceph_tpu.osd.ecbackend import ClientOp
+from ceph_tpu.osd.messages import (MECSubOpWrite, MECSubOpWriteReply,
+                                   sub_write_tids)
+from ceph_tpu.osd.scheduler import FifoScheduler, ShardedOpWQ
+from ceph_tpu.qa.cluster import MiniCluster
+
+pytestmark = pytest.mark.cephsan
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+async def _primary_backend(cluster, pool_name, oid):
+    pool = cluster.osdmap.pool_by_name(pool_name)
+    pg = cluster.osdmap.object_to_pg(pool.pool_id, oid)
+    _up, acting = cluster.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+    return (cluster.osds[acting[0]]._get_backend((pool.pool_id, pg)),
+            acting, pool, pg)
+
+
+class _HeldPump:
+    """Stall a backend's issue pump so admissions accumulate into ONE
+    deterministic batch: _kick_issue sees a not-done 'task' and only
+    sets the wanted flag; release() runs the real pump."""
+
+    def __init__(self, be):
+        self.be = be
+        self.held = []
+        self._real = be._spawn
+
+        def spawn(coro, name=""):
+            if name == "issue_pump":
+                self.held.append(coro)
+                return self       # task-like: done() -> False
+            return self._real(coro, name)
+        be._spawn = spawn
+
+    def done(self):
+        return False
+
+    async def release(self):
+        self.be._spawn = self._real
+        self.be._pump_task = None
+        self.be._pump_wanted = False
+        for coro in self.held:
+            await coro
+        self.held = []
+
+
+class TestCoalescing:
+    def test_ready_run_issues_as_one_batch(self, loop):
+        """Ops admitted while the pump is stalled issue as ONE batched
+        sub-write; every object reads back correct and the shard-side
+        apply saw the whole vector."""
+        async def go():
+            async with MiniCluster(4) as cluster:
+                cluster.create_ec_pool(
+                    "b", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=1, stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("b")
+                await io.write_full("warm", payload(1024, 1))
+                be, _a, _p, _pg = await _primary_backend(cluster, "b",
+                                                         "warm")
+                sizes = []
+                real_issue = be._issue_sub_writes
+
+                async def rec(ops):
+                    sizes.append(len(ops))
+                    return await real_issue(ops)
+                be._issue_sub_writes = rec
+                hold = _HeldPump(be)
+                blobs = {f"o{i}": payload(1024, 10 + i)
+                         for i in range(6)}
+                ops = []
+                for oid, data in blobs.items():
+                    ops.append(await be.enqueue_transaction(
+                        oid, [ClientOp("write_full", data=data)]))
+                await hold.release()
+                await asyncio.gather(*(op.on_commit for op in ops))
+                assert sizes and max(sizes) == 6, sizes
+                for oid, data in blobs.items():
+                    assert await io.read(oid) == data
+        loop.run_until_complete(go())
+
+    def test_same_oid_ops_split_across_batches(self, loop):
+        """Consecutive ops on ONE object never share a batch (each op's
+        staging reads its predecessor's applied hinfo/oi state), and
+        the appends still land in order."""
+        async def go():
+            async with MiniCluster(4) as cluster:
+                cluster.create_ec_pool(
+                    "b", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=1, stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("b")
+                await io.write_full("obj", payload(1024, 1))
+                be, _a, _p, _pg = await _primary_backend(cluster, "b",
+                                                         "obj")
+                sizes = []
+                real_issue = be._issue_sub_writes
+
+                async def rec(ops):
+                    sizes.append([o.oid for o in ops])
+                    return await real_issue(ops)
+                be._issue_sub_writes = rec
+                hold = _HeldPump(be)
+                parts = [payload(1024, 20 + i) for i in range(3)]
+                ops = [await be.enqueue_transaction(
+                    "obj", [ClientOp("append", data=p)]) for p in parts]
+                await hold.release()
+                await asyncio.gather(*(op.on_commit for op in ops))
+                for batch in sizes:
+                    assert len(batch) == len(set(batch)), batch
+                got = await io.read("obj")
+                assert got == payload(1024, 1) + b"".join(parts)
+        loop.run_until_complete(go())
+
+    def test_wq_burst_dequeue_caps_and_orders(self, loop):
+        """The shard pump drains ready ops in bursts of at most
+        batch_max, FIFO preserved, each op still individually charged
+        on the shard scheduler."""
+        async def go():
+            order = []
+            bursts = []
+            wq = ShardedOpWQ(1, lambda: FifoScheduler(16), batch_max=4,
+                             on_batch=lambda n: bursts.append(n))
+
+            def work(i):
+                async def run():
+                    order.append(i)
+                return run
+            for i in range(10):
+                wq.enqueue((0, 0), "client", work(i))
+            await wq.drain()
+            for _ in range(5):
+                await asyncio.sleep(0)
+            assert order == list(range(10))
+            assert max(bursts) <= 4
+            assert sum(bursts) == 10
+            d = wq.dump()
+            assert d["batch_max"] == 4
+            assert d["shards"][0]["started"] == 10
+        loop.run_until_complete(go())
+
+
+class TestBatchedWire:
+    def test_batched_frame_roundtrip_and_tids(self, loop):
+        """The batch vector and the reply's tids survive the flat
+        binary codec; the legacy single form stays tid-only."""
+        subs = [{"tid": 7 + i, "at_version": [2, 5 + i],
+                 "txn": {"writes": [[0, 16]], "oi": "00ff",
+                         "rollback": {"clone_gen": 5 + i}}}
+                for i in range(3)]
+        msg = MECSubOpWrite({
+            "pgid": [1, 0], "shard": 2, "from_osd": 3, "tid": 7,
+            "epoch": 4, "at_version": [2, 7], "trim_to": [0, 0],
+            "roll_forward_to": [2, 4],
+            "log_entries": [{"version": s["at_version"], "oid": f"o{i}",
+                             "op": "modify", "prior": [0, 0],
+                             "rollback": {}}
+                            for i, s in enumerate(subs)],
+            "txn": {"writes": []},
+            "lens": [16, 16, 16], "batch": subs}, b"x" * 48)
+        # multi-op frames advertise compat 2: 'batch' is semantics-
+        # bearing, so a pre-batching decoder must REJECT the frame
+        # (skipping the optional would apply the empty top-level txn
+        # and adopt every entry — log-ahead-of-data)
+        msg.compat_version = 2
+        header, data = msg.encode()
+        back = decode_message(header, bytes(data))
+        assert back.get("batch") == subs
+        assert sub_write_tids(back) == [7, 8, 9]
+        from ceph_tpu.msg.message import MessageError
+        old_head = MECSubOpWrite.HEAD_VERSION
+        MECSubOpWrite.HEAD_VERSION = 1      # a pre-batching decoder
+        try:
+            with pytest.raises(MessageError):
+                decode_message(header, bytes(data))
+        finally:
+            MECSubOpWrite.HEAD_VERSION = old_head
+        rep = MECSubOpWriteReply({
+            "pgid": [1, 0], "shard": 2, "from_osd": 3, "tid": 7,
+            "committed": True, "applied": True, "tids": [7, 8, 9]})
+        h2, d2 = rep.encode()
+        back2 = decode_message(h2, bytes(d2))
+        assert back2.get("tids") == [7, 8, 9]
+        single = MECSubOpWrite({
+            "pgid": [1, 0], "shard": 0, "from_osd": 1, "tid": 3,
+            "epoch": 1, "at_version": [1, 1], "trim_to": [0, 0],
+            "roll_forward_to": [0, 0], "log_entries": [], "txn":
+            {"writes": []}, "lens": []}, b"")
+        h3, d3 = single.encode()
+        back3 = decode_message(h3, bytes(d3))
+        assert back3.get("batch") is None
+        assert sub_write_tids(back3) == [3]
+
+
+class TestBatchDedup:
+    def test_batch_mixing_fresh_and_retries_double_applies_nothing(
+            self, loop):
+        """The batch-build dedup filter: an op whose reqid became
+        authoritative while it waited (peering republication) is acked
+        with the committed version — never applied a second time — and
+        the fresh riders of the same batch apply exactly once."""
+        async def go():
+            async with MiniCluster(4) as cluster:
+                cluster.create_ec_pool(
+                    "b", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=1, stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("b")
+                base = payload(1024, 1)
+                await io.write_full("obj", base)
+                be, _a, _p, _pg = await _primary_backend(cluster, "b",
+                                                         "obj")
+                hold = _HeldPump(be)
+                retry = await be.enqueue_transaction(
+                    "obj", [ClientOp("append", data=b"A" * 1024)],
+                    reqid="c1:9")
+                fresh = await be.enqueue_transaction(
+                    "f1", [ClientOp("write_full",
+                                    data=payload(1024, 3))])
+                # the mutation becomes authoritative while the batch is
+                # parked (what peering's reqid republication does after
+                # an interval change / pg split)
+                committed_v = (be.last_epoch, 99)
+                be.completed_reqids["c1:9"] = committed_v
+                await hold.release()
+                got_v = await retry.on_commit
+                assert tuple(got_v) == committed_v
+                await fresh.on_commit
+                # the retry never re-applied: obj is untouched
+                assert await io.read("obj") == base
+                assert await io.read("f1") == payload(1024, 3)
+        loop.run_until_complete(go())
+
+    def test_retry_dedup_across_pg_split_end_to_end(self, loop):
+        """A batch mixing fresh ops and a retry of a write whose first
+        attempt landed (entry in the log) but was never acked, across a
+        pg split, double-applies nothing (the split carries acked-only
+        reqids forward; peering republishes log reqids)."""
+        async def go():
+            async with MiniCluster(6) as cluster:
+                cluster.create_replicated_pool("rep", size=3, pg_num=4,
+                                               stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("rep")
+                base = payload(100, 42)
+                await io.write_full("obj", base)
+                be, acting, pool, pg = await _primary_backend(
+                    cluster, "rep", "obj")
+
+                # attempt 1: replica sends fail -> durable < min_size
+                # -> client-level failure with the entry already in the
+                # primary's log + store
+                real_send = be.send
+
+                async def failing_send(osd, msg):
+                    if msg.TYPE == "ec_sub_write":
+                        raise ConnectionError("replica down (test)")
+                    return await real_send(osd, msg)
+                be.send = failing_send
+                with pytest.raises(Exception):
+                    await be.submit_transaction(
+                        "obj", [ClientOp("append", data=b"A" * 50)],
+                        reqid="cx:7")
+                be.send = real_send
+
+                # peering elects the primary's log authoritative and
+                # republishes its reqids into completed_reqids (the
+                # applied-but-unacked entry rolls forward); the split
+                # then carries that map to the children while wiping
+                # the logs the reqid rode in
+                await cluster.peer_all()
+                await cluster.set_pg_num("rep", 8)
+                await cluster.peer_all()
+
+                # the retry rides a gathered burst with fresh writes —
+                # whatever batches form, nothing double-applies
+                nbe, _a2, _p2, _pg2 = await _primary_backend(
+                    cluster, "rep", "obj")
+                fresh = {f"n{i}": payload(200, 50 + i) for i in range(4)}
+                await asyncio.gather(
+                    nbe.submit_transaction(
+                        "obj", [ClientOp("append", data=b"A" * 50)],
+                        reqid="cx:7"),
+                    *(io.write_full(o, d) for o, d in fresh.items()))
+                got = await io.read("obj")
+                assert got == base + b"A" * 50, (
+                    f"{len(got)} bytes vs {len(base) + 50} acked "
+                    f"(double-apply or loss)")
+                for o, d in fresh.items():
+                    assert await io.read(o) == d
+        loop.run_until_complete(go())
+
+
+class TestBatchRollback:
+    def test_store_failure_rolls_back_whole_batch(self, loop):
+        """A replica's store apply failing mid-batch must leave NONE of
+        the batch's entries in that shard's log (all-or-nothing), mark
+        every object missing there, and still ack every op (remaining
+        shards satisfy min_size); peering then heals the shard."""
+        async def go():
+            async with MiniCluster(6) as cluster:
+                cluster.create_ec_pool(
+                    "b", {"plugin": "jax_rs", "k": "2", "m": "2"},
+                    pg_num=1, stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("b")
+                await io.write_full("warm", payload(1024, 1))
+                be, acting, pool, pg = await _primary_backend(
+                    cluster, "b", "warm")
+                victim = cluster.osds[acting[1]]
+                vbe = victim._get_backend((pool.pool_id, pg))
+                head_before = vbe.pg_log.head
+
+                # one-shot injected failure on the replica's NEXT
+                # queue_transaction (the batched sub-write apply)
+                real_qt = victim.store.queue_transaction
+                state = {"armed": True}
+
+                async def failing_qt(t):
+                    if state["armed"]:
+                        state["armed"] = False
+                        raise OSError("injected store failure (test)")
+                    return await real_qt(t)
+                victim.store.queue_transaction = failing_qt
+
+                hold = _HeldPump(be)
+                blobs = {f"r{i}": payload(1024, 30 + i)
+                         for i in range(4)}
+                ops = []
+                for oid, data in blobs.items():
+                    ops.append(await be.enqueue_transaction(
+                        oid, [ClientOp("write_full", data=data)]))
+                await hold.release()
+                versions = await asyncio.gather(
+                    *(op.on_commit for op in ops))
+                victim.store.queue_transaction = real_qt
+
+                # all-or-nothing on the failing shard: NONE of the
+                # batch's entries survive in its log, every object is
+                # recorded missing
+                minted = {tuple(v) for v in versions}
+                assert not minted & {e.version
+                                     for e in vbe.pg_log.entries}, (
+                    "batch entries leaked into the failed shard's log")
+                assert vbe.pg_log.head == head_before
+                for oid in blobs:
+                    assert oid in vbe.local_missing
+                # the acks were honest: every object reads back
+                for oid, data in blobs.items():
+                    assert await io.read(oid) == data
+                # and recovery heals the shard
+                await cluster.peer_all()
+                for oid in blobs:
+                    assert oid not in vbe.local_missing, (
+                        f"{oid} not recovered on the failed shard")
+        loop.run_until_complete(go())
+
+    def test_batched_reply_failure_fans_out_to_all_ops(self, loop):
+        """A committed=False batched reply (stale interval) fails every
+        rider of the batch, none silently."""
+        async def go():
+            async with MiniCluster(4) as cluster:
+                cluster.create_ec_pool(
+                    "b", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                    pg_num=1, stripe_unit=512)
+                client = await cluster.client()
+                io = client.io_ctx("b")
+                await io.write_full("warm", payload(1024, 1))
+                be, _a, _p, _pg = await _primary_backend(cluster, "b",
+                                                         "warm")
+                hold = _HeldPump(be)
+                ops = []
+                for i in range(3):
+                    ops.append(await be.enqueue_transaction(
+                        f"s{i}", [ClientOp("write_full",
+                                           data=payload(512, i))]))
+                await hold.release()
+                # forge the batched stale-interval verdict for a shard
+                for _ in range(100):
+                    if all(op.version != (0, 0) for op in ops):
+                        break
+                    await asyncio.sleep(0)
+                be.handle_sub_write_reply(MECSubOpWriteReply({
+                    "pgid": list(be.pgid), "shard": 1, "from_osd": 99,
+                    "tid": ops[0].tid, "committed": False,
+                    "applied": False, "error": "stale interval",
+                    "tids": [op.tid for op in ops]}))
+                results = await asyncio.gather(
+                    *(op.on_commit for op in ops),
+                    return_exceptions=True)
+                assert all(isinstance(r, Exception) for r in results), (
+                    "a rider of the failed batch was silently acked")
+        loop.run_until_complete(go())

@@ -261,9 +261,23 @@ class TestRoundTrip:
                     op = await be.enqueue_transaction(
                         "obj", [ClientOp("append",
                                          data=bytes([i]) * 1536)])
-                    assert op.version != (0, 0)     # encoded inline
-                    assert be.pg_log.head >= op.version
                     ops.append(op)
+                # admission only appends since batched dispatch; the
+                # issue pump mints the versions.  Staging is STILL
+                # stalled, so a version (and its log reservation) can
+                # only come from the encode path — minting and the log
+                # add share one pipeline-lock hold, so waiting for the
+                # head to cover every minted version observes the
+                # reservation, never the staging task.
+                for _ in range(200):
+                    if all(op.version != (0, 0)
+                           and be.pg_log.head >= op.version
+                           for op in ops):
+                        break
+                    await asyncio.sleep(0)
+                for op in ops:
+                    assert op.version != (0, 0)     # reserved at encode
+                    assert be.pg_log.head >= op.version
                 versions = [op.version for op in ops]
                 assert len(set(versions)) == len(versions), versions
                 # contiguous minting: no holes for the shard-side

@@ -280,7 +280,12 @@ REQUIRED_PERF_COUNTERS = {
             # write-path pipeline (sharded WQ / WAL group commit /
             # messenger corking) batch+depth histograms
             "osd_shard_queue_depth", "osd_wal_group_commit_batch",
-            "ms_cork_flush_frames"},
+            "ms_cork_flush_frames",
+            # batched sub-write dispatch (PR 9): ops per coalesced
+            # PG-batch, txns per shard-side batched apply, and the
+            # frames counter behind the frames/op < 1 claim
+            "osd_op_batch_size", "osd_subwrite_batch_txns",
+            "subop_w_frames"},
     "kernel": {"kernel_encode_lat", "kernel_decode_lat",
                "kernel_crc32c_lat", "kernel_encode_launches",
                "kernel_decode_launches", "kernel_crc32c_launches",
@@ -318,6 +323,11 @@ REQUIRED_PROM_SERIES = {
     # zero-copy wire path (PR 7): copy accounting + crc cache counters
     "ceph_bytes_copied", "ceph_copy_calls",
     "ceph_crc_cache_hits", "ceph_crc_cache_misses",
+    # batched sub-write dispatch (PR 9): batch-depth histograms + the
+    # sub-write frame counter (frames/op) — the grafana batching panel
+    "ceph_osd_op_batch_size_bucket",
+    "ceph_osd_subwrite_batch_txns_bucket",
+    "ceph_subop_w_frames",
 }
 
 

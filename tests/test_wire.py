@@ -105,6 +105,41 @@ class TestCodecRoundTrip:
             assert bytes(got.data) == bytes(payload), wtype
             assert got.from_name == "peer"
 
+    def test_copy_value_matches_codec_round_trip(self):
+        """wire.copy_value — the local transport's serialization-free
+        isolation path — must return EXACTLY what decode(encode(v))
+        returns, value for value, and refuse exactly what the codec
+        refuses (one error surface across transports)."""
+        import numpy as np
+
+        cases = list(_SAMPLES) + [
+            (1, 2, (3, "x")),                       # tuples -> lists
+            {2: "a", True: "b", None: "c", 2.5: "d"},   # key coercion
+            np.int64(7), np.float32(1.25),
+            bytearray(b"ab"), memoryview(b"cd"),
+            {"deep": [{"k": (np.uint8(3),)}]},
+        ]
+        for v in cases:
+            enc = bytearray()
+            wire._enc_value(enc, v)
+            via_codec, _pos = wire._dec_value(bytes(enc), 0)
+            assert wire.copy_value(v) == via_codec, v
+        # and the SAME rejections: unencodable values + nesting bombs
+        for bad in (object(), {"x": object()}, np.zeros(3)):
+            with pytest.raises(wire.WireError):
+                wire.copy_value(bad)
+        bomb = []
+        for _ in range(150):
+            bomb = [bomb]
+        with pytest.raises(wire.WireError):
+            wire.copy_value(bomb)
+        # full-fields parity over every registered type's synth fields
+        for wtype, cls in sorted(message_mod._REGISTRY.items()):
+            fields = synth_fields(cls)
+            header = wire.encode_header(cls, fields)
+            got = decode_message(header)
+            assert wire.copy_fields(fields) == got.fields, wtype
+
     def test_json_era_shape_preserved(self):
         """Decoded values are indistinguishable from the json.dumps
         era: tuples come back lists, non-str dict keys come back as
@@ -342,6 +377,74 @@ class TestZeroCopyWritePath:
                     f"copies) — zero-copy regression")
                 # and the bytes actually landed
                 assert await io.read("obj-zc") == data
+        loop.run_until_complete(go())
+
+    def test_batched_sub_writes_copy_nothing(self, loop):
+        """The bytes_copied == 0 pin EXTENDED over batched dispatch: a
+        burst of stripe-aligned writes coalesced into batched
+        sub-writes (one frame per shard carrying the whole vector)
+        still crosses messenger -> encode -> store without
+        materializing a single payload byte — the shared data segment
+        is adopted per-op views, never a concatenation."""
+        async def go():
+            cluster = MiniCluster(4)
+            cluster.create_ec_pool(
+                "zcb", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                pg_num=1, stripe_unit=512)
+            async with cluster:
+                client = await cluster.client()
+                io = client.io_ctx("zcb")
+                data = bytes(range(256)) * 16          # 4096 = 4 stripes
+                await io.write_full("warm", data)      # jit + map warm
+                # stall the primary's issue pump so the burst coalesces
+                # into one deterministic batch
+                from ceph_tpu.osd.ecbackend import ClientOp
+                pool = cluster.osdmap.pool_by_name("zcb")
+                pg = cluster.osdmap.object_to_pg(pool.pool_id, "warm")
+                _u, acting = cluster.osdmap.pg_to_up_acting_osds(
+                    pool.pool_id, pg)
+                be = cluster.osds[acting[0]]._get_backend(
+                    (pool.pool_id, pg))
+                sizes = []
+                real_issue = be._issue_sub_writes
+
+                async def rec(ops):
+                    sizes.append(len(ops))
+                    return await real_issue(ops)
+                be._issue_sub_writes = rec
+                held = []
+                real_spawn = be._spawn
+
+                class _Hold:
+                    def done(self):
+                        return False
+
+                def spawn(coro, name=""):
+                    if name == "issue_pump":
+                        held.append(coro)
+                        return _Hold()
+                    return real_spawn(coro, name)
+                be._spawn = spawn
+                before = dict(buffer_mod.STATS)
+                ops = []
+                for i in range(4):
+                    ops.append(await be.enqueue_transaction(
+                        f"zb{i}", [ClientOp("write_full", data=data)]))
+                be._spawn = real_spawn
+                be._pump_task = None
+                be._pump_wanted = False
+                for coro in held:
+                    await coro
+                await asyncio.gather(*(op.on_commit for op in ops))
+                after = dict(buffer_mod.STATS)
+                copied = after["bytes_copied"] - before["bytes_copied"]
+                assert copied == 0, (
+                    f"batched write path materialized {copied} bytes "
+                    f"({after['copy_calls'] - before['copy_calls']} "
+                    f"copies) — zero-copy regression")
+                assert max(sizes) == 4, sizes   # it really batched
+                for i in range(4):
+                    assert await io.read(f"zb{i}") == data
         loop.run_until_complete(go())
 
 

@@ -22,14 +22,21 @@ from typing import List, Optional
 
 # The CI seed set (check.sh): small, fixed, fast to replay.  The
 # acceptance bar for the sanitizer itself is the 25-seed sweep
-# (--seeds 25); these three are the regression canary — seeds that
-# found real bugs stay in the set so the bug class stays dead.
+# (--seeds 25); these are the regression canary — seeds that found
+# real bugs stay in the set so the bug class stays dead.
 # Seed 1 found the ShardedOpWQ start-order bug (task first-steps are
-# not ordered by spawn order).
-FIXED_SEEDS = (1, 7, 23)
+# not ordered by spawn order).  Seed 12 found the duplicate-eversion
+# mint (version reserved in a spawned task instead of at encode) —
+# kept since batched dispatch (PR 9) extends that reservation
+# invariant to whole contiguous batch ranges.
+FIXED_SEEDS = (1, 7, 12, 23)
 
 DEFAULT_SUITES = ("tests/test_thrash.py", "tests/test_sharded_wq.py",
-                  "tests/test_group_commit.py", "tests/test_wire.py")
+                  "tests/test_group_commit.py", "tests/test_wire.py",
+                  # batched sub-write dispatch: coalescing, batch-build
+                  # reqid dedup, whole-batch rollback — batch formation
+                  # is schedule-dependent, correctness must not be
+                  "tests/test_batching.py")
 
 
 def _fresh_seed() -> int:

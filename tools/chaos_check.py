@@ -117,6 +117,14 @@ async def run_chaos(args) -> int:
     cfg.set("ms_inject_drop_ratio", args.drop_ratio)
     if args.socket_failures:
         cfg.set("ms_inject_socket_failures", args.socket_failures)
+    if getattr(args, "force_batching", False):
+        # the batched leg: tiny batch ceiling OFF, long dequeue window
+        # ON, so multi-op sub-write frames form under the modest chaos
+        # workload — socket kills then land mid-BATCHED-frame and WAL
+        # crashes mid-BATCH-apply, and the gate still demands that no
+        # op of any batch is lost or duplicated
+        cfg.set("osd_op_batch_max", 16)
+        cfg.set("osd_op_batch_window_us", 1500)
     # a dropped reply must cost ~2s of retry, not the default 10s op
     # timeout — the gate wants op CHURN under failure, not one wedged
     # writer riding out the whole chaos window
@@ -244,6 +252,12 @@ async def run_chaos(args) -> int:
         for o in cluster.osds.values():
             for k in cork:
                 cork[k] += o.ms.cork_stats[k]
+        # batched sub-write dispatch accounting: frames built vs ops
+        # acked — the report shows whether the batched leg actually
+        # exercised multi-op frames
+        subw_frames = sum(
+            o.perf_coll.dump().get(f"osd.{o.whoami}", {})
+            .get("subop_w_frames", 0) for o in cluster.osds.values())
         from ceph_tpu.common import sanitizer as _san
         report = {
             "ok": not failures,
@@ -255,6 +269,9 @@ async def run_chaos(args) -> int:
             "wal_crashes": stats["wal_crashes"],
             "scrub_repaired": repaired, "backoffs_sent": backoffs,
             "wal": wal, "msgr_cork": cork,
+            "subwrite_frames": subw_frames,
+            "force_batching": bool(getattr(args, "force_batching",
+                                           False)),
             "store": args.store, "ms_type": args.ms_type,
             "crash_dumps": crash_dumps,
             "clog": {f"osd.{i}": o.clog.dump()["counts"]
@@ -354,6 +371,7 @@ def main(argv=None) -> int:
             return 2
         print("chaos_check: cephlint preflight clean")
     try:
+        args.force_batching = False
         rc = asyncio.new_event_loop().run_until_complete(
             run_chaos(args))
         if args.pipeline_pass and rc == 0:
@@ -371,6 +389,23 @@ def main(argv=None) -> int:
             p.no_thrash = True
             rc = asyncio.new_event_loop().run_until_complete(
                 run_chaos(p))
+        if args.pipeline_pass and rc == 0:
+            # the BATCHED leg: same fault planes, batching forced deep
+            # (long dequeue window) so socket kills hit mid-batched-
+            # frame and WAL crashes hit mid-batch-apply — no op of any
+            # batch may be lost or duplicated
+            import copy
+            b = copy.copy(args)
+            b.store = "block"
+            b.ms_type = "async+tcp"
+            b.socket_failures = args.socket_failures or 400
+            b.wal_crash_interval = args.wal_crash_interval or 1.0
+            b.duration = min(args.duration, 6.0)
+            b.expect_crash_dump = False
+            b.no_thrash = True
+            b.force_batching = True
+            rc = asyncio.new_event_loop().run_until_complete(
+                run_chaos(b))
         return rc
     except Exception:  # noqa: BLE001 — harness error, not a data verdict
         traceback.print_exc()
