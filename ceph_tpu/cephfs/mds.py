@@ -39,6 +39,7 @@ class MMDSOp(Message):
     """Client -> mds: fields: tid, op, args (json-able dict)."""
     TYPE = "mds_op"
     FIELDS = ("tid", "op", "args")
+    REPLY = "mds_op_reply"
 
 
 @register_message
@@ -46,6 +47,7 @@ class MMDSOpReply(Message):
     """mds -> client: fields: tid, result (0 or -errno), value."""
     TYPE = "mds_op_reply"
     FIELDS = ("tid", "result", "value")
+    REPLY = None
 
 
 class MDSDaemon(Dispatcher):

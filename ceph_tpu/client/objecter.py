@@ -25,10 +25,21 @@ import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import mc
 from ..common.log import dout
 from ..msg.messenger import Dispatcher, Messenger, Policy
-from ..osd.messages import ESTALE, MOSDOp, MOSDOpReply, unpack_buffers
+from ..osd.messages import ENOENT, ESTALE, MOSDOp, MOSDOpReply, \
+    unpack_buffers
 from ..osd.osdmap import NONE_OSD, OSDMap
+
+
+def _blob_bytes(data) -> bytes:
+    """Materialize a reply blob (bytes or BufferList) for the history
+    recorder — recording happens only while cephmc is armed, so the
+    copy never touches the production hot path."""
+    if hasattr(data, "to_bytes"):
+        return data.to_bytes()
+    return bytes(data)
 
 
 class ObjecterError(Exception):
@@ -236,6 +247,14 @@ class Objecter(Dispatcher):
         # mutation whose ack was lost from applying twice
         tid = self.new_tid()
         reqid = f"{self.ms.name}:{tid}"
+        # cephmc history: one logical op = one invoke/complete pair,
+        # however many wire attempts the retry loop takes (the recorder
+        # folds re-invocations by reqid — a retry that re-applies is a
+        # double-apply the linearizability checker must see, not a
+        # second legal op)
+        rec = mc.history()
+        hid = rec.invoke(self.ms.name, pool_id, oid, ops, data,
+                         reqid=reqid) if rec is not None else 0
         renewed = False
         attempt = 0
         # backoff parks never consume attempts (a block/unblock cycle is
@@ -258,13 +277,15 @@ class Objecter(Dispatcher):
                 attempt += 1
                 await self._resend_wait(attempt, seen_epoch=epoch0)
                 continue
-            rec = self.backoffs.get((tgt_pool, tgt_pg))
-            if rec is not None:
-                parked += await self._park(rec)
+            brec = self.backoffs.get((tgt_pool, tgt_pg))
+            if brec is not None:
+                parked += await self._park(brec)
                 if parked > park_budget:
+                    if rec is not None:
+                        rec.fail(hid, "backoff park budget")
                     raise ObjecterError(
                         f"op on {oid} blocked by osd backoff "
-                        f"({rec.reason}) for {parked:.1f}s")
+                        f"({brec.reason}) for {parked:.1f}s")
                 continue        # re-target: the map may have moved it
             fut = asyncio.get_running_loop().create_future()
             self._inflight[tid] = fut
@@ -298,14 +319,16 @@ class Objecter(Dispatcher):
                 # pace the resend like a plain retry instead, so a
                 # flapping queue (block/unblock per op) can never spin
                 # this loop at zero cost and past the old retry bound
-                rec = self.backoffs.get((tgt_pool, tgt_pg))
+                brec = self.backoffs.get((tgt_pool, tgt_pg))
                 t0 = time.monotonic()
-                if rec is not None:
-                    parked += await self._park(rec)
+                if brec is not None:
+                    parked += await self._park(brec)
                 else:
                     await self._resend_wait(0)
                     parked += time.monotonic() - t0
                 if parked > park_budget:
+                    if rec is not None:
+                        rec.fail(hid, "backoff park budget")
                     raise ObjecterError(
                         f"op on {oid} blocked by osd backoff for "
                         f"{parked:.1f}s")
@@ -320,6 +343,13 @@ class Objecter(Dispatcher):
                 continue
             if result != 0:
                 errs = [o.get("error") for o in outs if "error" in o]
+                if rec is not None and -result == ENOENT:
+                    # a definitive server verdict the sequential model
+                    # can produce (object absent at the linearization
+                    # point); other errnos fall through to the
+                    # unknown-outcome record below
+                    rec.complete(hid, error=ENOENT)
+                    rec = None
                 if (result == -13 and not renewed
                         and self.ticket_renewer is not None
                         and bool(reply.get("retry_auth"))):
@@ -335,10 +365,20 @@ class Objecter(Dispatcher):
                     self.ticket = await self.ticket_renewer()
                     renewed = True
                     continue
+                if rec is not None:
+                    rec.fail(hid, f"errno {-result}")
                 raise ObjecterError(
                     f"op on {oid} failed: {errs or reply['result']}",
                     errno=-result)
+            if rec is not None:
+                version = next((o.get("version") for o in outs
+                                if "version" in o), None)
+                rec.complete(hid, outs=outs,
+                             data=_blob_bytes(reply.data),
+                             version=version)
             return outs, reply.data
+        if rec is not None:
+            rec.fail(hid, str(last_err))
         raise ObjecterError(
             f"op on {oid} failed after {self.max_retries} tries: {last_err}")
 

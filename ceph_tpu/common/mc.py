@@ -1,0 +1,406 @@
+"""cephmc runtime — cross-daemon message-schedule exploration.
+
+cephsan (PR 6) made *task wakeup order* deterministic and explorable:
+``InterleavingLoop`` permutes the asyncio ready queue under a seed.
+That sees every race that lives in the ready queue — but ROADMAP item 1
+splits the one shared event loop into a real multi-process OSD fleet,
+and then cross-daemon races stop living in the ready queue: they move
+to the wire, where delivery order across connections is the schedule.
+This module is the FoundationDB-style move: build the protocol-schedule
+explorer while everything is still in-process and deterministic, so
+every protocol contract is pinned by a checker that survives the
+process split.
+
+Three pieces, all off by default (zero hot-path cost when off):
+
+- **Explorer** — a messenger-level interposition layer hooked at the
+  single point every cross-daemon delivery funnels through
+  (``Messenger._deliver``, both transports — the same layer the
+  ``_Injector`` fault hooks ride).  Every delivery is recorded as a
+  schedulable event; under a seed the explorer PARKS deliveries and
+  releases them in a permuted order across connections while
+  preserving per-connection FIFO (a real TCP session never reorders
+  within a connection; lossless peers rely on that).  Composable
+  extras: seeded lossy drops (client sessions only — lossless peers
+  retransmit by contract) and delayed deliveries (a parked lane head
+  held across extra release passes).
+- **Crash-restart points** — named durability boundaries (between
+  store apply and reply, mid-batch-fanout, mid-cork flush) where the
+  seeded RNG can declare "the daemon died here".  The call site
+  applies the crash's *local* observable effect (skip the reply, stop
+  the fanout, abort the session) and the registered restart handler —
+  wired by the explore harness to ``MiniCluster.kill_osd``/
+  ``revive_osd`` — makes the restart real, so recovery (peering,
+  interval changes, reqid republication) runs for every explored
+  crash point.  Points never fire unless a handler is registered: a
+  fired point with nobody to restart the daemon would wedge the
+  strictly-ordered PG pipeline forever.
+- **HistoryRecorder** — client ops recorded as invoke/complete events
+  (with payload digests, errno results and reported versions) into a
+  history ``tools/cephsan/linearize.py`` checks WGL-style against a
+  sequential RADOS object model.  Retries of one logical op share one
+  history entry (keyed by reqid): a retry that re-applies is exactly
+  the double-apply the checker must see as non-linearizable, not a
+  legal second op.
+
+Activation: ``install(Explorer(seed, ...))`` / ``install_from_env()``
+(``CEPHMC_SEED``, plus ``CEPHMC_DROPS``/``CEPHMC_DELAY``/
+``CEPHMC_CRASH`` rates), mirror of the cephsan ``CEPHSAN_SEED``
+contract — a failing schedule replays from its printed seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# --- module state -------------------------------------------------------------
+
+_explorer: "Optional[Explorer]" = None
+
+
+class Dropped(Exception):
+    """Raised out of ``interpose`` when the explorer drops a delivery
+    on a lossy session (the receiver never sees the frame; the client
+    times out and retries — the retry/dedup path under test)."""
+
+
+def active() -> bool:
+    return _explorer is not None
+
+
+def explorer() -> "Optional[Explorer]":
+    return _explorer
+
+
+def install(exp: "Explorer") -> "Explorer":
+    """Arm the explorer process-wide.  One explorer per explored
+    schedule: seeds derive per-instance RNGs, so re-install per run."""
+    global _explorer
+    _explorer = exp
+    return exp
+
+
+def uninstall() -> None:
+    global _explorer
+    if _explorer is not None:
+        _explorer._release_everything()
+    _explorer = None
+
+
+def install_from_env() -> "Optional[int]":
+    """``CEPHMC_SEED=<int>`` arms the explorer (rates from
+    ``CEPHMC_DROPS``/``CEPHMC_DELAY``/``CEPHMC_CRASH``, defaults
+    drops=0, delay=0.1, crash=0).  Returns the seed, or None."""
+    raw = os.environ.get("CEPHMC_SEED", "")
+    if not raw:
+        return None
+    s = int(raw)
+    install(Explorer(
+        s,
+        lossy_drop=float(os.environ.get("CEPHMC_DROPS", "0")),
+        delay=float(os.environ.get("CEPHMC_DELAY", "0.1")),
+        crash=float(os.environ.get("CEPHMC_CRASH", "0"))))
+    return s
+
+
+async def interpose(messenger, conn, msg) -> None:
+    """Messenger._deliver hook: record + (maybe) reorder/drop/delay.
+    No-op when the explorer is off."""
+    if _explorer is not None:
+        await _explorer.interpose(messenger, conn, msg)
+
+
+def crash_point(point: str, daemon: str = "") -> bool:
+    """Named durability boundary.  Returns True when the seeded RNG
+    declares a crash here — the caller applies the local effect (skip
+    the reply / stop the fanout / abort the session) and the explorer
+    schedules the registered restart handler for ``daemon``.  Never
+    fires without a restart handler."""
+    if _explorer is None:
+        return False
+    return _explorer.crash_point(point, daemon)
+
+
+def history() -> "Optional[HistoryRecorder]":
+    if _explorer is None:
+        return None
+    return _explorer.recorder
+
+
+# --- the explorer -------------------------------------------------------------
+
+
+class Explorer:
+    """One explored schedule: seeded delivery permutation + injected
+    drops/delays/crashes + the recorded trace and its state hash."""
+
+    def __init__(self, seed: int, reorder: float = 0.5,
+                 lossy_drop: float = 0.0, delay: float = 0.1,
+                 crash: float = 0.0, record_history: bool = True,
+                 crash_points: "Optional[Tuple[str, ...]]" = None,
+                 max_crashes: int = 4) -> None:
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.reorder = reorder        # P(park a deliverable head)
+        self.lossy_drop = lossy_drop  # P(drop | lossy session)
+        self.delay = delay            # P(hold a parked head one more pass)
+        self.crash = crash            # P(crash at an armed point)
+        self.crash_points = crash_points  # None = all points armed
+        self.max_crashes = max_crashes    # bound restarts per schedule
+        self.recorder = HistoryRecorder() if record_history else None
+        # lane = (sender, receiver): per-connection FIFO is preserved
+        # by parking ALL later deliveries of a lane behind its head
+        self._lanes: "Dict[Tuple[str, str], deque]" = {}
+        self._pump_task: "Optional[asyncio.Task]" = None
+        self._restart_handler: "Optional[Callable[[str], Any]]" = None
+        self._trace_sha = hashlib.sha1()
+        self.trace_len = 0
+        self.stats = {"deliveries": 0, "parked": 0, "drops": 0,
+                      "delays": 0, "crashes": 0}
+        self.crashes: "List[Tuple[str, str]]" = []   # (point, daemon)
+
+    # --- trace / state hash ---------------------------------------------------
+
+    def _record(self, kind: str, sender: str, receiver: str,
+                mtype: str, detail: str = "") -> None:
+        self._trace_sha.update(
+            f"{kind}|{sender}|{receiver}|{mtype}|{detail}\n".encode())
+        self.trace_len += 1
+
+    def state_hash(self) -> str:
+        """Digest of the delivery trace so far.  Two seeds producing
+        the same hash explored the same schedule — the sweep harness
+        dedups on it instead of re-exploring identical prefixes."""
+        return self._trace_sha.hexdigest()
+
+    # --- delivery interposition -----------------------------------------------
+
+    @staticmethod
+    def _lane_key(messenger, conn, msg) -> "Tuple[str, str]":
+        sender = (getattr(msg, "from_name", "")
+                  or getattr(conn, "peer_name", "")
+                  or getattr(conn, "peer_addr", ""))
+        return (str(sender), str(messenger.name))
+
+    async def interpose(self, messenger, conn, msg) -> None:
+        lane = self._lane_key(messenger, conn, msg)
+        mtype = getattr(msg, "TYPE", "?")
+        detail = str(msg.get("tid", "")) if hasattr(msg, "get") else ""
+        policy = getattr(conn, "policy", None)
+        if policy is not None and policy.lossy and self.lossy_drop > 0 \
+                and self.rng.random() < self.lossy_drop:
+            self.stats["drops"] += 1
+            self._record("drop", lane[0], lane[1], mtype, detail)
+            raise Dropped(f"cephmc: dropped {mtype} {lane[0]}->{lane[1]}")
+        q = self._lanes.setdefault(lane, deque())
+        if not q and (self.reorder <= 0
+                      or self.rng.random() >= self.reorder):
+            # deliver in arrival order (still a legal schedule; the
+            # permutation space comes from the parked fraction)
+            self.stats["deliveries"] += 1
+            self._record("deliver", lane[0], lane[1], mtype, detail)
+            return
+        # park: FIFO within the lane (q non-empty means a predecessor
+        # is parked — overtaking it would violate the session order a
+        # real connection guarantees)
+        fut = asyncio.get_running_loop().create_future()
+        q.append(fut)
+        self.stats["parked"] += 1
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+        # resolver is the pump below: every pass releases each lane
+        # head with probability >= 1 - delay, so every parked delivery
+        # is released in bounded passes (no wedge)
+        # cephlint: disable=reply-timeout
+        await fut
+        self.stats["deliveries"] += 1
+        self._record("deliver", lane[0], lane[1], mtype, detail)
+
+    async def _pump(self) -> None:
+        """Release parked deliveries: each pass visits the non-empty
+        lanes in seeded order and releases (or, with P=delay, holds)
+        each head.  Heads released in one pass interleave in the
+        released order — across-connection permutation — while each
+        lane drains FIFO."""
+        while any(self._lanes.values()):
+            await asyncio.sleep(0)
+            lanes = sorted(k for k, q in self._lanes.items() if q)
+            self.rng.shuffle(lanes)
+            for key in lanes:
+                q = self._lanes.get(key)
+                if not q:
+                    continue
+                if self.delay > 0 and self.rng.random() < self.delay:
+                    self.stats["delays"] += 1
+                    continue          # held one more pass
+                fut = q.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+            # one more pass so releases scheduled above run before the
+            # emptiness check (their interpose coroutines resume on
+            # the next loop iteration)
+            await asyncio.sleep(0)
+
+    def _release_everything(self) -> None:
+        """Uninstall/teardown: nothing may stay parked forever."""
+        for q in self._lanes.values():
+            while q:
+                fut = q.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+        self._lanes.clear()
+
+    # --- crash-restart points -------------------------------------------------
+
+    def on_crash(self, handler: "Callable[[str], Any]") -> None:
+        """Register the restart handler, called SYNCHRONOUSLY with the
+        daemon name (e.g. "osd.3") when a point fires.  It must decide
+        immediately: return False/None to DECLINE (too few live OSDs,
+        unknown daemon) — the point then does NOT fire and the caller
+        applies no local effect — or accept by returning True after
+        scheduling the kill/revive, or by returning the restart
+        coroutine for the explorer to schedule.  Deciding after the
+        fact would let a fired point's local effect (a withheld
+        sub-write reply) stand with no restart behind it, wedging the
+        strictly-ordered PG pipeline forever."""
+        self._restart_handler = handler
+
+    def crash_point(self, point: str, daemon: str) -> bool:
+        if self._restart_handler is None or self.crash <= 0:
+            return False
+        if self.crash_points is not None and point not in self.crash_points:
+            return False
+        if self.stats["crashes"] >= self.max_crashes:
+            return False
+        if self.rng.random() >= self.crash:
+            return False
+        res = self._restart_handler(daemon)
+        if res is None or res is False:
+            return False          # declined: nothing crashed
+        if asyncio.iscoroutine(res):
+            # QA-harness spawn (no CrashHandler here by design): a dead
+            # restart task surfaces as an unrestarted daemon in the
+            # explore report and fails the schedule loudly
+            # cephlint: disable=fire-and-forget
+            asyncio.ensure_future(res)
+        self.stats["crashes"] += 1
+        self.crashes.append((point, daemon))
+        self._record("crash", daemon, daemon, point)
+        return True
+
+    def report(self) -> dict:
+        return {"seed": self.seed, "trace_len": self.trace_len,
+                "state_hash": self.state_hash(), **self.stats,
+                "crash_sites": [list(c) for c in self.crashes]}
+
+
+# --- history recording --------------------------------------------------------
+
+_MODELED_OPS = ("write_full", "write", "append", "truncate", "delete",
+                "read", "stat", "omap_set", "omap_get", "omap_keys",
+                "omap_rm")
+
+
+def _digest(blob) -> str:
+    return hashlib.sha1(bytes(blob)).hexdigest()
+
+
+class HistoryRecorder:
+    """Client-op history: invoke/complete/fail events in real-time
+    order (one process, one loop => the event list IS the real-time
+    partial order the linearizability checker needs).
+
+    Retry folding: ``invoke`` with a reqid already seen returns the
+    FIRST attempt's op id — one logical op, however many wire attempts
+    it took.  A retried mutation that applies twice then fails the
+    sequential model (the read sees the payload twice), which is the
+    double-apply bug class, not two legal ops.
+    """
+
+    def __init__(self, payload_cap: int = 1 << 20) -> None:
+        self.events: "List[dict]" = []
+        self.payload_cap = payload_cap
+        self._next_id = 0
+        self._by_reqid: "Dict[str, int]" = {}
+
+    def invoke(self, client: str, pool: int, oid: str,
+               ops: "List[dict]", data: bytes = b"",
+               reqid: str = "") -> int:
+        if reqid and reqid in self._by_reqid:
+            op_id = self._by_reqid[reqid]
+            self.events.append({"e": "reinvoke", "id": op_id})
+            return op_id
+        self._next_id += 1
+        op_id = self._next_id
+        if reqid:
+            self._by_reqid[reqid] = op_id
+        data = bytes(data)
+        rec_ops: "List[dict]" = []
+        off = 0
+        for op in ops:
+            entry: "Dict[str, Any]" = {"op": str(op.get("op", "?"))}
+            for k in ("off", "len", "keys", "name"):
+                if k in op:
+                    entry[k] = op[k]
+            dlen = int(op.get("dlen", 0))
+            if dlen:
+                payload = data[off:off + dlen]
+                off += dlen
+                entry["len"] = dlen
+                entry["digest"] = _digest(payload)
+                if dlen <= self.payload_cap:
+                    entry["payload"] = payload.hex()
+            if entry["op"] not in _MODELED_OPS:
+                entry["opaque"] = True
+            rec_ops.append(entry)
+        self.events.append({"e": "invoke", "id": op_id,
+                            "client": client, "pool": int(pool),
+                            "oid": str(oid), "ops": rec_ops,
+                            "reqid": reqid})
+        return op_id
+
+    def complete(self, op_id: int, outs: "Optional[List[dict]]" = None,
+                 data: bytes = b"",
+                 version: "Optional[list]" = None,
+                 error: int = 0) -> None:
+        data = bytes(data)
+        ev: "Dict[str, Any]" = {"e": "complete", "id": op_id,
+                                "error": int(error)}
+        if version is not None:
+            ev["version"] = list(version)
+        if outs is not None:
+            # keep only the model-relevant completion facts: per-op
+            # read lengths (slicing the reply blob), stat results
+            kept, off = [], 0
+            for o in outs:
+                rec: "Dict[str, Any]" = {"op": str(o.get("op", "?"))}
+                dlen = int(o.get("dlen", 0))
+                if dlen or o.get("op") in ("read", "omap_get",
+                                           "omap_keys"):
+                    payload = data[off:off + dlen]
+                    off += dlen
+                    rec["len"] = dlen
+                    rec["digest"] = _digest(payload)
+                    if dlen <= self.payload_cap:
+                        rec["payload"] = payload.hex()
+                for k in ("size", "exists", "version"):
+                    if k in o:
+                        rec[k] = o[k]
+                kept.append(rec)
+            ev["outs"] = kept
+        self.events.append(ev)
+
+    def fail(self, op_id: int, error: str = "") -> None:
+        """Unknown outcome: the op MAY have taken effect (a timeout
+        raced its commit).  The checker lets it linearize anywhere
+        after its invocation — or never."""
+        self.events.append({"e": "fail", "id": op_id,
+                            "error": str(error)})
+
+    def to_history(self) -> dict:
+        return {"version": 1, "events": list(self.events)}

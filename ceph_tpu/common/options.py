@@ -164,6 +164,14 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_scrub_map_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
            desc="seconds to wait for a shard's scrub map",
            services=("osd",)),
+    Option("osd_recovery_push_timeout", float, 10.0, LEVEL_ADVANCED,
+           min=0.1,
+           desc="seconds to wait for recovery push acks before the "
+                "silent shards are deferred to the next peering pass "
+                "(a peer dying between receiving a push and replying "
+                "must never pin the RecoveryOp — and every write "
+                "parked on the object's degraded future — forever)",
+           see_also=("osd_peering_op_timeout",), services=("osd",)),
     Option("osd_ec_sub_read_timeout", float, 5.0, LEVEL_ADVANCED, min=0.1,
            desc="HARD per-shard window: seconds before a silent shard "
                 "read is treated as EIO even when no redundancy is "

@@ -28,6 +28,7 @@ class MMgrReport(Message):
     status (free-form dict), epoch."""
     TYPE = "mgr_report"
     FIELDS = ("daemon", "perf", "status", "epoch")
+    REPLY = None
 
 
 class MgrModule:

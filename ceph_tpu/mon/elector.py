@@ -94,6 +94,10 @@ class Elector:
                 # we outrank the proposer: counter-propose
                 await self.start_election()
         elif op == "ack":
+            # same-round dedup IS the contract: an ack binds to exactly
+            # this election round (stale acks are noise, a NEWER epoch
+            # arrives as propose/victory and is handled there)
+            # cephlint: disable=epoch-monotonicity
             if epoch == self.epoch and self.electing:
                 self.acks.add(frm)
                 if len(self.acks) > len(self.ranks) // 2 and \

@@ -10,6 +10,7 @@ class MMonElection(Message):
     """fields: op (propose|ack|victory|lease), rank, epoch, quorum?"""
     TYPE = "mon_election"
     FIELDS = ("op", "rank", "epoch?", "quorum?")
+    REPLY = None
 
 
 @register_message
@@ -19,6 +20,7 @@ class MMonPaxosMsg(Message):
     TYPE = "mon_paxos"
     FIELDS = ("op", "rank", "v?", "pn?", "value?", "last_committed?",
               "uncommitted_v?", "uncommitted_pn?")
+    REPLY = None
 
 
 @register_message
@@ -26,6 +28,7 @@ class MMonCommand(Message):
     """fields: tid, cmd (dict) — the 'ceph ...' JSON command RPC."""
     TYPE = "mon_command"
     FIELDS = ("tid", "cmd")
+    REPLY = "mon_command_reply"
 
 
 @register_message
@@ -33,6 +36,7 @@ class MMonCommandReply(Message):
     """fields: tid, result, out (dict)."""
     TYPE = "mon_command_reply"
     FIELDS = ("tid", "result", "out")
+    REPLY = None
 
 
 @register_message
@@ -40,6 +44,7 @@ class MMonSubscribe(Message):
     """fields: what (['osdmap', ...]), addr (subscriber's listen addr)."""
     TYPE = "mon_subscribe"
     FIELDS = ("what", "addr")
+    REPLY = None
 
 
 @register_message
@@ -47,6 +52,7 @@ class MOSDBoot(Message):
     """fields: osd_id, addr (reference MOSDBoot.h)."""
     TYPE = "osd_boot"
     FIELDS = ("osd_id", "addr")
+    REPLY = None
 
 
 @register_message
@@ -55,6 +61,7 @@ class MOSDBeacon(Message):
     carries the op-tracker's slow-op summary for mon health."""
     TYPE = "osd_beacon"
     FIELDS = ("osd_id", "epoch", "slow_ops?")
+    REPLY = None
 
 
 @register_message
@@ -64,6 +71,7 @@ class MOSDFailure(Message):
     receipt time for its grace window)."""
     TYPE = "osd_failure"
     FIELDS = ("reporter", "failed_osd")
+    REPLY = None
 
 
 @register_message
@@ -74,6 +82,7 @@ class MLog(Message):
     proposes through paxos (LogMonitor)."""
     TYPE = "log"
     FIELDS = ("entries",)
+    REPLY = None
 
 
 @register_message
@@ -83,3 +92,4 @@ class MCrashReport(Message):
     the mon, so boot-time re-posts are idempotent."""
     TYPE = "crash_report"
     FIELDS = ("dumps",)
+    REPLY = None

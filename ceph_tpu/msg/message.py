@@ -46,6 +46,12 @@ class Message:
     TYPE = ""
     HEAD_VERSION = 1     # current encoding version
     COMPAT_VERSION = 1   # oldest decoder this encoding supports
+    # Protocol pairing (checked by cephlint dispatch-coverage): the
+    # wire TYPE of this message's reply for request/reply RPCs, None
+    # for replies, events and one-way broadcasts.  Every registered
+    # subclass DECLARES this explicitly — the pairing table is the
+    # contract the multi-process fleet's hang-debugging starts from.
+    REPLY: "Optional[str]" = None
 
     def __init__(self, fields: "Optional[dict]" = None,
                  data: "bytes | np.ndarray | BufferList" = b"") -> None:
@@ -134,13 +140,18 @@ def decode_message(header, data: "bytes | BufferList" = b"",
 # --- generic types used by the transport itself ------------------------------
 
 
+# QA codec envelopes: the generic vehicle the wire/sanitizer suites
+# send through raw connections — no daemon dispatches them (and no
+# peer answers a ping), by design; the pragmas name that invariant.
 @register_message
-class MPing(Message):
+class MPing(Message):  # cephlint: disable=dispatch-coverage
     TYPE = "ping"
     FIELDS = ()
+    REPLY = None
 
 
 @register_message
-class MPong(Message):
+class MPong(Message):  # cephlint: disable=dispatch-coverage
     TYPE = "pong"
     FIELDS = ()
+    REPLY = None

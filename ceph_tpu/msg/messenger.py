@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common import sanitizer
+from ..common import mc, sanitizer
 from ..common.buffer import BufferList
 from ..common.throttle import Throttle
 from ..common.log import dout
@@ -282,7 +282,10 @@ class Connection:
             self._flush_task = asyncio.ensure_future(self._flush_loop())
         # wait for the burst that carries OUR frame (backpressure rides
         # the single drain inside it); senders coalesced into the same
-        # burst all resume together — that is the corking win
+        # burst all resume together — that is the corking win.
+        # resolver is the LOCAL flusher below: every burst resolves its
+        # done future in a finally, and teardown resolves on close
+        # cephlint: disable=reply-timeout
         await done
 
     async def _flush_loop(self) -> None:
@@ -365,6 +368,14 @@ class Connection:
                 return
             try:
                 for frame in burst:
+                    if mc.crash_point("ms.mid_cork_flush",
+                                      daemon=self.messenger.name):
+                        # cephmc durability boundary: the daemon dies
+                        # with this burst partially written — the tail
+                        # frames never reach the wire (lossless peers
+                        # replay them from unacked after the restart)
+                        self._abort()
+                        return
                     writer.writelines(frame)
                 await writer.drain()
             except (ConnectionError, OSError):
@@ -660,6 +671,9 @@ class _LocalConnection:
             # errors surfacing, not being logged away)
             fut = asyncio.get_running_loop().create_future()
             self._backlog.append((msg, fut))
+            # resolver is local: the delay cycle's finally blocks and
+            # mark_down() resolve every backlog future on every exit
+            # cephlint: disable=reply-timeout
             await fut
             return
         inj = self.messenger.injector
@@ -937,6 +951,15 @@ class Messenger:
     # --- dispatch ----------------------------------------------------------------
 
     async def _deliver(self, conn, msg: Message) -> None:
+        if mc.active():
+            # cephmc schedule exploration: every cross-daemon delivery
+            # is a schedulable event — the explorer may park it (and
+            # release it in a seeded permuted order across connections,
+            # FIFO within this one) or drop it on a lossy session
+            try:
+                await mc.interpose(self, conn, msg)
+            except mc.Dropped:
+                return
         cost = len(msg.data)
         await self.dispatch_throttle.aget(cost)
         try:

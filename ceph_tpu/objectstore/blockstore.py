@@ -468,6 +468,9 @@ class BlockStore(ObjectStore):
             self._gc_queue.append((rec, freed, fut))
         if self._gc_task is None or self._gc_task.done():
             self._gc_task = asyncio.ensure_future(self._gc_loop())
+        # resolver is the local group committer: every queued record is
+        # resolved per pass — exceptionally on injected WAL crashes
+        # cephlint: disable=reply-timeout
         await fut
 
     async def _gc_loop(self) -> None:

@@ -24,6 +24,7 @@ import numpy as np
 from ..common.config import Config
 from ..common.log import dout
 from ..common import buffer as buffer_mod
+from ..common import mc
 from ..common.perf_counters import (ExternalCounters, PerfCounters,
                                     PerfCountersBuilder,
                                     PerfCountersCollection)
@@ -228,6 +229,10 @@ class OSDDaemon(Dispatcher):
         self._copy_inflight: "Dict[int, asyncio.Future]" = {}
         # notify_id -> (pending watch_ids, done future)
         self._notifies: "Dict[int, Tuple[set, asyncio.Future]]" = {}
+        # peer osd -> (last echoed probe stamp, peer's map epoch):
+        # filled by osd_ping_reply (liveness evidence; mon beacons own
+        # failure detection)
+        self.hb_peers: "Dict[int, Tuple[float, int]]" = {}
         self._mgr_task = None
         self._agent_task = None
         self._scrub_task = None
@@ -589,6 +594,11 @@ class OSDDaemon(Dispatcher):
                     "pgmeta": json.dumps(fresh.meta_dict()).encode(),
                     "missing": json.dumps(
                         by_pg.get(pg, {})).encode(),
+                    # fresh trimmed logs hold no entries to testify
+                    # to: parent unbacked-mint markers are moot (the
+                    # data shortfall rides "missing") and a stale key
+                    # would clamp the child's complete_to forever
+                    "unbacked": json.dumps({}).encode(),
                     "gap_from": json.dumps(None).encode(),
                     # wholesale copy is safe: reqids are client-unique
                     # per logical op, and a retry targets the pg its
@@ -1267,6 +1277,10 @@ class OSDDaemon(Dispatcher):
                 # same lock for their enqueue, so they can't interleave
                 # either)
                 op = await be.enqueue_transaction(oid, ctx.mutations)
+                # bounded by the pipeline contract: commit fan-in
+                # resolves on the durable count, and an interval
+                # change's _drain_in_flight fails every in-flight op
+                # cephlint: disable=reply-timeout
                 await op.on_commit
         out = bytes(ret or b"")
         if key:
@@ -1605,7 +1619,9 @@ class OSDDaemon(Dispatcher):
                 raise
             if span:
                 span.finish("served")
-            await conn.send_message(reply)
+            # dead-peer replies are routine churn (the reading
+            # primary's watchdog writes us off and re-plans)
+            await self._reply_peering(conn, t, reply)
         elif t == "ec_sub_read_reply":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_sub_read_reply(msg)
@@ -1626,25 +1642,27 @@ class OSDDaemon(Dispatcher):
             be.handle_push_reply(msg)
         elif t == "pg_query":
             be = self._get_backend(tuple(msg["pgid"]))
-            await conn.send_message(be.handle_pg_query(msg))
+            await self._reply_peering(conn, t, be.handle_pg_query(msg))
         elif t == "pg_info":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)
         elif t == "pg_rewind":
             be = self._get_backend(tuple(msg["pgid"]))
-            await conn.send_message(be.handle_pg_rewind(msg))
+            await self._reply_peering(conn, t,
+                                      be.handle_pg_rewind(msg))
         elif t == "pg_rewind_ack":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)
         elif t == "pg_log":
             be = self._get_backend(tuple(msg["pgid"]))
-            await conn.send_message(be.handle_pg_log(msg))
+            await self._reply_peering(conn, t, be.handle_pg_log(msg))
         elif t == "pg_log_ack":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)
         elif t == "scrub_shard":
             be = self._get_backend(tuple(msg["pgid"]))
-            await conn.send_message(be.handle_scrub_shard(msg))
+            await self._reply_peering(conn, t,
+                                      be.handle_scrub_shard(msg))
         elif t == "scrub_shard_reply":
             be = self._get_backend(tuple(msg["pgid"]))
             be.handle_pg_info(msg)   # resolves the tid future
@@ -1654,9 +1672,30 @@ class OSDDaemon(Dispatcher):
             await conn.send_message(MOSDPingReply({
                 "from_osd": self.whoami, "epoch": self.osdmap.epoch,
                 "stamp": msg.get("stamp", 0)}))
+        elif t == "osd_ping_reply":
+            # cephlint dispatch-coverage found this reply UNHANDLED:
+            # it fell through to _deliver's silent drop, so a probing
+            # peer could never learn anything from its own probe.
+            # Record the peer's echo as liveness evidence (the mon
+            # beacon path owns failure detection; this is the local
+            # last-heard ledger admin sockets and future heartbeat
+            # logic read).
+            self.hb_peers[int(msg["from_osd"])] = (
+                float(msg.get("stamp", 0) or 0), int(msg["epoch"]))
         else:
             return False
         return True
+
+    async def _reply_peering(self, conn, what: str, reply) -> None:
+        """Send a peering/scrub RPC reply; a peer that died between
+        its query and our answer (thrasher kill, cephmc crash-restart)
+        is routine, not a crash — its own reply timeout re-drives the
+        exchange against whoever is primary after re-peering."""
+        try:
+            await conn.send_message(reply)
+        except (ConnectionError, OSError) as e:
+            dout("osd", 5, f"osd.{self.whoami}: {what} reply "
+                           f"undeliverable (peer died): {e}")
 
     # --- client ops (reference PrimaryLogPG::do_op -> execute_ctx) -----------
 
@@ -1694,6 +1733,14 @@ class OSDDaemon(Dispatcher):
         if span:
             span.finish("committed" if reply.get("committed")
                         else "rejected")
+        if mc.crash_point("osd.apply_no_reply",
+                          daemon=f"osd.{self.whoami}"):
+            # cephmc durability boundary: this shard dies AFTER the
+            # store apply but BEFORE the reply — the primary must
+            # degrade via the durable-count path and the restarted
+            # shard must reconcile through peering (the regime where
+            # the PR 6 reqid-dedup hole lived)
+            return
         try:
             await conn.send_message(reply)
         except (ConnectionError, OSError):

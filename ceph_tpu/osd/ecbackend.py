@@ -45,6 +45,7 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
 
 import numpy as np
 
+from ..common import mc
 from ..common.buffer import (BufferList, as_u8_array, buffer_length,
                              concat_u8)
 from ..common.log import dout
@@ -406,6 +407,12 @@ class ECBackend:
         self.pg_log = PGLog()
         # objects THIS shard is missing (persisted; cleared by pushes)
         self.local_missing: "Dict[str, Version]" = {}
+        # MINT-WITHOUT-APPLY entries (persisted): versions our log
+        # reserved at encode whose local apply a drain/crash killed —
+        # our log must not testify to them in auth elections
+        # (_complete_to clamps past them); cleared when a push backs
+        # them, a rewind drops them, or an adoption replaces the log
+        self.unbacked_mints: "Dict[str, Version]" = {}
         # head before the first gap in our log: set when handle_sub_write
         # sees a non-contiguous entry (we missed ops while the primary
         # couldn't reach us); peering treats everything after it as
@@ -497,6 +504,10 @@ class ECBackend:
                     self.local_missing = {
                         o: ver(v) for o, v in
                         json.loads(kv["missing"].decode()).items()}
+                if "unbacked" in kv:
+                    self.unbacked_mints = {
+                        o: ver(v) for o, v in
+                        json.loads(kv["unbacked"].decode()).items()}
                 if "gap_from" in kv:
                     raw = json.loads(kv["gap_from"].decode())
                     self.log_gap_from = ver(raw) if raw else None
@@ -530,6 +541,9 @@ class ECBackend:
             "pgmeta": json.dumps(self.pg_log.meta_dict()).encode(),
             "missing": json.dumps({o: list(v) for o, v in
                                    self.local_missing.items()}).encode(),
+            "unbacked": json.dumps(
+                {o: list(v) for o, v in
+                 self.unbacked_mints.items()}).encode(),
             "gap_from": json.dumps(
                 list(self.log_gap_from) if self.log_gap_from
                 else None).encode(),
@@ -639,10 +653,32 @@ class ECBackend:
         return any(hs.contains(oid) for hs in self._hit_set_archive())
 
     def _complete_to(self) -> Version:
-        """Newest version our log is known contiguous through — the head,
-        unless we detected a gap (missed sub-writes)."""
-        return (self.log_gap_from if self.log_gap_from is not None
+        """Newest version our log is known contiguous through AND
+        testimony-worthy — the head, unless we detected a gap (missed
+        sub-writes) or the log holds MINT-WITHOUT-APPLY entries
+        (unbacked_mints).  Versions are reserved in the log
+        synchronously at encode (seed 12's invariant), so a drain or
+        crash between mint and local apply leaves the log claiming
+        entries this shard never applied; counting those toward
+        auth-log election let a one-shard write become authoritative
+        (and its reqid be republished/acked) with this shard's stale
+        chunk then poisoning recovery decode (cephmc explore seed 9:
+        an acked truncate whose effect vanished).  ORDINARY
+        local_missing entries (adoption/recovery bookkeeping) do NOT
+        clamp: their data is backed by the >= k shards that elected
+        them — discounting those made every recovering shard look
+        divergent and wedged peering (cephmc seed 20)."""
+        base = (self.log_gap_from if self.log_gap_from is not None
                 else self.pg_log.head)
+        if self.unbacked_mints:
+            oldest = min(self.unbacked_mints.values())
+            prev = self.pg_log.tail
+            for e in self.pg_log.entries:
+                if e.version < oldest and e.version > prev:
+                    prev = e.version
+            if prev < base:
+                base = prev
+        return base
 
     # ------------------------------------------------------------- activation
 
@@ -757,7 +793,10 @@ class ECBackend:
                 # shorter than a parked pipeline): ride the in-flight
                 # attempt's outcome instead of enqueueing the mutation a
                 # second time — a second enqueue would double-apply an
-                # append (the reference's "dup op in progress" path)
+                # append (the reference's "dup op in progress" path).
+                # resolver is the OWNING attempt: its BaseException
+                # handler resolves the inflight future on every exit
+                # cephlint: disable=reply-timeout
                 return await asyncio.shield(cur)
             # reserve SYNCHRONOUSLY, before the first await: two
             # attempts interleaving their degraded/cls waits must
@@ -787,6 +826,12 @@ class ECBackend:
                                                         reqid=reqid)
             finally:
                 self._admissions_pending -= 1
+            # bounded by the pipeline contract: commit fan-in resolves
+            # on the durable count and _drain_in_flight fails every
+            # in-flight op on interval change (lossless peers never
+            # silently lose a sub-write reply; peer death IS an
+            # interval change)
+            # cephlint: disable=reply-timeout
             version = await op.on_commit
         except BaseException as e:
             if reqid:
@@ -874,6 +919,10 @@ class ECBackend:
             if trace_id:
                 self._recovery_trace[oid] = trace_id
             self._recovery_prio.append(oid)
+            # resolver is recovery: every degraded future is resolved on
+            # every _recover_object exit path (BaseException handler),
+            # and the push wait is bounded by osd_recovery_push_timeout
+            # cephlint: disable=reply-timeout
             await fut
 
     def _projected_oi(self, oid: str) -> ObjectInfo:
@@ -908,6 +957,23 @@ class ECBackend:
                 op.rewrite = True
                 size = buffer_length(cop.data)
             elif cop.op == "truncate":
+                if cop.off < size:
+                    # a shrink must physically destroy the sub-stripe
+                    # tail the chunk-aligned store truncate keeps:
+                    # write zeros over [truncate_to, stripe boundary)
+                    # or a later extension (truncate up, write past
+                    # end) READS THE OLD BYTES BACK — the stale-tail
+                    # resurrection cephmc's first explore sweep found
+                    # (seed 1; RADOS contract: extended regions read
+                    # as zeros).  Painted before any later op in this
+                    # vector, so a following append still wins.
+                    tail = min(
+                        size,
+                        self.sinfo.logical_to_next_stripe_offset(
+                            cop.off)) - cop.off
+                    if tail > 0:
+                        op.writes.append(
+                            (cop.off, np.zeros(tail, dtype=np.uint8)))
                 op.truncate_to = cop.off
                 size = cop.off
             elif cop.op == "delete":
@@ -1118,6 +1184,10 @@ class ECBackend:
 
     async def _finish_rmw_read(self, op: Op, rop: ReadOp,
                                extents: "List[Extent]") -> None:
+        # bounded by the read watchdog (_read_watchdog, spawned at
+        # _start_read): silent shards get EIO synthesized within
+        # osd_ec_sub_read_timeout, so rop.done always resolves
+        # cephlint: disable=reply-timeout
         await rop.done
         if op.oid in rop.errors:
             async with self._lock:
@@ -1549,6 +1619,17 @@ class ECBackend:
             if acting[shard] == self.whoami:
                 local_msgs.append((shard, msg, batch_ops))
             else:
+                if (shard != shards_wanted[0]
+                        and mc.crash_point(
+                            "osd.mid_batch_fanout",
+                            daemon=f"osd.{self.whoami}")):
+                    # cephmc durability boundary: the primary dies
+                    # MID-BATCH-FANOUT — some shards hold the batch
+                    # frame, the rest never see it.  The restart's
+                    # interval change must reconcile via log election
+                    # (divergent-entry rewind or republished reqids),
+                    # never half-apply the batch
+                    return
                 try:
                     await self.send(acting[shard], msg)
                 except (ConnectionError, OSError, ECError) as e:
@@ -2405,6 +2486,9 @@ class ECBackend:
             if fut is None or fut.done():
                 return  # no recovery in flight (unfound): legacy behavior
             self._recovery_prio.append(oid)
+            # resolver is recovery: every degraded future resolves on
+            # every _recover_object exit path; push waits are bounded
+            # cephlint: disable=reply-timeout
             await fut
 
     async def objects_read_at_snap(self, oid: str,
@@ -2438,6 +2522,9 @@ class ECBackend:
             return []
         rop = await self._start_read({oid: clipped},
                                      for_recovery=False, gen=gen)
+        # bounded by the read watchdog: silent shards get EIO
+        # synthesized within osd_ec_sub_read_timeout
+        # cephlint: disable=reply-timeout
         await rop.done
         if oid in rop.errors:
             raise ECError(f"snap read {oid} failed: errno "
@@ -2452,40 +2539,68 @@ class ECBackend:
     ) -> "Dict[str, List[Tuple[int, bytes]]]":
         """Primary read entry (reference objects_read_and_reconstruct
         ECBackend.cc:2345): fetch min shards, decode, trim to the
-        requested logical extents."""
-        for oid in reads:
-            if trace_id and oid in self.local_missing:
-                self._recovery_trace[oid] = trace_id
-            await self.wait_readable(oid)
-            self._hit_set_track(oid)
-        sizes = {oid: self.object_size(oid) for oid in reads}
-        clipped: "Dict[str, List[Extent]]" = {}
-        for oid, extents in reads.items():
-            out = []
-            for off, length in extents:
-                if length == 0:
-                    length = max(0, sizes[oid] - off)
-                length = min(length, max(0, sizes[oid] - off))
-                if length > 0:
-                    out.append((off, length))
-            clipped[oid] = out
-        todo = {o: e for o, e in clipped.items() if e}
-        results: "Dict[str, List[Tuple[int, bytes]]]" = {
-            o: [] for o in clipped}
-        if not todo:
+        requested logical extents.
+
+        Torn-read guard (cephmc explore seed 7): the read clips its
+        extents against object_info taken BEFORE the shard round — a
+        write committing between that snapshot and the shard replies
+        used to yield new data at the OLD length, a state no
+        linearization point contains (write_full data with the
+        pre-write size's stale tail appended).  Each object's oi
+        version is re-checked after the shard round; a moved version
+        re-clips and re-reads, so the served bytes and the served
+        length come from one consistent state."""
+        for attempt in range(5):
+            for oid in reads:
+                if trace_id and oid in self.local_missing:
+                    self._recovery_trace[oid] = trace_id
+                await self.wait_readable(oid)
+                self._hit_set_track(oid)
+            sizes = {oid: self.object_size(oid) for oid in reads}
+            versions = {oid: self._get_object_info(oid).version
+                        for oid in reads}
+            clipped: "Dict[str, List[Extent]]" = {}
+            for oid, extents in reads.items():
+                out = []
+                for off, length in extents:
+                    if length == 0:
+                        length = max(0, sizes[oid] - off)
+                    length = min(length, max(0, sizes[oid] - off))
+                    if length > 0:
+                        out.append((off, length))
+                clipped[oid] = out
+            todo = {o: e for o, e in clipped.items() if e}
+            results: "Dict[str, List[Tuple[int, bytes]]]" = {
+                o: [] for o in clipped}
+            if not todo:
+                return results
+            rop = await self._start_read(todo, for_recovery=False,
+                                         trace_id=trace_id)
+            # bounded by the read watchdog: silent shards get EIO
+            # synthesized within osd_ec_sub_read_timeout
+            # cephlint: disable=reply-timeout
+            await rop.done
+            if any(self._get_object_info(oid).version != versions[oid]
+                   for oid in reads):
+                if attempt < 4:
+                    continue  # a write landed mid-read: re-snapshot
+                # give-up is LOUD: under sustained same-object write
+                # load the served bytes may still be torn — a cephmc
+                # gate failure that points here is this, not a new
+                # data-path bug
+                dout("osd", 1,
+                     f"read of {sorted(reads)} still racing writes "
+                     f"after 5 snapshot attempts; serving last round")
+            for oid, extents in todo.items():
+                if oid in rop.errors:
+                    raise ECError(
+                        f"read {oid} failed: errno {rop.errors[oid]}")
+                shard_bufs = rop.complete.get(oid, {})
+                results[oid] = [
+                    (off,
+                     self._reconstruct_extent(shard_bufs, off, length))
+                    for off, length in extents]
             return results
-        rop = await self._start_read(todo, for_recovery=False,
-                                     trace_id=trace_id)
-        await rop.done
-        for oid, extents in todo.items():
-            if oid in rop.errors:
-                raise ECError(
-                    f"read {oid} failed: errno {rop.errors[oid]}")
-            shard_bufs = rop.complete.get(oid, {})
-            results[oid] = [
-                (off, self._reconstruct_extent(shard_bufs, off, length))
-                for off, length in extents]
-        return results
 
     def _reconstruct_extent(self,
                             shard_bufs: "Dict[int, Dict[int, bytes]]",
@@ -2525,6 +2640,10 @@ class ECBackend:
             # push replies) unambiguous — a second RecoveryOp would
             # clobber it and strand the first on never-matched replies
             covered = set(missing_on) <= set(existing.missing_on)
+            # joiner: the owning _recover_object resolves rop.done on
+            # every exit path, and its push wait is bounded by
+            # osd_recovery_push_timeout
+            # cephlint: disable=reply-timeout
             await existing.done
             if covered:
                 return
@@ -2580,6 +2699,9 @@ class ECBackend:
                                       want_to_read=sorted(rop.missing_on),
                                       exclude=exclude or set(rop.missing_on),
                                       trace_id=trace_id)
+        # bounded by the read watchdog: silent shards get EIO
+        # synthesized within osd_ec_sub_read_timeout
+        # cephlint: disable=reply-timeout
         await read.done
         if oid in read.errors:
             raise ECError(f"recovery read failed for {oid}")
@@ -2629,7 +2751,27 @@ class ECBackend:
         # WRITING: push rebuilt shards to their peers
         rop.state = RecoveryOp.WRITING
         await self._push_recovered(rop)
-        await rop.done
+        # Bounded push wait (cephlint reply-timeout): a peer that
+        # received the push but died before replying would otherwise
+        # pin this RecoveryOp — and every joiner parked on rop.done,
+        # and every write waiting on the object's degraded future —
+        # FOREVER.  On timeout the silent shards are written off for
+        # this attempt: they stay in peer_missing, so the next peering
+        # pass re-drives their recovery; the primary's own shard is
+        # already applied, so the object serves reads either way.
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(rop.done),
+                self.opt("osd_recovery_push_timeout", 10.0))
+        except asyncio.TimeoutError:
+            dout("osd", 1,
+                 f"recovery push for {oid!r} timed out on shards "
+                 f"{sorted(rop.waiting_on_pushes)}; deferring them "
+                 f"to the next peering pass")
+            rop.waiting_on_pushes.clear()
+            self.recovery_ops.pop(oid, None)
+            if not rop.done.done():
+                rop.done.set_result(None)
         # snapshot clones must survive shard rebuilds too: re-derive
         # every clone generation the primary holds for this object and
         # push it to the recovering shards (best effort; deep scrub
@@ -2658,6 +2800,9 @@ class ECBackend:
                                       for_recovery=True,
                                       want_to_read=sorted(missing_on),
                                       exclude=exclude, gen=gen)
+        # bounded by the read watchdog: silent shards get EIO
+        # synthesized within osd_ec_sub_read_timeout
+        # cephlint: disable=reply-timeout
         await read.done
         if oid in read.errors:
             raise ECError(f"clone read failed: errno "
@@ -2774,6 +2919,9 @@ class ECBackend:
         # push must not (the head may still be absent here)
         if int(msg.get("gen", NO_GEN)) == NO_GEN:
             self.local_missing.pop(msg["oid"], None)
+            # the push carries applied data for the object: our log's
+            # testimony about it is backed again
+            self.unbacked_mints.pop(msg["oid"], None)
         self._apply_pg_meta(t, cid)
         return MOSDPGPushReply({
             "pgid": list(self.pgid), "shard": shard,
@@ -2905,6 +3053,24 @@ class ECBackend:
                     latest[e.oid] = e
             for oid, e in latest.items():
                 missing[oid] = e.version
+            # MERGE the prior missing set, never replace it: complete_to
+            # is LOG contiguity, and a previous adoption advanced the
+            # log past entries whose DATA this shard still lacks.  A
+            # re-peer that derived missing from the log delta alone
+            # amnestied those objects — the primary then planned writes
+            # against an absent ObjectInfo (size 0) and an acked
+            # write_full's bytes vanished under the next append (cephmc
+            # explore seed 4; the reference's pg_missing_t persists
+            # across merge_log for exactly this reason).  Objects the
+            # auth log deletes are the one legitimate amnesty.
+            newest = {e.oid: e for e in auth.entries}   # last wins
+            dead = {oid for oid, e in newest.items()
+                    if e.op == "delete"}
+            for oid, v in self.local_missing.items():
+                if oid in dead:
+                    continue
+                cur = missing.get(oid)
+                missing[oid] = v if cur is None else max(cur, v)
         self.pg_log = auth
         for e in auth.entries:
             # merged entries carry their client reqids: retry dedup
@@ -2912,6 +3078,11 @@ class ECBackend:
             # merge (reference: merge_log carries pg_log_entry_t::reqid)
             if e.reqid:
                 self.completed_reqids[e.reqid] = e.version
+        # the adopted log is the electorate's: any unbacked mint of
+        # ours it contains is backed by the shards that elected it
+        # (and rides ``missing`` if our data lags); ones it lacks are
+        # gone from our log — either way the marker is spent
+        self.unbacked_mints = {}
         self.local_missing = missing
         self.log_gap_from = None
         self._apply_pg_meta(t, cid)
@@ -2988,6 +3159,10 @@ class ECBackend:
                 newer = [e.version for e in self.pg_log.entries
                          if e.oid == oid]
                 self.local_missing[oid] = max(newer) if newer else to
+        # rewound unbacked mints left the log: nothing to testify to
+        for oid, v in list(self.unbacked_mints.items()):
+            if v > to:
+                self.unbacked_mints.pop(oid, None)
         self._apply_pg_meta(t, cid)
 
     def _rollback_entry(self, t: Transaction, cid: Collection, shard: int,
@@ -2997,6 +3172,31 @@ class ECBackend:
         values, generation clones)."""
         sid = ObjectId(e.oid, shard)
         rb = e.rollback
+        # APPLIED guard: only undo entries this shard's STORE actually
+        # holds.  Since seed 12's fix, the primary reserves versions in
+        # the log synchronously at encode — the entry rides the log
+        # BEFORE the local staging task applies it, so a rewind racing
+        # that window sees a minted-but-never-applied entry.  The
+        # on-disk ObjectInfo is the applied truth: absent, or older
+        # than the entry, means the store is already in the pre-entry
+        # state and there is nothing to undo — the old clone-absent
+        # branch instead inferred "entry created the object" and
+        # REMOVED it, destroying the acked prior state (cephmc explore
+        # seed 4: write_full's bytes vanished under a later append).
+        try:
+            cur = ObjectInfo.decode(bytes(
+                self.store.get_attr(cid, sid, OI_KEY)))
+        except (NotFound, KeyError):
+            cur = None
+        if e.op == "delete":
+            # an APPLIED delete leaves the object absent — absence is
+            # the applied state here, and the rollback clone (staged
+            # by the delete's own txn) is what restores it; a PRESENT
+            # object older than the entry means the delete never ran
+            if cur is not None and cur.version < e.version:
+                return
+        elif cur is None or cur.version < e.version:
+            return
         if "clone_gen" in rb:
             gid = sid.with_gen(int(rb["clone_gen"]))
             if self.store.exists(cid, gid):
@@ -3094,12 +3294,86 @@ class ECBackend:
         finally:
             self.pending_queries.pop(tid, None)
 
+    def _op_durable_evidence(self, op: Op) -> bool:
+        """True when at least one shard (local staging included) has
+        ACKED this op's sub-write — evidence its entry is backed by
+        applied data somewhere.  Commit acks discard from
+        pending_commits without joining failed_shards; failures do
+        both, so the difference counts acks."""
+        if not op.acting:
+            return False          # never issued: no frame exists
+        initial = {s for s in range(self.k + self.m)
+                   if s < len(op.acting)
+                   and op.acting[s] != NONE_OSD}
+        return bool(initial - op.pending_commits - op.failed_shards)
+
     def _drain_in_flight(self, err: "Optional[Exception]" = None) -> None:
         """Fail every op still in the pipeline (reference: on interval
         change in-flight ops are requeued; here the client sees EIO and
         retries against the re-peered PG)."""
         err = err or NotActive(f"pg {self.pgid}: interval change, "
                                f"op aborted by peering")
+        # Entries minted at encode whose sub-writes NO shard has acked
+        # must not survive in our log: peering would elect them (ours
+        # is the longest log), republish their reqids, and the client's
+        # retry would be ACKED for a mutation that never applied
+        # anywhere (cephmc explore seed 9: an acked truncate with no
+        # effect).  Drop the zero-evidence SUFFIX only — an entry below
+        # one with durable evidence stays, because log contiguity is
+        # election currency; and if a shard applied it after all, that
+        # shard's longer log wins the election and the entry survives
+        # through it, data attached.
+        dropped = False
+        for op in reversed(list(self.waiting_commit)):
+            if op.version and self.pg_log.head == op.version \
+                    and not self._op_durable_evidence(op):
+                self.pg_log.entries = [e for e in self.pg_log.entries
+                                       if e.version != op.version]
+                self.pg_log.head = (self.pg_log.entries[-1].version
+                                    if self.pg_log.entries
+                                    else self.pg_log.tail)
+                dropped = True
+            else:
+                break
+        if dropped:
+            # consumed persist deltas may already name the dropped
+            # entries: the next persist must rewrite wholesale
+            self.pg_log.mark_full_rewrite()
+        # Entries KEPT (durable evidence elsewhere) whose LOCAL staging
+        # never applied: our own shard is stale for them — record it,
+        # or peering would count our log-complete shard as a data
+        # source and recovery would decode the acked state from a
+        # stale chunk (cephmc explore seed 9).  The my_shard ack is
+        # the local-staging commit, so "still pending or failed" means
+        # the store never applied it here.
+        my = self.my_shard
+        marked = False
+        for op in self.waiting_commit:
+            if op.version and my >= 0 and (
+                    my in op.pending_commits
+                    or my in op.failed_shards):
+                cur = self.local_missing.get(op.oid)
+                if cur is None or cur < op.version:
+                    self.local_missing[op.oid] = op.version
+                    marked = True
+                prev = self.unbacked_mints.get(op.oid)
+                if prev is None or prev > op.version:
+                    # oldest unbacked mint per object: the clamp needs
+                    # the FIRST version our testimony is hollow from
+                    self.unbacked_mints[op.oid] = op.version
+                    marked = True
+        if dropped or marked:
+            # PERSIST the drop/markers now: both exist to stop our log
+            # from testifying to data our store never applied, and an
+            # un-persisted marker dies with the next crash-restart —
+            # the reloaded meta would resurrect the lie and the next
+            # election would trust it (cephmc explore seed 9's second
+            # act)
+            try:
+                self._persist_pg_meta(my if my >= 0 else 0)
+            except Exception as e:  # noqa: BLE001 — a failed persist
+                # leaves the pre-drain meta: strictly the old behavior
+                dout("osd", 1, f"drain meta persist failed: {e}")
         for op in (list(self.waiting_state) + list(self.waiting_reads)
                    + list(self.waiting_commit)):
             self._fail_op(op, err)

@@ -121,6 +121,9 @@ class EncodeService:
         self._codecs[key] = codec
         if self._flusher is None or self._flusher.done():
             self._flusher = asyncio.ensure_future(self._flush_loop())
+        # resolver is the local flush loop: every queued request is
+        # resolved per pass, exceptionally on encode failure
+        # cephlint: disable=reply-timeout
         return await fut
 
     def _host_encode(self, codec: ErasureCodeInterface,

@@ -73,6 +73,7 @@ class MOSDOp(Message):
               "trace_id?",     # root span for the op's sub-op tree
               "ticket?",       # cephx service ticket
               "internal?")     # cluster-internal op (copy_from reads)
+    REPLY = "osd_op_reply"
 
 
 @register_message
@@ -82,6 +83,7 @@ class MOSDOpReply(Message):
     TYPE = "osd_op_reply"
     FIELDS = ("tid", "result", "outs",
               "retry_auth?")   # EACCES refinement: fresh ticket may fix
+    REPLY = None
 
 
 # --- EC sub ops (primary <-> shard) ------------------------------------------
@@ -117,6 +119,7 @@ class MECSubOpWrite(Message):
               "trim_to", "roll_forward_to", "log_entries", "txn", "lens",
               "trace?",        # child span crossing the messenger
               "batch?")        # per-op [{tid, at_version, txn}] vector
+    REPLY = "ec_sub_write_reply"
 
 
 @register_message
@@ -129,6 +132,7 @@ class MECSubOpWriteReply(Message):
     TYPE = "ec_sub_write_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "committed", "applied",
               "error?", "missing?", "tids?")
+    REPLY = None
 
 
 def sub_write_tids(msg) -> "List[int]":
@@ -151,6 +155,7 @@ class MECSubOpRead(Message):
     TYPE = "ec_sub_read"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "to_read",
               "attrs_to_read", "trace?")
+    REPLY = "ec_sub_read_reply"
 
 
 @register_message
@@ -163,6 +168,7 @@ class MECSubOpReadReply(Message):
     TYPE = "ec_sub_read_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "buffers_read",
               "lens", "attrs_read", "errors", "omap_read?")
+    REPLY = None
 
 
 # --- recovery (primary -> peer shard) ----------------------------------------
@@ -178,6 +184,7 @@ class MOSDPGPush(Message):
     TYPE = "pg_push"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "version",
               "whole", "off", "attrs", "gen?", "remove?", "omap?")
+    REPLY = "pg_push_reply"
 
 
 @register_message
@@ -186,6 +193,7 @@ class MOSDPGPushReply(Message):
     TYPE = "pg_push_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "result",
               "gen?")
+    REPLY = None
 
 
 # --- peering (reference MOSDPGQuery / MOSDPGNotify / MOSDPGLog) --------------
@@ -197,6 +205,7 @@ class MPGQuery(Message):
     fields: pgid, shard, from_osd, tid, epoch."""
     TYPE = "pg_query"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "epoch")
+    REPLY = "pg_info"
 
 
 @register_message
@@ -208,6 +217,7 @@ class MPGInfo(Message):
     TYPE = "pg_info"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "log", "objects",
               "missing", "complete_to", "object_versions")
+    REPLY = None
 
 
 @register_message
@@ -218,6 +228,7 @@ class MPGRewind(Message):
     fields: pgid, shard, from_osd, tid, to=[epoch,v], epoch."""
     TYPE = "pg_rewind"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "to", "epoch")
+    REPLY = "pg_rewind_ack"
 
 
 @register_message
@@ -226,6 +237,7 @@ class MPGRewindAck(Message):
     rejected set when the shard refused (stale primary epoch)."""
     TYPE = "pg_rewind_ack"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "head", "rejected?")
+    REPLY = None
 
 
 @register_message
@@ -241,6 +253,7 @@ class MPGLog(Message):
     TYPE = "pg_log"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "log", "objects",
               "epoch")
+    REPLY = "pg_log_ack"
 
 
 @register_message
@@ -251,6 +264,7 @@ class MPGLogAck(Message):
     TYPE = "pg_log_ack"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "missing",
               "rejected?")
+    REPLY = None
 
 
 # --- maps / control ----------------------------------------------------------
@@ -263,6 +277,7 @@ class MWatchNotify(Message):
     data = notify payload."""
     TYPE = "watch_notify"
     FIELDS = ("notify_id", "watch_id", "oid", "pgid")
+    REPLY = "watch_notify_ack"
 
 
 @register_message
@@ -271,6 +286,7 @@ class MWatchNotifyAck(Message):
     fields: notify_id, watch_id."""
     TYPE = "watch_notify_ack"
     FIELDS = ("notify_id", "watch_id")
+    REPLY = None
 
 
 @register_message
@@ -279,6 +295,7 @@ class MScrubShard(Message):
     fields: pgid, shard, from_osd, tid, deep."""
     TYPE = "scrub_shard"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "deep")
+    REPLY = "scrub_shard_reply"
 
 
 @register_message
@@ -287,6 +304,7 @@ class MScrubShardReply(Message):
     objects ({oid: {size, oi, hinfo, crc?}})."""
     TYPE = "scrub_shard_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "objects")
+    REPLY = None
 
 
 @register_message
@@ -304,6 +322,7 @@ class MOSDBackoff(Message):
     instead of letting it ride out the full op timeout."""
     TYPE = "osd_backoff"
     FIELDS = ("op", "pgid", "id", "reason", "epoch", "tid?")
+    REPLY = None
 
 
 @register_message
@@ -311,6 +330,7 @@ class MOSDMapMsg(Message):
     """Map epoch broadcast (reference MOSDMap.h); full map json in data."""
     TYPE = "osd_map"
     FIELDS = ("epoch",)
+    REPLY = None
 
 
 @register_message
@@ -319,9 +339,11 @@ class MOSDPing(Message):
     echoes only the probe stamp; sender identity rides the session."""
     TYPE = "osd_ping"
     FIELDS = ("stamp?",)
+    REPLY = "osd_ping_reply"
 
 
 @register_message
 class MOSDPingReply(Message):
     TYPE = "osd_ping_reply"
     FIELDS = ("from_osd", "epoch", "stamp")
+    REPLY = None

@@ -109,6 +109,9 @@ class MClockScheduler:
         c.queue.append(fut)
         self._dispatch()
         try:
+            # resolver is local: every slot release re-runs _dispatch,
+            # which grants queued futures in tag order
+            # cephlint: disable=reply-timeout
             await fut
         except asyncio.CancelledError:
             if fut.done() and not fut.cancelled():

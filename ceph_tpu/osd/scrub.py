@@ -255,6 +255,9 @@ async def _rebuild_hinfo(backend, oid: str, present: "Dict[int, dict]",
         return set()
     read = await backend._start_read({oid: [(0, -1)]}, for_recovery=True,
                                      want_to_read=list(range(k + m)))
+    # bounded by the read watchdog: silent shards get EIO synthesized
+    # within osd_ec_sub_read_timeout
+    # cephlint: disable=reply-timeout
     await read.done
     if oid in read.errors:
         return set()

@@ -52,6 +52,20 @@ if [ "$1" = "--sanitize" ]; then
     exit 0
 fi
 
+echo "== cephmc schedule exploration (tools/cephsan --explore) =="
+# bounded cephmc stage: fixed canary seeds + one fresh seed, each one
+# an explored cross-daemon message schedule (delivery permutation,
+# lossy drops, crash-restarts at durability boundaries) over a live
+# thrash-style MiniCluster workload, gated on the WGL linearizability
+# check of the recorded client history.  A failing seed prints its
+# exact reproduce line.
+env JAX_PLATFORMS=cpu python -m tools.cephsan --explore
+mc_rc=$?
+if [ "$mc_rc" -ne 0 ]; then
+    echo "cephmc gate FAILED (exit $mc_rc)"
+    exit "$mc_rc"
+fi
+
 echo "== loadgen smoke (tools/loadgen.py) =="
 # one open-loop row over the binary wire path: nonzero exit when any
 # op fails, the generator goes closed-loop-bound (sched lag), or the

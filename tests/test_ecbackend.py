@@ -59,6 +59,29 @@ class TestRoundTrip:
                 assert st["size"] == 1000
         loop.run_until_complete(go())
 
+    def test_truncate_shrink_never_resurrects_old_bytes(self, loop):
+        """cephmc explore seed 1's stale-tail resurrection, pinned:
+        the chunk-aligned store truncate keeps the last partial
+        stripe, so a shrink must physically zero the kept tail — or
+        truncate-up / write-past-shrink reads the pre-shrink bytes
+        back (RADOS contract: extended regions read as zeros)."""
+        async def go():
+            async with make_cluster() as cluster:
+                client = await cluster.client()
+                io = client.io_ctx("ecpool")
+                await io.write_full("t", b"x" * 50)
+                await io.truncate("t", 20)
+                await io.truncate("t", 40)
+                got = await io.read("t")
+                assert got == b"x" * 20 + b"\x00" * 20, got[18:22]
+                await io.write_full("u", b"y" * 64)
+                await io.truncate("u", 10)
+                await io.write("u", b"AB", 30)
+                got = await io.read("u")
+                assert got == b"y" * 10 + b"\x00" * 20 + b"AB", \
+                    got[8:33]
+        loop.run_until_complete(go())
+
     def test_many_objects_spread_pgs(self, loop):
         async def go():
             async with make_cluster() as cluster:

@@ -1,0 +1,316 @@
+"""cephmc — the message-schedule explorer + linearizability gate.
+
+Covers the explorer runtime (deterministic replay, per-connection
+FIFO, drops, crash points), one end-to-end explored schedule over a
+MiniCluster, and the acceptance proof the gate exists for: the PR 6
+reqid-dedup hole deliberately RE-INTRODUCED is caught by the checker
+as a non-linearizable history with a printed reproduce seed.
+"""
+
+import argparse
+import asyncio
+
+import pytest
+
+from ceph_tpu.common import mc
+from ceph_tpu.qa.cluster import MiniCluster
+from tools.cephsan import linearize
+from tools.cephsan.explore import _run_schedule
+
+pytestmark = pytest.mark.cephmc
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_mc():
+    yield
+    mc.uninstall()
+
+
+class _FakePolicy:
+    def __init__(self, lossy):
+        self.lossy = lossy
+
+
+class _FakeConn:
+    def __init__(self, peer_name, lossy=False):
+        self.peer_name = peer_name
+        self.peer_addr = f"local:{peer_name}"
+        self.policy = _FakePolicy(lossy)
+
+
+class _FakeMessenger:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeMsg:
+    def __init__(self, mtype, tid=0):
+        self.TYPE = mtype
+        self.from_name = ""
+        self._tid = tid
+
+    def get(self, key, default=None):
+        return self._tid if key == "tid" else default
+
+
+def _explore_args(**kw):
+    base = dict(reorder=0.5, drops=0.0, delay=0.1, crash=0.0,
+                max_crashes=3, osds=5, pool_type="ec", k=2, m=1,
+                pg_num=4, clients=2, ops=10, objects=4, max_size=512,
+                op_timeout=3.0)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+# ------------------------------------------------ explorer unit tests
+
+
+def test_same_seed_same_schedule_hash(loop):
+    """The replay contract: one seed, one schedule."""
+    async def drive(exp):
+        ms = _FakeMessenger("osd.1")
+        conns = [_FakeConn(f"peer.{i}") for i in range(3)]
+        async def one(c, n):
+            for i in range(n):
+                await exp.interpose(ms, c, _FakeMsg("ec_sub_write", i))
+        await asyncio.gather(*(one(c, 5) for c in conns))
+        return exp.state_hash()
+
+    hashes = [loop.run_until_complete(drive(mc.Explorer(42)))
+              for _ in range(2)]
+    other = loop.run_until_complete(drive(mc.Explorer(43)))
+    assert hashes[0] == hashes[1]
+    assert other != hashes[0]
+
+
+def test_per_connection_fifo_survives_full_reordering(loop):
+    """reorder=1.0 parks everything — but within one lane delivery
+    must stay FIFO (a real connection never reorders)."""
+    async def go():
+        exp = mc.install(mc.Explorer(7, reorder=1.0, delay=0.3))
+        ms = _FakeMessenger("osd.0")
+        a, b = _FakeConn("peer.a"), _FakeConn("peer.b")
+        order = []
+
+        async def send(conn, tag, i):
+            await exp.interpose(ms, conn, _FakeMsg("m", i))
+            order.append((tag, i))
+
+        await asyncio.gather(*(
+            [send(a, "a", i) for i in range(6)]
+            + [send(b, "b", i) for i in range(6)]))
+        for tag in ("a", "b"):
+            seq = [i for t, i in order if t == tag]
+            assert seq == sorted(seq), (tag, order)
+        # and the interleaving genuinely mixed the two lanes
+        assert order != sorted(order)
+        assert exp.stats["parked"] > 0
+    loop.run_until_complete(go())
+
+
+def test_lossy_drops_only_on_lossy_sessions(loop):
+    async def go():
+        exp = mc.install(mc.Explorer(3, reorder=0.0, lossy_drop=1.0))
+        ms = _FakeMessenger("osd.0")
+        lossless, lossy = _FakeConn("c", False), _FakeConn("d", True)
+        await exp.interpose(ms, lossless, _FakeMsg("m"))   # delivered
+        with pytest.raises(mc.Dropped):
+            await exp.interpose(ms, lossy, _FakeMsg("m"))
+        assert exp.stats["drops"] == 1
+        assert exp.stats["deliveries"] == 1
+    loop.run_until_complete(go())
+
+
+def test_crash_points_fire_only_with_handler_and_budget(loop):
+    async def go():
+        exp = mc.install(mc.Explorer(5, crash=1.0, max_crashes=2))
+        # no handler: never fires
+        assert not mc.crash_point("osd.apply_no_reply", "osd.1")
+        hit = []
+
+        def handler(daemon):
+            if daemon == "osd.9":
+                return False      # decline: the point must NOT fire
+            hit.append(daemon)
+            return True
+        exp.on_crash(handler)
+        # a DECLINED point does not fire, count, or spend budget —
+        # firing without a restart behind it would wedge the pipeline
+        assert not mc.crash_point("osd.apply_no_reply", "osd.9")
+        assert exp.stats["crashes"] == 0
+        assert mc.crash_point("osd.apply_no_reply", "osd.1")
+        assert mc.crash_point("osd.mid_batch_fanout", "osd.2")
+        # budget exhausted
+        assert not mc.crash_point("osd.apply_no_reply", "osd.3")
+        assert hit == ["osd.1", "osd.2"]
+        assert exp.crashes == [("osd.apply_no_reply", "osd.1"),
+                               ("osd.mid_batch_fanout", "osd.2")]
+    loop.run_until_complete(go())
+
+
+# ------------------------------------------------ end-to-end schedules
+
+
+def test_explored_schedule_green_and_linearizable():
+    rep = asyncio.new_event_loop().run_until_complete(
+        _run_schedule(9, _explore_args()))
+    assert rep["ok"], rep["linearizability"]["violations"]
+    assert rep["linearizability"]["checked"] > 0
+    assert rep["explorer"]["deliveries"] > 0
+    assert rep["explorer"]["parked"] > 0
+
+
+def test_crash_restart_schedule_still_linearizable():
+    """Crash-restarts at durability boundaries (apply-no-reply,
+    mid-batch-fanout) + real kill/revive + peering must keep every
+    acked op's effects linearizable."""
+    rep = asyncio.new_event_loop().run_until_complete(
+        _run_schedule(3, _explore_args(crash=0.05, osds=6, m=2,
+                                       ops=14)))
+    assert rep["ok"], rep["linearizability"]["violations"]
+    # the schedule genuinely exercised the crash machinery
+    assert rep["explorer"]["crashes"] >= 1
+    assert len(rep["restarts"]) >= 1
+
+
+# ------------------------------------------------ the gate sees the bug
+
+
+def test_reintroduced_reqid_dedup_hole_is_caught(loop, capsys):
+    """Acceptance proof: the PR 6 reqid-dedup hole (retry re-applied
+    after an interval change drained the first attempt) deliberately
+    re-introduced is flagged by the linearizability checker as a
+    NON-linearizable history, with the reproduce seed printed — the
+    gate can see this bug class, so the process split can't silently
+    bring it back."""
+    async def go():
+        exp = mc.install(mc.Explorer(7, reorder=0.0, delay=0.0))
+        rec = exp.recorder
+        async with MiniCluster(6) as cluster:
+            cluster.create_replicated_pool("rep", size=3, pg_num=4,
+                                           stripe_unit=512)
+            client = await cluster.client()
+            io = client.io_ctx("rep")
+            base = b"q" * 100
+            await io.write_full("obj", base)
+            pool = cluster.osdmap.pool_by_name("rep")
+            pg = cluster.osdmap.object_to_pg(pool.pool_id, "obj")
+            _up, acting = cluster.osdmap.pg_to_up_acting_osds(
+                pool.pool_id, pg)
+            be = cluster.osds[acting[0]]._get_backend(
+                (pool.pool_id, pg))
+            from ceph_tpu.osd.ecbackend import ClientOp
+
+            # attempt 1: replica sends fail -> applied on the primary,
+            # never acked (exactly the cephsan seed-7 staging)
+            real_send = be.send
+            async def failing_send(osd, msg):
+                if msg.TYPE == "ec_sub_write":
+                    raise ConnectionError("replica down (test)")
+                return await real_send(osd, msg)
+            be.send = failing_send
+            hid = rec.invoke("client.0", pool.pool_id, "obj",
+                             [{"op": "append", "dlen": 50}], b"x" * 50,
+                             reqid="c:retry")
+            with pytest.raises(Exception):
+                await be.submit_transaction(
+                    "obj", [ClientOp("append", data=b"x" * 50)],
+                    reqid="c:retry")
+            rec.fail(hid, "durable < min_size")
+            be.send = real_send
+
+            # interval change; then RE-INTRODUCE the hole: drop the
+            # republished reqid (pre-PR6 state — commit never inserted
+            # it, and now peering "forgot" to republish it)
+            await be.peer(force=True)
+            be.completed_reqids.pop("c:retry", None)
+
+            # the client's retry: same reqid, same logical op (the
+            # recorder folds it) — with the hole it RE-APPLIES
+            assert rec.invoke("client.0", pool.pool_id, "obj",
+                              [{"op": "append", "dlen": 50}],
+                              b"x" * 50, reqid="c:retry") == hid
+            await be.submit_transaction(
+                "obj", [ClientOp("append", data=b"x" * 50)],
+                reqid="c:retry")
+            rec.complete(hid)
+
+            got = await io.read("obj")       # recorded via objecter
+            assert got == base + b"x" * 100  # the double-apply
+        history = rec.to_history()
+        report = linearize.check(history)
+        mc.uninstall()
+        return report
+
+    report = loop.run_until_complete(go())
+    assert not report["linearizable"]
+    cx = report["violations"][0]
+    assert cx["object"] == "obj"
+    assert any("append" in op for op in cx["ops"])
+    print(f"cephmc: seed 7: NON-LINEARIZABLE (reqid-dedup hole)\n"
+          f"cephmc: reproduce with:\n"
+          f"    python -m tools.cephsan --explore --seed-list 7 "
+          f"--fresh 0")
+    out = capsys.readouterr().out
+    assert "reproduce with" in out and "--seed-list 7" in out
+
+
+def test_fixed_hole_same_staging_is_linearizable(loop):
+    """Negative control: the SAME staging without re-introducing the
+    hole (peering republishes the reqid, the retry dedups) records a
+    linearizable history."""
+    async def go():
+        exp = mc.install(mc.Explorer(7, reorder=0.0, delay=0.0))
+        rec = exp.recorder
+        async with MiniCluster(6) as cluster:
+            cluster.create_replicated_pool("rep", size=3, pg_num=4,
+                                           stripe_unit=512)
+            client = await cluster.client()
+            io = client.io_ctx("rep")
+            base = b"q" * 100
+            await io.write_full("obj", base)
+            pool = cluster.osdmap.pool_by_name("rep")
+            pg = cluster.osdmap.object_to_pg(pool.pool_id, "obj")
+            _up, acting = cluster.osdmap.pg_to_up_acting_osds(
+                pool.pool_id, pg)
+            be = cluster.osds[acting[0]]._get_backend(
+                (pool.pool_id, pg))
+            from ceph_tpu.osd.ecbackend import ClientOp
+            real_send = be.send
+            async def failing_send(osd, msg):
+                if msg.TYPE == "ec_sub_write":
+                    raise ConnectionError("replica down (test)")
+                return await real_send(osd, msg)
+            be.send = failing_send
+            hid = rec.invoke("client.0", pool.pool_id, "obj",
+                             [{"op": "append", "dlen": 50}], b"x" * 50,
+                             reqid="c:retry")
+            with pytest.raises(Exception):
+                await be.submit_transaction(
+                    "obj", [ClientOp("append", data=b"x" * 50)],
+                    reqid="c:retry")
+            rec.fail(hid, "durable < min_size")
+            be.send = real_send
+            await be.peer(force=True)
+            rec.invoke("client.0", pool.pool_id, "obj",
+                       [{"op": "append", "dlen": 50}], b"x" * 50,
+                       reqid="c:retry")
+            await be.submit_transaction(
+                "obj", [ClientOp("append", data=b"x" * 50)],
+                reqid="c:retry")
+            rec.complete(hid)
+            got = await io.read("obj")
+            assert got == base + b"x" * 50   # deduped
+        history = rec.to_history()
+        mc.uninstall()
+        return linearize.check(history)
+
+    assert loop.run_until_complete(go())["linearizable"]

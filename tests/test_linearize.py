@@ -1,0 +1,302 @@
+"""The linearizability checker itself (tools/cephsan/linearize.py).
+
+The checker is the cephmc gate's verdict — it must accept every legal
+concurrent history (or the gate cries wolf) and reject each of the
+bug classes the explorer exists to catch: lost write, double-apply,
+stale read, torn batch.  Histories here are hand-seeded through the
+same HistoryRecorder the objecter hook uses, so the wire format and
+the checker agree by construction.
+"""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, ".")  # repo root: tools/ is not installed
+
+from ceph_tpu.common.mc import HistoryRecorder
+from tools.cephsan import linearize
+
+
+def d(blob: bytes) -> str:
+    return hashlib.sha1(blob).hexdigest()
+
+
+def read_out(blob: bytes):
+    return [{"op": "read", "dlen": len(blob)}]
+
+
+def check(rec: HistoryRecorder) -> dict:
+    return linearize.check(rec.to_history())
+
+
+# ------------------------------------------------ linearizable histories
+
+
+def test_sequential_write_read_is_linearizable():
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 3}],
+                   b"abc")
+    rec.complete(w)
+    r = rec.invoke("c0", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"abc"), data=b"abc")
+    rep = check(rec)
+    assert rep["linearizable"] and rep["checked"] == 1
+
+
+def test_concurrent_overlap_accepts_either_order():
+    # two overlapping write_fulls; a read overlapping both may see
+    # either payload — both interleavings are legal
+    for winner in (b"AAA", b"BBB"):
+        rec = HistoryRecorder()
+        w1 = rec.invoke("c1", 1, "o", [{"op": "write_full", "dlen": 3}],
+                        b"AAA")
+        w2 = rec.invoke("c2", 1, "o", [{"op": "write_full", "dlen": 3}],
+                        b"BBB")
+        r = rec.invoke("c3", 1, "o", [{"op": "read", "off": 0,
+                                       "len": 0}])
+        rec.complete(w1)
+        rec.complete(w2)
+        rec.complete(r, outs=read_out(winner), data=winner)
+        assert check(rec)["linearizable"], winner
+
+
+def test_unknown_outcome_write_may_or_may_not_apply():
+    # a failed append may have committed: reads showing either state
+    # are legal
+    for seen in (b"base", b"basex"):
+        rec = HistoryRecorder()
+        w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                       b"base")
+        rec.complete(w)
+        a = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}],
+                       b"x")
+        rec.fail(a, "timeout")
+        r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0,
+                                       "len": 0}])
+        rec.complete(r, outs=read_out(seen), data=seen)
+        assert check(rec)["linearizable"], seen
+
+
+def test_absent_object_semantics():
+    # this tree's contract: read of an absent object returns empty
+    # with result 0, stat reports exists=False
+    rec = HistoryRecorder()
+    r = rec.invoke("c0", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b""), data=b"")
+    s = rec.invoke("c0", 1, "o", [{"op": "stat"}])
+    rec.complete(s, outs=[{"op": "stat", "size": 0, "exists": False,
+                           "dlen": 0}])
+    assert check(rec)["linearizable"]
+
+
+def test_truncate_zero_extension():
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                   b"xxxx")
+    rec.complete(w)
+    t = rec.invoke("c0", 1, "o", [{"op": "truncate", "off": 2}])
+    rec.complete(t)
+    t2 = rec.invoke("c0", 1, "o", [{"op": "truncate", "off": 4}])
+    rec.complete(t2)
+    r = rec.invoke("c0", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"xx\x00\x00"), data=b"xx\x00\x00")
+    assert check(rec)["linearizable"]
+    # the stale-tail resurrection (the pre-fix store behavior) is NOT
+    # linearizable: bytes past the shrink must never come back
+    rec2 = HistoryRecorder()
+    w = rec2.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                    b"xxxx")
+    rec2.complete(w)
+    t = rec2.invoke("c0", 1, "o", [{"op": "truncate", "off": 2}])
+    rec2.complete(t)
+    t2 = rec2.invoke("c0", 1, "o", [{"op": "truncate", "off": 4}])
+    rec2.complete(t2)
+    r = rec2.invoke("c0", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec2.complete(r, outs=read_out(b"xxxx"), data=b"xxxx")
+    assert not check(rec2)["linearizable"]
+
+
+# ------------------------------------------------ the bug classes
+
+
+def test_lost_write_is_non_linearizable():
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                   b"base")
+    rec.complete(w)
+    a = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 2}], b"zz")
+    rec.complete(a)           # ACKED
+    r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"base"), data=b"base")  # zz lost
+    rep = check(rec)
+    assert not rep["linearizable"]
+    assert rep["violations"]
+
+
+def test_double_apply_retry_folding_catches_it():
+    # the PR 6 reqid-dedup hole's shape: an append whose first attempt
+    # failed is retried WITH THE SAME REQID — one logical op.  A
+    # history where the read then sees the payload twice has no
+    # linearization (the recorder folds the re-invocation, so the
+    # checker sees one append, not two legal ones).
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                   b"base")
+    rec.complete(w)
+    a1 = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}], b"A",
+                    reqid="c0:7")
+    rec.fail(a1, "replicas down")
+    a2 = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}], b"A",
+                    reqid="c0:7")
+    assert a1 == a2           # folded: same logical op
+    rec.complete(a2)
+    r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"baseAA"), data=b"baseAA")
+    rep = check(rec)
+    assert not rep["linearizable"]
+    # ...whereas the correctly-deduped outcome is linearizable
+    rec2 = HistoryRecorder()
+    w = rec2.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 4}],
+                    b"base")
+    rec2.complete(w)
+    a1 = rec2.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}],
+                     b"A", reqid="c0:7")
+    rec2.fail(a1, "replicas down")
+    rec2.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}], b"A",
+                reqid="c0:7")
+    rec2.complete(a1)
+    r = rec2.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec2.complete(r, outs=read_out(b"baseA"), data=b"baseA")
+    assert check(rec2)["linearizable"]
+
+
+def test_stale_read_is_non_linearizable():
+    # read INVOKED AFTER an acked write completed must see it — an old
+    # value is a real-time (linearizability, not just serializability)
+    # violation
+    rec = HistoryRecorder()
+    w1 = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 3}],
+                    b"old")
+    rec.complete(w1)
+    w2 = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 3}],
+                    b"new")
+    rec.complete(w2)
+    r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"old"), data=b"old")
+    rep = check(rec)
+    assert not rep["linearizable"]
+
+
+def test_torn_batch_is_non_linearizable():
+    # a composite op vector applies atomically: a read seeing the
+    # write of sub-op 1 but not the truncate of sub-op 2 observes a
+    # state no linearization point contains
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 8}],
+                   b"ABCDEFGH")
+    rec.complete(w)
+    b = rec.invoke("c0", 1, "o",
+                   [{"op": "write", "off": 0, "dlen": 2},
+                    {"op": "truncate", "off": 4}], b"xy")
+    rec.complete(b)
+    r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    # torn: write applied, truncate not
+    rec.complete(r, outs=read_out(b"xyCDEFGH"), data=b"xyCDEFGH")
+    assert not check(rec)["linearizable"]
+    # the atomic outcome is fine
+    rec2 = HistoryRecorder()
+    w = rec2.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 8}],
+                    b"ABCDEFGH")
+    rec2.complete(w)
+    b = rec2.invoke("c0", 1, "o",
+                    [{"op": "write", "off": 0, "dlen": 2},
+                     {"op": "truncate", "off": 4}], b"xy")
+    rec2.complete(b)
+    r = rec2.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec2.complete(r, outs=read_out(b"xyCD"), data=b"xyCD")
+    assert check(rec2)["linearizable"]
+
+
+# ------------------------------------------------ counterexamples & CLI
+
+
+def test_minimal_counterexample_names_the_blocking_op():
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 1}],
+                   b"a")
+    rec.complete(w)
+    a = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}], b"b")
+    rec.complete(a)
+    bad = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0,
+                                     "len": 0}])
+    rec.complete(bad, outs=read_out(b"a"), data=b"a")   # lost append
+    # plenty of innocent later traffic the counterexample must NOT
+    # need
+    for i in range(4):
+        x = rec.invoke("c0", 1, "o", [{"op": "append", "dlen": 1}],
+                       b"c")
+        rec.complete(x)
+    rep = check(rec)
+    assert not rep["linearizable"]
+    cx = rep["violations"][0]
+    # minimal prefix: stops at the violating read, not the tail
+    assert any("read" in op for op in cx["blocking"])
+    assert len(cx["ops"]) <= 3
+
+
+def test_per_object_locality():
+    # violations are localized: a broken object must not taint others
+    rec = HistoryRecorder()
+    for oid, ok in (("good", True), ("bad", False)):
+        w = rec.invoke("c0", 1, oid,
+                       [{"op": "write_full", "dlen": 2}], b"hi")
+        rec.complete(w)
+        seen = b"hi" if ok else b"XX"
+        r = rec.invoke("c1", 1, oid, [{"op": "read", "off": 0,
+                                       "len": 0}])
+        rec.complete(r, outs=read_out(seen), data=seen)
+    rep = check(rec)
+    assert not rep["linearizable"]
+    assert rep["objects"]["good"]["ok"]
+    assert not rep["objects"]["bad"]["ok"]
+
+
+def test_opaque_ops_skip_the_object():
+    rec = HistoryRecorder()
+    e = rec.invoke("c0", 1, "o", [{"op": "call", "cls": "x",
+                                   "method": "y"}])
+    rec.complete(e)
+    rep = check(rec)
+    assert rep["linearizable"] and rep["skipped"] == 1
+
+
+def test_cli_verdict_and_exit_codes(tmp_path):
+    rec = HistoryRecorder()
+    w = rec.invoke("c0", 1, "o", [{"op": "write_full", "dlen": 2}],
+                   b"ab")
+    rec.complete(w)
+    r = rec.invoke("c1", 1, "o", [{"op": "read", "off": 0, "len": 0}])
+    rec.complete(r, outs=read_out(b"ab"), data=b"ab")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(rec.to_history()))
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.cephsan.linearize", str(good)],
+        capture_output=True, text=True)
+    assert res.returncode == 0 and "LINEARIZABLE" in res.stdout
+
+    rec.events[-1]["outs"][0]["digest"] = d(b"nope")
+    rec.events[-1]["outs"][0].pop("payload", None)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(rec.to_history()))
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.cephsan.linearize", str(bad)],
+        capture_output=True, text=True)
+    assert res.returncode == 1 and "VIOLATION" in res.stdout
+
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.cephsan.linearize",
+         str(tmp_path / "missing.json")],
+        capture_output=True, text=True)
+    assert res.returncode == 2

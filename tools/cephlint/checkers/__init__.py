@@ -1,18 +1,23 @@
-"""Checker registry — the nine invariants, by check id."""
+"""Checker registry — the twelve invariants, by check id."""
 
 from .base import Checker, Module, ReportContext  # noqa: F401
 from .aliasing import BufferAliasChecker
 from .atomicity import AwaitAtomicityChecker, IterMutateChecker
 from .blocking import BlockingCallChecker
+from .dispatch import DispatchCoverageChecker
+from .epochs import EpochMonotonicityChecker
 from .kernels import KernelPurityChecker
 from .locks import LockOrderChecker
 from .messages import MsgSymmetryChecker
 from .options import OptionsChecker
 from .tasks import FireAndForgetChecker
+from .timeouts import ReplyTimeoutChecker
 
 ALL_CHECKERS = (BlockingCallChecker, FireAndForgetChecker,
                 LockOrderChecker, MsgSymmetryChecker, OptionsChecker,
                 KernelPurityChecker, AwaitAtomicityChecker,
-                IterMutateChecker, BufferAliasChecker)
+                IterMutateChecker, BufferAliasChecker,
+                DispatchCoverageChecker, ReplyTimeoutChecker,
+                EpochMonotonicityChecker)
 
 CHECKERS = {c.name: c for c in ALL_CHECKERS}

@@ -1,0 +1,180 @@
+"""dispatch-coverage — every registered Message is handled and every
+request declares (and gets) its reply.
+
+The multi-process phase turns every protocol hole into a hang: a
+message type nobody dispatches is silently dropped at ``_deliver``'s
+"unhandled message" dout, and a request whose reply type is never
+constructed parks its sender forever.  In one process that shows up as
+a flaky test; across processes it is an outage.  So the pairing table
+becomes a declared, checked contract (built on the same FIELDS /
+register_message machinery msg-symmetry already enforces):
+
+- every ``@register_message`` class declares ``REPLY`` — the wire type
+  string of its reply for request/reply messages, ``None`` for
+  replies, events and one-way broadcasts.  A missing declaration is a
+  finding: "reply-less request or undeclared one-way" is exactly the
+  ambiguity the checker exists to kill.
+- every declared reply type must itself be a registered type, and must
+  be CONSTRUCTED somewhere in the tree (a reply nobody builds is a
+  request nobody answers).
+- every registered type must be matched by some dispatch site —
+  a ``msg.TYPE == "t"`` / ``t != "t"`` compare or a membership test
+  over literal types, the tree's universal handler idioms.  Types
+  handled nowhere are findings (pragma QA-only envelope types with the
+  invariant named).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, const_str, terminal_attr
+
+
+class DispatchCoverageChecker(Checker):
+    name = "dispatch-coverage"
+    description = "registered Message types: handler reachable + " \
+                  "declared (and produced) reply type"
+
+    # --- collect --------------------------------------------------------------
+
+    def collect(self, module: Module) -> dict:
+        classes: "List[dict]" = []
+        handled: "List[str]" = []
+        constructed: "List[str]" = []
+
+        # names aliasing <obj>.TYPE (t = msg.TYPE), per module — the
+        # alias idiom is function-local but collecting module-wide
+        # only ever ADDS handler evidence
+        type_aliases: "Set[str]" = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "TYPE":
+                type_aliases.add(node.targets[0].id)
+
+        def is_type_expr(e: ast.expr) -> bool:
+            if isinstance(e, ast.Attribute) and e.attr == "TYPE":
+                return True
+            return isinstance(e, ast.Name) and e.id in type_aliases
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node, classes)
+            elif isinstance(node, ast.Call):
+                cls_name = terminal_attr(node.func)
+                if len(cls_name) > 1 and cls_name[0] == "M" and \
+                        cls_name[1].isupper():
+                    constructed.append(cls_name)
+            elif isinstance(node, ast.Compare) and \
+                    len(node.ops) == 1 and len(node.comparators) == 1:
+                op, rhs = node.ops[0], node.comparators[0]
+                if not (is_type_expr(node.left)
+                        or is_type_expr(rhs)):
+                    continue
+                if isinstance(op, (ast.Eq, ast.NotEq)):
+                    for side in (node.left, rhs):
+                        s = const_str(side)
+                        if s is not None:
+                            handled.append(s)
+                elif isinstance(op, (ast.In, ast.NotIn)) and \
+                        isinstance(rhs, (ast.Tuple, ast.List,
+                                         ast.Set)):
+                    for elt in rhs.elts:
+                        s = const_str(elt)
+                        if s is not None:
+                            handled.append(s)
+        return {"classes": classes, "handled": sorted(set(handled)),
+                "constructed": sorted(set(constructed))}
+
+    @staticmethod
+    def _collect_class(node: ast.ClassDef, classes: "List[dict]") -> None:
+        registered = any(terminal_attr(d) == "register_message"
+                         for d in node.decorator_list)
+        if not registered:
+            return
+        wire_type = None
+        reply = None          # "..." | None (declared) | missing
+        has_reply = False
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt = stmt.targets[0].id
+                if tgt == "TYPE":
+                    wire_type = const_str(stmt.value)
+                elif tgt == "REPLY":
+                    has_reply = True
+                    reply = const_str(stmt.value)
+                    if reply is None and not (
+                            isinstance(stmt.value, ast.Constant)
+                            and stmt.value.value is None):
+                        # non-literal REPLY: flagged at report time
+                        reply = "?"
+        classes.append({"name": node.name, "type": wire_type,
+                        "reply": reply, "has_reply": has_reply,
+                        "line": node.lineno})
+
+    # --- report ---------------------------------------------------------------
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        registry: "Dict[str, Tuple[str, dict]]" = {}
+        handled: "Set[str]" = set()
+        constructed: "Set[str]" = set()
+        for path, f in facts.items():
+            for c in f.get("classes", ()):
+                if c["type"]:
+                    registry[c["type"]] = (path, c)
+            handled.update(f.get("handled", ()))
+            constructed.update(f.get("constructed", ()))
+
+        for wtype, (path, c) in sorted(registry.items()):
+            ctx_line = f"class {c['name']}"
+            if not c["has_reply"]:
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=ctx_line,
+                    message=f"{c['name']} declares no REPLY: set "
+                            f"REPLY = '<type>' for a request that "
+                            f"awaits an answer, REPLY = None for a "
+                            f"reply/event/one-way — the protocol "
+                            f"pairing table must be explicit before "
+                            f"the fleet goes multi-process"))
+            elif c["reply"] == "?":
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=ctx_line,
+                    message=f"{c['name']}.REPLY is not a string "
+                            f"literal or None — cephlint cannot check "
+                            f"the pairing"))
+            elif c["reply"] is not None:
+                rhit = registry.get(c["reply"])
+                if rhit is None:
+                    out.append(Finding(
+                        check=self.name, path=path, line=c["line"],
+                        context=ctx_line,
+                        message=f"{c['name']}.REPLY names "
+                                f"{c['reply']!r} but no registered "
+                                f"message declares that TYPE"))
+                elif rhit[1]["name"] not in constructed:
+                    out.append(Finding(
+                        check=self.name, path=path, line=c["line"],
+                        context=ctx_line,
+                        message=f"{c['name']} awaits reply "
+                                f"{c['reply']!r} but no site ever "
+                                f"constructs {rhit[1]['name']} — the "
+                                f"request can never be answered"))
+            if wtype not in handled:
+                out.append(Finding(
+                    check=self.name, path=path, line=c["line"],
+                    context=ctx_line,
+                    message=f"message type {wtype!r} has no reachable "
+                            f"dispatch handler (no TYPE compare or "
+                            f"membership test anywhere matches it): "
+                            f"it would be silently dropped at "
+                            f"_deliver's unhandled-message fallback"))
+        return out

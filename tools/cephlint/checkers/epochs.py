@@ -1,0 +1,87 @@
+"""epoch-monotonicity — equality where the peering contract wants
+ordering.
+
+Epochs and eversions are MONOTONIC: the protocol's questions about
+them are directional — "is this message stale?" (``msg_epoch <
+peered_epoch``: reject), "did the map move past what this attempt
+targeted?" (``epoch > seen``: re-target).  An equality test collapses
+both directions into one bit and silently misroutes the one it
+dropped: ``if msg.epoch != self.epoch: reject`` bounces messages from
+a NEWER interval that the daemon should instead catch up to — the
+classic split-brain-adjacent bug the reference's peering code avoids
+by always comparing with ``<`` / ``>=``.
+
+The checker flags ``==`` / ``!=`` comparisons where BOTH operands are
+epoch-shaped: a name/attribute whose terminal segment contains
+"epoch", a subscript/``get`` read of an "epoch"-ish message key, or an
+``int()`` coercion of one.  Same-round dedup sites where equality IS
+the contract (election acks for exactly this round, idempotent
+re-delivery drops) carry a pragma naming that invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, const_str, terminal_attr
+
+
+def _is_epochish(node: ast.expr) -> bool:
+    """A value that denominates in map/interval epochs."""
+    if isinstance(node, ast.Call):
+        fn = terminal_attr(node.func)
+        if fn == "int" and node.args:
+            return _is_epochish(node.args[0])
+        if fn == "get" and node.args:
+            key = const_str(node.args[0])
+            return key is not None and "epoch" in key
+        return False
+    if isinstance(node, ast.Subscript):
+        key = const_str(node.slice)
+        return key is not None and "epoch" in key
+    name = terminal_attr(node)
+    return bool(name) and "epoch" in name.lower()
+
+
+class EpochMonotonicityChecker(Checker):
+    name = "epoch-monotonicity"
+    description = "==/!= between epochs where staleness needs </>="
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Compare)
+                    and len(node.ops) == 1
+                    and len(node.comparators) == 1
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq))):
+                continue
+            lhs, rhs = node.left, node.comparators[0]
+            # literal compares (epoch == 0 sentinels) are existence
+            # checks, not ordering decisions — only flag epoch-vs-epoch
+            if isinstance(lhs, ast.Constant) or \
+                    isinstance(rhs, ast.Constant):
+                continue
+            if _is_epochish(lhs) and _is_epochish(rhs):
+                op = "!=" if isinstance(node.ops[0], ast.NotEq) else "=="
+                hits.append({"line": node.lineno, "op": op,
+                             "context": module.context(node.lineno)})
+        return {"hits": hits}
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in sorted(facts.items()):
+            for h in f.get("hits", ()):
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    context=h["context"],
+                    message=f"'{h['op']}' between epochs discards the "
+                            f"staleness direction — the peering "
+                            f"contract compares with </>= (older = "
+                            f"stale reject, newer = catch up); if "
+                            f"equality IS the contract here "
+                            f"(same-round dedup), pragma it naming "
+                            f"that invariant"))
+        return out

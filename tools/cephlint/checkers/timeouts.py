@@ -1,0 +1,190 @@
+"""reply-timeout — every await of a reply future is bounded.
+
+The osd_ec_subread_timeout lesson, enforced tree-wide: a future that a
+REMOTE peer resolves (reply fan-in, sub-op ack, paxos accept) awaited
+bare is an unbounded wait — one silently-dropped reply pins the
+awaiting op forever, and across processes "silently dropped" is a
+routine failure, not an injection.  Every such await must ride
+``asyncio.wait_for`` (or an equivalent watchdog, in which case the
+site carries a pragma naming the invariant that bounds it — e.g. the
+EC read watchdog that synthesizes EIO for silent shards, or the
+peering drain that fails every in-flight op on interval change).
+
+Detection, two-phase:
+
+- collect: (a) attribute names that ever hold a created future —
+  ``self.x = loop.create_future()``, ``op.on_commit = ...``, futures
+  stored into attribute-keyed containers (``self._inflight[tid] =
+  fut``) or built by comprehensions; (b) bare ``await X`` sites where
+  X is a local name assigned from ``create_future()``, a name aliased
+  from such an attribute (one level, matching the aliasing checker's
+  taint depth), or a direct attribute access.  ``asyncio.shield(x)``
+  is transparent: shield protects the future from cancellation, it
+  does not bound the wait.
+- report: the attribute set is unioned tree-wide, then every bare
+  await whose target resolves into it (or was locally created) is a
+  finding.  ``asyncio.wait_for(...)`` never matches — the await's
+  operand is the wait_for call, not the future.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, terminal_attr
+
+
+def _unwrap_shield(node: ast.expr) -> ast.expr:
+    """``asyncio.shield(x)`` -> x (shield is not a timeout)."""
+    if isinstance(node, ast.Call) and \
+            terminal_attr(node.func) == "shield" and node.args:
+        return node.args[0]
+    return node
+
+
+def _is_create_future(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and \
+        terminal_attr(node.func) == "create_future"
+
+
+def _contains_create_future(node: ast.expr) -> bool:
+    return any(_is_create_future(n) for n in ast.walk(node))
+
+
+class ReplyTimeoutChecker(Checker):
+    name = "reply-timeout"
+    description = "bare awaits of reply futures (no wait_for/watchdog)"
+
+    # --- collect --------------------------------------------------------------
+
+    def collect(self, module: Module) -> dict:
+        future_attrs: "Set[str]" = set()
+        awaits: "List[dict]" = []
+
+        # pass 1: attribute names that hold futures anywhere in the file
+        for node in ast.walk(module.tree):
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                tgt, val = node.target, node.value
+            if tgt is None or not _contains_create_future(val):
+                continue
+            if isinstance(tgt, ast.Attribute):
+                # op.on_commit = create_future() / self.x = {...}
+                future_attrs.add(tgt.attr)
+            elif isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Attribute):
+                # self._inflight[tid] = fut-expression
+                future_attrs.add(tgt.value.attr)
+        # futures stored into attrs/containers via a local var:
+        #   fut = loop.create_future(); self._inflight[tid] = fut
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            local_futs: "Set[str]" = set()
+            for node in ast.walk(fn):
+                tgt = val = None
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and \
+                        node.value is not None:
+                    tgt, val = node.target, node.value
+                if tgt is None:
+                    continue
+                if isinstance(tgt, ast.Name) and \
+                        _contains_create_future(val):
+                    local_futs.add(tgt.id)
+                elif isinstance(val, ast.Name) and \
+                        val.id in local_futs:
+                    if isinstance(tgt, ast.Subscript) and \
+                            isinstance(tgt.value, ast.Attribute):
+                        future_attrs.add(tgt.value.attr)
+                    elif isinstance(tgt, ast.Attribute):
+                        future_attrs.add(tgt.attr)
+
+        # pass 2: bare awaits, per function (alias tracking is local)
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, ast.AsyncFunctionDef)]:
+            self._collect_awaits(fn, module, awaits)
+        return {"future_attrs": sorted(future_attrs),
+                "awaits": awaits}
+
+    @staticmethod
+    def _collect_awaits(fn, module: Module,
+                        awaits: "List[dict]") -> None:
+        local_futs: "Set[str]" = set()       # names = created futures
+        aliases: "Dict[str, str]" = {}       # name -> source attr name
+        for node in ast.walk(fn):
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                tgt = node.target
+            if isinstance(tgt, ast.Name):
+                name, val = tgt.id, node.value
+                if _contains_create_future(val):
+                    local_futs.add(name)
+                    continue
+                # one level of alias taint: fut = self.degraded.get(o),
+                # cur = self.inflight[reqid], done = self._flush_done
+                src: "Optional[str]" = None
+                if isinstance(val, ast.Attribute):
+                    src = val.attr
+                elif isinstance(val, ast.Subscript) and \
+                        isinstance(val.value, ast.Attribute):
+                    src = val.value.attr
+                elif isinstance(val, ast.Call) and \
+                        isinstance(val.func, ast.Attribute) and \
+                        val.func.attr == "get" and \
+                        isinstance(val.func.value, ast.Attribute):
+                    src = val.func.value.attr
+                if src is not None:
+                    aliases[name] = src
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Await):
+                continue
+            target = _unwrap_shield(node.value)
+            rec = None
+            if isinstance(target, ast.Name):
+                if target.id in local_futs:
+                    rec = {"kind": "local", "attr": ""}
+                elif target.id in aliases:
+                    rec = {"kind": "attr", "attr": aliases[target.id]}
+            elif isinstance(target, ast.Attribute):
+                rec = {"kind": "attr", "attr": target.attr}
+            if rec is None:
+                continue
+            rec.update({"line": node.lineno, "fn": fn.name,
+                        "context": module.context(node.lineno)})
+            awaits.append(rec)
+
+    # --- report ---------------------------------------------------------------
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        future_attrs: "Set[str]" = set()
+        for f in facts.values():
+            future_attrs.update(f.get("future_attrs", ()))
+        for path, f in sorted(facts.items()):
+            for a in f.get("awaits", ()):
+                if a["kind"] == "attr" and a["attr"] not in future_attrs:
+                    continue
+                what = ("a locally created future" if a["kind"] == "local"
+                        else f"future attribute {a['attr']!r}")
+                out.append(Finding(
+                    check=self.name, path=path, line=a["line"],
+                    context=a["context"],
+                    message=f"{a['fn']}() awaits {what} with no "
+                            f"timeout: a lost resolver (dropped "
+                            f"reply, dead peer) pins this await "
+                            f"forever — wrap in asyncio.wait_for, or "
+                            f"pragma naming the watchdog/invariant "
+                            f"that bounds it"))
+        return out

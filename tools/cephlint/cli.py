@@ -41,6 +41,11 @@ def main(argv: "Optional[list]" = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="snapshot current findings into --baseline and "
                          "exit 0")
+    ap.add_argument("--prune-pragmas", action="store_true",
+                    help="fix mode for stale-pragma findings: rewrite "
+                         "the files, removing pragma check names that "
+                         "no longer fire on their covered line (a "
+                         "pragma left empty is deleted)")
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the per-file fact cache")
     ap.add_argument("--cache", default=DEFAULT_CACHE,
@@ -85,6 +90,19 @@ def main(argv: "Optional[list]" = None) -> int:
             print(f"cephlint: wrote {len(findings)} baseline entr"
                   f"{'y' if len(findings) == 1 else 'ies'} to "
                   f"{args.baseline}")
+            return 0
+        if args.prune_pragmas:
+            from .checkers import ReportContext
+            linter = Linter(checks=checks, cache_path=cache)
+            findings = linter.run(
+                args.paths, ReportContext(lockdep_dump=lockdep_dump))
+            stale = [f for f in findings if f.check == "stale-pragma"]
+            rewritten = linter.prune_pragmas(stale)
+            print(f"cephlint: pruned {len(stale)} stale pragma "
+                  f"entr{'y' if len(stale) == 1 else 'ies'} across "
+                  f"{len(rewritten)} file(s)")
+            for p in rewritten:
+                print(f"  {p}")
             return 0
         baseline_path = None if args.no_baseline else args.baseline
         findings, suppressed = lint_paths(

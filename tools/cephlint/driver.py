@@ -20,7 +20,7 @@ from . import pragmas as pragmas_mod
 from .checkers import ALL_CHECKERS, CHECKERS, Module, ReportContext
 from .findings import Finding
 
-_CACHE_SCHEMA = 1
+_CACHE_SCHEMA = 2     # v2: pragma_records (stale-pragma detection)
 
 
 def discover(paths: "Sequence[str]") -> "List[str]":
@@ -97,11 +97,20 @@ class Linter:
         facts = {}
         for checker in self.checkers:
             facts[checker.name] = checker.collect(module)
-        per_line, file_wide = pragmas_mod.extract(source)
+        records = pragmas_mod.extract_records(source)
+        per_line: "Dict[int, Set[str]]" = {}
+        file_wide: "Set[str]" = set()
+        for rec in records:
+            if rec["form"] == "file":
+                file_wide.update(rec["checks"])
+            elif rec["target"]:
+                per_line.setdefault(rec["target"],
+                                    set()).update(rec["checks"])
         entry = {"sha": sha, "facts": facts,
                  "pragmas": {str(k): sorted(v)
                              for k, v in per_line.items()},
-                 "file_pragmas": sorted(file_wide)}
+                 "file_pragmas": sorted(file_wide),
+                 "pragma_records": records}
         if cached is not None and cached.get("sha") == sha:
             # extend a cache entry produced by a narrower --checks run
             entry["facts"] = {**cached.get("facts", {}), **facts}
@@ -141,6 +150,12 @@ class Linter:
                      for p, e in entries.items()}
             findings.extend(checker.report(facts, ctx))
 
+        # stale-pragma detection runs against the PRE-suppression
+        # findings: a pragma is live iff the check it disables still
+        # fires on its covered line — anything else is rot that hides
+        # future regressions at that site
+        findings.extend(self._stale_pragmas(findings, entries))
+
         # pragma suppression
         kept: "List[Finding]" = []
         for f in findings:
@@ -155,6 +170,107 @@ class Linter:
             kept.append(f)
         kept.sort(key=Finding.sort_key)
         return kept
+
+    def _stale_pragmas(self, findings: "List[Finding]",
+                       entries: "Dict[str, dict]") -> "List[Finding]":
+        """-> stale-pragma findings: pragma'd checks that no longer
+        fire on their covered line.  Only checks in THIS run's checker
+        set are judged (a --checks subset must not false-stale the
+        other checkers' pragmas); 'all' is never judged."""
+        active = {c.name for c in self.checkers}
+        fired_line: "Set[Tuple[str, str, int]]" = set()
+        fired_file: "Set[Tuple[str, str]]" = set()
+        for f in findings:
+            fired_line.add((f.check, f.path, f.line))
+            fired_file.add((f.check, f.path))
+        out: "List[Finding]" = []
+        for path, entry in sorted(entries.items()):
+            for rec in entry.get("pragma_records", ()):
+                for check in rec["checks"]:
+                    if check == "all" or check not in active:
+                        continue
+                    if rec["form"] == "file":
+                        live = (check, path) in fired_file
+                    else:
+                        live = (check, path,
+                                rec["target"]) in fired_line
+                    if live:
+                        continue
+                    scope = ("anywhere in this file"
+                             if rec["form"] == "file"
+                             else f"on line {rec['target']}")
+                    out.append(Finding(
+                        check="stale-pragma", path=path,
+                        line=rec["line"],
+                        extra={"stale_check": check,
+                               "form": rec["form"],
+                               "target": rec["target"]},
+                        message=f"pragma disables {check!r} but that "
+                                f"check no longer fires {scope} — "
+                                f"prune it (--prune-pragmas) so the "
+                                f"suppression can't hide a future "
+                                f"regression"))
+        return out
+
+    def prune_pragmas(self, stale: "List[Finding]") -> "List[str]":
+        """Rewrite files removing the stale check names reported by
+        ``_stale_pragmas``; a pragma left with no checks is removed
+        outright (a standalone pragma's whole line goes).  Returns the
+        list of rewritten paths."""
+        by_file: "Dict[str, List[Finding]]" = {}
+        for f in stale:
+            if f.check == "stale-pragma":
+                by_file.setdefault(f.path, []).append(f)
+        rewritten: "List[str]" = []
+        for path, fs in sorted(by_file.items()):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    lines = fh.read().split("\n")
+            except OSError:
+                continue
+            drop: "Dict[int, Set[str]]" = {}
+            for f in fs:
+                drop.setdefault(f.line,
+                                set()).add(f.extra["stale_check"])
+            changed = False
+            for lineno, checks in sorted(drop.items(), reverse=True):
+                idx = lineno - 1
+                if idx >= len(lines):
+                    continue
+                m = pragmas_mod._PRAGMA_RE.search(lines[idx])
+                if m is None:
+                    continue
+                keep = [c.strip() for c in m.group(2).split(",")
+                        if c.strip() and c.strip() not in checks]
+                # preserve whatever follows the check-name list (a
+                # justification comment, a trailing noqa): the fix
+                # mode removes stale NAMES, never human prose
+                tail = lines[idx][m.end():]
+                if keep:
+                    new = (lines[idx][:m.start()]
+                           + f"# cephlint: {m.group(1)}="
+                           + ",".join(keep) + tail)
+                elif tail.strip():
+                    # the pragma goes but its trailing comment (a
+                    # second '#...' such as a noqa) stays one
+                    t2 = tail.strip()
+                    new = (lines[idx][:m.start()].rstrip() + "  "
+                           + (t2 if t2.startswith("#") else "# " + t2))
+                else:
+                    new = lines[idx][:m.start()].rstrip()
+                if new.strip() == "":
+                    del lines[idx]
+                else:
+                    lines[idx] = new
+                changed = True
+            if changed:
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write("\n".join(lines))
+                rewritten.append(path)
+                self._cache.pop(path, None)
+                self._cache_dirty = True
+        self._save_cache()
+        return rewritten
 
 
 def lint_paths(paths: "Sequence[str]",

@@ -27,46 +27,70 @@ _PRAGMA_RE = re.compile(
     r"#\s*cephlint:\s*(disable(?:-file)?)\s*=\s*([\w\-, ]+)")
 
 
-def extract(source: str) -> "Tuple[Dict[int, Set[str]], Set[str]]":
-    """-> (line -> disabled checks, file-wide disabled checks).
+def extract_records(source: str) -> "List[dict]":
+    """Every pragma as a record:
+
+        {"line": <comment line>, "target": <covered code line, 0 for
+         disable-file>, "checks": [...], "form":
+         "trailing"|"standalone"|"file"}
+
+    The records are what stale-pragma detection and ``--prune-pragmas``
+    operate on; ``extract`` derives the suppression maps from them.
 
     Tokenizes rather than regexing raw lines so a pragma-looking string
     LITERAL (e.g. in this very test suite) is not honored as a pragma.
     """
-    per_line: "Dict[int, Set[str]]" = {}
-    file_wide: "Set[str]" = set()
-    # (line, is_own_line) for standalone pragmas awaiting their target
-    pending: "List[Set[str]]" = []
+    records: "List[dict]" = []
+    pending: "List[dict]" = []      # standalone pragmas awaiting target
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
     except (tokenize.TokenError, IndentationError, SyntaxError):
-        return per_line, file_wide
+        return records
     lines = source.splitlines()
     for tok in tokens:
         if tok.type == tokenize.COMMENT:
             m = _PRAGMA_RE.search(tok.string)
             if not m:
                 continue
-            checks = {c.strip() for c in m.group(2).split(",") if c.strip()}
-            if m.group(1) == "disable-file":
-                file_wide |= checks
-                continue
+            checks = sorted({c.strip() for c in m.group(2).split(",")
+                             if c.strip()})
             lineno = tok.start[0]
+            if m.group(1) == "disable-file":
+                records.append({"line": lineno, "target": 0,
+                                "checks": checks, "form": "file"})
+                continue
             before = lines[lineno - 1][: tok.start[1]].strip() \
                 if lineno - 1 < len(lines) else ""
             if before:
                 # trailing pragma: covers its own line
-                per_line.setdefault(lineno, set()).update(checks)
+                records.append({"line": lineno, "target": lineno,
+                                "checks": checks, "form": "trailing"})
             else:
                 # standalone pragma: covers the next code line
-                pending.append(checks)
+                rec = {"line": lineno, "target": 0, "checks": checks,
+                       "form": "standalone"}
+                records.append(rec)
+                pending.append(rec)
         elif tok.type in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
                           tokenize.DEDENT):
             continue
         elif pending:
-            for checks in pending:
-                per_line.setdefault(tok.start[0], set()).update(checks)
+            for rec in pending:
+                rec["target"] = tok.start[0]
             pending = []
+    return records
+
+
+def extract(source: str) -> "Tuple[Dict[int, Set[str]], Set[str]]":
+    """-> (line -> disabled checks, file-wide disabled checks)."""
+    per_line: "Dict[int, Set[str]]" = {}
+    file_wide: "Set[str]" = set()
+    for rec in extract_records(source):
+        if rec["form"] == "file":
+            file_wide.update(rec["checks"])
+        elif rec["target"]:
+            per_line.setdefault(rec["target"],
+                                set()).update(rec["checks"])
     return per_line, file_wide
 
 

@@ -73,10 +73,19 @@ def run_seed(seed: int, suites: "List[str]", freeze: bool,
 
 
 def main(argv: "Optional[List[str]]" = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--explore" in argv:
+        # cephmc mode: message-schedule exploration + linearizability
+        # gate (tools/cephsan/explore.py) — same seed contract, one
+        # protocol layer up from the interleaving sweep
+        argv.remove("--explore")
+        from . import explore
+        return explore.main(argv)
     ap = argparse.ArgumentParser(
         prog="cephsan",
         description="seeded interleaving sweep over the concurrency "
-                    "suites")
+                    "suites (--explore: cephmc message-schedule "
+                    "sweep with the linearizability gate)")
     ap.add_argument("--seeds", type=int, default=0, metavar="N",
                     help="sweep seeds 1..N (the acceptance bar is 25)")
     ap.add_argument("--seed-list", default="",

@@ -1,0 +1,371 @@
+"""cephmc explore — seeded message-schedule sweeps with a
+linearizability gate.
+
+Each seed is ONE explored schedule: a MiniCluster runs a deterministic
+client workload while the cephmc explorer permutes cross-daemon
+delivery order (per-connection FIFO preserved), drops lossy frames,
+delays lane heads, and fires crash-restart points at durability
+boundaries (the registered handler kill/revives the OSD, so peering,
+interval changes and reqid republication run for every explored
+crash).  The recorded invoke/complete history is then checked
+WGL-style against the sequential RADOS object model
+(tools/cephsan/linearize.py) — "no lost write / no double-apply /
+reads see a linearization point" is the gate, not a per-test assert.
+
+State-hash dedup: two seeds whose recorded delivery traces hash the
+same explored the same schedule; the sweep counts them once, so wider
+sweeps spend their budget on NEW interleavings.
+
+A failing seed prints its exact reproduce line — same contract as the
+cephsan interleaving sweep (CEPHSAN_SEED) one module over.
+
+    python -m tools.cephsan --explore                  # canary seeds
+    python -m tools.cephsan --explore --seeds 25       # acceptance bar
+    python -m tools.cephsan --explore --seed-list 7    # replay
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.common import mc  # noqa: E402
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.common.log import dout  # noqa: E402
+from tools.cephsan import linearize  # noqa: E402
+
+# Regression canary (check.sh): seeds that found real bugs during the
+# first triage sweep stay fixed so their bug classes stay dead — the
+# cephsan FIXED_SEEDS contract one protocol layer up.
+# Seed 1 found the STALE-TAIL RESURRECTION: a chunk-aligned store
+#   truncate kept the sub-stripe tail, so truncate-down-then-extend
+#   (or write-past-shrink) read the old bytes back; fixed by zeroing
+#   the kept tail at shrink (ecbackend._prepare_plan).
+# Seed 7 found the TORN READ: the read path clipped against
+#   object_info taken BEFORE the shard round, so a write_full landing
+#   mid-read returned new data at the old length — a state no
+#   linearization point contains; fixed by the oi-version re-check
+#   loop in objects_read_and_reconstruct.
+# Seeds 4 and 9 found the MINT-WITHOUT-APPLY family: versions are
+#   reserved in the primary's log synchronously at encode (seed 12's
+#   invariant), so a drain/crash between mint and local apply leaves
+#   the log testifying to entries the store never applied.  Seed 4:
+#   rewinding such an entry removed the PRE-entry object (rollback's
+#   clone-absent branch) — fixed by the APPLIED guard in
+#   _rollback_entry + the local_missing merge in handle_pg_log.
+#   Seed 9: the lying log won auth election, republished the entry's
+#   reqid (an acked truncate with one data shard), and recovery
+#   decoded the acked state from the primary's stale chunk — fixed by
+#   dropping zero-evidence entries at drain, recording kept-but-
+#   locally-unapplied ones as missing + unbacked (persisted), and
+#   clamping _complete_to past unbacked mints.
+# Seeds 3 and 11 pin crash-restart regimes (apply-no-reply and
+#   mid-batch-fanout boundaries) that also exposed the pg_query
+#   dead-peer reply crash (now _reply_peering) during triage.
+EXPLORE_FIXED_SEEDS = (1, 3, 4, 7, 9, 11)
+
+_MUTATIONS = ("write_full", "append", "write", "truncate", "omap_set")
+
+
+async def _workload(cluster, pool: str, seed: int, n_clients: int,
+                    ops_per_client: int, n_objects: int,
+                    max_size: int, with_omap: bool) -> dict:
+    """Deterministic seeded op mix: the schedule explorer supplies the
+    nondeterminism, the workload must not add its own."""
+    import random
+    stats = {"ok": 0, "failed": 0}
+    kinds = ("write_full", "append", "append", "read", "read",
+             "write", "truncate", "stat")
+    if with_omap:       # omap ops require a replicated pool
+        kinds += ("omap_set", "omap_get")
+
+    async def one_client(idx: int) -> None:
+        rng = random.Random(seed * 1009 + idx)
+        client = await cluster.client()
+        io = client.io_ctx(pool)
+        for _n in range(ops_per_client):
+            oid = f"obj-{rng.randrange(n_objects)}"
+            kind = rng.choice(kinds)
+            size = rng.randrange(1, max_size)
+            payload = bytes(rng.randrange(256)
+                            for _ in range(min(size, 512)))
+            try:
+                if kind == "write_full":
+                    await io.write_full(oid, payload)
+                elif kind == "append":
+                    await io.append(oid, payload)
+                elif kind == "write":
+                    await io.write(oid, payload,
+                                   off=rng.randrange(256))
+                elif kind == "truncate":
+                    await io.truncate(oid, rng.randrange(512))
+                elif kind == "read":
+                    await io.read(oid)
+                elif kind == "stat":
+                    await io.stat(oid)
+                elif kind == "omap_set":
+                    await io.omap_set(
+                        oid, {f"k{rng.randrange(4)}": payload[:16]})
+                elif kind == "omap_get":
+                    await io.omap_get(oid)
+                stats["ok"] += 1
+            except Exception as e:  # noqa: BLE001 — failed/unknown ops
+                # are legal history (the recorder marked them); the
+                # checker decides whether their effects linearize
+                stats["failed"] += 1
+                dout("qa", 10, f"explore op {kind} {oid} failed: {e}")
+    await asyncio.gather(*(one_client(i) for i in range(n_clients)))
+    return stats
+
+
+async def _run_schedule(seed: int, args) -> dict:
+    """One explored schedule -> report dict (verdict + explorer + lin
+    stats)."""
+    exp = mc.install(mc.Explorer(
+        seed, reorder=args.reorder, lossy_drop=args.drops,
+        delay=args.delay, crash=args.crash,
+        max_crashes=args.max_crashes))
+    cfg = Config()
+    cfg.set("rados_osd_op_timeout", args.op_timeout)
+    restarts: "List[str]" = []
+    restart_lock = asyncio.Lock()
+    try:
+        from ceph_tpu.qa.cluster import MiniCluster
+        async with MiniCluster(n_osds=args.osds, config=cfg) as cluster:
+            if args.pool_type == "ec":
+                cluster.create_ec_pool(
+                    "mc", {"plugin": "jax_rs", "k": str(args.k),
+                           "m": str(args.m)}, pg_num=args.pg_num,
+                    stripe_unit=64)
+            else:
+                cluster.create_replicated_pool("mc", size=3,
+                                               pg_num=args.pg_num,
+                                               stripe_unit=256)
+
+            pending_restart = {"n": 0}
+
+            async def _kill_revive(osd_id: int, daemon: str) -> None:
+                async with restart_lock:
+                    await cluster.kill_osd(osd_id)
+                    await asyncio.sleep(0.05)
+                    await cluster.revive_osd(osd_id)
+                    await cluster.peer_all()
+                    pending_restart["n"] -= 1
+
+            def _restart(daemon: str):
+                # SYNCHRONOUS accept/decline (the crash point applies
+                # its local effect only on accept — a declined point
+                # must leave the daemon untouched or the withheld
+                # reply would wedge the PG pipeline with nobody to
+                # restart it).  Count restarts still in flight so
+                # concurrent points can't kill below recoverability.
+                if not daemon.startswith("osd."):
+                    return False
+                osd_id = int(daemon.split(".", 1)[1])
+                live = [i for i, o in cluster.osds.items() if o.up]
+                if osd_id not in live or \
+                        len(live) - pending_restart["n"] <= args.k + 1:
+                    return False
+                pending_restart["n"] += 1
+                restarts.append(daemon)
+                return _kill_revive(osd_id, daemon)
+            exp.on_crash(_restart)
+
+            wl = await _workload(cluster, "mc", seed,
+                                 n_clients=args.clients,
+                                 ops_per_client=args.ops,
+                                 n_objects=args.objects,
+                                 max_size=args.max_size,
+                                 with_omap=args.pool_type
+                                 == "replicated")
+            # heal + final audit reads: every object's post-heal
+            # content joins the history, so a lost or doubled write
+            # that survived to the end is caught even if the workload
+            # never re-read that object
+            for i, osd in list(cluster.osds.items()):
+                if not osd.up:
+                    await cluster.revive_osd(i)
+            await cluster.peer_all()
+            reader = await cluster.client()
+            io = reader.io_ctx("mc")
+            for i in range(args.objects):
+                try:
+                    await asyncio.wait_for(io.read(f"obj-{i}"),
+                                           timeout=10.0)
+                except Exception:  # noqa: BLE001 — absent objects
+                    pass           # (ENOENT) are recorded completions
+    finally:
+        history = exp.recorder.to_history() if exp.recorder else None
+        mc.uninstall()
+    dump_dir = os.environ.get("CEPHMC_HISTORY", "")
+    if dump_dir and history is not None:
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(dump_dir, f"history-{seed}.json")
+        with open(path, "w") as f:
+            json.dump(history, f)
+        print(f"cephmc: history for seed {seed} -> {path}")
+    lin = linearize.check(history) if history is not None else {
+        "linearizable": True, "checked": 0, "skipped": 0,
+        "violations": []}
+    return {"seed": seed, "ok": bool(lin["linearizable"]),
+            "workload": wl, "restarts": restarts,
+            "explorer": exp.report(),
+            "linearizability": {
+                "linearizable": lin["linearizable"],
+                "checked": lin["checked"], "skipped": lin["skipped"],
+                "violations": lin["violations"]}}
+
+
+def run_schedule(seed: int, args) -> dict:
+    """One schedule on a fresh event loop (composable with cephsan:
+    when --sanitize is set the loop policy already hands out seeded
+    InterleavingLoops, so task wakeup order is explored too)."""
+    loop = asyncio.new_event_loop()
+    try:
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(_run_schedule(seed, args))
+    finally:
+        loop.close()
+
+
+def _fresh_seed() -> int:
+    return (int(time.time() * 1000) ^ (os.getpid() << 12)) % 1_000_000
+
+
+def main(argv: "Optional[List[str]]" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cephsan --explore",
+        description="cephmc message-schedule sweep with the "
+                    "linearizability gate")
+    ap.add_argument("--seeds", type=int, default=0,
+                    help="sweep seeds 1..N (the acceptance bar is 25)")
+    ap.add_argument("--seed-list", default="",
+                    help="explicit seeds (replay mode)")
+    ap.add_argument("--fresh", type=int, default=1,
+                    help="extra fresh (time-derived) seeds, printed "
+                         "for replay (default 1; 0 for deterministic "
+                         "CI)")
+    ap.add_argument("--keep-going", action="store_true")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="also permute task wakeup order (cephsan "
+                         "InterleavingLoop, seed derived per schedule)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full sweep report as JSON")
+    # schedule-shape knobs (defaults = the CI gate's shape)
+    ap.add_argument("--reorder", type=float, default=0.5)
+    ap.add_argument("--drops", type=float, default=0.05)
+    ap.add_argument("--delay", type=float, default=0.15)
+    ap.add_argument("--crash", type=float, default=0.02)
+    ap.add_argument("--max-crashes", type=int, default=3)
+    ap.add_argument("--osds", type=int, default=6)
+    ap.add_argument("--pool-type", choices=("ec", "replicated"),
+                    default="ec")
+    ap.add_argument("--k", type=int, default=2)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--pg-num", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--ops", type=int, default=24)
+    ap.add_argument("--objects", type=int, default=8)
+    ap.add_argument("--max-size", type=int, default=2048)
+    ap.add_argument("--op-timeout", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    if args.seed_list:
+        try:
+            seeds = [int(s) for s in args.seed_list.split(",")
+                     if s.strip()]
+        except ValueError as e:
+            print(f"cephmc: bad --seed-list: {e}", file=sys.stderr)
+            return 2
+    elif args.seeds > 0:
+        seeds = list(range(1, args.seeds + 1))
+    else:
+        seeds = list(EXPLORE_FIXED_SEEDS)
+    seeds += [_fresh_seed() for _ in range(max(0, args.fresh))]
+
+    print(f"cephmc: exploring {len(seeds)} schedule(s) "
+          f"{seeds if len(seeds) <= 12 else seeds[:12] + ['...']} "
+          f"reorder={args.reorder} drops={args.drops} "
+          f"delay={args.delay} crash={args.crash}")
+    hashes: "Dict[str, int]" = {}
+    reports: "List[dict]" = []
+    failed: "List[int]" = []
+    for seed in seeds:
+        if args.sanitize:
+            from ceph_tpu.common import sanitizer
+            sanitizer.install(seed * 7919 + 1, freeze=True)
+        t0 = time.monotonic()
+        try:
+            rep = run_schedule(seed, args)
+        except Exception as e:  # noqa: BLE001 — harness error: loud,
+            # not a linearizability verdict
+            import traceback
+            traceback.print_exc()
+            print(f"cephmc: seed {seed}: HARNESS ERROR {e}")
+            failed.append(seed)
+            if not args.keep_going:
+                break
+            continue
+        finally:
+            if args.sanitize:
+                from ceph_tpu.common import sanitizer
+                sanitizer.uninstall()
+        dt = time.monotonic() - t0
+        h = rep["explorer"]["state_hash"][:12]
+        dup = h in hashes
+        hashes[h] = hashes.get(h, 0) + 1
+        ex = rep["explorer"]
+        status = "ok" if rep["ok"] else "NON-LINEARIZABLE"
+        print(f"cephmc: seed {seed}: {status} [{dt:.1f}s] "
+              f"deliveries={ex['deliveries']} parked={ex['parked']} "
+              f"drops={ex['drops']} crashes={ex['crashes']} "
+              f"restarts={len(rep['restarts'])} "
+              f"objects={rep['linearizability']['checked']} "
+              f"hash={h}{' (dup schedule)' if dup else ''}")
+        reports.append(rep)
+        if not rep["ok"]:
+            failed.append(seed)
+            print(json.dumps(rep["linearizability"]["violations"],
+                             indent=2))
+            print(f"cephmc: reproduce with:\n"
+                  f"    python -m tools.cephsan --explore "
+                  f"--seed-list {seed} --fresh 0"
+                  f"{' --sanitize' if args.sanitize else ''}")
+            if not args.keep_going:
+                break
+    unique = len(hashes)
+    summary = {"schedules_explored": len(reports),
+               "unique_schedules": unique,
+               "deliveries": sum(r["explorer"]["deliveries"]
+                                 for r in reports),
+               "drops": sum(r["explorer"]["drops"] for r in reports),
+               "crashes": sum(r["explorer"]["crashes"]
+                              for r in reports),
+               "restarts": sum(len(r["restarts"]) for r in reports),
+               "linearizable": not failed,
+               "failing_seeds": failed}
+    if args.json:
+        print(json.dumps({"summary": summary, "schedules": reports},
+                         indent=1))
+    if failed:
+        print(f"cephmc: {len(failed)} failing seed(s): "
+              f"{','.join(map(str, failed))}")
+        return 1
+    print(f"cephmc: all {len(reports)} schedule(s) green "
+          f"({unique} unique, "
+          f"{summary['deliveries']} deliveries, "
+          f"{summary['drops']} drops, {summary['crashes']} crashes, "
+          f"{summary['restarts']} restarts)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

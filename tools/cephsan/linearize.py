@@ -1,0 +1,427 @@
+"""linearize — WGL linearizability checker for RADOS client histories.
+
+Input: a history recorded by ``ceph_tpu.common.mc.HistoryRecorder``
+(invoke/complete/fail events for client ops, with payload digests,
+errno results and reported versions).  The checker asks the only
+question that matters for a storage system's client contract: does
+some total order of the ops exist that (a) respects real time — an op
+that completed before another was invoked comes first — and (b) makes
+every completion's result match a SEQUENTIAL RADOS object model
+(write/append/truncate/delete/omap byte-for-byte semantics)?
+
+"No lost write / no double-apply / reads see a linearization point"
+stops being a per-test assertion and becomes a checked property of any
+recorded run.
+
+Algorithm: Wing & Gong's search with Lowe's memoization, per object —
+linearizability is compositional (Herlihy & Wing locality), so each
+object's subhistory is checked independently, which keeps the search
+small.  Unknown-outcome ops (client saw an error/timeout; the mutation
+may or may not have committed) may linearize anywhere after their
+invocation or never — exactly the reference's "unacked writes may
+vanish but must never half-apply".
+
+Retries share one history entry (the recorder folds them by reqid), so
+a retry that re-applies shows up as a model/result mismatch — the
+double-apply class cephsan seed 7 found in PR 6 — not as two legal ops.
+
+Standalone CLI:
+
+    python -m tools.cephsan.linearize history.json [--object OID] [-v]
+
+Exit codes: 0 = linearizable, 1 = violation found, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_INF = 1 << 60
+
+
+class HistoryError(Exception):
+    """Malformed history (not a verdict)."""
+
+
+def _digest(blob: bytes) -> str:
+    return hashlib.sha1(bytes(blob)).hexdigest()
+
+
+# --- sequential RADOS object model --------------------------------------------
+
+
+class RadosObject:
+    """The sequential specification of one RADOS object: a byte string
+    plus an omap, created on first mutation, gone on delete."""
+
+    __slots__ = ("exists", "data", "omap")
+
+    def __init__(self) -> None:
+        self.exists = False
+        self.data = b""
+        self.omap: "Dict[str, str]" = {}
+
+    def copy(self) -> "RadosObject":
+        o = RadosObject()
+        o.exists, o.data, o.omap = self.exists, self.data, dict(self.omap)
+        return o
+
+    def snapshot(self) -> tuple:
+        return (self.exists, self.data,
+                tuple(sorted(self.omap.items())))
+
+    # -> (ok, errno, out_payload, out_meta); mutations return ok with
+    # no payload, reads return the modeled bytes for result matching
+    def apply(self, op: dict) -> "Tuple[bool, int, bytes, dict]":
+        kind = op["op"]
+        payload = bytes.fromhex(op["payload"]) if "payload" in op \
+            else b"\x00" * int(op.get("len", 0))
+        if kind == "write_full":
+            self.exists, self.data = True, payload
+            return True, 0, b"", {}
+        if kind == "append":
+            self.exists, self.data = True, self.data + payload
+            return True, 0, b"", {}
+        if kind == "write":
+            off = int(op.get("off", 0))
+            d = self.data
+            if len(d) < off:
+                d = d + b"\x00" * (off - len(d))
+            self.exists = True
+            self.data = d[:off] + payload + d[off + len(payload):]
+            return True, 0, b"", {}
+        if kind == "truncate":
+            size = int(op.get("off", 0))
+            if not self.exists:
+                self.exists = True
+            d = self.data
+            self.data = d[:size] + b"\x00" * max(0, size - len(d))
+            return True, 0, b"", {}
+        if kind == "delete":
+            if not self.exists:
+                return True, 2, b"", {}           # ENOENT
+            self.exists, self.data, self.omap = False, b"", {}
+            return True, 0, b"", {}
+        if kind == "read":
+            # this tree's read semantics: extents clip to the object
+            # size, an absent object reads as empty with result 0 (the
+            # striper's hole semantics) — never ENOENT
+            off = int(op.get("off", 0))
+            length = int(op.get("len", 0))
+            end = len(self.data) if length == 0 else off + length
+            return True, 0, self.data[off:end], {}
+        if kind == "stat":
+            # stat never errors: absent objects report size 0,
+            # exists False (daemon.py's stat handler)
+            return True, 0, b"", {"size": len(self.data),
+                                  "exists": self.exists}
+        if kind == "omap_set":
+            kv = json.loads(payload.decode()) if payload else {}
+            self.exists = True
+            self.omap.update({str(k): str(v) for k, v in kv.items()})
+            return True, 0, b"", {}
+        if kind == "omap_rm":
+            for k in op.get("keys", []):
+                self.omap.pop(str(k), None)
+            return True, 0, b"", {}
+        if kind == "omap_get":
+            # absent objects serve an empty map with result 0
+            keys = op.get("keys")
+            sel = self.omap if keys is None else {
+                k: self.omap[k] for k in keys if k in self.omap}
+            return True, 0, json.dumps(
+                sel, sort_keys=True).encode(), {"omap": dict(sel)}
+        if kind == "omap_keys":
+            return True, 0, json.dumps(
+                sorted(self.omap)).encode(), {"omap_keys":
+                                              sorted(self.omap)}
+        return False, 0, b"", {}                  # unmodelable
+
+
+# --- history entries ----------------------------------------------------------
+
+
+@dataclass
+class Entry:
+    op_id: int
+    oid: str
+    client: str
+    ops: "List[dict]"
+    invoke_at: int                      # event index of first invoke
+    complete_at: int = _INF             # _INF = pending/unknown outcome
+    known: bool = False                 # completion observed?
+    error: int = 0                      # completion errno (0 = ok)
+    outs: "List[dict]" = field(default_factory=list)
+    version: "Optional[list]" = None
+    opaque: bool = False
+
+    def describe(self) -> str:
+        ops = "+".join(o["op"] for o in self.ops)
+        when = ("unknown-outcome" if not self.known
+                else f"ok" if self.error == 0 else f"errno {self.error}")
+        return (f"op {self.op_id} [{self.client}] {ops} on "
+                f"{self.oid!r} -> {when}")
+
+
+def parse_history(history: dict) -> "Dict[str, List[Entry]]":
+    """-> oid -> entries (invoke order).  Raises HistoryError on
+    malformed input."""
+    if not isinstance(history, dict) or "events" not in history:
+        raise HistoryError("history must be {'events': [...]}")
+    entries: "Dict[int, Entry]" = {}
+    per_object: "Dict[str, List[Entry]]" = {}
+    for idx, ev in enumerate(history["events"]):
+        kind = ev.get("e")
+        if kind == "invoke":
+            e = Entry(op_id=int(ev["id"]), oid=str(ev["oid"]),
+                      client=str(ev.get("client", "")),
+                      ops=list(ev.get("ops", [])), invoke_at=idx)
+            e.opaque = any(o.get("opaque") for o in e.ops)
+            entries[e.op_id] = e
+            per_object.setdefault(e.oid, []).append(e)
+        elif kind == "reinvoke":
+            # a retry of a known logical op: same entry, completion
+            # window still open (handled by the shared Entry)
+            if int(ev["id"]) not in entries:
+                raise HistoryError(f"reinvoke of unknown op {ev['id']}")
+        elif kind == "complete":
+            e = entries.get(int(ev["id"]))
+            if e is None:
+                raise HistoryError(f"complete of unknown op {ev['id']}")
+            e.complete_at = idx
+            e.known = True
+            e.error = int(ev.get("error", 0))
+            e.outs = list(ev.get("outs", []))
+            e.version = ev.get("version")
+        elif kind == "fail":
+            # unknown outcome: leave complete_at = _INF (the op may
+            # linearize anywhere after invoke, or never)
+            if int(ev["id"]) not in entries:
+                raise HistoryError(f"fail of unknown op {ev['id']}")
+        elif kind is None:
+            raise HistoryError(f"event {idx} has no 'e' kind")
+    return per_object
+
+
+# --- result matching ----------------------------------------------------------
+
+
+def _result_matches(entry: Entry, obj: RadosObject) -> bool:
+    """Apply ``entry``'s ops to a COPY of ``obj``; True when every
+    recorded completion fact matches the model.  Composite op vectors
+    apply atomically — a torn batch (some sub-ops applied, some not)
+    can never match any linearization point."""
+    trial = obj.copy()
+    out_idx = 0
+    for op in entry.ops:
+        ok, errno, payload, meta = trial.apply(op)
+        if not ok:
+            return False
+        if not entry.known:
+            continue
+        if errno != 0:
+            # the model says this sub-op errors here (e.g. read of an
+            # absent object): the recorded completion must carry it
+            return entry.error == errno
+        # match the next recorded out for this sub-op by name (the
+        # reply's outs ride in op order; mutations may record nothing)
+        rec = None
+        for j in range(out_idx, len(entry.outs)):
+            if entry.outs[j].get("op") == op["op"]:
+                rec, out_idx = entry.outs[j], j + 1
+                break
+        if rec is None:
+            continue                      # no recorded fact to check
+        if op["op"] == "read" and "digest" in rec:
+            if rec["digest"] != _digest(payload):
+                return False
+        elif op["op"] in ("omap_get", "omap_keys") and \
+                "payload" in rec:
+            # compare structurally: the daemon's json key order is
+            # insertion order, the model's is sorted — same map
+            try:
+                got = json.loads(bytes.fromhex(rec["payload"])
+                                 .decode() or "null")
+            except ValueError:
+                return False
+            want = (meta.get("omap") if op["op"] == "omap_get"
+                    else meta.get("omap_keys"))
+            if op["op"] == "omap_get" and got != want:
+                return False
+            if op["op"] == "omap_keys" and sorted(got or []) != want:
+                return False
+        if "size" in rec and "size" in meta and \
+                int(rec["size"]) != meta["size"]:
+            return False
+        if "exists" in rec and "exists" in meta and \
+                bool(rec["exists"]) != bool(meta["exists"]):
+            return False
+    if entry.known and entry.error != 0:
+        return False        # client saw an error the model can't produce
+    obj.exists, obj.data, obj.omap = trial.exists, trial.data, trial.omap
+    return True
+
+
+# --- WGL search ---------------------------------------------------------------
+
+
+def _search_entries(entries: "List[Entry]",
+                    max_states: int = 200_000) -> bool:
+    """Wing & Gong search with Lowe-style state memoization: True when
+    some legal linearization of ``entries`` exists."""
+    entries = sorted(entries, key=lambda e: e.invoke_at)
+    n = len(entries)
+    seen: "Set[tuple]" = set()
+    explored = 0
+
+    def candidates(done: "frozenset") -> "List[int]":
+        """Minimal ops: not yet linearized, invoked before every
+        unlinearized KNOWN completion (real-time order)."""
+        horizon = min((entries[i].complete_at for i in range(n)
+                       if i not in done), default=_INF)
+        return [i for i in range(n) if i not in done
+                and entries[i].invoke_at <= horizon]
+
+    def search(done: "frozenset", obj: RadosObject) -> bool:
+        nonlocal explored
+        key = (done, obj.snapshot())
+        if key in seen:
+            return False
+        seen.add(key)
+        explored += 1
+        if explored > max_states:
+            raise HistoryError(
+                f"search budget exceeded ({max_states} states)")
+        # success: every KNOWN-completed op linearized (unknown ops
+        # may stay unlinearized forever)
+        if all(i in done or not entries[i].known for i in range(n)):
+            return True
+        for i in candidates(done):
+            e = entries[i]
+            trial = obj.copy()
+            if not _result_matches(e, trial):
+                continue
+            if search(done | {i}, trial):
+                return True
+        return False
+
+    sys.setrecursionlimit(max(10_000, n * 20 + 1000))
+    return search(frozenset(), RadosObject())
+
+
+def _check_object(oid: str, entries: "List[Entry]"
+                  ) -> "Tuple[bool, Optional[dict]]":
+    """-> (linearizable, counterexample|None) for one object."""
+    if any(e.opaque for e in entries):
+        return True, {"skipped": True,
+                      "reason": "opaque (unmodeled) ops on object"}
+    if _search_entries(entries):
+        return True, None
+
+    # minimal counterexample: the shortest event-prefix of this
+    # object's subhistory that is already non-linearizable — re-run
+    # the search over growing prefixes (completions past the cut
+    # become unknown-outcome, exactly what a shorter recording would
+    # have seen)
+    entries = sorted(entries, key=lambda e: e.invoke_at)
+    for cut in sorted({e.complete_at for e in entries if e.known}):
+        prefix: "List[Entry]" = []
+        for e in entries:
+            if e.invoke_at > cut:
+                continue
+            pe = Entry(**dict(e.__dict__))
+            if pe.complete_at > cut:
+                pe.complete_at, pe.known = _INF, False
+                pe.error, pe.outs, pe.version = 0, [], None
+            prefix.append(pe)
+        try:
+            ok = _search_entries(prefix)
+        except HistoryError:
+            ok = True          # budget blown on a probe: inconclusive
+        if not ok:
+            blocking = [e for e in entries
+                        if e.known and e.complete_at == cut]
+            return False, {
+                "object": oid,
+                "prefix_events": cut + 1,
+                "ops": [e.describe() for e in prefix],
+                "blocking": [e.describe() for e in blocking],
+            }
+    return False, {"object": oid,
+                   "ops": [e.describe() for e in entries],
+                   "blocking": []}
+
+
+def check(history: dict, objects: "Optional[List[str]]" = None
+          ) -> dict:
+    """Check a recorded history.  -> report dict:
+
+    {"linearizable": bool, "objects": {oid: {"ok": bool, ...}},
+     "checked": n, "skipped": n, "violations": [counterexample...]}
+    """
+    per_object = parse_history(history)
+    report: "Dict[str, dict]" = {}
+    violations: "List[dict]" = []
+    checked = skipped = 0
+    for oid in sorted(per_object):
+        if objects is not None and oid not in objects:
+            continue
+        ok, detail = _check_object(oid, per_object[oid])
+        if detail is not None and detail.get("skipped"):
+            skipped += 1
+            report[oid] = {"ok": True, "skipped": True}
+            continue
+        checked += 1
+        report[oid] = {"ok": ok}
+        if not ok:
+            violations.append(detail)
+            report[oid]["counterexample"] = detail
+    return {"linearizable": not violations, "objects": report,
+            "checked": checked, "skipped": skipped,
+            "violations": violations}
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def main(argv: "Optional[List[str]]" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="linearize",
+        description="WGL linearizability check of a recorded RADOS "
+                    "client history against the sequential object "
+                    "model")
+    ap.add_argument("history", help="history JSON (HistoryRecorder "
+                                    "dump, or '-' for stdin)")
+    ap.add_argument("--object", action="append", default=None,
+                    help="check only this object (repeatable)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        if args.history == "-":
+            history = json.load(sys.stdin)
+        else:
+            with open(args.history) as f:
+                history = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"linearize: cannot read history: {e}", file=sys.stderr)
+        return 2
+    try:
+        rep = check(history, objects=args.object)
+    except HistoryError as e:
+        print(f"linearize: {e}", file=sys.stderr)
+        return 2
+    if args.verbose or not rep["linearizable"]:
+        print(json.dumps(rep, indent=2))
+    print(f"linearize: {rep['checked']} object(s) checked, "
+          f"{rep['skipped']} skipped: "
+          f"{'LINEARIZABLE' if rep['linearizable'] else 'VIOLATION'}")
+    return 0 if rep["linearizable"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -341,6 +341,13 @@ def main(argv=None) -> int:
     ap.add_argument("--sanitize-seed", type=int, default=0,
                     help="interleaving seed (default: derived from "
                          "--seed; printed either way for replay)")
+    ap.add_argument("--explore", type=int, default=0, metavar="N",
+                    help="after the chaos rounds, run an N-schedule "
+                         "cephmc sweep (message-delivery permutation "
+                         "+ drops + crash-restarts at durability "
+                         "boundaries, seeds derived from --seed) with "
+                         "the linearizability gate; composes with "
+                         "--sanitize and --pipeline-pass")
     args = ap.parse_args(argv)
     if args.sanitize:
         from ceph_tpu.common import sanitizer
@@ -406,10 +413,33 @@ def main(argv=None) -> int:
             b.force_batching = True
             rc = asyncio.new_event_loop().run_until_complete(
                 run_chaos(b))
+        if args.explore > 0 and rc == 0:
+            rc = _explore_leg(args)
         return rc
     except Exception:  # noqa: BLE001 — harness error, not a data verdict
         traceback.print_exc()
         return 2
+
+
+def _explore_leg(args) -> int:
+    """cephmc leg: N explored message schedules, linearizability-gated
+    (tools/cephsan/explore.py's runner, seeds derived from --seed so
+    the chaos invocation replays end to end)."""
+    from tools.cephsan import explore as mc_explore
+    seeds = ",".join(str(args.seed * 31 + i + 1)
+                     for i in range(args.explore))
+    argv = ["--seed-list", seeds, "--fresh", "0", "--keep-going",
+            "--json"]
+    if args.sanitize:
+        argv.append("--sanitize")
+    print(f"== cephmc explore leg ({args.explore} schedule(s), "
+          f"seeds {seeds}) ==")
+    rc = mc_explore.main(argv)
+    if rc != 0:
+        print("chaos_check: cephmc explore leg FAILED "
+              "(non-linearizable history or harness error)",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
