@@ -110,6 +110,11 @@ class Objecter(Dispatcher):
         # expired/stale ticket, then the op retries with the fresh one
         self.ticket: "Optional[str]" = None
         self.ticket_renewer = None
+        # distributed tracing + client-side op tracking: the owning
+        # client installs these (rados.py); None keeps bare Objecters
+        # (unit tests, tools) zero-cost
+        self.tracer = None
+        self.op_tracker = None
 
     def new_tid(self) -> int:
         self._next_tid += 1
@@ -241,12 +246,45 @@ class Objecter(Dispatcher):
                          ops: "List[dict]", data: bytes = b"",
                          pg: "Optional[int]" = None
                          ) -> "Tuple[List[dict], bytes]":
-        last_err: "Optional[Exception]" = None
         # one tid per *logical* op: retries reuse it, and the server-side
         # reqid dedup (reference osd_reqid_t in the PG log) keeps a
         # mutation whose ack was lost from applying twice
         tid = self.new_tid()
         reqid = f"{self.ms.name}:{tid}"
+        # root span: the whole logical op, retries included — retries
+        # reuse the tid so every wire attempt folds under one trace_id
+        # (= reqid, the same key cephmc folds histories by)
+        root = None
+        if self.tracer is not None:
+            root = self.tracer.start_root(
+                "osd_op", reqid, tags={"oid": str(oid),
+                                       "pool": int(pool_id),
+                                       "client": self.ms.name})
+        top = None
+        if self.op_tracker is not None:
+            opnames = ",".join(str(o.get("op", "?")) for o in ops)
+            top = self.op_tracker.create(
+                f"osd_op(client {pool_id}:{oid} [{opnames}])",
+                trace_id=reqid)
+        try:
+            outs, rdata = await self._op_attempts(
+                pool_id, oid, ops, data, pg, tid, reqid, root)
+            if top is not None:
+                top.finish()
+            return outs, rdata
+        except BaseException:
+            if top is not None:
+                top.finish("error")
+            raise
+        finally:
+            if root is not None:
+                root.finish()
+
+    async def _op_attempts(self, pool_id: int, oid: str,
+                           ops: "List[dict]", data: bytes,
+                           pg: "Optional[int]", tid: int, reqid: str,
+                           root) -> "Tuple[List[dict], bytes]":
+        last_err: "Optional[Exception]" = None
         # cephmc history: one logical op = one invoke/complete pair,
         # however many wire attempts the retry loop takes (the recorder
         # folds re-invocations by reqid — a retry that re-applies is a
@@ -296,6 +334,12 @@ class Objecter(Dispatcher):
                       # ZTracer spans, ECBackend.cc:2063-2068)
                       "trace_id": reqid,
                       "map_epoch": self.osdmap.epoch}
+            if root is not None:
+                # sampled: the trace context rides the wire ("parent"
+                # is the sampled-marker downstream daemons key on); the
+                # messenger stamps "sent" for the wire span
+                fields["trace"] = {"id": reqid, "span": "osd_op",
+                                   "parent": root.span_id}
             if self.ticket:
                 fields["ticket"] = self.ticket
             msg = MOSDOp(fields, data)

@@ -28,6 +28,17 @@ class RadosClient:
         self.monc, self.osdmap = attach_monc(self.ms, mon_addrs, osdmap)
         self.objecter = Objecter(self.ms, self.osdmap)
         self.admin_socket = None
+        # distributed tracing + client-side op tracking: the objecter
+        # opens the root span per logical op (sampled 1-in-N), the
+        # messenger records wire spans for sampled replies, and the
+        # op tracker backs dump_ops_in_flight/dump_historic_ops here
+        # just like on the OSD
+        from ..common.tracing import Tracer
+        from ..common.tracked_op import OpTracker
+        self.tracer = Tracer.from_config(name, self.ms._config)
+        self.objecter.tracer = self.tracer
+        self.objecter.op_tracker = OpTracker.from_config(self.ms._config)
+        self.ms.tracer = self.tracer
         # client-side clog handle (reference: librados carries a
         # LogClient too — client-observed errors belong in the cluster
         # log just like daemon ones)
@@ -69,8 +80,12 @@ class RadosClient:
                    "client status")
         from ..common.log import register_log_commands
         from ..common.lockdep import register_lockdep_commands
+        from ..common.tracing import register_trace_commands
+        from ..common.tracked_op import register_ops_commands
         register_log_commands(a)
         register_lockdep_commands(a)
+        register_ops_commands(a, self.objecter.op_tracker)
+        register_trace_commands(a, self.tracer)
         a.register("clog stats",
                    lambda _c: self.clog.dump(),
                    "cluster-log client counters")

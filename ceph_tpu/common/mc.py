@@ -361,7 +361,12 @@ class HistoryRecorder:
         self.events.append({"e": "invoke", "id": op_id,
                             "client": client, "pool": int(pool),
                             "oid": str(oid), "ops": rec_ops,
-                            "reqid": reqid})
+                            "reqid": reqid,
+                            # the reqid IS the distributed trace id
+                            # (objecter roots spans on it): a failing
+                            # seed names the trace to pull from the
+                            # daemons' 'trace dump' buffers
+                            "trace_id": reqid})
         return op_id
 
     def complete(self, op_id: int, outs: "Optional[List[dict]]" = None,

@@ -292,6 +292,16 @@ OPTIONS: "dict[str, Option]" = _opts(
     Option("osd_enable_op_tracker", bool, True, LEVEL_ADVANCED,
            desc="track in-flight ops for admin-socket dumps",
            services=("osd",)),
+    Option("osd_trace_sample_rate", int, 0, LEVEL_ADVANCED, min=0,
+           desc="distributed-trace sampling: 1-in-N client ops get a "
+                "full client->primary->shards->store span tree "
+                "(0 = tracing off; sampling is decided at the root "
+                "and rides the wire, so downstream daemons never "
+                "re-roll)", services=("osd", "client")),
+    Option("osd_trace_buffer_size", int, 2000, LEVEL_ADVANCED, min=1,
+           desc="finished spans each daemon buffers for 'trace dump' "
+                "(ring: oldest spans drop first, memory stays bounded)",
+           services=("osd", "client")),
     # --- client -------------------------------------------------------------
     Option("rados_osd_op_timeout", float, 10.0, LEVEL_ADVANCED, min=0.1,
            desc="seconds a client op may wait for an OSD reply before "

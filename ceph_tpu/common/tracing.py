@@ -1,0 +1,213 @@
+"""Distributed op tracing — the ZTracer/blkin analog, transport-agnostic.
+
+Reference: the C++ OSD threads ZTracer spans through every EC sub-op
+(ECBackend.cc:2063-2068) so one client op can be reconstructed as a
+tree across client -> primary -> shards -> store.  This module is that
+reconstruction's substrate for the rebuild: each daemon owns a
+``Tracer`` with a bounded buffer of finished spans, the trace context
+rides the ``trace`` optional already declared on the hot-path messages
+(wire-derivable, so it survives the local transport, tcp, and the
+coming multi-process split), and ``tools/trace.py`` assembles the
+per-daemon ``trace dump`` outputs into trees + a critical-path table.
+
+Sampling is decided ONCE, at the root (``start_root``, 1-in-N per
+``osd_trace_sample_rate``); downstream daemons open spans whenever the
+incoming trace context carries a ``parent`` span id — the root's
+sampled-marker — so no daemon re-rolls the dice and a sampled op is
+traced end to end.  ``sample_rate`` 0 disables tracing entirely: no
+spans, no buffer traffic, no hot-path cost (pinned by
+tests/test_tracing.py).
+
+Clocks: spans are stamped with ``time.monotonic()``.  Every dump
+carries a ``{monotonic, wall}`` anchor pair so an assembler can align
+dumps from daemons that do not share a process clock (the multi-process
+split); co-hosted daemons share the clock and align trivially.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+def sampled_ctx(trace: "Any") -> bool:
+    """True when a message's ``trace`` field marks a root-sampled op
+    (the root stamps its span id as ``parent``; correlation-only trace
+    contexts — TrackedOp joining — carry no parent)."""
+    return isinstance(trace, dict) and bool(trace.get("parent")) \
+        and bool(trace.get("id"))
+
+
+class Span:
+    """One timed operation in a trace tree.  Open via
+    ``Tracer.start_span``/``start_root``; ``finish()`` (idempotent)
+    stamps the end and commits the span to the tracer's buffer.
+    Usable as a context manager — the span-balance cephlint checker
+    requires every ``start_span`` to reach ``finish`` on all paths."""
+
+    __slots__ = ("_tracer", "trace_id", "span_id", "parent_id",
+                 "name", "start", "end", "tags")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: str, parent_id: str = "",
+                 tags: "Optional[dict]" = None) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tags: "Dict[str, Any]" = dict(tags or {})
+        self.start = time.monotonic()
+        self.end = 0.0
+
+    def finish(self, **tags) -> None:
+        if self.end:
+            return                      # idempotent: first finish wins
+        self.end = time.monotonic()
+        if tags:
+            self.tags.update(tags)
+        self._tracer._store(self.to_dict())
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "daemon": self._tracer.daemon, "name": self.name,
+                "start": self.start, "end": self.end,
+                "tags": self.tags}
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.finish()
+        return False
+
+
+class Tracer:
+    """Per-daemon span factory + bounded finished-span buffer.
+
+    ``sample_rate`` is 1-in-N: every Nth root op is traced (0 = off).
+    The buffer is a deque(maxlen=buffer_size) — memory is bounded no
+    matter how long tracing stays on; ``total_spans`` keeps the
+    lifetime count so a dump shows how much the ring dropped."""
+
+    def __init__(self, daemon: str, sample_rate: int = 0,
+                 buffer_size: int = 2000) -> None:
+        self.daemon = daemon
+        self.sample_rate = max(0, int(sample_rate))
+        self.buffer_size = max(1, int(buffer_size))
+        self._buf: "deque[dict]" = deque(maxlen=self.buffer_size)
+        self._roots_seen = 0
+        self.total_spans = 0
+        self._next_id = 0
+
+    @classmethod
+    def from_config(cls, daemon: str, config) -> "Tracer":
+        return cls(daemon,
+                   sample_rate=int(config.get("osd_trace_sample_rate")),
+                   buffer_size=int(config.get("osd_trace_buffer_size")))
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample_rate > 0
+
+    def new_span_id(self) -> str:
+        self._next_id += 1
+        return f"{self.daemon}:{self._next_id:x}"
+
+    # --- span creation ----------------------------------------------------
+
+    def start_root(self, name: str, trace_id: str,
+                   tags: "Optional[dict]" = None) -> "Optional[Span]":
+        """Root span, sampling decided HERE (1-in-N).  None when this
+        op is unsampled (or tracing is off) — callers thread the None
+        through and every downstream span stays un-opened."""
+        if self.sample_rate <= 0:
+            return None
+        self._roots_seen += 1
+        if (self._roots_seen - 1) % self.sample_rate:
+            return None
+        return Span(self, name, str(trace_id), self.new_span_id(),
+                    "", tags)
+
+    def start_span(self, name: str, trace_id: str, parent: str = "",
+                   tags: "Optional[dict]" = None) -> Span:
+        """Child span (no sampling roll — the root already decided).
+        Every call site must close it on all paths (context manager or
+        a finally/guarded ``finish()``): cephlint span-balance."""
+        return Span(self, name, str(trace_id), self.new_span_id(),
+                    str(parent or ""), tags)
+
+    def record(self, name: str, trace_id: str, start: float,
+               end: float, parent: str = "",
+               tags: "Optional[dict]" = None,
+               span_id: "Optional[str]" = None) -> str:
+        """Append an already-finished span retroactively from existing
+        timing anchors (the pipelined write path keeps per-op
+        timestamps; opening live spans there would add open/close pairs
+        to code that completes out of band).  Returns the span id."""
+        sid = span_id or self.new_span_id()
+        self._store({"trace_id": str(trace_id), "span_id": sid,
+                     "parent_id": str(parent or ""),
+                     "daemon": self.daemon, "name": name,
+                     "start": float(start), "end": float(end),
+                     "tags": dict(tags or {})})
+        return sid
+
+    def _store(self, span: dict) -> None:
+        self._buf.append(span)
+        self.total_spans += 1
+
+    # --- introspection ----------------------------------------------------
+
+    @property
+    def span_count(self) -> int:
+        return len(self._buf)
+
+    def dump(self, clear: bool = False) -> dict:
+        """'trace dump' admin-command payload: buffered spans + the
+        clock anchor an assembler needs to align daemons that do not
+        share a monotonic clock."""
+        spans = list(self._buf)
+        if clear:
+            self._buf.clear()
+        return {"daemon": self.daemon,
+                "sample_rate": self.sample_rate,
+                "buffer_size": self.buffer_size,
+                "total_spans": self.total_spans,
+                "anchor": {"monotonic": time.monotonic(),
+                           "wall": time.time()},
+                "spans": spans}
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+
+def register_trace_commands(asok, tracer: Tracer) -> None:
+    """Register the tracing surface on a daemon's admin socket."""
+    asok.register(
+        "trace dump",
+        lambda c: tracer.dump(clear=bool(c.get("clear"))),
+        "buffered trace spans (+ clock anchor); 'clear': drain them")
+    asok.register(
+        "trace status",
+        lambda _c: {"daemon": tracer.daemon,
+                    "sample_rate": tracer.sample_rate,
+                    "buffered": tracer.span_count,
+                    "total_spans": tracer.total_spans},
+        "tracing sample rate and buffer occupancy")
+
+
+async def loop_lag_sampler(perf, interval: float = 0.1,
+                           hist: str = "loop_lag_ms") -> None:
+    """Event-loop lag sampler: sleep ``interval`` and histogram the
+    overshoot (ms).  A loaded loop wakes late — the overshoot IS the
+    scheduling delay every other coroutine on this loop is paying, the
+    single-process floor the ROADMAP's attribution work names."""
+    import asyncio
+    while True:
+        t0 = time.monotonic()
+        await asyncio.sleep(interval)
+        lag_ms = (time.monotonic() - t0 - interval) * 1e3
+        perf.hinc(hist, max(0.0, lag_ms))

@@ -162,3 +162,18 @@ class OpTracker:
                 "total": self.slow_ops_total,
                 "oldest_age": round(max((o.age for o in slow),
                                         default=0.0), 3)}
+
+
+def register_ops_commands(asok, tracker: OpTracker) -> None:
+    """Register the op-tracking admin commands (dump_ops_in_flight /
+    dump_historic_ops, trace_ids included in every dump) on any
+    daemon's admin socket — the reference ships these on every daemon
+    type, not just the OSD.  Mirrors register_log_commands."""
+    asok.register("dump_ops_in_flight",
+                  lambda _c: tracker.dump_in_flight(),
+                  "ops currently in flight, with event timelines "
+                  "and trace_ids")
+    asok.register("dump_historic_ops",
+                  lambda _c: tracker.dump_historic(),
+                  "recently completed ops (bounded history ring), "
+                  "with event timelines and trace_ids")

@@ -290,6 +290,14 @@ class MgrDaemon(Dispatcher):
             "mgr", self.config, clog=self.clog,
             post_fn=self.monc.send_crash if self.monc else None)
         self.admin_socket = None
+        # op tracking + tracing parity with the other daemons: report
+        # ingestion shows up in dump_historic_ops, and the (off by
+        # default) tracer collects wire spans for sampled messages
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker.from_config(self.config)
+        self.tracer = Tracer.from_config("mgr", self.config)
+        self.ms.tracer = self.tracer
         self.register_module(StatusModule)
         self.register_module(PrometheusModule)
         from .dashboard import DashboardModule
@@ -325,8 +333,12 @@ class MgrDaemon(Dispatcher):
         from ..common.log import register_log_commands
         from ..common.lockdep import register_lockdep_commands
         a = AdminSocket(path.replace("$name", "mgr"))
+        from ..common.tracked_op import register_ops_commands
+        from ..common.tracing import register_trace_commands
         register_log_commands(a)
         register_lockdep_commands(a)
+        register_ops_commands(a, self.op_tracker)
+        register_trace_commands(a, self.tracer)
         a.register("status",
                    lambda _c: {"num_reports": len(self.reports),
                                "modules": sorted(self.modules)},
@@ -370,6 +382,9 @@ class MgrDaemon(Dispatcher):
     async def _handle_report(self, conn, msg: Message) -> bool:
         if msg.TYPE != "mgr_report":
             return False
+        top = self.op_tracker.create(
+            f"mgr_report({msg['daemon']})",
+            trace_id=f"{msg['daemon']}:{int(msg.get('epoch', 0))}")
         self.reports[str(msg["daemon"])] = {
             "ts": time.monotonic(), "perf": dict(msg.get("perf", {})),
             "status": dict(msg.get("status", {})),
@@ -382,6 +397,7 @@ class MgrDaemon(Dispatcher):
         for name in [n for n, r in self.reports.items()
                      if now - r["ts"] > horizon]:
             del self.reports[name]
+        top.finish()
         return True
 
     # --- convenience ----------------------------------------------------------

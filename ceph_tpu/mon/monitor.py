@@ -68,6 +68,15 @@ class MonDaemon(Dispatcher):
         self.config = config or Config()
         self.ms = Messenger.create(f"mon.{rank}", self.config)
         self.ms.add_dispatcher(self)
+        # op tracking + tracing on the mon too: 'ceph daemon mon.N
+        # dump_historic_ops' shows recent commands with trace ids, and
+        # a tracer (off by default) collects wire spans for messages
+        # that carry sampled trace context
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker.from_config(self.config)
+        self.tracer = Tracer.from_config(f"mon.{rank}", self.config)
+        self.ms.tracer = self.tracer
         self.store: "Dict[str, bytes]" = {}
         self.paxos = Paxos(rank, _MonTransport(self), self.store,
                            self._on_commit)
@@ -144,8 +153,12 @@ class MonDaemon(Dispatcher):
         from ..common.admin_socket import AdminSocket
         from ..common.lockdep import register_lockdep_commands
         a = AdminSocket(path.replace("$name", f"mon.{self.rank}"))
+        from ..common.tracked_op import register_ops_commands
+        from ..common.tracing import register_trace_commands
         register_log_commands(a)
         register_lockdep_commands(a)
+        register_ops_commands(a, self.op_tracker)
+        register_trace_commands(a, self.tracer)
         a.register("status",
                    lambda _c: {"rank": self.rank,
                                "leader": self.elector.leader,
@@ -735,7 +748,12 @@ class MonDaemon(Dispatcher):
             await conn.send_message(MMonCommandReply({
                 "tid": tid, "result": -EAGAIN, "out": out}))
             return
+        peer0 = str(getattr(conn, "peer_name", "") or "")
+        top = self.op_tracker.create(
+            f"mon_command({cmd.get('prefix', '?')})",
+            trace_id=f"{peer0}:{tid}")
         async with self._cmd_lock:
+            top.mark("locked")
             try:
                 denied = self._check_mon_caps(conn, cmd)
                 if denied is not None:
@@ -747,6 +765,7 @@ class MonDaemon(Dispatcher):
                 result, out = -EAGAIN, {"error": str(e)}
             except Exception as e:  # noqa: BLE001 — command errors -> reply
                 result, out = -22, {"error": f"{type(e).__name__}: {e}"}
+        top.finish("done" if result == 0 else f"result={result}")
         # every command leaves an audit-channel trail (reference
         # Monitor::handle_command '[audit] from=... cmd=...: dispatch')
         # — batched through this mon's clog, so a command storm costs

@@ -27,6 +27,7 @@ import json
 import os
 import random
 import struct
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -246,6 +247,7 @@ class Connection:
             if self.policy.lossy:
                 raise ConnectionError(f"connection to {self.peer_addr} closed")
             return
+        _stamp_trace_sent(msg)
         sanitizer.handoff(msg, "messenger.send")
         header, data = msg.encode()
         self.out_seq += 1
@@ -651,6 +653,7 @@ class _LocalConnection:
     async def send_message(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_addr} closed")
+        _stamp_trace_sent(msg)
         sanitizer.handoff(msg, "messenger.send")
         if self.peer.stopped:
             # lossless reconnect: the peer may have restarted and
@@ -776,6 +779,15 @@ class _LocalConnection:
                     f"connection to {self.peer_addr} closed"))
 
 
+def _stamp_trace_sent(msg: Message) -> None:
+    """Stamp the send time into a sampled trace context (the wire-span
+    start).  Only root-sampled contexts carry ``parent``; correlation-
+    only contexts stay untouched so unsampled ops pay nothing."""
+    trace = msg.fields.get("trace")
+    if isinstance(trace, dict) and trace.get("parent"):
+        trace["sent"] = time.monotonic()
+
+
 class Messenger:
     """create() -> bind() -> add_dispatcher() -> start()."""
 
@@ -799,6 +811,10 @@ class Messenger:
         self.cork_stats = {"cork_flushes": 0, "cork_frames": 0,
                            "max_cork_frames": 0}
         self.on_cork_flush = None
+        # distributed tracing: the owning daemon installs its Tracer
+        # here; _deliver then records a wire span for every sampled
+        # message that crossed this messenger (send stamp -> delivery)
+        self.tracer = None
         self.dispatch_throttle = Throttle(
             f"{name}-dispatch", int(self.conf("ms_dispatch_throttle_bytes")))
         self.local = self.conf("ms_type") == "async+local"
@@ -951,6 +967,19 @@ class Messenger:
     # --- dispatch ----------------------------------------------------------------
 
     async def _deliver(self, conn, msg: Message) -> None:
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            trace = msg.fields.get("trace")
+            if isinstance(trace, dict) and trace.get("parent") \
+                    and trace.get("sent") is not None:
+                # receiver-side wire span: sender's stamp -> now.  Both
+                # ends share the process monotonic clock today; dump()
+                # anchors keep this assemblable after the fleet splits.
+                tracer.record(f"wire:{msg.TYPE}", trace.get("id", ""),
+                              float(trace["sent"]), time.monotonic(),
+                              parent=str(trace["parent"]),
+                              tags={"from": msg.from_name,
+                                    "to": self.name})
         if mc.active():
             # cephmc schedule exploration: every cross-daemon delivery
             # is a schedulable event — the explorer may park it (and

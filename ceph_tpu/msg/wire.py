@@ -62,17 +62,19 @@ class WireError(Exception):
 # check_specs() raises on it (tests/test_wire.py runs both).
 WIRE_SPECS: "Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]" = {
     "osd_op": (("tid", "pool", "pg", "oid", "ops", "map_epoch"),
-               ("reqid", "trace_id", "ticket", "internal")),
-    "osd_op_reply": (("tid", "result", "outs"), ("retry_auth",)),
+               ("reqid", "trace_id", "ticket", "internal", "trace")),
+    "osd_op_reply": (("tid", "result", "outs"),
+                     ("retry_auth", "trace")),
     # optionals are APPEND-ONLY (the version-skew contract): "batch" /
-    # "tids" (batched sub-write dispatch) ride behind the older ones
+    # "tids" (batched sub-write dispatch) and "trace" (distributed
+    # tracing context) ride behind the older ones
     "ec_sub_write": (("pgid", "shard", "from_osd", "tid", "epoch",
                       "at_version", "trim_to", "roll_forward_to",
                       "log_entries", "txn", "lens"),
                      ("trace", "batch")),
     "ec_sub_write_reply": (("pgid", "shard", "from_osd", "tid",
                             "committed", "applied"),
-                           ("error", "missing", "tids")),
+                           ("error", "missing", "tids", "trace")),
     "ec_sub_read": (("pgid", "shard", "from_osd", "tid", "to_read",
                      "attrs_to_read"), ("trace",)),
     "ec_sub_read_reply": (("pgid", "shard", "from_osd", "tid",

@@ -111,6 +111,16 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
                          "txns")
           .add_histogram("ms_cork_flush_frames",
                          "frames per corked messenger flush", "frames")
+          # attribution instruments (distributed tracing's perf-side
+          # half): loop lag is the scheduling delay every coroutine on
+          # this daemon's event loop pays (sampled overshoot of a
+          # fixed-interval sleep); cpu attribution is the process_time
+          # burned per dispatch tick — together they name how much of
+          # an op's wall time is queueing on the shared process
+          .add_histogram("loop_lag_ms",
+                         "event-loop scheduling lag samples", "ms")
+          .add_histogram("daemon_cpu_attribution",
+                         "cpu time per message dispatch tick", "us")
           .create_perf_counters())
     coll.add(pc)
     return pc
@@ -155,6 +165,12 @@ class OSDDaemon(Dispatcher):
             or EncodeService.from_config(self.config)
         # per-op event timelines + historic ops (reference TrackedOp)
         self.op_tracker = OpTracker.from_config(self.config)
+        # distributed tracing (reference ZTracer/blkin): this daemon's
+        # span buffer; the messenger gets the same tracer so it can
+        # record wire spans for sampled messages it delivers
+        from ..common.tracing import Tracer
+        self.tracer = Tracer.from_config(f"osd.{osd_id}", self.config)
+        self.ms.tracer = self.tracer
         # cluster log + crash telemetry (reference LogClient +
         # ceph-crash): clog batches significant events to the mon's
         # LogMonitor; the crash handler persists dumps for any task
@@ -240,6 +256,7 @@ class OSDDaemon(Dispatcher):
         # seeded on first sight so intervals count from boot, not epoch
         self._scrub_stamps: "Dict[Tuple[int, int], List[float]]" = {}
         self._beacon_task = None
+        self._loop_lag_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
         # last-consumed pg_num per pool: a map epoch raising it triggers
         # the local collection split (reference OSD::split_pgs)
@@ -323,6 +340,11 @@ class OSDDaemon(Dispatcher):
         # QA unless a test tunes the intervals down
         self._scrub_task = self.crash.task(self._scrub_loop(),
                                            "scrub_loop")
+        # event-loop lag sampler: the per-daemon share of the shared
+        # process loop's scheduling delay, as a perf histogram
+        from ..common.tracing import loop_lag_sampler
+        self._loop_lag_task = self.crash.task(
+            loop_lag_sampler(self.perf), "loop_lag_sampler")
         dout("osd", 1, f"osd.{self.whoami} up at {self.ms.listen_addr}")
         self.clog.info(f"osd.{self.whoami} up at {self.ms.listen_addr}")
         # dumps from previous incarnations (kill -9 + respawn against
@@ -1063,12 +1085,10 @@ class OSDDaemon(Dispatcher):
                    lambda _c: (self.perf_coll.reset(),
                                {"success": True})[1],
                    "zero every perf counter and histogram")
-        a.register("dump_ops_in_flight",
-                   lambda _c: self.op_tracker.dump_in_flight(),
-                   "ops currently being processed")
-        a.register("dump_historic_ops",
-                   lambda _c: self.op_tracker.dump_historic(),
-                   "recently completed ops with event timelines")
+        from ..common.tracing import register_trace_commands
+        from ..common.tracked_op import register_ops_commands
+        register_ops_commands(a, self.op_tracker)
+        register_trace_commands(a, self.tracer)
         a.register("dump_backoffs",
                    lambda _c: self.dump_backoffs(),
                    "live client backoffs (blocks not yet unblocked) "
@@ -1153,6 +1173,8 @@ class OSDDaemon(Dispatcher):
             self._agent_task.cancel()
         if self._scrub_task:
             self._scrub_task.cancel()
+        if self._loop_lag_task:
+            self._loop_lag_task.cancel()
         if self._mgr_task:
             self._mgr_task.cancel()
         # flush pending clog entries while the messenger still works
@@ -1192,7 +1214,7 @@ class OSDDaemon(Dispatcher):
                        fast_read=lambda p=pgid[0]: getattr(
                            self.osdmap.get_pool(p), "fast_read", False),
                        perf=self.perf, profiler=self.profiler,
-                       spawn=self.crash.guard)
+                       spawn=self.crash.guard, tracer=self.tracer)
         be.last_epoch = self.osdmap.epoch
         # activation hook: peering completion releases the PG's
         # backoffs so blocked sessions resend (backoff protocol)
@@ -1521,8 +1543,17 @@ class OSDDaemon(Dispatcher):
         """Crash-guarded dispatch: an unhandled exception in any
         message path leaves a crash dump before propagating — 'the OSD
         stopped replying' becomes a one-command diagnosis."""
-        return await self.crash.dispatch_guard(
-            self._ms_dispatch_inner, conn, msg)
+        # per-dispatch-tick CPU attribution: process_time burned while
+        # this dispatch held the loop (awaits interleave other work, so
+        # this attributes the tick, not the message alone — the honest
+        # single-process number until the fleet splits)
+        t0 = time.process_time()
+        try:
+            return await self.crash.dispatch_guard(
+                self._ms_dispatch_inner, conn, msg)
+        finally:
+            self.perf.hinc("daemon_cpu_attribution",
+                           (time.process_time() - t0) * 1e6)
 
     async def _ms_dispatch_inner(self, conn, msg: Message) -> bool:
         t = msg.TYPE
@@ -1816,6 +1847,17 @@ class OSDDaemon(Dispatcher):
         top = self.op_tracker.create(
             f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
             trace_id=str(msg.get("trace_id", "")))
+        # sampled op: the OSD-side server span (shard dequeue -> reply
+        # sent); stage spans (queue/encode/sub_write/store) parent here
+        tr = msg.get("trace")
+        tspan = None
+        if self.tracer.enabled and isinstance(tr, dict) \
+                and tr.get("parent"):
+            tspan = self.tracer.start_span(
+                "osd:op", str(tr.get("id", "")),
+                parent=str(tr["parent"]),
+                tags={"osd": self.whoami,
+                      "oid": str(msg.get("oid", ""))})
         with top:
             try:
                 if self._crash_injected == "op" \
@@ -1845,8 +1887,10 @@ class OSDDaemon(Dispatcher):
                                                  reason, bid)
                         return
                 top.mark("reached_pg")
-                await self._do_client_op(conn, msg, top)
+                await self._do_client_op(conn, msg, top, tspan)
             finally:
+                if tspan is not None:
+                    tspan.finish()
                 if took:
                     self.op_throttle.put(1)
                 self._maybe_release_queue_backoffs()
@@ -1925,7 +1969,19 @@ class OSDDaemon(Dispatcher):
                     f"{max_write}")
         return ""
 
-    async def _do_client_op(self, conn, msg: MOSDOp, top=None) -> None:
+    def _reply_trace(self, msg: MOSDOp) -> "Optional[dict]":
+        """Trace context for this op's MOSDOpReply: the reply leg's
+        wire span parents to the client's root, a sibling of the
+        server-side span (None when the op wasn't sampled)."""
+        tr = msg.get("trace")
+        if self.tracer.enabled and isinstance(tr, dict) \
+                and tr.get("parent"):
+            return {"id": str(tr.get("id", "")), "span": "osd_op_reply",
+                    "parent": str(tr["parent"])}
+        return None
+
+    async def _do_client_op(self, conn, msg: MOSDOp, top=None,
+                            tspan=None) -> None:
         self.perf.inc("op")
         if self._split_task is not None and not self._split_task.done():
             # a pg_num split is consuming the new map: ops wait so they
@@ -1933,12 +1989,12 @@ class OSDDaemon(Dispatcher):
             await self._split_task
         self._inflight_client_ops += 1
         try:
-            await self._do_client_op_inner(conn, msg, top)
+            await self._do_client_op_inner(conn, msg, top, tspan)
         finally:
             self._inflight_client_ops -= 1
 
     async def _do_client_op_inner(self, conn, msg: MOSDOp,
-                                  top=None) -> None:
+                                  top=None, tspan=None) -> None:
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
         if oid and pgid[0] in self.osdmap.pools:
@@ -1949,19 +2005,25 @@ class OSDDaemon(Dispatcher):
                 # client targeted with a pre-split map: make it refresh
                 # and resend (reference: ops from an older interval are
                 # requeued/ESTALEd, never served on the wrong PG)
-                await conn.send_message(MOSDOpReply({
-                    "tid": msg["tid"], "result": -ESTALE,
-                    "outs": [{"error": "wrong pg for object "
-                                       "(map changed?)"}]}))
+                fields = {"tid": msg["tid"], "result": -ESTALE,
+                          "outs": [{"error": "wrong pg for object "
+                                             "(map changed?)"}]}
+                rt = self._reply_trace(msg)
+                if rt:
+                    fields["trace"] = rt
+                await conn.send_message(MOSDOpReply(fields))
                 return
         # size guards (reference OSD::op_is_too_big: osd_max_write_size
         # on the mutation payload, osd_object_max_size on the resulting
         # extent) — EFBIG at admission, never a half-applied monster op
         too_big = self._op_too_big(msg)
         if too_big:
-            await conn.send_message(MOSDOpReply({
-                "tid": msg["tid"], "result": -EFBIG,
-                "outs": [{"error": too_big}]}))
+            fields = {"tid": msg["tid"], "result": -EFBIG,
+                      "outs": [{"error": too_big}]}
+            rt = self._reply_trace(msg)
+            if rt:
+                fields["trace"] = rt
+            await conn.send_message(MOSDOpReply(fields))
             return
         deny = self._check_osd_caps(msg)
         if deny is not None and "generation" in deny[0] \
@@ -1971,10 +2033,13 @@ class OSDDaemon(Dispatcher):
             await self._refresh_service_keys()
             deny = self._check_osd_caps(msg)
         if deny is not None:
-            await conn.send_message(MOSDOpReply({
-                "tid": msg["tid"], "result": -EACCES,
-                "retry_auth": deny[1],
-                "outs": [{"error": deny[0]}]}))
+            fields = {"tid": msg["tid"], "result": -EACCES,
+                      "retry_auth": deny[1],
+                      "outs": [{"error": deny[0]}]}
+            rt = self._reply_trace(msg)
+            if rt:
+                fields["trace"] = rt
+            await conn.send_message(MOSDOpReply(fields))
             return
         be = self._get_backend(pgid)
         be.last_epoch = self.osdmap.epoch
@@ -2167,7 +2232,8 @@ class OSDDaemon(Dispatcher):
                 version = await be.submit_transaction(
                     oid, mutations, reqid=str(msg.get("reqid", "")),
                     trace_id=top.trace_id if top else "",
-                    tracked=top)
+                    tracked=top,
+                    span=tspan.span_id if tspan is not None else "")
                 if getattr(pool, "tier_of", None) is not None and any(
                         m.op == "delete" for m in mutations):
                     # write-through deletes: a surviving base copy
@@ -2201,5 +2267,8 @@ class OSDDaemon(Dispatcher):
                 result = -EIO
             outs.append({"error": str(e)})
         _lens, blob = pack_buffers(out_bufs)
-        await conn.send_message(MOSDOpReply({
-            "tid": msg["tid"], "result": result, "outs": outs}, blob))
+        fields = {"tid": msg["tid"], "result": result, "outs": outs}
+        rt = self._reply_trace(msg)
+        if rt:
+            fields["trace"] = rt
+        await conn.send_message(MOSDOpReply(fields, blob))

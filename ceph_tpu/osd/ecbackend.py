@@ -166,6 +166,10 @@ class Op:
     # distributed trace id (reference ZTracer span threaded through EC
     # sub-writes, ECBackend.cc:2063-2068); "" = untraced
     trace_id: str = ""
+    # SAMPLED trace: the OSD-side server span id stage spans (queue/
+    # encode/sub_write) and sub-op wire contexts parent under; "" =
+    # correlation-only (TrackedOp joining) with zero tracer spans
+    span: str = ""
     # client reqid: rides the log entry so retry dedup survives a
     # primary change (reference pg_log_entry_t::reqid)
     reqid: str = ""
@@ -276,7 +280,7 @@ class ECBackend:
                  config=None, mesh_plane=None,
                  device_mesh: bool = False,
                  fast_read=False, perf=None, profiler=None,
-                 spawn=None) -> None:
+                 spawn=None, tracer=None) -> None:
         self.pgid = tuple(pgid)
         self.whoami = whoami
         self.codec = codec
@@ -304,6 +308,10 @@ class ECBackend:
         # sub-op rtt / commit) and kernel profiler (decode + crc timing)
         self.perf = perf
         self.profiler = profiler or profiler_mod.NULL
+        # distributed tracing: the daemon's Tracer; stage spans for
+        # sampled ops are recorded retroactively from the existing
+        # timing anchors (None = no tracing, zero cost)
+        self.tracer = tracer
         # device-mesh collective data plane (pool flag device_mesh):
         # sub-write encode/fan-out + recovery decode ride XLA collectives
         # over a (pg, shard) mesh; the messenger carries only metadata
@@ -779,7 +787,8 @@ class ECBackend:
                                  ops: "Sequence[ClientOp]",
                                  reqid: str = "",
                                  trace_id: str = "",
-                                 tracked=None) -> Version:
+                                 tracked=None,
+                                 span: str = "") -> Version:
         """Primary entry (reference ECBackend::submit_transaction
         ECBackend.cc:1483 -> start_rmw :1839).  Returns the committed
         version once every up shard acked.  ``reqid`` dedups client
@@ -823,7 +832,8 @@ class ECBackend:
                     op = await self.enqueue_transaction(oid, ops,
                                                         trace_id=trace_id,
                                                         tracked=tracked,
-                                                        reqid=reqid)
+                                                        reqid=reqid,
+                                                        span=span)
             finally:
                 self._admissions_pending -= 1
             # bounded by the pipeline contract: commit fan-in resolves
@@ -861,7 +871,8 @@ class ECBackend:
                                   ops: "Sequence[ClientOp]",
                                   trace_id: str = "",
                                   tracked=None,
-                                  reqid: str = "") -> Op:
+                                  reqid: str = "",
+                                  span: str = "") -> Op:
         """Admit a mutation into the pipeline and return its Op without
         waiting for commit.  The pipeline commits strictly in admission
         order, so once op A is enqueued, no later op can commit before
@@ -870,7 +881,7 @@ class ECBackend:
         reads AND this enqueue)."""
         op = Op(tid=self.new_tid(), oid=oid, ops=list(ops),
                 trace_id=trace_id, tracked=tracked, reqid=reqid,
-                admitted_at=time.monotonic())
+                span=span, admitted_at=time.monotonic())
         op.on_commit = asyncio.get_running_loop().create_future()
         self._hit_set_track(oid)
         # peering drains + blocks the pipeline (reference: client ops are
@@ -1294,6 +1305,13 @@ class ECBackend:
             # the same lock hold
             op.version = (self.last_epoch, base_v + 1 + i)
             self._stage_hinc("op_w_queue_lat", t_encode - op.admitted_at)
+            if op.span and self.tracer is not None:
+                # retroactive stage span from the existing anchors: the
+                # shard-queue + batch-collect wait this op paid
+                self.tracer.record("queue", op.trace_id,
+                                   op.admitted_at, t_encode,
+                                   parent=op.span,
+                                   tags={"tid": op.tid})
             if op.tracked is not None:
                 op.tracked.mark("encode_start")
         preps = [self._prep_sub_write(op) for op in ops]
@@ -1355,6 +1373,11 @@ class ECBackend:
             op.sent_at = now
             if not op.delete:
                 self._stage_hinc("op_w_encode_lat", now - t_encode)
+            if op.span and self.tracer is not None:
+                self.tracer.record("encode", op.trace_id,
+                                   t_encode, now, parent=op.span,
+                                   tags={"tid": op.tid,
+                                         "batch": len(ops)})
             if op.tracked is not None:
                 op.tracked.mark("encoded")
                 op.tracked.mark("subops_sent")
@@ -1601,9 +1624,14 @@ class ECBackend:
             if traced is not None:
                 # child span per EC sub-write crossing the messenger
                 # (reference ECBackend.cc:2063-2068 ZTracer child);
-                # a batch rides its first traced op's span
+                # a batch rides its first traced op's span.  "parent"
+                # (only when that op is root-sampled) is the marker
+                # downstream tracers key on — correlation stays
+                # unconditional, tracer spans are opt-in
                 fields["trace"] = {"id": traced.trace_id,
                                    "span": "sub_write"}
+                if traced.span:
+                    fields["trace"]["parent"] = traced.span
             msg = MECSubOpWrite(fields, blob)
             if len(subs) > 1:
                 # semantics-bearing content: a decoder that would skip
@@ -1716,6 +1744,14 @@ class ECBackend:
         if op.sent_at:
             self._stage_hinc("subop_w_rtt",
                              time.monotonic() - op.sent_at)
+            if op.span and self.tracer is not None:
+                # per-shard sub-write span: fan-out -> commit ack (the
+                # wire + store time this shard cost the op)
+                self.tracer.record("sub_write", op.trace_id,
+                                   op.sent_at, time.monotonic(),
+                                   parent=op.span,
+                                   tags={"shard": shard,
+                                         "tid": op.tid})
         if op.tracked is not None:
             op.tracked.mark(f"sub_write_committed(shard={shard})")
         self._check_commit_queue()
@@ -1843,6 +1879,10 @@ class ECBackend:
         shard = int(msg["shard"])
         batch = msg.get("batch")
         tids = [int(s["tid"]) for s in batch] if batch else None
+        tr = msg.get("trace")
+        sampled = (self.tracer is not None and self.tracer.enabled
+                   and isinstance(tr, dict) and tr.get("parent"))
+        t_store = time.monotonic()
 
         def _reply(verdict: dict) -> MECSubOpWriteReply:
             rep = {"pgid": list(self.pgid), "shard": shard,
@@ -1850,6 +1890,12 @@ class ECBackend:
                    **verdict}
             if tids:
                 rep["tids"] = tids
+            if sampled:
+                # reply leg's wire span parents where the sub-write's
+                # did: under the primary's server span
+                rep["trace"] = {"id": str(tr.get("id", "")),
+                                "span": "sub_write_reply",
+                                "parent": str(tr["parent"])}
             return MECSubOpWriteReply(rep)
 
         if int(msg.get("epoch", 1 << 62)) < self.peered_epoch:
@@ -1963,6 +2009,15 @@ class ECBackend:
                 for e in entries:
                     self.local_missing[e.oid] = tuple(e.version)
             raise
+        if sampled:
+            # store span: staging + WAL/group commit on THIS shard
+            # (entry -> durable), recorded on the shard's own tracer
+            self.tracer.record("store", str(tr.get("id", "")),
+                               t_store, time.monotonic(),
+                               parent=str(tr["parent"]),
+                               tags={"shard": shard,
+                                     "osd": self.whoami,
+                                     "batch": len(sub_txns)})
         return _reply({"committed": True, "applied": True})
 
     def _stage_sub_txn(self, t: Transaction, cid: Collection,

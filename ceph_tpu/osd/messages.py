@@ -72,7 +72,8 @@ class MOSDOp(Message):
               "reqid?",        # client retry-dedup id (rides pg log)
               "trace_id?",     # root span for the op's sub-op tree
               "ticket?",       # cephx service ticket
-              "internal?")     # cluster-internal op (copy_from reads)
+              "internal?",     # cluster-internal op (copy_from reads)
+              "trace?")        # {id, span, parent?} trace context
     REPLY = "osd_op_reply"
 
 
@@ -82,7 +83,8 @@ class MOSDOpReply(Message):
     metadata; read payloads concatenated in ``data``."""
     TYPE = "osd_op_reply"
     FIELDS = ("tid", "result", "outs",
-              "retry_auth?")   # EACCES refinement: fresh ticket may fix
+              "retry_auth?",   # EACCES refinement: fresh ticket may fix
+              "trace?")        # trace context echoed for the reply leg
     REPLY = None
 
 
@@ -131,7 +133,7 @@ class MECSubOpWriteReply(Message):
     error verdicts hold for all of them."""
     TYPE = "ec_sub_write_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "committed", "applied",
-              "error?", "missing?", "tids?")
+              "error?", "missing?", "tids?", "trace?")
     REPLY = None
 
 
@@ -183,7 +185,8 @@ class MOSDPGPush(Message):
     generation-collection moves, omap rides replicated-pool pushes."""
     TYPE = "pg_push"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "version",
-              "whole", "off", "attrs", "gen?", "remove?", "omap?")
+              "whole", "off", "attrs", "gen?", "remove?", "omap?",
+              "trace?")
     REPLY = "pg_push_reply"
 
 
@@ -192,7 +195,7 @@ class MOSDPGPushReply(Message):
     """fields: pgid, shard, from_osd, tid, oid, result, gen."""
     TYPE = "pg_push_reply"
     FIELDS = ("pgid", "shard", "from_osd", "tid", "oid", "result",
-              "gen?")
+              "gen?", "trace?")
     REPLY = None
 
 

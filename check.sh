@@ -72,9 +72,13 @@ echo "== loadgen smoke (tools/loadgen.py) =="
 # post-batching knee regresses — 600 op/s offered sits ABOVE the
 # pre-batching full-config knee (~500, PR 7 LOADGEN.json), and the
 # batched write path must still serve >= 400 of it in the smoke's
-# small 3-osd shape (the pre-batching path collapses earlier)
+# small 3-osd shape (the pre-batching path collapses earlier).
+# --trace 1 samples every op and additionally gates on the tracing
+# pipeline end to end: >=95% of ops must assemble into COMPLETE
+# root-to-store span trees with every critical-path stage (wire,
+# queue, encode, store, reply) carrying nonzero attributed time
 env JAX_PLATFORMS=cpu python tools/loadgen.py --smoke \
-    --rates 600 --min-achieved 400 --objects 512 \
+    --rates 600 --min-achieved 400 --objects 512 --trace 1 \
     -o osd_ec_batch_min_device_bytes=1000000000000
 lg_rc=$?
 if [ "$lg_rc" -ne 0 ]; then

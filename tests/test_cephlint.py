@@ -93,6 +93,58 @@ def test_fire_and_forget_fires_only_on_discarded_handles(tmp_path):
     assert all("CrashHandler.guard" in f.message for f in found)
 
 
+def test_span_balance_fires_on_unclosed_spans(tmp_path):
+    """Satellite: every tracer.start_span/start_root must be closed on
+    all paths — context-managed, finally-finished, or handed off."""
+    p = write(tmp_path, "sp.py", """
+        class D:
+            async def bad_discard(self, tracer, tid):
+                tracer.start_span("osd:op", tid)            # BAD
+                self.tracer.start_root("osd_op", tid)       # BAD
+
+            async def bad_unfinished(self, tracer, tid):
+                s = tracer.start_span("osd:op", tid)        # BAD
+                await self.work()
+
+            async def ok_finally(self, tracer, tid):
+                s = tracer.start_span("osd:op", tid)
+                try:
+                    await self.work()
+                finally:
+                    if s is not None:
+                        s.finish()
+
+            async def ok_with(self, tracer, tid):
+                with tracer.start_span("osd:op", tid):
+                    await self.work()
+
+            async def ok_handoff(self, tracer, tid):
+                s = tracer.start_span("osd:op", tid)
+                await self.inner(s)
+                r = tracer.start_root("osd_op", tid)
+                return r
+
+            async def ok_stored(self, tracer, tid):
+                self._span = tracer.start_span("osd:op", tid)
+
+            async def ok_record(self, tracer, tid, t0, t1):
+                tracer.record("queue", tid, t0, t1)  # born finished
+    """)
+    found = run_checks([p], checks=["span-balance"])
+    assert len(found) == 3, found
+    assert sum("discarded" in f.message for f in found) == 2
+    assert sum("never finished" in f.message for f in found) == 1
+    assert all(f.line <= 9 for f in found), found
+
+
+def test_span_balance_pragma_silences(tmp_path):
+    p = write(tmp_path, "sp2.py", """
+        def leak(tracer, tid):
+            tracer.start_span("x", tid)  # cephlint: disable=span-balance
+    """)
+    assert run_checks([p], checks=["span-balance"]) == []
+
+
 def test_lock_order_inversion_across_files(tmp_path):
     write(tmp_path, "m1.py", """
         from ceph_tpu.common.lockdep import DepLock
@@ -597,7 +649,7 @@ def test_cli_json_format_and_exit_codes(tmp_path):
     for check in ("blocking-call", "fire-and-forget", "lock-order",
                   "msg-symmetry", "options", "kernel-purity",
                   "await-atomicity", "iter-mutate-across-await",
-                  "buffer-aliasing"):
+                  "buffer-aliasing", "span-balance"):
         assert check in r.stdout
 
 

@@ -285,7 +285,10 @@ REQUIRED_PERF_COUNTERS = {
             # PG-batch, txns per shard-side batched apply, and the
             # frames counter behind the frames/op < 1 claim
             "osd_op_batch_size", "osd_subwrite_batch_txns",
-            "subop_w_frames"},
+            "subop_w_frames",
+            # critical-path attribution (PR 16): event-loop scheduling
+            # lag samples (ms) + cpu time per message dispatch tick (us)
+            "loop_lag_ms", "daemon_cpu_attribution"},
     "kernel": {"kernel_encode_lat", "kernel_decode_lat",
                "kernel_crc32c_lat", "kernel_encode_launches",
                "kernel_decode_launches", "kernel_crc32c_launches",
@@ -328,6 +331,11 @@ REQUIRED_PROM_SERIES = {
     "ceph_osd_op_batch_size_bucket",
     "ceph_osd_subwrite_batch_txns_bucket",
     "ceph_subop_w_frames",
+    # per-daemon host attribution (PR 16): loop scheduling lag + cpu
+    # per dispatch tick — the grafana loop-lag/critical-path panels
+    "ceph_loop_lag_ms_bucket", "ceph_loop_lag_ms_count",
+    "ceph_daemon_cpu_attribution_bucket",
+    "ceph_daemon_cpu_attribution_sum",
 }
 
 

@@ -3,15 +3,28 @@ through the op path and across the wire — child span per EC sub-write,
 ECBackend.cc:2063-2068; TrackedOp.h:101): a trace id born at the client
 op propagates through sub-writes, sub-reads, recovery reads and pushes,
 and every daemon's dump_historic_ops can be correlated by it.
+
+Part two (distributed spans, common/tracing.py): with
+osd_trace_sample_rate on, the same trace id names a SPAN TREE — client
+root -> wire -> osd server span -> queue/encode/sub_write/store ->
+reply — assembled by tools/trace.py, on the local AND tcp transports.
+Sampling is decided once at the root, retries fold (trace_id = reqid),
+buffers are bounded, and sample_rate=0 produces zero spans.
 """
 
 import asyncio
+import os
+import sys
 
 import numpy as np
 import pytest
 
 from ceph_tpu.common.config import Config
+from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.qa.cluster import MiniCluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # tools.trace import
 
 PROFILE = {"plugin": "jax_rs", "k": "3", "m": "2"}
 
@@ -132,4 +145,221 @@ def test_degraded_write_trace_shows_recovery_spans(loop):
             # and the write itself still fanned out sub-writes
             assert any(d.startswith("ec_sub_write[sub_write]")
                        for d in descs), descs
+    loop.run_until_complete(go())
+
+
+# ---------------------------------------------- distributed span trees
+
+
+def _tracer_spans(cluster, client):
+    spans = list(client.tracer.dump()["spans"])
+    for osd in cluster.osds.values():
+        spans.extend(osd.tracer.dump()["spans"])
+    return spans
+
+
+def _trees(cluster, client):
+    from tools import trace as trace_tool
+    dumps = [client.tracer.dump()] + [o.tracer.dump()
+                                      for o in cluster.osds.values()]
+    return trace_tool, trace_tool.assemble(trace_tool.load_dumps(dumps))
+
+
+@pytest.mark.parametrize("ms_type", ["async+local", "async+tcp"])
+def test_write_trace_assembles_complete_tree(loop, ms_type):
+    """Tentpole acceptance: a sampled write's spans — client root,
+    wire, osd server span, queue, encode, per-shard sub_write + store,
+    reply legs — assemble into ONE complete tree with full parentage,
+    on the in-process AND the real-socket transport."""
+    async def go():
+        cfg = Config()
+        cfg.set("osd_trace_sample_rate", 1)
+        cfg.set("ms_type", ms_type)
+        async with MiniCluster(n_osds=5, config=cfg) as c:
+            c.create_ec_pool("t", PROFILE, pg_num=2, stripe_unit=64)
+            client = await c.client()
+            await client.io_ctx("t").write_full("obj", b"x" * 2000)
+            tid = client.objecter._next_tid
+            reqid = f"{client.objecter.ms.name}:{tid}"
+            trace_tool, trees = _trees(c, client)
+            tree = trees[reqid]
+            assert tree.complete, tree.render()
+            names = {s["name"] for s in tree.spans}
+            for want in ("osd_op", "osd:op", "queue", "encode",
+                         "sub_write", "store", "wire:osd_op",
+                         "wire:ec_sub_write",
+                         "wire:ec_sub_write_reply",
+                         "wire:osd_op_reply"):
+                assert want in names, (want, sorted(names))
+            # parentage: server span under the root, stages under the
+            # server span — and stage spans live on the PRIMARY while
+            # store spans live on every shard daemon
+            root = tree.root
+            srv = next(s for s in tree.spans if s["name"] == "osd:op")
+            assert srv["parent_id"] == root["span_id"]
+            for s in tree.spans:
+                if s["name"] in ("queue", "encode", "sub_write"):
+                    assert s["parent_id"] == srv["span_id"], s
+            stores = [s for s in tree.spans if s["name"] == "store"]
+            assert len(stores) == 5                       # k+m shards
+            assert len({s["daemon"] for s in stores}) == 5
+            # the attribution partitions the measured latency exactly
+            attr = tree.attribution()
+            assert attr["store"] > 0 and attr["encode"] > 0
+            total = sum(attr.values())
+            assert abs(total - tree.duration()) < 1e-6 * max(
+                1.0, tree.duration())
+            # chrome export round-trips
+            doc = trace_tool.to_chrome({reqid: tree})
+            assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+    loop.run_until_complete(go())
+
+
+def test_sampling_honors_rate_and_downstream_follows(loop):
+    """1-in-N decided once at the root: rate=3 over 9 writes roots
+    exactly 3 traces, and the OSDs open server spans for exactly those
+    3 (no downstream re-roll)."""
+    async def go():
+        cfg = Config()
+        cfg.set("osd_trace_sample_rate", 3)
+        async with MiniCluster(n_osds=5, config=cfg) as c:
+            c.create_ec_pool("t", PROFILE, pg_num=2, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("t")
+            for i in range(9):
+                await io.write_full(f"o{i}", b"y" * 700)
+            spans = _tracer_spans(c, client)
+            roots = [s for s in spans if s["name"] == "osd_op"]
+            assert len(roots) == 3, [s["trace_id"] for s in roots]
+            srv = [s for s in spans if s["name"] == "osd:op"]
+            assert {s["trace_id"] for s in srv} == \
+                {s["trace_id"] for s in roots}
+    loop.run_until_complete(go())
+
+
+def test_retry_spans_fold_under_one_trace():
+    """trace_id = reqid, which is stable across wire retries: a second
+    attempt's spans land in the SAME tree, not a sibling trace."""
+    from tools import trace as trace_tool
+    client = Tracer("client.9", sample_rate=1)
+    osd = Tracer("osd.3", sample_rate=1)
+    reqid = "client.9:41"
+    root = client.start_root("osd_op", reqid)
+    # attempt 1 reaches the osd and dies before the reply
+    osd.record("wire:osd_op", reqid, 1.0, 1.1, parent=root.span_id)
+    with osd.start_span("osd:op", reqid, parent=root.span_id):
+        pass
+    # attempt 2 (same reqid -> same trace) succeeds
+    osd.record("wire:osd_op", reqid, 2.0, 2.1, parent=root.span_id,
+               tags={"attempt": 2})
+    with osd.start_span("osd:op", reqid, parent=root.span_id):
+        pass
+    root.finish()
+    trees = trace_tool.assemble(trace_tool.load_dumps(
+        [client.dump(), osd.dump()]))
+    assert set(trees) == {reqid}
+    tree = trees[reqid]
+    assert tree.complete
+    assert sum(1 for s in tree.spans if s["name"] == "osd:op") == 2
+    assert not tree.orphans
+
+
+def test_span_buffer_bounds_memory():
+    tr = Tracer("osd.7", sample_rate=1, buffer_size=8)
+    for i in range(100):
+        tr.record("queue", f"t:{i}", 0.0, 1.0)
+    assert tr.span_count == 8                  # ring bounded
+    assert tr.total_spans == 100               # lifetime count kept
+    d = tr.dump(clear=True)
+    assert len(d["spans"]) == 8
+    assert d["total_spans"] == 100
+    assert {"monotonic", "wall"} <= set(d["anchor"])
+    assert tr.span_count == 0                  # clear drained it
+
+
+def test_sample_rate_zero_adds_zero_spans(loop):
+    """The overhead pin: tracing off (the default) must put NOTHING in
+    any buffer — no root, no wire spans, no stage spans — while the
+    TrackedOp trace-id correlation (part one above) keeps working."""
+    async def go():
+        async with MiniCluster(n_osds=5) as c:
+            c.create_ec_pool("t", PROFILE, pg_num=2, stripe_unit=64)
+            client = await c.client()
+            io = client.io_ctx("t")
+            await io.write_full("obj", b"z" * 1500)
+            assert await io.read("obj") == b"z" * 1500
+            assert client.tracer.total_spans == 0
+            assert not client.tracer.enabled
+            for osd in c.osds.values():
+                assert osd.tracer.total_spans == 0
+            # correlation-only trace ids still flow (no tracer needed)
+            tid = client.objecter._next_tid - 1
+            trace = f"{client.objecter.ms.name}:{tid}"
+            descs = []
+            for osd in c.osds.values():
+                for op in osd.op_tracker.dump_historic()["ops"]:
+                    if op["trace_id"] == trace:
+                        descs.append(op["description"])
+            assert any(d.startswith("osd_op(") for d in descs), descs
+    loop.run_until_complete(go())
+
+
+def test_trace_admin_commands_and_loop_attribution(loop, tmp_path):
+    """'trace dump'/'trace status' serve over every daemon's admin
+    socket (client included, via the shared registration helpers), and
+    the host-attribution histograms populate: cpu per dispatch tick on
+    every message, loop lag samples once the sampler has run."""
+    import json
+    import socket
+
+    def ask(path, cmd):
+        s = socket.socket(socket.AF_UNIX)
+        s.connect(path)
+        s.sendall((json.dumps(cmd) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        s.close()
+        return json.loads(buf.decode())
+
+    async def go():
+        cfg = Config()
+        cfg.set("osd_trace_sample_rate", 1)
+        cfg.set("admin_socket", str(tmp_path / "$name.asok"))
+        async with MiniCluster(n_osds=4, config=cfg) as c:
+            c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
+                                   "m": "1"}, pg_num=1, stripe_unit=64)
+            client = await c.client()
+            await client.io_ctx("p").write_full("obj", b"q" * 500)
+            await asyncio.sleep(0.25)      # loop-lag sampler interval
+            osd_sock = str(tmp_path / "osd.0.asok")
+            st = await asyncio.to_thread(
+                ask, osd_sock, {"prefix": "trace status"})
+            assert st["result"]["sample_rate"] == 1
+            dump = await asyncio.to_thread(
+                ask, osd_sock, {"prefix": "trace dump"})
+            assert dump["result"]["spans"], dump["result"]
+            # the client's admin socket serves ops + trace verbs too
+            csock = str(tmp_path / f"{client.ms.name}.asok")
+            cd = await asyncio.to_thread(
+                ask, csock, {"prefix": "dump_historic_ops"})
+            assert cd["result"]["num_ops"] >= 1
+            assert all("trace_id" in op for op in cd["result"]["ops"])
+            ct = await asyncio.to_thread(
+                ask, csock, {"prefix": "trace dump"})
+            assert any(s["name"] == "osd_op"
+                       for s in ct["result"]["spans"])
+            # host attribution histograms populated: cpu per dispatch
+            # tick wherever messages actually landed (an OSD outside
+            # the 1-pg acting set legitimately dispatches nothing),
+            # loop lag on every daemon (the sampler always runs)
+            dumps = [osd.perf_coll.dump()[f"osd.{osd.whoami}"]
+                     for osd in c.osds.values()]
+            assert sum(d["daemon_cpu_attribution"]["count"]
+                       for d in dumps) > 0
+            for d in dumps:
+                assert d["loop_lag_ms"]["count"] > 0
     loop.run_until_complete(go())

@@ -13,6 +13,13 @@ daemon's admin socket (the 'ceph daemon <sock> <cmd>' form).
   python tools/ceph.py --mon ... osd erasure-code-profile set myprof \
       --kw k=4 --kw m=2 --kw plugin=jax_rs
   python tools/ceph.py daemon /run/osd.0.asok dump_historic_ops
+  python tools/ceph.py daemon /run/osd.0.asok dump_ops_in_flight
+  python tools/ceph.py daemon /run/osd.0.asok trace status
+  python tools/ceph.py daemon /run/osd.0.asok trace dump clear
+
+The ops/trace verbs are served by every daemon (osd, mon, mgr, client)
+— historic/in-flight op dumps carry trace_ids, and 'trace dump' drains
+the span buffer tools/trace.py assembles into per-op trees.
 """
 
 from __future__ import annotations
@@ -160,6 +167,11 @@ def main(argv=None) -> int:
             words = words[:2]
         elif words[:2] == ["log", "dump"] and len(words) > 2:
             kwargs["num"] = words[2]
+            words = words[:2]
+        elif words[:2] == ["trace", "dump"] and len(words) > 2:
+            # ceph daemon <sock> trace dump [clear]
+            if words[2] == "clear":
+                kwargs["clear"] = "1"
             words = words[:2]
         prefix = " ".join(words)
         print(json.dumps(admin_command(path, prefix, **kwargs), indent=1))

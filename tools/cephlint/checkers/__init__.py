@@ -1,4 +1,4 @@
-"""Checker registry — the twelve invariants, by check id."""
+"""Checker registry — the thirteen invariants, by check id."""
 
 from .base import Checker, Module, ReportContext  # noqa: F401
 from .aliasing import BufferAliasChecker
@@ -10,6 +10,7 @@ from .kernels import KernelPurityChecker
 from .locks import LockOrderChecker
 from .messages import MsgSymmetryChecker
 from .options import OptionsChecker
+from .spans import SpanBalanceChecker
 from .tasks import FireAndForgetChecker
 from .timeouts import ReplyTimeoutChecker
 
@@ -18,6 +19,6 @@ ALL_CHECKERS = (BlockingCallChecker, FireAndForgetChecker,
                 KernelPurityChecker, AwaitAtomicityChecker,
                 IterMutateChecker, BufferAliasChecker,
                 DispatchCoverageChecker, ReplyTimeoutChecker,
-                EpochMonotonicityChecker)
+                EpochMonotonicityChecker, SpanBalanceChecker)
 
 CHECKERS = {c.name: c for c in ALL_CHECKERS}

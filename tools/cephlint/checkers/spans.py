@@ -1,0 +1,142 @@
+"""span-balance — tracer spans opened and never finished.
+
+A live span (common/tracing.py ``Tracer.start_span`` /
+``start_root``) only lands in the daemon's dump buffer when
+``finish()`` runs; an open span abandoned on an early return or an
+exception path is a hole in every trace tree that touches it —
+tools/trace.py reports the op INCOMPLETE and the critical-path
+attribution silently loses a stage.  (Retroactively-recorded spans,
+``Tracer.record(start, end)``, are born finished and are not the
+concern here.)
+
+The fix is one of:
+
+- context-manager the span: ``with tracer.start_span(...) as s:``
+  (``__exit__`` finishes),
+- a finally/guard: ``s = tracer.start_span(...)`` with ``s.finish()``
+  on every exit (``try/finally`` is the idiom),
+- hand the span somewhere that owns its lifetime (argument position,
+  return, attribute store).
+
+Flagged: a ``start_span``/``start_root`` call used as a bare
+expression statement (span discarded: can NEVER be finished), or
+assigned to a local name on which the same function neither calls
+``.finish(`` nor uses ``with``, and which never escapes (argument,
+return/yield, attribute/container store).  Mirrors fire-and-forget's
+deliberate shallowness: escape analysis says "handled elsewhere", not
+"proved balanced" — the pinned tracing tests are the belt, this is
+the suspender.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..findings import Finding
+from .base import Checker, Module, ReportContext, dotted
+
+_OPENERS = (".start_span", ".start_root")
+
+
+def _opener_name(node: ast.AST) -> str:
+    """Dotted call-target when ``node`` opens a live span, else ''."""
+    if not isinstance(node, ast.Call):
+        return ""
+    name = dotted(node.func)
+    return name if name.endswith(_OPENERS) else ""
+
+
+def _escapes(fn: ast.AST, name: str) -> bool:
+    """True when ``name`` is finished, context-managed, or handed off
+    within ``fn`` (shallow: any such use counts as handled)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            # s.finish(...) — the balancing call
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "finish" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == name:
+                return True
+            # argument position: the callee owns the lifetime now
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id == name:
+                    return True
+        elif isinstance(node, (ast.Return, ast.Yield)):
+            if isinstance(node.value, ast.Name) and node.value.id == name:
+                return True
+        elif isinstance(node, ast.Assign):
+            # re-homed into an attribute/subscript (self._span = s) or
+            # built into a container the caller drains later
+            val = node.value
+            holds = (isinstance(val, ast.Name) and val.id == name) or (
+                isinstance(val, (ast.Tuple, ast.List))
+                and any(isinstance(e, ast.Name) and e.id == name
+                        for e in val.elts))
+            if holds and any(not isinstance(t, ast.Name)
+                             for t in node.targets):
+                return True
+    return False
+
+
+class SpanBalanceChecker(Checker):
+    name = "span-balance"
+    description = "tracer span opened but never finished on any path"
+
+    def collect(self, module: Module) -> dict:
+        hits: "List[dict]" = []
+        funcs = [n for n in ast.walk(module.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Expr):
+                    call = node.value
+                    opener = _opener_name(call)
+                    if opener:
+                        hits.append({
+                            "line": node.lineno, "col": node.col_offset,
+                            "call": opener, "kind": "discarded",
+                            "context": module.context(node.lineno)})
+                elif isinstance(node, ast.Assign):
+                    opener = _opener_name(node.value)
+                    if not opener:
+                        continue
+                    targets = node.targets
+                    if len(targets) != 1 \
+                            or not isinstance(targets[0], ast.Name):
+                        continue  # attribute store: owner's lifetime
+                    if not _escapes(fn, targets[0].id):
+                        hits.append({
+                            "line": node.lineno, "col": node.col_offset,
+                            "call": opener, "kind": "unfinished",
+                            "name": targets[0].id,
+                            "context": module.context(node.lineno)})
+        # with tracer.start_span(...) as s: — balanced by __exit__,
+        # matched by the Expr/Assign walk never seeing the call
+        return {"hits": hits}
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for path, f in facts.items():
+            for h in f.get("hits", ()):
+                if h["kind"] == "discarded":
+                    msg = (f"{h['call']}(...) result discarded: the "
+                           f"span can never be finished and every "
+                           f"trace through it assembles INCOMPLETE — "
+                           f"use 'with', or keep the handle and "
+                           f"finish() it in a finally")
+                else:
+                    msg = (f"span {h['name']!r} from {h['call']}(...) "
+                           f"is never finished in this function: "
+                           f"finish() it on every exit (try/finally "
+                           f"or 'with'), or hand it off explicitly")
+                out.append(Finding(
+                    check=self.name, path=path, line=h["line"],
+                    col=h["col"], context=h["context"], message=msg))
+        return out

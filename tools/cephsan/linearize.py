@@ -159,13 +159,15 @@ class Entry:
     outs: "List[dict]" = field(default_factory=list)
     version: "Optional[list]" = None
     opaque: bool = False
+    trace_id: str = ""                  # distributed-trace id (= reqid)
 
     def describe(self) -> str:
         ops = "+".join(o["op"] for o in self.ops)
         when = ("unknown-outcome" if not self.known
                 else f"ok" if self.error == 0 else f"errno {self.error}")
+        trace = f" trace={self.trace_id}" if self.trace_id else ""
         return (f"op {self.op_id} [{self.client}] {ops} on "
-                f"{self.oid!r} -> {when}")
+                f"{self.oid!r} -> {when}{trace}")
 
 
 def parse_history(history: dict) -> "Dict[str, List[Entry]]":
@@ -180,7 +182,9 @@ def parse_history(history: dict) -> "Dict[str, List[Entry]]":
         if kind == "invoke":
             e = Entry(op_id=int(ev["id"]), oid=str(ev["oid"]),
                       client=str(ev.get("client", "")),
-                      ops=list(ev.get("ops", [])), invoke_at=idx)
+                      ops=list(ev.get("ops", [])), invoke_at=idx,
+                      trace_id=str(ev.get("trace_id")
+                                   or ev.get("reqid") or ""))
             e.opaque = any(o.get("opaque") for o in e.ops)
             entries[e.op_id] = e
             per_object.setdefault(e.oid, []).append(e)

@@ -129,6 +129,10 @@ async def run_chaos(args) -> int:
     # timeout — the gate wants op CHURN under failure, not one wedged
     # writer riding out the whole chaos window
     cfg.set("rados_osd_op_timeout", args.op_timeout)
+    # sample 1-in-4 ops into span trees: the report's span counts prove
+    # tracing survives socket kills / retries / daemon restarts (retry
+    # attempts fold under the reqid trace, they don't fork trees)
+    cfg.set("osd_trace_sample_rate", 4)
     async with MiniCluster(n_osds=args.osds, config=cfg,
                            store=args.store) as cluster:
         if args.pool_type == "ec":
@@ -258,6 +262,21 @@ async def run_chaos(args) -> int:
         subw_frames = sum(
             o.perf_coll.dump().get(f"osd.{o.whoami}", {})
             .get("subop_w_frames", 0) for o in cluster.osds.values())
+        # distributed-tracing accounting under chaos: lifetime span
+        # counts per daemon (sampled 1-in-4 above), plus how many
+        # sampled roots the surviving buffers still assemble complete
+        spans = {f"osd.{i}": o.tracer.total_spans
+                 for i, o in cluster.osds.items()}
+        spans.update({c.ms.name: c.tracer.total_spans
+                      for c in cluster.clients})
+        from tools import trace as trace_tool
+        trees = trace_tool.assemble(trace_tool.load_dumps(
+            [o.tracer.dump() for o in cluster.osds.values()]
+            + [c.tracer.dump() for c in cluster.clients]))
+        tracing = dict(trace_tool.completeness(trees), spans=spans)
+        if sum(spans.values()) == 0:
+            failures.append("tracing sampled 1-in-4 ops but no daemon "
+                            "recorded a single span")
         from ceph_tpu.common import sanitizer as _san
         report = {
             "ok": not failures,
@@ -270,6 +289,7 @@ async def run_chaos(args) -> int:
             "scrub_repaired": repaired, "backoffs_sent": backoffs,
             "wal": wal, "msgr_cork": cork,
             "subwrite_frames": subw_frames,
+            "tracing": tracing,
             "force_batching": bool(getattr(args, "force_batching",
                                            False)),
             "store": args.store, "ms_type": args.ms_type,

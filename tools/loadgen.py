@@ -137,8 +137,26 @@ async def run_point(cluster, ios, payloads, rate: float,
     }
 
 
+def _trace_report(cluster, clients) -> "tuple[dict, str]":
+    """Assemble the run's tracer dumps (in-process: every daemon's
+    buffer is reachable directly) into per-op trees and attribute the
+    critical path — returns (JSON-able report, printable table)."""
+    import trace as trace_tool  # tools/trace.py (path set up above)
+    trees = trace_tool.assemble(trace_tool.load_dumps(
+        [o.tracer.dump() for o in cluster.osds.values()]
+        + [cl.tracer.dump() for cl in clients]))
+    report = dict(trace_tool.completeness(trees),
+                  **trace_tool.aggregate_attribution(trees))
+    return report, trace_tool.attribution_table(trees)
+
+
 async def run(args) -> dict:
     cfg = Config()
+    if args.trace:
+        cfg.set("osd_trace_sample_rate", args.trace)
+        # the default 2000-span buffer rotates out early ops in a long
+        # sweep; size for the run unless the caller chose a size
+        cfg.set("osd_trace_buffer_size", 200000)
     for kv in args.opt:
         key, _, val = kv.partition("=")
         cfg.set(key.strip(), val.strip())
@@ -195,6 +213,10 @@ async def run(args) -> dict:
             print(json.dumps(
                 {k: v for k, v in row.items()
                  if k != "stage_percentiles"}), file=sys.stderr)
+        trace_attr = None
+        if args.trace:
+            trace_attr, table = _trace_report(c, c.clients)
+            print(table, file=sys.stderr)
         return {
             "metric": "osd_open_loop_latency_vs_load",
             "opts": dict(kv.partition("=")[::2] for kv in args.opt),
@@ -204,6 +226,7 @@ async def run(args) -> dict:
             "ec": {"k": args.k, "m": args.m,
                    "stripe_unit": args.stripe_unit},
             "rows": rows,
+            "trace_attribution": trace_attr,
             "methodology": {
                 "arrivals": "Poisson (exponential inter-arrival, "
                             "seeded rng), issued as independent tasks "
@@ -255,6 +278,12 @@ def main() -> None:
     p.add_argument("--out", default="",
                    help="write the full JSON artifact here "
                         "(LOADGEN.json); stdout gets it either way")
+    p.add_argument("--trace", type=int, default=0, metavar="N",
+                   help="sample 1-in-N ops into distributed traces "
+                        "(1 = every op) and print the critical-path "
+                        "attribution table after the sweep; in --smoke "
+                        "mode also asserts a complete root-to-store "
+                        "critical path was assembled")
     p.add_argument("--smoke", action="store_true",
                    help="CI gate: tiny sweep, nonzero exit when the "
                         "generator is closed-loop-bound or ops fail")
@@ -271,7 +300,8 @@ def main() -> None:
     print(json.dumps(res if not args.smoke else {
         "metric": res["metric"],
         "rows": [{k: v for k, v in r.items()
-                  if k != "stage_percentiles"} for r in res["rows"]]}))
+                  if k != "stage_percentiles"} for r in res["rows"]],
+        "trace_attribution": res.get("trace_attribution")}))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(res, f, indent=1)
@@ -285,6 +315,22 @@ def main() -> None:
                 print(f"loadgen smoke: achieved "
                       f"{row['achieved_op_s']} op/s < required "
                       f"{args.min_achieved} (batching knee regression)",
+                      file=sys.stderr)
+        if args.trace and ok:
+            # the tracing gate: sampled ops must assemble into complete
+            # trees whose critical path reaches every write-path stage
+            # (client root -> wire -> queue -> encode -> store -> reply)
+            ta = res.get("trace_attribution") or {}
+            st = ta.get("stages", {})
+            ok = (ta.get("complete", 0) > 0
+                  and ta.get("ratio", 0.0) >= 0.95
+                  and all(st.get(s, 0.0) > 0.0 for s in
+                          ("wire", "queue", "encode", "store", "reply")))
+            if not ok:
+                print(f"loadgen smoke: incomplete critical path "
+                      f"(complete={ta.get('complete')}/"
+                      f"{ta.get('traces')}, stages="
+                      f"{sorted(s for s, v in st.items() if v > 0)})",
                       file=sys.stderr)
         sys.exit(0 if ok else 1)
 

@@ -60,6 +60,10 @@ def _merged_histograms(osds) -> dict:
 
 async def run(args) -> dict:
     cfg = Config()
+    trace_rate = int(getattr(args, "trace", 0))
+    if trace_rate:
+        cfg.set("osd_trace_sample_rate", trace_rate)
+        cfg.set("osd_trace_buffer_size", 200000)
     for kv in getattr(args, "opt", []):
         key, _, val = kv.partition("=")
         cfg.set(key.strip(), val.strip())
@@ -98,8 +102,13 @@ async def run(args) -> dict:
         def reset_counters() -> None:
             # warmup (and each --repeat round's predecessor) must not
             # pollute the latency percentiles or the fsync/group-commit
-            # /cork accounting
+            # /cork accounting — nor the critical-path attribution
+            if trace_rate:
+                for cl in clients:
+                    cl.tracer.clear()
             for osd in c.osds.values():
+                if trace_rate:
+                    osd.tracer.clear()
                 for key in osd.encode_service.stats:
                     osd.encode_service.stats[key] = 0
                 osd.perf_coll.reset()
@@ -204,6 +213,18 @@ async def run(args) -> dict:
                 if h:
                     batching[f"{name}_p50"] = h["p50"]
                     batching[f"{name}_p99"] = h["p99"]
+            attribution = None
+            if trace_rate:
+                import trace as trace_tool  # tools/trace.py
+                trees = trace_tool.assemble(trace_tool.load_dumps(
+                    [o.tracer.dump() for o in c.osds.values()]
+                    + [cl.tracer.dump() for cl in clients]))
+                attribution = dict(
+                    trace_tool.completeness(trees),
+                    sample_rate=trace_rate,
+                    **trace_tool.aggregate_attribution(trees))
+                print(trace_tool.attribution_table(trees),
+                      file=sys.stderr)
             return {
                 "metric": "osd_write_path",
                 "opts": dict(kv.partition("=")[::2]
@@ -222,6 +243,7 @@ async def run(args) -> dict:
                 "msgr": cork,
                 "batching": batching,
                 "latency_percentiles": pcts,
+                "trace_attribution": attribution,
             }
 
         # --repeat N: median-of-N self-contained rounds (same warmed
@@ -272,6 +294,11 @@ def main() -> None:
                         "osd_ec_batch_min_device_bytes=1000000000000 "
                         "keeps small encodes on the host GF path when "
                         "no accelerator is attached)")
+    p.add_argument("--trace", type=int, default=0, metavar="N",
+                   help="sample 1-in-N ops into distributed traces "
+                        "(1 = every op) and report critical-path "
+                        "attribution ('trace_attribution' in the JSON "
+                        "row + a table on stderr)")
     args = p.parse_args()
     print(json.dumps(asyncio.run(run(args))))
 

@@ -142,10 +142,36 @@ def main() -> None:
             row["platform"] = platform
             open_loop.append(row)
             print(json.dumps(row), flush=True)
+    # traced point (PR 16 distributed spans): 1-in-1 sampling on the
+    # qd1 small-op shape names the per-op floor stage by stage —
+    # tools/trace.py assembles every daemon's span buffer into trees
+    # and the timeline sweep partitions each op's measured latency
+    critical_path = {}
+    for platform in platforms:
+        env = {"JAX_PLATFORMS": "cpu"} if platform == "cpu" else {}
+        rec = run_point(env, clients=1, size=16 << 10,
+                        seconds=args.seconds, osds=4, store="mem",
+                        k=2, m=1, stripe_unit=8192, pgs=16, repeat=1,
+                        trace=1, opt=HOST_ENCODE_OPT)
+        critical_path[platform] = rec.get("trace_attribution")
+        print(json.dumps({"critical_path": platform,
+                          **(rec.get("trace_attribution") or {})}),
+              flush=True)
     out = {
         "metric": "osd_write_path_suite",
         "rows": rows,
         "open_loop_rows": open_loop,
+        "critical_path": {
+            "how": "qd1 16 KiB k=2 m=1 hostenc point re-run with "
+                   "--trace 1: every op's spans (client root -> wire "
+                   "-> osd queue -> encode -> per-shard sub-write/"
+                   "store -> reply) assembled by tools/trace.py; "
+                   "'stages' are summed seconds across complete "
+                   "traces, partitioning the measured op latency "
+                   "exactly (residue = 'other': event-loop dispatch "
+                   "gaps and reply fan-in wait)",
+            "per_platform": critical_path,
+        },
         "attribution": {
             "environment_shift": "this artifact generation's host runs "
                                  "the PR 7 build MEASURABLY slower "

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""trace — assemble distributed op traces and attribute the critical path.
+
+Each daemon keeps a bounded buffer of finished spans (sampled at
+osd_trace_sample_rate, off by default); 'ceph daemon <sock> trace dump'
+drains it.  This tool merges dumps from every daemon that touched an
+op, stitches the spans into per-trace trees (trace_id = the client
+reqid, so retries fold into one tree), and answers the question the
+perf counters can't: where inside ONE op's ~1 ms does the time go —
+client ceremony, wire, shard queue, encode, store apply, or reply
+fan-in.
+
+Usage:
+  python tools/trace.py tree osd0.json osd1.json client.json
+  python tools/trace.py tree dumps/*.json --trace client.0:17
+  python tools/trace.py attribution dumps/*.json
+  python tools/trace.py export dumps/*.json --out trace.json
+  python tools/trace.py summary dumps/*.json
+
+'export' writes Chrome trace-event JSON — load it in Perfetto
+(ui.perfetto.dev) or chrome://tracing; each daemon renders as a
+process row, each trace tree as nested slices.
+
+The assembly/attribution helpers are imported by tools/loadgen.py and
+tools/osd_bench.py (--trace) to print an attribution table from
+in-process tracer dumps after a run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# span name -> attribution stage.  wire legs split by direction: the
+# request-side hops count as "wire", the ack legs as "reply" (reply
+# fan-in is its own line in the critical path, ECBackend's commit
+# gather).  Unlisted span names fall through to "other".
+_STAGE_OF = {
+    "wire:osd_op": "wire",
+    "wire:ec_sub_write": "wire",
+    "wire:ec_sub_write_reply": "reply",
+    "wire:osd_op_reply": "reply",
+    "queue": "queue",
+    "encode": "encode",
+    "store": "store",
+    "sub_write": "sub_write",
+}
+
+# innermost-wins priority for overlapping spans during the timeline
+# sweep: a store apply inside a sub_write RTT inside the server span
+# bills to "store", not three times.
+_PRIORITY = ["store", "encode", "queue", "reply", "wire", "sub_write",
+             "client", "other"]
+
+STAGES = _PRIORITY
+
+ROOT_NAMES = ("osd_op",)
+
+
+def load_dumps(sources: "List") -> "List[dict]":
+    """Merge trace dumps (file paths or already-parsed dump dicts) into
+    one span list, times aligned to the wall clock via each dump's
+    {monotonic, wall} anchor so spans from different processes share a
+    timeline.  In-process dumps (one monotonic clock) align trivially.
+    """
+    spans: "List[dict]" = []
+    for src in sources:
+        dump = src
+        if isinstance(src, str):
+            with open(src) as f:
+                dump = json.load(f)
+        anchor = dump.get("anchor") or {}
+        shift = float(anchor.get("wall", 0.0)) - \
+            float(anchor.get("monotonic", 0.0))
+        for s in dump.get("spans", []):
+            s = dict(s)
+            s["start"] = float(s["start"]) + shift
+            s["end"] = float(s["end"]) + shift
+            spans.append(s)
+    return spans
+
+
+class TraceTree:
+    """One logical op's spans, stitched by span_id/parent_id."""
+
+    def __init__(self, trace_id: str, spans: "List[dict]") -> None:
+        self.trace_id = trace_id
+        self.spans = sorted(spans, key=lambda s: s["start"])
+        self.by_id = {s["span_id"]: s for s in self.spans}
+        self.children: "Dict[str, List[dict]]" = {}
+        self.orphans: "List[dict]" = []
+        self.root: "Optional[dict]" = None
+        for s in self.spans:
+            pid = s.get("parent_id", "")
+            if not pid and s["name"] in ROOT_NAMES:
+                self.root = s          # last root wins; one expected
+            elif pid in self.by_id:
+                self.children.setdefault(pid, []).append(s)
+            else:
+                self.orphans.append(s)
+
+    @property
+    def complete(self) -> bool:
+        """Root present, every span's parent resolves, and the server
+        span made it back — the tree tells the whole story."""
+        return (self.root is not None and not self.orphans
+                and any(s["name"] == "osd:op" for s in self.spans))
+
+    def duration(self) -> float:
+        return (self.root["end"] - self.root["start"]) if self.root else 0.0
+
+    def attribution(self) -> "Dict[str, float]":
+        """Partition the root span's duration into stage buckets by a
+        timeline sweep (innermost active span wins), so the stage sums
+        equal the measured op latency BY CONSTRUCTION — residue the
+        spans don't explain is named 'other', never silently dropped.
+        """
+        out = {st: 0.0 for st in _PRIORITY}
+        if self.root is None:
+            return out
+        t0, t1 = self.root["start"], self.root["end"]
+        intervals = []
+        for s in self.spans:
+            st = _STAGE_OF.get(s["name"])
+            if st is None:
+                continue
+            a, b = max(s["start"], t0), min(s["end"], t1)
+            if b > a:
+                intervals.append((a, b, st))
+        # everything before the request hits the wire is client-side
+        # ceremony (objecter checks, throttles, encode of the message)
+        req = [i for i in intervals if i[2] == "wire"]
+        if req:
+            first_wire = min(a for a, _b, _s in req)
+            if first_wire > t0:
+                intervals.append((t0, first_wire, "client"))
+        cuts = sorted({t0, t1, *(a for a, _b, _s in intervals),
+                       *(b for _a, b, _s in intervals)})
+        rank = {st: i for i, st in enumerate(_PRIORITY)}
+        for a, b in zip(cuts, cuts[1:]):
+            active = [st for (x, y, st) in intervals if x <= a and b <= y]
+            st = min(active, key=lambda s: rank[s]) if active else "other"
+            out[st] += b - a
+        return out
+
+    def render(self, indent: str = "  ") -> str:
+        lines = [f"trace {self.trace_id}"
+                 + ("" if self.complete else "  [INCOMPLETE]")]
+        if self.root is None:
+            for s in self.spans:
+                lines.append(f"{indent}(rootless) {self._line(s)}")
+            return "\n".join(lines)
+        t0 = self.root["start"]
+
+        def walk(span: dict, depth: int) -> None:
+            lines.append(indent * depth + self._line(span, t0))
+            for c in sorted(self.children.get(span["span_id"], []),
+                            key=lambda s: s["start"]):
+                walk(c, depth + 1)
+
+        walk(self.root, 1)
+        for s in self.orphans:
+            lines.append(f"{indent}(orphan) {self._line(s, t0)}")
+        return "\n".join(lines)
+
+    @staticmethod
+    def _line(s: dict, t0: float = 0.0) -> str:
+        dur_us = (s["end"] - s["start"]) * 1e6
+        off_us = (s["start"] - t0) * 1e6
+        tags = "".join(f" {k}={v}" for k, v in
+                       sorted(s.get("tags", {}).items()))
+        return (f"{s['name']:<28} +{off_us:8.0f}us {dur_us:8.0f}us "
+                f"[{s['daemon']}]{tags}")
+
+
+def assemble(spans: "List[dict]") -> "Dict[str, TraceTree]":
+    """span list -> trace_id -> TraceTree (insertion = first-seen)."""
+    by_trace: "Dict[str, List[dict]]" = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace_id", ""), []).append(s)
+    return {tid: TraceTree(tid, ss) for tid, ss in by_trace.items()}
+
+
+def completeness(trees: "Dict[str, TraceTree]") -> dict:
+    total = len(trees)
+    done = sum(1 for t in trees.values() if t.complete)
+    return {"traces": total, "complete": done,
+            "ratio": (done / total) if total else 1.0}
+
+
+def aggregate_attribution(trees: "Dict[str, TraceTree]") -> dict:
+    """Mean per-stage seconds + share across complete traces."""
+    stages = {st: 0.0 for st in _PRIORITY}
+    n, total = 0, 0.0
+    for t in trees.values():
+        if not t.complete:
+            continue
+        n += 1
+        total += t.duration()
+        for st, v in t.attribution().items():
+            stages[st] += v
+    return {"ops": n, "total_s": total,
+            "mean_op_us": (total / n * 1e6) if n else 0.0,
+            "stages": stages}
+
+
+def attribution_table(trees: "Dict[str, TraceTree]") -> str:
+    agg = aggregate_attribution(trees)
+    comp = completeness(trees)
+    lines = [f"traces: {comp['traces']}  complete: {comp['complete']} "
+             f"({comp['ratio']:.0%})  "
+             f"mean op latency: {agg['mean_op_us']:.0f}us"]
+    if not agg["ops"]:
+        return lines[0]
+    lines.append(f"{'stage':<10} {'mean us/op':>12} {'share':>8}")
+    for st in _PRIORITY:
+        v = agg["stages"][st]
+        if v <= 0.0:
+            continue
+        lines.append(f"{st:<10} {v / agg['ops'] * 1e6:>12.1f} "
+                     f"{v / agg['total_s']:>7.1%}")
+    return "\n".join(lines)
+
+
+def to_chrome(trees: "Dict[str, TraceTree]") -> dict:
+    """Chrome trace-event JSON (Perfetto/chrome://tracing): complete
+    ('X') events, one process row per daemon, one thread per trace."""
+    events = []
+    daemons = sorted({s["daemon"] for t in trees.values()
+                      for s in t.spans})
+    pid_of = {d: i + 1 for i, d in enumerate(daemons)}
+    for d, pid in pid_of.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": d}})
+    for tidx, t in enumerate(trees.values()):
+        for s in t.spans:
+            events.append({
+                "name": s["name"], "cat": s.get("trace_id", ""),
+                "ph": "X", "pid": pid_of[s["daemon"]], "tid": tidx + 1,
+                "ts": s["start"] * 1e6,
+                "dur": max(s["end"] - s["start"], 0.0) * 1e6,
+                "args": dict(s.get("tags", {}),
+                             trace_id=s.get("trace_id", ""),
+                             span_id=s.get("span_id", ""),
+                             parent_id=s.get("parent_id", ""))})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("mode", choices=("tree", "attribution", "export",
+                                    "summary"))
+    p.add_argument("dumps", nargs="+", help="trace dump JSON files")
+    p.add_argument("--trace", default="",
+                   help="only this trace id (tree mode)")
+    p.add_argument("--out", default="",
+                   help="output path (export mode; default stdout)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+
+    trees = assemble(load_dumps(args.dumps))
+    if args.mode == "tree":
+        picked = ({args.trace: trees[args.trace]} if args.trace
+                  else trees)
+        if args.trace and args.trace not in trees:
+            raise SystemExit(f"trace {args.trace!r} not in dumps "
+                             f"(have {len(trees)})")
+        for t in picked.values():
+            print(t.render())
+    elif args.mode == "attribution":
+        if args.json:
+            print(json.dumps(dict(aggregate_attribution(trees),
+                                  **completeness(trees)), indent=1))
+        else:
+            print(attribution_table(trees))
+    elif args.mode == "summary":
+        comp = completeness(trees)
+        out = dict(comp, incomplete=[t.trace_id for t in trees.values()
+                                     if not t.complete][:20])
+        print(json.dumps(out, indent=1))
+    elif args.mode == "export":
+        doc = to_chrome(trees)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote {args.out} ({len(doc['traceEvents'])} events)"
+                  f" — load in ui.perfetto.dev")
+        else:
+            print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
