@@ -25,7 +25,7 @@ import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..common import mc
+from ..common import history as history_mod
 from ..common.log import dout
 from ..msg.messenger import Dispatcher, Messenger, Policy
 from ..osd.messages import ENOENT, ESTALE, MOSDOp, MOSDOpReply, \
@@ -35,8 +35,9 @@ from ..osd.osdmap import NONE_OSD, OSDMap
 
 def _blob_bytes(data) -> bytes:
     """Materialize a reply blob (bytes or BufferList) for the history
-    recorder — recording happens only while cephmc is armed, so the
-    copy never touches the production hot path."""
+    recorder — recording happens only while a recorder is armed
+    (cephmc or client_history_record), so the copy never touches the
+    production hot path."""
     if hasattr(data, "to_bytes"):
         return data.to_bytes()
     return bytes(data)
@@ -285,12 +286,15 @@ class Objecter(Dispatcher):
                            pg: "Optional[int]", tid: int, reqid: str,
                            root) -> "Tuple[List[dict], bytes]":
         last_err: "Optional[Exception]" = None
-        # cephmc history: one logical op = one invoke/complete pair,
+        # audit history: one logical op = one invoke/complete pair,
         # however many wire attempts the retry loop takes (the recorder
         # folds re-invocations by reqid — a retry that re-applies is a
         # double-apply the linearizability checker must see, not a
-        # second legal op)
-        rec = mc.history()
+        # second legal op).  history_mod.active() resolves to the cephmc
+        # explorer's recorder under a model-checking run, else to the
+        # process-installed one (client_history_record / proc_chaos) —
+        # the recording is transport-agnostic either way.
+        rec = history_mod.active()
         hid = rec.invoke(self.ms.name, pool_id, oid, ops, data,
                          reqid=reqid) if rec is not None else 0
         renewed = False

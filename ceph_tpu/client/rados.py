@@ -55,6 +55,15 @@ class RadosClient:
     async def connect(self, addr: str = "") -> None:
         await self.ms.bind(addr or f"client:{id(self) & 0xFFFF}")
         self.clog.start()
+        # client_history_record arms the transport-agnostic op-history
+        # recorder (common/history.py): every objecter op records
+        # invoke/complete events linearize.py can audit, against real
+        # sockets or the local transport alike
+        self._history_path = str(
+            self.ms.conf("client_history_record") or "")
+        if self._history_path:
+            from ..common import history as history_mod
+            history_mod.install()
         if self.monc is not None:
             await self.monc.subscribe_osdmap()
             await self.monc.wait_for_map()
@@ -89,6 +98,10 @@ class RadosClient:
         a.register("clog stats",
                    lambda _c: self.clog.dump(),
                    "cluster-log client counters")
+        from ..common.history import register_history_commands
+        from ..msg.messenger import register_netfault_commands
+        register_history_commands(a)
+        register_netfault_commands(a, self.ms)
         a.start()
         self.admin_socket = a
 
@@ -123,6 +136,13 @@ class RadosClient:
         self.objecter.ticket_renewer = renewer
 
     async def shutdown(self) -> None:
+        hist_path = getattr(self, "_history_path", "")
+        if hist_path and hist_path != "-":
+            from ..common import history as history_mod
+            try:
+                history_mod.dump_to(hist_path)
+            except (OSError, RuntimeError):
+                pass  # recording is QA plumbing: never fail a shutdown
         await self.clog.stop()
         if self.admin_socket is not None:
             self.admin_socket.stop()

@@ -1,0 +1,218 @@
+"""Transport-agnostic client-op history recording.
+
+The HistoryRecorder started life inside the cephmc explorer (PR 12):
+the in-process model checker armed it, the Objecter fed it, and
+``tools/cephsan/linearize.py`` checked the result WGL-style against a
+sequential RADOS object model.  That coupling meant histories only
+existed under the explorer — against a real-socket ProcCluster (real
+partitions, kill -9, reconnect replay) there was nothing to audit.
+
+This module is the recorder on its own feet:
+
+- ``HistoryRecorder`` — the event log itself, unchanged contract:
+  invoke/complete/fail events in real-time order, retries of one
+  logical op folded into one entry by reqid (a retry that re-applies
+  is the double-apply bug the checker must see, not a legal second
+  op).
+- a process-level ``install()/uninstall()/recorder()`` surface — any
+  client can arm recording without the explorer, e.g. via the
+  ``client_history_record`` option or directly from a harness
+  (tools/proc_chaos.py records every nemesis round this way).
+- ``active()`` — the resolution the Objecter uses: the cephmc
+  explorer's recorder when a model-checking run is interposing
+  (explorer runs own their histories), else the installed standalone
+  one.
+- ``dump_to()`` + ``register_history_commands()`` — file and
+  admin-socket dump paths, so a history recorded against live daemons
+  reaches ``linearize.py`` like any explorer history does.
+
+The history format is the linearize.py input contract
+(``{"version": 1, "events": [...]}``); both producers share it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+_MODELED_OPS = ("write_full", "write", "append", "truncate", "delete",
+                "read", "stat", "omap_set", "omap_get", "omap_keys",
+                "omap_rm")
+
+
+def _digest(blob) -> str:
+    return hashlib.sha1(bytes(blob)).hexdigest()
+
+
+class HistoryRecorder:
+    """Client-op history: invoke/complete/fail events in real-time
+    order (one process, one loop => the event list IS the real-time
+    partial order the linearizability checker needs).
+
+    Retry folding: ``invoke`` with a reqid already seen returns the
+    FIRST attempt's op id — one logical op, however many wire attempts
+    it took.  A retried mutation that applies twice then fails the
+    sequential model (the read sees the payload twice), which is the
+    double-apply bug class, not two legal ops.
+    """
+
+    def __init__(self, payload_cap: int = 1 << 20) -> None:
+        self.events: "List[dict]" = []
+        self.payload_cap = payload_cap
+        self._next_id = 0
+        self._by_reqid: "Dict[str, int]" = {}
+
+    def invoke(self, client: str, pool: int, oid: str,
+               ops: "List[dict]", data: bytes = b"",
+               reqid: str = "") -> int:
+        if reqid and reqid in self._by_reqid:
+            op_id = self._by_reqid[reqid]
+            self.events.append({"e": "reinvoke", "id": op_id})
+            return op_id
+        self._next_id += 1
+        op_id = self._next_id
+        if reqid:
+            self._by_reqid[reqid] = op_id
+        data = bytes(data)
+        rec_ops: "List[dict]" = []
+        off = 0
+        for op in ops:
+            entry: "Dict[str, Any]" = {"op": str(op.get("op", "?"))}
+            for k in ("off", "len", "keys", "name"):
+                if k in op:
+                    entry[k] = op[k]
+            dlen = int(op.get("dlen", 0))
+            if dlen:
+                payload = data[off:off + dlen]
+                off += dlen
+                entry["len"] = dlen
+                entry["digest"] = _digest(payload)
+                if dlen <= self.payload_cap:
+                    entry["payload"] = payload.hex()
+            if entry["op"] not in _MODELED_OPS:
+                entry["opaque"] = True
+            rec_ops.append(entry)
+        self.events.append({"e": "invoke", "id": op_id,
+                            "client": client, "pool": int(pool),
+                            "oid": str(oid), "ops": rec_ops,
+                            "reqid": reqid,
+                            # the reqid IS the distributed trace id
+                            # (objecter roots spans on it): a failing
+                            # seed names the trace to pull from the
+                            # daemons' 'trace dump' buffers
+                            "trace_id": reqid})
+        return op_id
+
+    def complete(self, op_id: int, outs: "Optional[List[dict]]" = None,
+                 data: bytes = b"",
+                 version: "Optional[list]" = None,
+                 error: int = 0) -> None:
+        data = bytes(data)
+        ev: "Dict[str, Any]" = {"e": "complete", "id": op_id,
+                                "error": int(error)}
+        if version is not None:
+            ev["version"] = list(version)
+        if outs is not None:
+            # keep only the model-relevant completion facts: per-op
+            # read lengths (slicing the reply blob), stat results
+            kept, off = [], 0
+            for o in outs:
+                rec: "Dict[str, Any]" = {"op": str(o.get("op", "?"))}
+                dlen = int(o.get("dlen", 0))
+                if dlen or o.get("op") in ("read", "omap_get",
+                                           "omap_keys"):
+                    payload = data[off:off + dlen]
+                    off += dlen
+                    rec["len"] = dlen
+                    rec["digest"] = _digest(payload)
+                    if dlen <= self.payload_cap:
+                        rec["payload"] = payload.hex()
+                for k in ("size", "exists", "version"):
+                    if k in o:
+                        rec[k] = o[k]
+                kept.append(rec)
+            ev["outs"] = kept
+        self.events.append(ev)
+
+    def fail(self, op_id: int, error: str = "") -> None:
+        """Unknown outcome: the op MAY have taken effect (a timeout
+        raced its commit).  The checker lets it linearize anywhere
+        after its invocation — or never."""
+        self.events.append({"e": "fail", "id": op_id,
+                            "error": str(error)})
+
+    def to_history(self) -> dict:
+        return {"version": 1, "events": list(self.events)}
+
+
+# --- process-level recorder ----------------------------------------------------
+
+_recorder: "Optional[HistoryRecorder]" = None
+
+
+def install(payload_cap: int = 1 << 20) -> HistoryRecorder:
+    """Arm standalone recording process-wide (idempotent: an already-
+    installed recorder is kept — two clients in one process share one
+    real-time order, which is exactly what the checker wants)."""
+    global _recorder
+    if _recorder is None:
+        _recorder = HistoryRecorder(payload_cap=payload_cap)
+    return _recorder
+
+
+def installed() -> "Optional[HistoryRecorder]":
+    return _recorder
+
+
+def uninstall() -> "Optional[HistoryRecorder]":
+    global _recorder
+    rec, _recorder = _recorder, None
+    return rec
+
+
+def active() -> "Optional[HistoryRecorder]":
+    """The recorder op attempts feed: a cephmc explorer's while a
+    model-checking run is interposing (explorer runs own their
+    histories), else the installed standalone one, else None."""
+    from . import mc
+    exp = mc.explorer()
+    if exp is not None and exp.recorder is not None:
+        return exp.recorder
+    return _recorder
+
+
+def dump_to(path: str,
+            recorder: "Optional[HistoryRecorder]" = None) -> dict:
+    """Write the history JSON (the linearize.py input) to ``path``."""
+    rec = recorder if recorder is not None else active()
+    if rec is None:
+        raise RuntimeError("no history recorder armed")
+    hist = rec.to_history()
+    with open(path, "w") as f:
+        json.dump(hist, f)
+    return hist
+
+
+def register_history_commands(a) -> None:
+    """Admin-socket dump path: ``history dump`` returns the full event
+    list (pipe it to a file, feed it to linearize.py), ``history
+    stats`` the arming state and event count."""
+
+    def _dump(_c: dict) -> dict:
+        rec = active()
+        if rec is None:
+            raise RuntimeError(
+                "no history recorder armed "
+                "(set client_history_record or history.install())")
+        return rec.to_history()
+
+    def _stats(_c: dict) -> dict:
+        rec = active()
+        return {"armed": rec is not None,
+                "events": len(rec.events) if rec is not None else 0}
+
+    a.register("history dump", _dump,
+               "dump the recorded op history (linearize.py input)")
+    a.register("history stats", _stats,
+               "history recorder arming state and event count")

@@ -127,9 +127,10 @@ def crash_point(point: str, daemon: str = "") -> bool:
 
 
 def history() -> "Optional[HistoryRecorder]":
-    if _explorer is None:
-        return None
-    return _explorer.recorder
+    """The recorder op attempts feed (see common/history.py: the
+    explorer's when one is armed, else the standalone installed one)."""
+    from . import history as _hist
+    return _hist.active()
 
 
 # --- the explorer -------------------------------------------------------------
@@ -300,112 +301,8 @@ class Explorer:
 
 
 # --- history recording --------------------------------------------------------
-
-_MODELED_OPS = ("write_full", "write", "append", "truncate", "delete",
-                "read", "stat", "omap_set", "omap_get", "omap_keys",
-                "omap_rm")
-
-
-def _digest(blob) -> str:
-    return hashlib.sha1(bytes(blob)).hexdigest()
-
-
-class HistoryRecorder:
-    """Client-op history: invoke/complete/fail events in real-time
-    order (one process, one loop => the event list IS the real-time
-    partial order the linearizability checker needs).
-
-    Retry folding: ``invoke`` with a reqid already seen returns the
-    FIRST attempt's op id — one logical op, however many wire attempts
-    it took.  A retried mutation that applies twice then fails the
-    sequential model (the read sees the payload twice), which is the
-    double-apply bug class, not two legal ops.
-    """
-
-    def __init__(self, payload_cap: int = 1 << 20) -> None:
-        self.events: "List[dict]" = []
-        self.payload_cap = payload_cap
-        self._next_id = 0
-        self._by_reqid: "Dict[str, int]" = {}
-
-    def invoke(self, client: str, pool: int, oid: str,
-               ops: "List[dict]", data: bytes = b"",
-               reqid: str = "") -> int:
-        if reqid and reqid in self._by_reqid:
-            op_id = self._by_reqid[reqid]
-            self.events.append({"e": "reinvoke", "id": op_id})
-            return op_id
-        self._next_id += 1
-        op_id = self._next_id
-        if reqid:
-            self._by_reqid[reqid] = op_id
-        data = bytes(data)
-        rec_ops: "List[dict]" = []
-        off = 0
-        for op in ops:
-            entry: "Dict[str, Any]" = {"op": str(op.get("op", "?"))}
-            for k in ("off", "len", "keys", "name"):
-                if k in op:
-                    entry[k] = op[k]
-            dlen = int(op.get("dlen", 0))
-            if dlen:
-                payload = data[off:off + dlen]
-                off += dlen
-                entry["len"] = dlen
-                entry["digest"] = _digest(payload)
-                if dlen <= self.payload_cap:
-                    entry["payload"] = payload.hex()
-            if entry["op"] not in _MODELED_OPS:
-                entry["opaque"] = True
-            rec_ops.append(entry)
-        self.events.append({"e": "invoke", "id": op_id,
-                            "client": client, "pool": int(pool),
-                            "oid": str(oid), "ops": rec_ops,
-                            "reqid": reqid,
-                            # the reqid IS the distributed trace id
-                            # (objecter roots spans on it): a failing
-                            # seed names the trace to pull from the
-                            # daemons' 'trace dump' buffers
-                            "trace_id": reqid})
-        return op_id
-
-    def complete(self, op_id: int, outs: "Optional[List[dict]]" = None,
-                 data: bytes = b"",
-                 version: "Optional[list]" = None,
-                 error: int = 0) -> None:
-        data = bytes(data)
-        ev: "Dict[str, Any]" = {"e": "complete", "id": op_id,
-                                "error": int(error)}
-        if version is not None:
-            ev["version"] = list(version)
-        if outs is not None:
-            # keep only the model-relevant completion facts: per-op
-            # read lengths (slicing the reply blob), stat results
-            kept, off = [], 0
-            for o in outs:
-                rec: "Dict[str, Any]" = {"op": str(o.get("op", "?"))}
-                dlen = int(o.get("dlen", 0))
-                if dlen or o.get("op") in ("read", "omap_get",
-                                           "omap_keys"):
-                    payload = data[off:off + dlen]
-                    off += dlen
-                    rec["len"] = dlen
-                    rec["digest"] = _digest(payload)
-                    if dlen <= self.payload_cap:
-                        rec["payload"] = payload.hex()
-                for k in ("size", "exists", "version"):
-                    if k in o:
-                        rec[k] = o[k]
-                kept.append(rec)
-            ev["outs"] = kept
-        self.events.append(ev)
-
-    def fail(self, op_id: int, error: str = "") -> None:
-        """Unknown outcome: the op MAY have taken effect (a timeout
-        raced its commit).  The checker lets it linearize anywhere
-        after its invocation — or never."""
-        self.events.append({"e": "fail", "id": op_id,
-                            "error": str(error)})
-
-    def to_history(self) -> dict:
-        return {"version": 1, "events": list(self.events)}
+# The recorder moved to common/history.py (transport-agnostic: real-
+# socket ProcCluster clients record without the explorer).  Re-exported
+# here for the explore harnesses and tests that import it from mc.
+from .history import (HistoryRecorder, _MODELED_OPS,  # noqa: F401,E402
+                      _digest)

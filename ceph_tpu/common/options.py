@@ -455,6 +455,16 @@ OPTIONS: "dict[str, Option]" = _opts(
            desc="max random injected delivery delay (seconds, QA)"),
     Option("ms_inject_drop_ratio", float, 0.0, LEVEL_DEV, min=0, max=1,
            desc="probability of dropping an outgoing message (QA)"),
+    Option("ms_inject_net_faults", str, "", LEVEL_DEV,
+           desc="boot-time per-link fault rules, semicolon-separated "
+                "'peer=osd.1,dir=out,kind=partition' specs — same "
+                "fields as the injectnetfault admin command (QA)"),
+    Option("client_history_record", str, "", LEVEL_DEV,
+           desc="record a linearizability-audit history of every "
+                "objecter op (invoke/complete, retries folded by "
+                "reqid); the value is the file the history JSON dumps "
+                "to at client shutdown, or '-' to record in memory "
+                "only (admin-socket 'history dump' reads it live)"),
     # --- mon ----------------------------------------------------------------
     Option("mon_lease", float, 5.0, LEVEL_ADVANCED, min=0.1,
            desc="leader lease duration (seconds)", services=("mon",)),

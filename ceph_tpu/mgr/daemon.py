@@ -131,7 +131,8 @@ _CANON_BUCKETS = 41
 # u64 gauges and u64 counters to the same plain number, so the exporter
 # needs the distinction here — typing a shrinking series as 'counter'
 # makes every decrease read as a counter reset to rate()/increase()
-_GAUGE_SERIES = frozenset(("ceph_osd_backoffs_active",))
+_GAUGE_SERIES = frozenset(("ceph_osd_backoffs_active",
+                           "ceph_net_faults_active"))
 
 
 class PrometheusModule(HttpModule):
@@ -343,6 +344,8 @@ class MgrDaemon(Dispatcher):
                    lambda _c: {"num_reports": len(self.reports),
                                "modules": sorted(self.modules)},
                    "mgr status")
+        from ..msg.messenger import register_netfault_commands
+        register_netfault_commands(a, self.ms)
         a.start()
         self.admin_socket = a
 

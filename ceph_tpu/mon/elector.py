@@ -13,6 +13,8 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Dict, List, Optional, Set
 
+from ..common.log import dout
+
 
 class Elector:
     def __init__(self, rank: int, ranks: "List[int]",
@@ -41,6 +43,8 @@ class Elector:
         self.leader = None
         self.acked = self.rank
         self.acks = {self.rank}
+        dout("mon", 5, f"elector.{self.rank}: proposing epoch "
+                       f"{self.epoch}")
         for peer in self.ranks:
             if peer != self.rank:
                 await self.send(peer, "propose", {"epoch": self.epoch})
@@ -78,6 +82,10 @@ class Elector:
 
     async def handle(self, frm: int, op: str, fields: dict) -> None:
         epoch = int(fields.get("epoch", 0))
+        dout("mon", 5, f"elector.{self.rank}: {op} e{epoch} from "
+                       f"{frm} (self e{self.epoch} electing="
+                       f"{self.electing} acked={self.acked} "
+                       f"acks={sorted(self.acks)})")
         if op == "propose":
             if epoch < self.epoch:
                 return
@@ -85,13 +93,26 @@ class Elector:
                 self.epoch = epoch
                 self.acked = None
                 self.electing = True
+                # liveness: this node may have had no election of its
+                # own in flight (e.g. it had already won) — without a
+                # timer nothing retries if the proposer can't win, and
+                # the whole quorum wedges in electing=True (a mon that
+                # boots late and keeps re-proposing used to freeze the
+                # established pair exactly this way)
+                if self._task:
+                    self._task.cancel()
+                self._task = asyncio.ensure_future(self._expire())
             if frm < self.rank and (self.acked is None
                                     or frm <= self.acked):
                 # defer to the lower rank (reference Elector::handle_propose)
                 self.acked = frm
                 await self.send(frm, "ack", {"epoch": self.epoch})
-            elif self.rank < frm and not self.electing:
-                # we outrank the proposer: counter-propose
+            elif self.rank < frm and self.acked is None:
+                # we outrank the proposer and haven't committed to
+                # anyone this epoch: counter-propose.  acked==rank means
+                # our own round is already in flight (timer armed) —
+                # restarting it on every higher-rank propose would
+                # livelock the election instead of letting it expire.
                 await self.start_election()
         elif op == "ack":
             # same-round dedup IS the contract: an ack binds to exactly
@@ -99,6 +120,12 @@ class Elector:
             # arrives as propose/victory and is handled there)
             # cephlint: disable=epoch-monotonicity
             if epoch == self.epoch and self.electing:
+                # the guard on the line above IS the post-await
+                # re-validation: any interleaved task that moved the
+                # election on (new epoch, victory) makes it false and
+                # the ack is dropped.  The paired "read" is the entry
+                # dout, which is inert logging.
+                # cephlint: disable=await-atomicity
                 self.acks.add(frm)
                 if len(self.acks) > len(self.ranks) // 2 and \
                         self.acked == self.rank and \
@@ -108,6 +135,11 @@ class Elector:
         elif op == "victory":
             if epoch >= self.epoch:
                 self.epoch = epoch
+                # epoch >= self.epoch above re-validates after any
+                # await in this handler: a victory for a superseded
+                # round never lands.  The paired "read" is the entry
+                # dout, which is inert logging.
+                # cephlint: disable=await-atomicity
                 self.electing = False
                 self.leader = frm
                 self.quorum = [int(x) for x in fields["quorum"]]

@@ -172,6 +172,8 @@ class MonDaemon(Dispatcher):
                    lambda c: (self.config.set(c["key"], c["value"]),
                               {"success": True})[1],
                    "set a config value at runtime")
+        from ..msg.messenger import register_netfault_commands
+        register_netfault_commands(a, self.ms)
         a.start()
         self.admin_socket = a
 
@@ -210,6 +212,14 @@ class MonDaemon(Dispatcher):
     async def _on_win(self, quorum: "List[int]") -> None:
         dout("mon", 1, f"mon.{self.rank} leader of {quorum} "
                        f"(epoch {self.elector.epoch})")
+        # leader_init waits out a full collect round-trip.  on_win runs
+        # inside the dispatch of the winning ack, so awaiting it here
+        # parks that connection's dispatch queue — the very queue the
+        # peon's collect reply arrives on — and the collect can only
+        # time out.  Spawn it; election state is already settled.
+        self.crash.guard(self._leader_init(quorum), "leader_init")
+
+    async def _leader_init(self, quorum: "List[int]") -> None:
         try:
             await self.paxos.leader_init(quorum)
         except PaxosError as e:
@@ -422,6 +432,22 @@ class MonDaemon(Dispatcher):
         await self._broadcast_map()
         return v
 
+    def _bg_propose_osd_ops(self, ops: "List[dict]", what: str) -> None:
+        """Propose from a dispatch context without blocking it.  A
+        propose waits for quorum accepts, and those accepts arrive on
+        the mon↔mon dispatch queues — a dispatch handler that awaits a
+        propose inline therefore stalls (or deadlocks, if the accept
+        rides the queue it is blocking) for the full propose timeout.
+        Every dispatch-path proposal goes through here; the senders all
+        retry (boot resend, failure re-report), so a lost round only
+        costs latency."""
+        async def run() -> None:
+            try:
+                await self._propose_osd_ops(ops)
+            except PaxosError as e:
+                dout("mon", 5, f"{what} propose failed: {e}")
+        self.crash.guard(run(), f"propose_{what}")
+
     async def _propose_auth_ops(self, ops: "List[dict]") -> int:
         value = json.dumps({"service": "auth", "ops": ops}).encode()
         return await self.paxos.propose(value)
@@ -511,8 +537,21 @@ class MonDaemon(Dispatcher):
     # --- dispatch -------------------------------------------------------------
 
     async def ms_dispatch(self, conn, msg: Message) -> bool:
-        return await self.crash.dispatch_guard(
-            self._ms_dispatch_inner, conn, msg)
+        try:
+            return await self.crash.dispatch_guard(
+                self._ms_dispatch_inner, conn, msg)
+        except PaxosError as e:
+            # a propose that lost its quorum mid-round (election churn,
+            # partitioned peon) is an expected coordination failure, not
+            # a crash: the proposer retries (osd boots/beacons resend,
+            # commands EAGAIN).  Letting it unwind tore down the tcp
+            # session that happened to DELIVER the triggering message,
+            # which put the sender into reconnect backoff — late acks
+            # then excluded live mons from the next quorum and a 3-mon
+            # fleet flapped between two-member quorums forever.
+            dout("mon", 1, f"mon.{self.rank}: dropped "
+                 f"{msg.TYPE} dispatch: {e}")
+            return True
 
     async def _ms_dispatch_inner(self, conn, msg: Message) -> bool:
         t = msg.TYPE
@@ -528,7 +567,14 @@ class MonDaemon(Dispatcher):
             await self.paxos.handle(int(msg["rank"]), msg["op"],
                                     msg.fields)
         elif t == "mon_command":
-            await self._handle_command(conn, msg)
+            # commands propose (pool create, osd set-state, config set)
+            # and a propose must never block a dispatch queue — a
+            # command FORWARDED by a peon would otherwise wedge that
+            # mon↔mon link until the propose times out (in a 2-member
+            # quorum the needed accept rides the blocked queue itself).
+            # The reply goes out from the task when the round commits.
+            self.crash.guard(self._handle_command(conn, msg),
+                             "handle_command")
         elif t == "mon_subscribe":
             self.subs.add(msg["addr"])
             payload = json.dumps(self.osdmap.to_dict()).encode()
@@ -551,7 +597,7 @@ class MonDaemon(Dispatcher):
                     self.clog.cluster.info(
                         f"osd.{osd} joined the cluster at {msg['addr']}")
                 self.clog.cluster.info(f"osd.{osd} boot")
-                await self._propose_osd_ops(ops)
+                self._bg_propose_osd_ops(ops, "boot")
             elif self.elector.leader is not None and \
                     not self.elector.electing:
                 # peon: forward to the leader (reference forward_request)
@@ -563,7 +609,11 @@ class MonDaemon(Dispatcher):
         elif t == "osd_failure":
             await self._handle_failure(msg)
         elif t == "log":
-            await self._submit_log_entries(list(msg.get("entries") or []))
+            # leader branch proposes; committed-order dedup makes a
+            # reordered or double-landed batch harmless
+            self.crash.guard(
+                self._submit_log_entries(list(msg.get("entries") or [])),
+                "submit_log")
         elif t == "crash_report":
             dumps = list(msg.get("dumps") or [])
             # newness check BEFORE the propose: the client broadcasts
@@ -571,7 +621,8 @@ class MonDaemon(Dispatcher):
             # the cluster log (the store itself dedups by crash_id)
             fresh = [m for m in dumps
                      if str(m.get("crash_id", "")) not in self.crashes]
-            await self._submit_crash_dumps(dumps)
+            self.crash.guard(self._submit_crash_dumps(dumps),
+                             "submit_crash")
             if self.is_leader:
                 for m in fresh:
                     # surface the crash in the cluster log too, so
@@ -614,8 +665,8 @@ class MonDaemon(Dispatcher):
             self.clog.cluster.warn(
                 f"osd.{failed} marked down after {len(reporters)} "
                 f"failure report(s)")
-            await self._propose_osd_ops(
-                [{"op": "mark_down", "osd": failed}])
+            self._bg_propose_osd_ops(
+                [{"op": "mark_down", "osd": failed}], "mark_down")
 
     # --- ticks: beacon grace / down-out --------------------------------------
 

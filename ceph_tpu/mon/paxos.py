@@ -298,7 +298,23 @@ class Paxos:
             self.uncommitted_value = bytes.fromhex(fields["value"])
         if len(self._collected) >= len(self.quorum) and \
                 self._collect_fut and not self._collect_fut.done():
+            # resolve the fut HERE (idempotency guard for a replayed
+            # "last"), then finish in a spawned task: _finish_collect
+            # may re-propose a dead leader's value, and that propose
+            # waits for accepts which arrive on the connection that
+            # delivered THIS message — finishing inline can only time
+            # the round out
+            self._collect_fut.set_result(None)
+            self.spawn(self._finish_collect_bg(), "finish_collect")
+
+    async def _finish_collect_bg(self) -> None:
+        try:
             await self._finish_collect()
+        except PaxosError as e:
+            # expected when the quorum churns mid-collect; the next
+            # election retries
+            from ..common.log import dout
+            dout("mon", 5, f"paxos.{self.rank}: finish_collect: {e}")
 
     async def _handle_begin(self, frm: int, fields: dict) -> None:
         """Peon: accept iff pn matches our promise (reference

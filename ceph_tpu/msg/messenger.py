@@ -28,6 +28,7 @@ import os
 import random
 import struct
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -83,12 +84,91 @@ class Dispatcher:
         """Peer session dropped (lossy) or replaced."""
 
 
+class _NetFaultRule:
+    """One directed per-link fault (runtime-settable via the
+    ``injectnetfault`` admin command or ``ms_inject_net_faults``).
+
+    ``peer`` matches the remote's entity name OR listen address, or
+    ``*`` for every link.  ``dir`` is from this messenger's viewpoint:
+    ``out`` = traffic we send toward the peer, ``in`` = traffic the
+    peer sends us (including session establishment we would accept).
+
+    Kinds:
+      partition  blackhole: blocks send, receive, connect AND accept
+                 in the matched direction(s) — one rule with dir=out
+                 on A against B is the asymmetric (one-way) case
+      refuse     connect/accept refusal only; established streams live
+      drop       probabilistic frame drop (lossy links lose the frame;
+                 lossless links retransmit, as the legacy knob does)
+      delay      fixed + uniform-jitter per-frame delay, FIFO preserved
+      reorder    window seconds of independent per-frame delay; frames
+                 genuinely overtake only on lossy local links (a TCP
+                 stream cannot reorder within a session, and lossless
+                 seq dedup would drop late frames as duplicates) —
+                 elsewhere it degrades to a jittered FIFO delay
+      kill       abort the session carrying the matched frame
+                 (count=1 gives a one-shot deterministic mid-stream
+                 kill, the reconnect-replay test hook)
+    """
+
+    KINDS = ("partition", "refuse", "drop", "delay", "reorder", "kill")
+    DIRS = ("in", "out", "both")
+
+    def __init__(self, rule_id: int, peer: str = "*",
+                 direction: str = "both", kind: str = "partition",
+                 prob: float = 1.0, delay: float = 0.0,
+                 jitter: float = 0.0, window: float = 0.0,
+                 count: int = 0) -> None:
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(want one of {'/'.join(self.KINDS)})")
+        if direction not in self.DIRS:
+            raise ValueError(f"bad dir {direction!r} (want in/out/both)")
+        self.rule_id = rule_id
+        self.peer = str(peer) or "*"
+        self.direction = direction
+        self.kind = kind
+        self.prob = float(prob)
+        self.delay = float(delay)
+        self.jitter = float(jitter)
+        self.window = float(window)
+        self.count = int(count)
+        self.trips = 0
+
+    def matches(self, direction: str, peer_addr: str,
+                peer_name: str) -> bool:
+        if self.direction != "both" and self.direction != direction:
+            return False
+        if self.peer == "*":
+            return True
+        return (peer_addr != "" and self.peer == peer_addr) or \
+               (peer_name != "" and self.peer == peer_name)
+
+    def to_dict(self) -> dict:
+        return {"id": self.rule_id, "peer": self.peer,
+                "dir": self.direction, "kind": self.kind,
+                "prob": self.prob, "delay": self.delay,
+                "jitter": self.jitter, "window": self.window,
+                "count": self.count, "trips": self.trips}
+
+
 class _Injector:
-    """QA fault injection shared by both transports."""
+    """QA fault injection shared by both transports.
+
+    Two layers: the legacy uniform-random knobs
+    (ms_inject_socket_failures / ms_inject_drop_ratio /
+    ms_inject_delay_max) and a per-link rule table of _NetFaultRule,
+    mutated live from the admin-socket thread (see
+    register_netfault_commands) — every read path iterates a snapshot,
+    so a concurrent set/clear never trips mid-iteration."""
 
     def __init__(self, messenger: "Messenger") -> None:
         self.m = messenger
         self.rng = random.Random(hash(messenger.name) & 0xFFFFFFFF)
+        self.rules: "Dict[int, _NetFaultRule]" = {}
+        self._next_id = 1
+
+    # --- legacy uniform knobs ---------------------------------------------
 
     def kill_socket(self) -> bool:
         n = int(self.m.conf("ms_inject_socket_failures"))
@@ -102,6 +182,165 @@ class _Injector:
         d = float(self.m.conf("ms_inject_delay_max"))
         if d > 0:
             await asyncio.sleep(self.rng.random() * d)
+
+    # --- rule table (admin-socket mutable) --------------------------------
+
+    def set_rule(self, spec: dict) -> dict:
+        kw = {}
+        for k in ("peer", "kind", "prob", "delay", "jitter", "window",
+                  "count"):
+            if k in spec and spec[k] is not None:
+                kw[k] = spec[k]
+        if spec.get("dir"):
+            kw["direction"] = spec["dir"]
+        rule = _NetFaultRule(self._next_id, **kw)
+        self._next_id += 1
+        self.rules[rule.rule_id] = rule
+        self._sync_gauge()
+        dout("ms", 1, f"{self.m.name}: injectnetfault set "
+                      f"{rule.to_dict()}")
+        return rule.to_dict()
+
+    def clear_rules(self, rule_id: "Optional[int]" = None,
+                    peer: "Optional[str]" = None) -> int:
+        if rule_id is not None:
+            n = 1 if self.rules.pop(int(rule_id), None) is not None else 0
+        elif peer:
+            ids = [r.rule_id for r in list(self.rules.values())
+                   if r.peer == peer]
+            for i in ids:
+                self.rules.pop(i, None)
+            n = len(ids)
+        else:
+            n = len(self.rules)
+            self.rules.clear()
+        self._sync_gauge()
+        if n:
+            dout("ms", 1, f"{self.m.name}: injectnetfault cleared {n} "
+                          f"rule(s)")
+        return n
+
+    def list_rules(self) -> "List[dict]":
+        return [r.to_dict() for r in list(self.rules.values())]
+
+    def load_spec(self, spec: str) -> None:
+        """Boot-time rules (ms_inject_net_faults): semicolon-separated
+        ``key=value`` comma lists, same fields as the admin verb."""
+        for part in str(spec).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields: dict = {}
+            for kv in part.split(","):
+                k, _, v = kv.partition("=")
+                fields[k.strip()] = v.strip()
+            self.set_rule(fields)
+
+    def _sync_gauge(self) -> None:
+        self.m.net_stats["net_faults_active"] = len(self.rules)
+
+    def _trip(self, rule: _NetFaultRule) -> None:
+        rule.trips += 1
+        self.m.net_stats["net_fault_trips"] += 1
+        if rule.count and rule.trips >= rule.count:
+            self.rules.pop(rule.rule_id, None)
+            self._sync_gauge()
+
+    def _match(self, direction: str, kinds: "Tuple[str, ...]",
+               peer_addr: str, peer_name: str
+               ) -> "Optional[_NetFaultRule]":
+        for r in list(self.rules.values()):
+            if r.kind not in kinds:
+                continue
+            if not r.matches(direction, peer_addr, peer_name):
+                continue
+            if r.prob < 1.0 and self.rng.random() >= r.prob:
+                continue
+            self._trip(r)
+            return r
+        return None
+
+    # --- transport decision points ----------------------------------------
+
+    def deny_connect(self, peer_addr: str, peer_name: str = "") -> bool:
+        """Outgoing session establishment blocked?"""
+        return self._match("out", ("partition", "refuse"),
+                           peer_addr, peer_name) is not None
+
+    def deny_accept(self, peer_addr: str, peer_name: str = "") -> bool:
+        """Incoming session establishment blocked?"""
+        return self._match("in", ("partition", "refuse"),
+                           peer_addr, peer_name) is not None
+
+    def send_partitioned(self, peer_addr: str,
+                         peer_name: str = "") -> bool:
+        """Outbound blackhole on this link (message granularity)."""
+        return self._match("out", ("partition",),
+                           peer_addr, peer_name) is not None
+
+    def frame_fault(self, peer_addr: str,
+                    peer_name: str = "") -> "Optional[str]":
+        """Per-outbound-frame action: 'drop' | 'kill' | None."""
+        r = self._match("out", ("drop", "kill"), peer_addr, peer_name)
+        return r.kind if r is not None else None
+
+    def recv_fault(self, peer_addr: str,
+                   peer_name: str = "") -> "Optional[str]":
+        """Per-inbound-frame action on tcp: partition/kill/drop all
+        abort the session BEFORE delivery — skipping a frame while the
+        stream continues would open a silent seq gap on lossless links,
+        which reconnect replay can never heal."""
+        r = self._match("in", ("partition", "kill", "drop"),
+                        peer_addr, peer_name)
+        return r.kind if r is not None else None
+
+    def recv_partitioned(self, peer_addr: str,
+                         peer_name: str = "") -> bool:
+        """Inbound blackhole (local transport delivery check)."""
+        return self._match("in", ("partition",),
+                           peer_addr, peer_name) is not None
+
+    def reorder_window(self, peer_addr: str,
+                       peer_name: str = "") -> float:
+        """Widest matched reorder window (the local-lossy overtaking
+        path); 0.0 when no reorder rule matches."""
+        w = 0.0
+        for r in list(self.rules.values()):
+            if r.kind != "reorder":
+                continue
+            if not r.matches("out", peer_addr, peer_name):
+                continue
+            if r.prob < 1.0 and self.rng.random() >= r.prob:
+                continue
+            self._trip(r)
+            w = max(w, r.window)
+        return w
+
+    def _delay_for(self, direction: str, peer_addr: str,
+                   peer_name: str) -> float:
+        d = 0.0
+        for r in list(self.rules.values()):
+            if r.kind not in ("delay", "reorder"):
+                continue
+            if not r.matches(direction, peer_addr, peer_name):
+                continue
+            if r.prob < 1.0 and self.rng.random() >= r.prob:
+                continue
+            self._trip(r)
+            if r.kind == "delay":
+                d += r.delay + (self.rng.uniform(0, r.jitter)
+                                if r.jitter > 0 else 0.0)
+            else:
+                # reorder degraded to jittered FIFO delay (see
+                # _NetFaultRule: true overtaking is lossy-local only)
+                d += self.rng.uniform(0, r.window)
+        return d
+
+    def send_delay(self, peer_addr: str, peer_name: str = "") -> float:
+        return self._delay_for("out", peer_addr, peer_name)
+
+    def recv_delay(self, peer_addr: str, peer_name: str = "") -> float:
+        return self._delay_for("in", peer_addr, peer_name)
 
 
 class Connection:
@@ -122,9 +361,26 @@ class Connection:
         self._send_lock = DepLock("messenger.send")
         self._connected = asyncio.Event()
         self.closed = False
+        # reconnect telemetry: _had_session marks the first established
+        # session (later ones count as reconnects), _handshook tells the
+        # outgoing loop whether the last session got past the banner
+        # (handshake failures back off; established-session deaths
+        # reconnect immediately)
+        self._had_session = False
+        self._handshook = False
         self._salt = os.urandom(4)
         self._peer_salt = b"\x00" * 4
         self._task: "Optional[asyncio.Task]" = None
+        # per-connection dispatch queue (reference DispatchQueue): the
+        # read loop enqueues and keeps reading; a dedicated task
+        # delivers in FIFO order.  Dispatching inline from the read
+        # loop deadlocks any handler that awaits a reply from the same
+        # peer — a mon leader dispatching a peon-forwarded osd_boot
+        # awaits that peon's paxos accept, which is queued behind the
+        # blocked read loop, stalling the link for the full propose
+        # timeout and starving election acks into quorum flap
+        self._dispatch_q: "deque" = deque()
+        self._dispatch_task: "Optional[asyncio.Task]" = None
         # corked out-queue (reference AsyncConnection out_q + MSG_MORE
         # coalescing): send_message enqueues, the flusher writes every
         # queued frame in one syscall burst and drains ONCE — an EC
@@ -247,6 +503,24 @@ class Connection:
             if self.policy.lossy:
                 raise ConnectionError(f"connection to {self.peer_addr} closed")
             return
+        if self.messenger.injector.send_partitioned(self.peer_addr,
+                                                    self.peer_name):
+            # blackhole: the message never reaches the wire and the
+            # CALLER sees the link as dead (an EC primary's failed
+            # sub-write is what files the mon failure report — a
+            # partition that silently swallowed sends would leave a
+            # one-way-dead peer looking healthy forever).  The session
+            # drops too, so the reconnect loop runs into deny_connect
+            # and keeps the link down until the rule clears.
+            dout("ms", 5, f"{self.messenger.name}: injected partition "
+                 f"to {self.peer_addr or self.peer_name}")
+            self._abort()
+            if self.policy.lossy:
+                self.closed = True
+                self.messenger._drop_connection(self)
+            raise ConnectionError(
+                f"injected partition to "
+                f"{self.peer_addr or self.peer_name}")
         _stamp_trace_sent(msg)
         sanitizer.handoff(msg, "messenger.send")
         header, data = msg.encode()
@@ -265,10 +539,16 @@ class Connection:
         With ms_cork_max_bytes=0 corking is off and the frame writes +
         drains individually, the old per-frame behavior."""
         if not self.policy.lossy:
-            # wait for an (re)established session
-            try:
-                await asyncio.wait_for(self._connected.wait(), timeout=30)
-            except asyncio.TimeoutError:
+            if not self._connected.is_set():
+                # no session yet: the frame already sits in unacked, and
+                # the next session's replay delivers it in seq order —
+                # _session writes the replay tail with no await between
+                # it and _connected.set(), so a later send cannot
+                # overtake it.  Parking the sender here (the old 30 s
+                # wait) deadlocked boot-time fan-out: a mon electing
+                # against not-yet-started peers blocked inside its own
+                # init for 30 s per dead peer, so a 3-mon fleet never
+                # printed ready.
                 return
         elif not self._connected.is_set():
             raise ConnectionError(f"no session to {self.peer_addr}")
@@ -341,12 +621,13 @@ class Connection:
         killed = False
         async with self._send_lock:
             for frame in frames:
-                dropped = inj.drop()
+                act = inj.frame_fault(self.peer_addr, self.peer_name)
+                dropped = inj.drop() or act == "drop"
                 if dropped and self.policy.lossy:
                     dout("ms", 5, f"{self.messenger.name}: injected drop "
                          f"to {self.peer_addr}")
                     continue
-                if inj.kill_socket():
+                if inj.kill_socket() or act == "kill":
                     dout("ms", 5, f"{self.messenger.name}: injected "
                          f"socket kill to {self.peer_addr}")
                     killed = True
@@ -361,6 +642,11 @@ class Connection:
                     await asyncio.sleep(0.02 + inj.rng.random() * 0.05)
                 else:
                     await inj.maybe_delay()
+                    extra = inj.send_delay(self.peer_addr, self.peer_name)
+                    if extra > 0:
+                        # rule delay sleeps IN ORDER inside the lock,
+                        # like maybe_delay: a slow link, not a reorderer
+                        await asyncio.sleep(extra)
                 burst.append(frame)
             writer = self._writer
             if killed:
@@ -441,6 +727,9 @@ class Connection:
         self._abort()
         if self._task is not None:
             self._task.cancel()
+        if self._dispatch_task is not None:
+            self._dispatch_task.cancel()
+        self._dispatch_q.clear()
 
     # --- session (outgoing side) -----------------------------------------------
 
@@ -448,10 +737,26 @@ class Connection:
         self._task = asyncio.get_running_loop().create_task(
             self._run_outgoing())
 
+    def _reconnect_delay(self, attempt: int) -> float:
+        """Capped equal-jitter backoff (the PR-2 client pattern, see
+        Objecter.backoff_delay): uniform over [bound/2, bound] where
+        bound doubles from ms_initial_backoff up to ms_max_backoff —
+        a fleet of peers reconnecting after a partition heals must not
+        stampede the survivor in lockstep."""
+        base = float(self.messenger.conf("ms_initial_backoff"))
+        cap = float(self.messenger.conf("ms_max_backoff"))
+        bound = min(cap, base * (2 ** min(attempt, 32)))
+        return self.messenger.injector.rng.uniform(bound / 2, bound)
+
     async def _run_outgoing(self) -> None:
-        backoff = float(self.messenger.conf("ms_initial_backoff"))
+        attempt = 0
+        inj = self.messenger.injector
         while not self.closed:
             try:
+                if inj.deny_connect(self.peer_addr, self.peer_name):
+                    dout("ms", 5, f"{self.messenger.name}: injected "
+                         f"connect refusal to {self.peer_addr}")
+                    raise OSError("injected connect refusal")
                 reader, writer = await asyncio.open_connection(
                     *entity_addr(self.peer_addr))
                 self.messenger._apply_sockopts(writer)
@@ -463,11 +768,10 @@ class Connection:
                     self.closed = True
                     self.messenger._drop_connection(self)
                     return
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2,
-                              float(self.messenger.conf("ms_max_backoff")))
+                await asyncio.sleep(self._reconnect_delay(attempt))
+                attempt += 1
                 continue
-            backoff = float(self.messenger.conf("ms_initial_backoff"))
+            self._handshook = False
             try:
                 await self._session(reader, writer, client_side=True)
             except (OSError, MessageError, asyncio.IncompleteReadError):
@@ -479,6 +783,15 @@ class Connection:
                 for d in self.messenger.dispatchers:
                     d.ms_handle_reset(self)
                 return
+            if self._handshook:
+                attempt = 0
+            else:
+                # the connect succeeded but the handshake did not (auth
+                # failure, injected accept refusal): back off like a
+                # refused connect instead of spinning a hot
+                # connect/banner/die loop against the peer
+                await asyncio.sleep(self._reconnect_delay(attempt))
+                attempt += 1
 
     def _banner(self, peer_salt: bytes = b"") -> bytes:
         """Handshake banner.  Challenge-response auth (cephx-style):
@@ -536,7 +849,14 @@ class Connection:
             # received from us, so replay resends exactly the lost tail
             writer.writelines(self._banner())
             await writer.drain()
+            prev_peer_salt = self._peer_salt
             ph = await self._read_banner(reader)
+            if self._peer_salt != prev_peer_salt:
+                # the accept side minted a fresh conn (it always does):
+                # its outgoing seq stream restarts, so our dedup
+                # watermark from the previous session would swallow
+                # every reply as a replayed duplicate
+                self.in_seq = 0
             if auth_on:
                 # the server's proof binds OUR fresh salt: not replayable
                 try:
@@ -552,9 +872,16 @@ class Connection:
                     raise MessageError(f"cannot authenticate: {e}")
                 await self._send_ctrl({"type": "__auth", "auth": proof})
             peer_in_seq = int(ph.get("in_seq", 0))
+            self._handshook = True
+            if self._had_session:
+                self.messenger.net_stats["ms_reconnects"] += 1
+            self._had_session = True
             if not self.policy.lossy:
                 self.unacked = [(s, f) for s, f in self.unacked
                                 if s > peer_in_seq]
+                if self.unacked:
+                    self.messenger.net_stats["ms_replayed_frames"] += \
+                        len(self.unacked)
                 self._connected.set()
                 for _, fr in list(self.unacked):
                     # replay reuses the built frames verbatim: segment
@@ -565,9 +892,29 @@ class Connection:
                 self._connected.set()
         else:
             await self._read_banner(reader)
-            # restore receive progress for this peer (survives reconnects)
+            if self.messenger.injector.deny_accept(self.peer_addr,
+                                                   self.peer_name):
+                # partitions must cover session ESTABLISHMENT too: the
+                # peer's banner dies here, before any auth or replay
+                dout("ms", 5, f"{self.messenger.name}: injected accept "
+                     f"refusal for {self.peer_name or self.peer_addr}")
+                raise MessageError(
+                    f"injected accept refusal for "
+                    f"{self.peer_name or self.peer_addr}")
+            # restore receive progress for this peer — but ONLY for a
+            # reconnect of the same connection incarnation.  The salt is
+            # minted once per Connection object and rides every banner,
+            # so it identifies the peer's outgoing seq stream: a fresh
+            # peer conn (lossy client remake, peer restart) restarts
+            # out_seq at 0, and restoring the old addr-keyed watermark
+            # against it would swallow every frame of the new session as
+            # a "replayed duplicate" — a one-way-dead link that looks
+            # connected (the proc_chaos partition rounds found this:
+            # post-heal reads black-holed until the new session's seqs
+            # caught up with the dead one's high-water mark).
             key = self.peer_addr or self.peer_name
-            self.in_seq = self.messenger._peer_in_seq.get(key, 0)
+            psalt, pseq = self.messenger._peer_in_seq.get(key, ("", 0))
+            self.in_seq = pseq if psalt == self._peer_salt.hex() else 0
             # server's banner carries its proof bound to the client salt;
             # the client must answer with an __auth frame before any
             # message is accepted
@@ -585,6 +932,22 @@ class Connection:
                 dout("ms", 5, f"{self.messenger.name}: injected recv kill")
                 self._abort()
                 return
+            act = inj.recv_fault(self.peer_addr, self.peer_name)
+            if act is not None:
+                # in-dir rule fault: abort BEFORE the dedup check runs
+                # and in_seq advances — the frame was read but never
+                # delivered, so a lossless peer replays it on reconnect
+                # (never skip-and-continue: a seq gap on a live session
+                # is a silent lossless loss nothing can heal)
+                dout("ms", 5, f"{self.messenger.name}: injected recv "
+                     f"{act} from {self.peer_name or self.peer_addr}")
+                self._abort()
+                return
+            rd = inj.recv_delay(self.peer_addr, self.peer_name)
+            if rd > 0:
+                # slow inbound link: the read loop is sequential, so
+                # sleeping here delays delivery FIFO
+                await asyncio.sleep(rd)
             if ack:
                 self.unacked = [(s, f) for s, f in self.unacked if s > ack]
             if flags & FLAG_CTRL:
@@ -613,15 +976,43 @@ class Connection:
                 if seq <= self.in_seq:
                     continue  # replayed duplicate
                 self.in_seq = seq
-                self.messenger._peer_in_seq[self.peer_addr or
-                                            self.peer_name] = seq
+                self.messenger._peer_in_seq[
+                    self.peer_addr or self.peer_name] = \
+                    (self._peer_salt.hex(), seq)
             # a malformed frame body (truncated, bit-flipped past the
             # crc, unknown type) raises MessageError out of this loop:
             # the session drops and resyncs — codec noise NEVER reaches
             # ms_dispatch or the CrashHandler
             msg = decode_message(header, data, from_name=self.peer_name)
-            await self.messenger._deliver(self, msg)
+            self._enqueue_dispatch(msg)
             self._schedule_ack()
+
+    def _enqueue_dispatch(self, msg: Message) -> None:
+        # acked-once-queued: in_seq already advanced, so the peer won't
+        # replay this frame — the queue is process-local, and a process
+        # death loses queued-undelivered messages exactly like it loses
+        # dispatched-unapplied ones
+        self._dispatch_q.append(msg)
+        if self._dispatch_task is None or self._dispatch_task.done():
+            self._dispatch_task = asyncio.ensure_future(
+                self._dispatch_loop())
+
+    async def _dispatch_loop(self) -> None:
+        while self._dispatch_q:
+            msg = self._dispatch_q.popleft()
+            try:
+                await self.messenger._deliver(self, msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a dispatch failure must not kill the transport (the
+                # old inline path tore down the session that happened
+                # to deliver the message, punishing the wrong layer);
+                # daemons' CrashHandler has already dumped by the time
+                # the exception reaches here
+                dout("ms", -1, f"{self.messenger.name}: dispatch of "
+                     f"{getattr(msg, 'TYPE', '?')} from "
+                     f"{self.peer_name or self.peer_addr} raised: {e!r}")
 
 
 class _LocalConnection:
@@ -653,6 +1044,14 @@ class _LocalConnection:
     async def send_message(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_addr} closed")
+        if self.messenger.injector.send_partitioned(self.peer_addr,
+                                                    self.peer_name):
+            # same contract as the tcp transport: the caller must SEE
+            # the blackholed link (failure reports depend on it)
+            dout("ms", 5, f"{self.messenger.name}: injected partition "
+                 f"to {self.peer_name}")
+            raise ConnectionError(
+                f"injected partition to {self.peer_name}")
         _stamp_trace_sent(msg)
         sanitizer.handoff(msg, "messenger.send")
         if self.peer.stopped:
@@ -680,8 +1079,22 @@ class _LocalConnection:
             await fut
             return
         inj = self.messenger.injector
-        delay = 0.0
-        if inj.drop() or inj.kill_socket():
+        if self.policy.lossy:
+            w = inj.reorder_window(self.peer_addr, self.peer_name)
+            if w > 0:
+                # true reordering — lossy links only: each matched
+                # frame rides its own independent delay and may
+                # overtake later sends.  Delivery failures vanish like
+                # any lossy drop would.
+                # resolver is the detached task itself; a lossy frame
+                # has no sender to ack
+                # cephlint: disable=fire-and-forget
+                asyncio.ensure_future(
+                    self._deliver_reordered(msg, inj.rng.uniform(0, w)))
+                return
+        delay = inj.send_delay(self.peer_addr, self.peer_name)
+        act = inj.frame_fault(self.peer_addr, self.peer_name)
+        if inj.drop() or inj.kill_socket() or act in ("drop", "kill"):
             if self.policy.lossy:
                 dout("ms", 5, f"{self.messenger.name}: injected local drop")
                 return
@@ -690,7 +1103,7 @@ class _LocalConnection:
             # transport simulates that with a redelivery delay
             dout("ms", 5, f"{self.messenger.name}: injected local drop, "
                  f"lossless retransmit")
-            delay = 0.05 + inj.rng.random() * 0.1
+            delay += 0.05 + inj.rng.random() * 0.1
         dmax = float(self.messenger.conf("ms_inject_delay_max"))
         if dmax > 0:
             delay += inj.rng.random() * dmax
@@ -739,6 +1152,13 @@ class _LocalConnection:
             return
         await self._deliver_msg(msg)
 
+    async def _deliver_reordered(self, msg: Message, delay: float) -> None:
+        try:
+            await asyncio.sleep(delay)
+            await self._deliver_msg(msg)
+        except Exception:  # noqa: BLE001 — lossy link: a reordered
+            pass           # frame that misses its peer is just lost
+
     async def _deliver_msg(self, msg: Message) -> None:
         if self.peer.stopped:
             new = Messenger._local_registry.get(self.peer_addr)
@@ -765,6 +1185,23 @@ class _LocalConnection:
         data = msg.data
         if not isinstance(data, BufferList):
             data = BufferList(data) if data else BufferList()
+        rinj = self.peer.injector
+        if rinj.recv_partitioned(self.messenger.listen_addr,
+                                 self.messenger.name):
+            # the RECEIVER's inbound blackhole: on a one-way partition
+            # installed on the victim, senders still see the link dead
+            # (their write vanished) while the victim's own outbound
+            # traffic flows untouched
+            if self.policy.lossy:
+                dout("ms", 5, f"{self.peer.name}: injected inbound "
+                     f"partition drop from {self.messenger.name}")
+                return
+            raise ConnectionError(
+                f"injected partition at {self.peer_name}")
+        rdelay = rinj.recv_delay(self.messenger.listen_addr,
+                                 self.messenger.name)
+        if rdelay > 0:
+            await asyncio.sleep(rdelay)
         peer_msg = type(msg)(fields, data)
         peer_msg.priority = msg.priority
         peer_msg.from_name = self.messenger.name
@@ -803,9 +1240,25 @@ class Messenger:
         self.connections: "Dict[str, Connection]" = {}
         self._server: "Optional[asyncio.AbstractServer]" = None
         self._accepted: "List[Connection]" = []
-        self._peer_in_seq: "Dict[str, int]" = {}
+        # peer addr -> (peer stream salt, highest seq received): receive
+        # progress survives reconnects of the SAME peer incarnation only
+        # (see the watermark restore in Connection._session)
+        self._peer_in_seq: "Dict[str, Tuple[str, int]]" = {}
         self.stopped = False
+        # link-fault + session telemetry: active-rule gauge and trip
+        # counts for the injectnetfault table, plus lossless session
+        # re-establishments and the unacked frames replayed into them
+        # (the reconnect-replay contract, observable).  Daemons export
+        # this dict through their perf collection.
+        self.net_stats = {"net_faults_active": 0, "net_fault_trips": 0,
+                          "ms_reconnects": 0, "ms_replayed_frames": 0}
         self.injector = _Injector(self)
+        try:
+            spec = str(self.conf("ms_inject_net_faults") or "")
+        except Exception:  # noqa: BLE001 — option absent in bare configs
+            spec = ""
+        if spec:
+            self.injector.load_spec(spec)
         # corked-send telemetry (per-connection flushers report here);
         # on_cork_flush(frames) is the daemon's perf-histogram hook
         self.cork_stats = {"cork_flushes": 0, "cork_frames": 0,
@@ -916,6 +1369,13 @@ class Messenger:
             peer = Messenger._local_registry.get(addr)
             if peer is None or peer.stopped:
                 raise ConnectionError(f"no local peer at {addr}")
+            if self.injector.deny_connect(addr, peer.name):
+                # establishment-level refusal on the in-process
+                # transport: the connection is never created (an
+                # already-cached one keeps working — refuse blocks new
+                # sessions only, exactly like the tcp path)
+                raise ConnectionError(
+                    f"injected connect refusal to {peer.name}")
             lconn = _LocalConnection(self, peer, policy)
             self.connections[addr] = lconn  # type: ignore[assignment]
             return lconn
@@ -998,3 +1458,34 @@ class Messenger:
             dout("ms", 1, f"{self.name}: unhandled message {msg!r}")
         finally:
             self.dispatch_throttle.put(cost)
+
+
+def register_netfault_commands(a, messenger: "Messenger") -> None:
+    """Admin-socket surface for the per-link fault table — the nemesis
+    driver's runtime control plane (tools/proc_chaos.py stages
+    partitions by calling these on live daemons).  Registered by every
+    daemon that owns a messenger (mon, osd, mgr, client)."""
+    inj = messenger.injector
+
+    def _clear(c: dict) -> dict:
+        rid = c.get("id")
+        return {"cleared": inj.clear_rules(
+            rule_id=int(rid) if rid is not None else None,
+            peer=c.get("peer"))}
+
+    a.register(
+        "injectnetfault set",
+        lambda c: inj.set_rule(c),
+        "install a link fault rule: peer=<name|addr|*> dir=<in|out|both> "
+        "kind=<partition|refuse|drop|delay|reorder|kill> [prob=P] "
+        "[delay=S] [jitter=S] [window=S] [count=N]")
+    a.register(
+        "injectnetfault clear",
+        _clear,
+        "clear fault rules: id=<rule id> | peer=<name|addr> | "
+        "(no args: all)")
+    a.register(
+        "injectnetfault list",
+        lambda _c: {"rules": inj.list_rules(),
+                    "stats": dict(messenger.net_stats)},
+        "active link fault rules and trip/reconnect/replay counters")

@@ -225,6 +225,18 @@ class OSDDaemon(Dispatcher):
              "crc_cache_hits": "per-raw cached crc32c lookups served",
              "crc_cache_misses": "crc32c computed fresh"},
             unit="bytes"))
+        # link-fault + session telemetry (PR 17): the injectnetfault
+        # rule gauge/trips and the lossless reconnect-replay counters
+        # ride the mgr report into Prometheus like any counter group
+        # (net_faults_active is exported as a gauge — see _GAUGE_SERIES)
+        self.perf_coll.add(ExternalCounters(
+            "msgr_net", self.ms.net_stats,
+            {"net_faults_active": "installed injectnetfault rules",
+             "net_fault_trips": "frames/sessions a fault rule acted on",
+             "ms_reconnects": "lossless sessions re-established after "
+                              "a drop",
+             "ms_replayed_frames": "unacked frames replayed into "
+                                   "re-established sessions"}))
         self.encode_service.profiler = self.profiler
         # cephx ticket validation (rotating secrets arrive from the mon
         # at boot / lazily on unknown generations; static-mode harnesses
@@ -256,6 +268,7 @@ class OSDDaemon(Dispatcher):
         # seeded on first sight so intervals count from boot, not epoch
         self._scrub_stamps: "Dict[Tuple[int, int], List[float]]" = {}
         self._beacon_task = None
+        self._reboot_task = None
         self._loop_lag_task = None
         self._peer_tasks: "Dict[Tuple[int, int], asyncio.Task]" = {}
         # last-consumed pg_num per pool: a map epoch raising it triggers
@@ -360,6 +373,16 @@ class OSDDaemon(Dispatcher):
         and client ops for the pool wait on the split."""
         if not self.up:
             return
+        if self.monc is not None and not osdmap.is_up(self.whoami):
+            # the map says we're down but we're alive: failure reports
+            # during a partition marked us down while our beacons still
+            # flowed (the one-way case).  Reference OSDs notice the map
+            # and re-boot; re-announce after a short grace so the down
+            # state is observable (and the reporter's partition gets a
+            # chance to clear) instead of flapping every tick.
+            if self._reboot_task is None or self._reboot_task.done():
+                self._reboot_task = self.crash.task(
+                    self._reboot_after_markdown(), "reboot_after_markdown")
         splits = []
         changed = False
         for pool_id, pool in osdmap.pools.items():
@@ -722,6 +745,21 @@ class OSDDaemon(Dispatcher):
                 self.whoami, slow_ops=self.op_tracker.slow_summary())
             await asyncio.sleep(interval)
 
+    async def _reboot_after_markdown(self) -> None:
+        """Rejoin after a spurious mark_down (failure reports filed by
+        a peer we're partitioned from, while we're alive and beaconing).
+        Re-announces boot until the map shows us up again — without
+        this, a healed partition leaves the victim down forever (its
+        beacons update last_beacon but never propose mark_up)."""
+        grace = float(self.config.get("osd_heartbeat_grace"))
+        await asyncio.sleep(min(1.0, grace / 2.0))
+        while self.up and not self.osdmap.is_up(self.whoami):
+            await self.monc.send_boot(self.whoami, self.ms.listen_addr)
+            for _ in range(10):
+                if not self.up or self.osdmap.is_up(self.whoami):
+                    return
+                await asyncio.sleep(0.1)
+
     async def _scrub_loop(self) -> None:
         """Background scrub scheduler.  One scrub at a time per OSD;
         deep scrubs repair automatically only under
@@ -1057,7 +1095,13 @@ class OSDDaemon(Dispatcher):
         store_stats = getattr(self.store, "stats", None)
         if store_stats:
             out["objectstore"] = dict(store_stats)
-        out["msgr"] = dict(self.ms.cork_stats)
+        out["msgr"] = {**self.ms.cork_stats, **self.ms.net_stats}
+        # active fault-rule detail (the gauge in msgr_net counts them;
+        # the rules themselves are what an operator debugging a wedged
+        # recovery needs to SEE)
+        rules = self.ms.injector.list_rules()
+        if rules:
+            out["net_faults"] = rules
         if self.mesh_plane is not None:
             out["mesh_plane"] = dict(self.mesh_plane.stats)
         return out
@@ -1136,9 +1180,12 @@ class OSDDaemon(Dispatcher):
                    "stop the jax.profiler trace and flush it to disk")
         a.register("status",
                    lambda _c: {"whoami": self.whoami, "up": self.up,
+                               "booted": self.osdmap.is_up(self.whoami),
                                "epoch": self.osdmap.epoch,
                                "num_pgs": len(self.backends)},
                    "daemon status")
+        from ..msg.messenger import register_netfault_commands
+        register_netfault_commands(a, self.ms)
         a.start()
         self.admin_socket = a
 
@@ -1169,6 +1216,8 @@ class OSDDaemon(Dispatcher):
                 await asyncio.sleep(0.01)
         if self._beacon_task:
             self._beacon_task.cancel()
+        if self._reboot_task:
+            self._reboot_task.cancel()
         if self._agent_task:
             self._agent_task.cancel()
         if self._scrub_task:

@@ -4,6 +4,15 @@ Reference: src/vstart.sh + qa/standalone/ceph-helpers.sh: spin real
 mon/osd PROCESSES on localhost with throwaway data dirs, so tests cover
 real sockets, real process death (kill -9), and restart-from-disk —
 the regimes the in-process MiniCluster cannot reach.
+
+Readiness: the daemons print a ``{"ready": true}`` line after init,
+but "printed ready" and "actually serving" are not the same instant —
+thrash tests racing a reviving OSD's boot saw phantom failures.  Every
+start now also polls the daemon's admin socket (``status``) until it
+answers — and, for OSDs, until the map shows the OSD booted — within a
+deadline.  The admin sockets double as the nemesis control plane:
+``admin()`` drives ``injectnetfault`` on live daemons
+(tools/proc_chaos.py).
 """
 
 from __future__ import annotations
@@ -32,7 +41,8 @@ class ProcCluster:
     """Launch/kill/revive mon+osd subprocesses."""
 
     def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
-                 options: "Optional[List[str]]" = None) -> None:
+                 options: "Optional[List[str]]" = None,
+                 asok: bool = True) -> None:
         self.base_dir = base_dir
         self.options = list(options or [])
         self.mon_addrs: "Dict[int, str]" = {
@@ -40,15 +50,59 @@ class ProcCluster:
         self.n_osds = n_osds
         self.procs: "Dict[str, subprocess.Popen]" = {}
         self.osd_logs: "Dict[str, object]" = {}
+        # admin sockets under base_dir: readiness polls + the
+        # injectnetfault nemesis control plane ride them
+        self.asok_dir = os.path.join(base_dir, "asok") if asok else ""
 
     @property
     def mon_spec(self) -> str:
         return ",".join(f"{r}={a}" for r, a in self.mon_addrs.items())
 
+    def asok_path(self, name: str) -> str:
+        """Admin-socket path for a daemon ('mon.0', 'osd.3')."""
+        if not self.asok_dir:
+            raise RuntimeError("cluster started without admin sockets")
+        return os.path.join(self.asok_dir, f"{name}.asok")
+
+    def admin(self, name: str, prefix: str, timeout: float = 5.0,
+              **args) -> dict:
+        """Run an admin-socket command on a live daemon."""
+        from ..common.admin_socket import admin_command
+        return admin_command(self.asok_path(name), prefix,
+                             timeout=timeout, **args)
+
+    def _wait_ready(self, name: str, deadline: float) -> None:
+        """Poll the daemon's admin socket until it serves requests —
+        and, for OSDs, until the mon has acknowledged its boot (the
+        map shows it up).  Without this, revive_osd returns while the
+        OSD is still announcing itself and a thrash test's next kill
+        races the boot."""
+        if not self.asok_dir:
+            return
+        from ..common.admin_socket import AdminSocketError
+        last: "Optional[Exception]" = None
+        while time.monotonic() < deadline:
+            proc = self.procs.get(name)
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(f"{name} died while becoming ready")
+            try:
+                st = self.admin(name, "status", timeout=2.0)
+                if not name.startswith("osd.") or st.get("booted"):
+                    return
+                last = RuntimeError(f"{name} serving but not booted "
+                                    f"into the map yet")
+            except (OSError, AdminSocketError, RuntimeError) as e:
+                last = e
+            time.sleep(0.1)
+        raise RuntimeError(f"{name} not serving before deadline: {last}")
+
     def _spawn(self, name: str, argv: "List[str]",
                timeout: float = 30.0) -> dict:
         log = open(os.path.join(self.base_dir, f"{name}.log"), "ab")
         self.osd_logs[name] = log
+        if self.asok_dir:
+            os.makedirs(self.asok_dir, exist_ok=True)
+            argv = [*argv, "--asok", self.asok_dir]
         proc = subprocess.Popen(
             [sys.executable, DAEMON, *argv],
             stdout=subprocess.PIPE, stderr=log, text=True)
@@ -72,6 +126,7 @@ class ProcCluster:
             raise RuntimeError(f"{name} boot timeout after {timeout}s")
         info = json.loads(line)
         assert info.get("ready"), info
+        self._wait_ready(name, deadline)
         return info
 
     def start(self) -> None:
@@ -80,13 +135,41 @@ class ProcCluster:
             self._spawn(f"mon.{r}", [
                 "mon", "--rank", str(r), "--mon-addrs", self.mon_spec,
                 *sum((["-o", o] for o in self.options), [])])
+        self.wait_for_quorum()
         for i in range(self.n_osds):
             self.start_osd(i)
+
+    def wait_for_quorum(self, timeout: float = 30.0) -> None:
+        """Block until some mon reports an elected leader.  Polling a
+        single mon for a leader DURING start() would deadlock (rank 0
+        cannot win an election before a majority exists), so this runs
+        once after every mon is serving."""
+        if not self.asok_dir:
+            return
+        from ..common.admin_socket import AdminSocketError
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for r in self.mon_addrs:
+                try:
+                    st = self.admin(f"mon.{r}", "status", timeout=2.0)
+                except (OSError, AdminSocketError, RuntimeError):
+                    continue
+                if st.get("leader") is not None:
+                    return
+            time.sleep(0.1)
+        raise RuntimeError(f"no mon quorum within {timeout}s")
 
     def start_osd(self, osd_id: int) -> dict:
         return self._spawn(f"osd.{osd_id}", [
             "osd", "--id", str(osd_id), "--mon-addrs", self.mon_spec,
             "--data", os.path.join(self.base_dir, f"osd.{osd_id}"),
+            *sum((["-o", o] for o in self.options), [])])
+
+    def start_mon(self, rank: int) -> dict:
+        """(Re)spawn one mon at its original address (leader-kill
+        recovery; mon state rebuilds from its peers' paxos log)."""
+        return self._spawn(f"mon.{rank}", [
+            "mon", "--rank", str(rank), "--mon-addrs", self.mon_spec,
             *sum((["-o", o] for o in self.options), [])])
 
     def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
@@ -95,6 +178,14 @@ class ProcCluster:
         if proc is not None:
             proc.send_signal(sig)
             proc.wait(timeout=10)
+        if self.asok_dir:
+            # a SIGKILLed daemon leaves its socket file behind; remove
+            # it so a readiness poll after revive can't connect to the
+            # dead incarnation's stale path state
+            try:
+                os.unlink(self.asok_path(name))
+            except OSError:
+                pass
 
     def revive_osd(self, osd_id: int) -> dict:
         """Respawn against the same data dir (restart-from-disk)."""

@@ -86,6 +86,20 @@ if [ "$lg_rc" -ne 0 ]; then
     exit "$lg_rc"
 fi
 
+echo "== proc_chaos smoke (tools/proc_chaos.py) =="
+# one bounded nemesis round against a REAL-process cluster (mon/osd
+# subprocesses over tcp): SIGKILL an acting-set OSD mid-write, heal,
+# then gate on reconvergence, readback (every surviving value must be
+# one the client was told about) and the WGL linearizability audit of
+# the recorded client op history.  A failing seed prints its exact
+# PROC_CHAOS_SEED=... reproduce line.
+env JAX_PLATFORMS=cpu python tools/proc_chaos.py --smoke
+pchaos_rc=$?
+if [ "$pchaos_rc" -ne 0 ]; then
+    echo "proc_chaos smoke FAILED (exit $pchaos_rc)"
+    exit "$pchaos_rc"
+fi
+
 echo "== tier-1 tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

@@ -177,6 +177,127 @@ class TestTcp:
         run(main())
 
 
+class TestNetFaultRules:
+    """Per-link fault table (injectnetfault): the proc_chaos nemesis
+    control plane.  Rules are runtime-settable, directed, and counted;
+    every trip shows in net_stats."""
+
+    def test_one_shot_recv_kill_never_loses_lossless_message(self):
+        """The hardest in-flight instant: the frame was READ off the
+        socket but not yet delivered when the session dies.  A one-shot
+        in-dir kill rule (count=1) pins exactly that point.  The
+        lossless contract must hold: the sender replays on reconnect,
+        seq dedup suppresses any duplicate, and the message arrives
+        exactly once."""
+        async def main():
+            scfg = make_config()
+            server = Messenger.create("osd.0", scfg)
+            coll = Collector()
+            server.add_dispatcher(coll)
+            await server.bind("127.0.0.1:0")
+            rule = server.injector.set_rule(
+                {"peer": "*", "dir": "in", "kind": "kill", "count": 1})
+            ccfg = make_config(ms_initial_backoff=0.02,
+                               ms_max_backoff=0.1)
+            client = Messenger.create("osd.1", ccfg)
+            conn = client.get_connection(server.listen_addr)
+            await conn.send_message(MTest({"n": 1}, b"must-arrive"))
+            await wait_for(lambda: coll.received, 10)
+            await asyncio.sleep(0.2)   # window for a duplicate to land
+            assert [m["n"] for m in coll.received] == [1]
+            assert coll.received[0].data == b"must-arrive"
+            # the one-shot rule expired at its count...
+            assert rule["id"] not in {r["id"]
+                                      for r in server.injector.list_rules()}
+            # ...and the trip, the reconnect, and the replay all show
+            # in the counters the Prometheus schema freezes
+            assert server.net_stats["net_fault_trips"] == 1
+            assert server.net_stats["net_faults_active"] == 0
+            assert client.net_stats["ms_reconnects"] >= 1
+            assert client.net_stats["ms_replayed_frames"] >= 1
+            await client.shutdown()
+            await server.shutdown()
+
+        run(main())
+
+    def test_partition_raises_then_heals(self):
+        """An out-dir partition blackholes the link at the sender with
+        a visible ConnectionError (the failure-report trigger), and
+        clearing the rule heals the same session."""
+        async def main():
+            cfg = make_config()
+            server = Messenger.create("osd.0", cfg)
+            coll = Collector()
+            server.add_dispatcher(coll)
+            await server.bind("127.0.0.1:0")
+            client = Messenger.create("osd.1", make_config())
+            conn = client.get_connection(server.listen_addr)
+            await conn.send_message(MTest({"n": 1}))
+            await wait_for(lambda: coll.received)
+            client.injector.set_rule(
+                {"peer": "*", "dir": "out", "kind": "partition"})
+            with pytest.raises(ConnectionError):
+                await conn.send_message(MTest({"n": 2}))
+            client.injector.clear_rules()
+            await conn.send_message(MTest({"n": 3}))
+            await wait_for(lambda: len(coll.received) == 2)
+            # the partitioned send was refused, not silently queued
+            assert [m["n"] for m in coll.received] == [1, 3]
+            await client.shutdown()
+            await server.shutdown()
+
+        run(main())
+
+    def test_refuse_blocks_new_sessions_until_cleared(self):
+        async def main():
+            cfg = make_config()
+            server = Messenger.create("osd.0", cfg)
+            coll = Collector()
+            server.add_dispatcher(coll)
+            await server.bind("127.0.0.1:0")
+            server.injector.set_rule(
+                {"peer": "*", "dir": "in", "kind": "refuse"})
+            from ceph_tpu.msg.messenger import Policy
+            client = Messenger.create("client.1", make_config(
+                ms_initial_backoff=0.01, ms_max_backoff=0.05))
+            conn = client.get_connection(server.listen_addr,
+                                         Policy.lossy_client())
+            with pytest.raises(ConnectionError):
+                for _ in range(200):
+                    await conn.send_message(MTest({"n": 0}))
+                    await asyncio.sleep(0.02)
+            assert coll.received == []
+            server.injector.clear_rules()
+            client2 = Messenger.create("client.2", make_config())
+            conn2 = client2.get_connection(server.listen_addr)
+            await conn2.send_message(MTest({"n": 5}))
+            await wait_for(lambda: coll.received)
+            assert coll.received[0]["n"] == 5
+            await client.shutdown()
+            await client2.shutdown()
+            await server.shutdown()
+
+        run(main())
+
+    def test_reconnect_backoff_equal_jitter_bounds(self):
+        """ms_initial_backoff/ms_max_backoff: capped equal-jitter —
+        every delay lands in [bound/2, bound] with bound doubling up to
+        the cap (a healing fleet must not stampede in lockstep)."""
+        async def main():
+            cfg = make_config(ms_initial_backoff=0.1, ms_max_backoff=1.0)
+            client = Messenger.create("client.1", cfg)
+            conn = client.get_connection("127.0.0.1:1")
+            for attempt in range(12):
+                bound = min(1.0, 0.1 * (2 ** attempt))
+                for _ in range(16):
+                    d = conn._reconnect_delay(attempt)
+                    assert bound / 2 <= d <= bound, (attempt, d)
+            conn.mark_down()
+            await client.shutdown()
+
+        run(main())
+
+
 class TestLocalTransport:
     def test_roundtrip_and_injection(self):
         async def main():

@@ -49,6 +49,150 @@ async def make_pool(client, name="p", k=2, m=2):
     await client.monc.wait_for_map()
 
 
+def _acting_for(client, pool_name, oid):
+    pool = client.osdmap.pool_by_name(pool_name)
+    pg = client.osdmap.object_to_pg(pool.pool_id, oid)
+    _up, acting = client.osdmap.pg_to_up_acting_osds(pool.pool_id, pg)
+    return acting
+
+
+def test_partition_and_kill9_midwrite_linearizable(tmp_path, loop):
+    """Partition the primary from one shard AND kill -9 another shard
+    while writes are in flight, heal, and audit the full client op
+    history with tools/cephsan/linearize.py: whatever the outcome of
+    each interrupted write, the history must stay linearizable and the
+    final value must be one the client was told about."""
+    from ceph_tpu.common import history as history_mod
+    from tools.cephsan import linearize
+
+    async def go():
+        with ProcCluster(str(tmp_path), n_mons=1, n_osds=5,
+                         options=["osd_heartbeat_grace=2.0"]) as pc:
+            cfg = Config()
+            cfg.set("ms_type", "async+tcp")
+            cfg.set("client_history_record", "-")
+            cfg.set("rados_osd_op_timeout", 2.0)
+            client = RadosClient(None, name="client.qa", config=cfg,
+                                 mon_addrs=dict(pc.mon_addrs))
+            await client.connect("127.0.0.1:0")
+            await make_pool(client)
+            io = client.io_ctx("p")
+            acked, unknown = None, []
+            await io.write_full("obj", payload(2000, 0))
+            acked = payload(2000, 0)
+
+            acting = _acting_for(client, "p", "obj")
+            primary, cut, dead = acting[0], acting[1], acting[2]
+            # sever primary -> one shard (failure-report path) and
+            # kill -9 another shard outright
+            pc.admin(f"osd.{primary}", "injectnetfault set",
+                     peer=f"osd.{cut}", dir="out", kind="partition")
+            pc.kill(f"osd.{dead}")
+            from ceph_tpu.client.objecter import ObjecterError
+            for seed in range(1, 6):
+                data = payload(2000 + seed, seed)
+                try:
+                    await asyncio.wait_for(
+                        asyncio.shield(io.write_full("obj", data)), 4.0)
+                    acked = data
+                except (asyncio.TimeoutError, ConnectionError, OSError,
+                        ObjecterError):
+                    unknown.append(data)
+
+            # heal: clear the rule, revive the dead shard, reconverge
+            pc.admin(f"osd.{primary}", "injectnetfault clear")
+            pc.revive_osd(dead)
+            for _ in range(300):
+                await asyncio.sleep(0.1)
+                if all(client.osdmap.is_up(o) for o in acting):
+                    break
+            # the healed link may still be riding out reconnect
+            # backoff; keep writing until one lands
+            data = payload(9000, 99)
+            for _ in range(20):
+                try:
+                    await io.write_full("obj", data)
+                    break
+                except (ObjecterError, ConnectionError, OSError):
+                    unknown.append(data)
+                    await asyncio.sleep(1.0)
+            else:
+                raise AssertionError("no write succeeded after heal")
+            acked = data
+            got = await io.read("obj")
+            assert got == acked or any(got == u for u in unknown), \
+                "read returned a value the client was never told about"
+
+            rec = history_mod.installed()
+            assert rec is not None, "client_history_record never armed"
+            res = linearize.check(rec.to_history())
+            assert res["linearizable"], res["violations"][:3]
+            await client.shutdown()
+            history_mod.uninstall()
+    loop.run_until_complete(go())
+
+
+def test_oneway_partition_marks_down_via_failure_report(tmp_path, loop):
+    """A one-way partition (primary can't reach one shard, the shard
+    still beacons the mon) must get the shard marked down through the
+    primary's failure report — beacon-grace silence can never fire
+    here (grace is set to 60s), so the report path is the only one."""
+    async def go():
+        with ProcCluster(str(tmp_path), n_mons=1, n_osds=5,
+                         options=["osd_heartbeat_grace=60.0"]) as pc:
+            client = await tcp_client(pc)
+            await make_pool(client)
+            io = client.io_ctx("p")
+            await io.write_full("obj", payload(3000, 1))
+            acting = _acting_for(client, "p", "obj")
+            primary, victim = acting[0], acting[1]
+            pc.admin(f"osd.{primary}", "injectnetfault set",
+                     peer=f"osd.{victim}", dir="out", kind="partition")
+            st = pc.admin(f"osd.{primary}", "injectnetfault list")
+            assert st["rules"] and st["stats"]["net_faults_active"] == 1
+
+            from ceph_tpu.client.objecter import ObjecterError
+
+            async def hammer():
+                # traffic is what turns the blackhole into a report
+                for seed in range(2, 40):
+                    try:
+                        await asyncio.wait_for(
+                            asyncio.shield(io.write_full(
+                                "obj", payload(1000, seed))), 2.0)
+                    except (asyncio.TimeoutError, ConnectionError,
+                            OSError, ObjecterError):
+                        pass
+                    if not client.osdmap.is_up(victim):
+                        return
+
+            await asyncio.wait_for(hammer(), 30.0)
+            assert not client.osdmap.is_up(victim), \
+                "one-way partition never produced a failure-report " \
+                "mark_down"
+            # the victim process itself never died
+            assert pc.procs[f"osd.{victim}"].poll() is None
+            pc.admin(f"osd.{primary}", "injectnetfault clear")
+            for _ in range(300):
+                await asyncio.sleep(0.1)
+                if client.osdmap.is_up(victim):
+                    break
+            assert client.osdmap.is_up(victim), \
+                "victim never rejoined after the heal"
+            data = payload(4000, 77)
+            for _ in range(20):
+                try:
+                    await io.write_full("obj", data)
+                    break
+                except (ObjecterError, ConnectionError, OSError):
+                    await asyncio.sleep(1.0)
+            else:
+                raise AssertionError("no write succeeded after heal")
+            assert await io.read("obj") == data
+            await client.shutdown()
+    loop.run_until_complete(go())
+
+
 def test_process_cluster_round_trip_and_kill9(tmp_path, loop):
     async def go():
         with ProcCluster(str(tmp_path), n_mons=1, n_osds=5,

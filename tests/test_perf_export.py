@@ -301,6 +301,11 @@ REQUIRED_PERF_COUNTERS = {
     # segment-cache hit rate (process-wide, snapshotted per daemon)
     "buffer": {"bytes_copied", "copy_calls",
                "crc_cache_hits", "crc_cache_misses"},
+    # link-fault + session telemetry (PR 17): injectnetfault rule gauge
+    # and trip counter, lossless reconnect/replay counters — the
+    # partition-drill observability surface
+    "msgr_net": {"net_faults_active", "net_fault_trips",
+                 "ms_reconnects", "ms_replayed_frames"},
 }
 
 REQUIRED_PROM_SERIES = {
@@ -336,6 +341,12 @@ REQUIRED_PROM_SERIES = {
     "ceph_loop_lag_ms_bucket", "ceph_loop_lag_ms_count",
     "ceph_daemon_cpu_attribution_bucket",
     "ceph_daemon_cpu_attribution_sum",
+    # link-fault + session telemetry (PR 17): active-rule gauge (a
+    # non-zero value outside a drill is an alert), fault trips, and
+    # the lossless reconnect/replay counters — the grafana partition
+    # panel
+    "ceph_net_faults_active", "ceph_net_fault_trips",
+    "ceph_ms_reconnects", "ceph_ms_replayed_frames",
 }
 
 

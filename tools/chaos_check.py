@@ -368,6 +368,13 @@ def main(argv=None) -> int:
                          "boundaries, seeds derived from --seed) with "
                          "the linearizability gate; composes with "
                          "--sanitize and --pipeline-pass")
+    ap.add_argument("--proc", type=int, default=0, metavar="N",
+                    help="after the chaos rounds, run N multi-process "
+                         "nemesis rounds (tools/proc_chaos.py: real "
+                         "mon/osd processes over tcp, link-level "
+                         "injectnetfault rules, readback + "
+                         "linearizability gates; seeds derived from "
+                         "--seed)")
     args = ap.parse_args(argv)
     if args.sanitize:
         from ceph_tpu.common import sanitizer
@@ -435,6 +442,8 @@ def main(argv=None) -> int:
                 run_chaos(b))
         if args.explore > 0 and rc == 0:
             rc = _explore_leg(args)
+        if args.proc > 0 and rc == 0:
+            rc = _proc_leg(args)
         return rc
     except Exception:  # noqa: BLE001 — harness error, not a data verdict
         traceback.print_exc()
@@ -459,6 +468,25 @@ def _explore_leg(args) -> int:
         print("chaos_check: cephmc explore leg FAILED "
               "(non-linearizable history or harness error)",
               file=sys.stderr)
+    return rc
+
+
+def _proc_leg(args) -> int:
+    """proc_chaos leg: N nemesis rounds against a REAL-process cluster
+    (tools/proc_chaos.py — mon/osd subprocesses over tcp, admin-socket
+    driven injectnetfault rules), seeds derived from --seed so the
+    chaos invocation replays end to end; a failing round prints its
+    own PROC_CHAOS_SEED reproduce line."""
+    from tools import proc_chaos
+    base = args.seed * 31 + 1
+    print(f"== proc_chaos leg ({args.proc} nemesis round(s), "
+          f"base seed {base}) ==")
+    rc = proc_chaos.main(["--rounds", str(args.proc),
+                          "--seed", str(base)])
+    if rc != 0:
+        print("chaos_check: proc_chaos leg FAILED (lost write, "
+              "non-linearizable history, failed reconvergence, or "
+              "harness error)", file=sys.stderr)
     return rc
 
 

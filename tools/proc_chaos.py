@@ -1,0 +1,478 @@
+#!/usr/bin/env python
+"""proc_chaos — Jepsen-style nemesis harness over a real-process cluster.
+
+Where tools/chaos_check.py thrashes the in-process MiniCluster, this
+drives a qa/vstart.py ProcCluster: real mon+osd processes on real
+sockets, so the faults are the real ones — SIGKILL and restart from
+disk, mon leader death, and link-level partitions staged through the
+daemons' `injectnetfault` admin verbs (the messenger fault table).
+
+Each seeded round runs a concurrent write workload against an EC pool
+while one nemesis fires, then heals and checks three gates:
+
+- RECONVERGE: every OSD is back up-and-booted and a mon leader exists
+  within ``--bound`` seconds of the heal;
+- READBACK: every object reads back a value the client was actually
+  told about — the last acknowledged write, or a write whose outcome
+  was unknown (timed out / connection error mid-round).  Anything else
+  is a lost or duplicated write;
+- LINEARIZE: the full client op history (common/history.py, armed via
+  ``client_history_record``) passes tools/cephsan/linearize.py against
+  the sequential object model.
+
+Nemeses (rotating; ``--nemesis`` forces one):
+
+  kill_osd           SIGKILL an acting-set OSD mid-write, restart from disk
+  kill_mon_leader    SIGKILL the mon quorum leader, restart it
+  partition_primary  blackhole the primary <-> its shard peers (both ways)
+  isolate_client     blackhole the client <-> the primary
+  oneway_partition   primary -> shard blackhole ONE WAY; gate: the mon
+                     must mark the shard down via the primary's failure
+                     report (not beacon silence)
+  slow_recovery      kill + revive an OSD with delay rules on the links
+                     it recovers over
+
+A failing round prints a reproduce line; the seed fully determines the
+round's nemesis and workload:
+
+  PROC_CHAOS_SEED=<seed> python tools/proc_chaos.py --rounds 1
+
+Exit codes: 0 = all gates pass; 1 = gate violation; 2 = harness error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import random
+import shutil
+import signal
+import sys
+import tempfile
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.common import history as history_mod  # noqa: E402
+from ceph_tpu.client.objecter import ObjecterError  # noqa: E402
+from ceph_tpu.client.rados import RadosClient  # noqa: E402
+from ceph_tpu.qa.vstart import ProcCluster  # noqa: E402
+from tools.cephsan import linearize  # noqa: E402
+
+# every way a client op can end without an ack: the op's outcome is
+# UNKNOWN (it may still have applied), never "didn't happen"
+OP_ERRORS = (asyncio.TimeoutError, ConnectionError, OSError,
+             ObjecterError)
+
+NEMESES = ("kill_osd", "kill_mon_leader", "partition_primary",
+           "isolate_client", "oneway_partition", "slow_recovery")
+
+
+class GateFailure(Exception):
+    pass
+
+
+class _Round:
+    """One nemesis round: cluster handles + the per-object write model."""
+
+    def __init__(self, args, rseed: int, base_dir: str) -> None:
+        self.args = args
+        self.rseed = rseed
+        self.base_dir = base_dir
+        self.rng = random.Random(rseed)
+        self.pc: "ProcCluster|None" = None
+        self.client: "RadosClient|None" = None
+        self.io = None
+        self.objects = [f"obj{i}" for i in range(args.objects)]
+        # oid -> {"acked": bytes|None, "unknown": [bytes, ...]}
+        self.model = {o: {"acked": None, "unknown": []}
+                      for o in self.objects}
+        self.stragglers: "list[asyncio.Task]" = []
+        self.notes: "list[str]" = []
+
+    # --- blocking cluster calls off the client loop -----------------------
+
+    async def _bg(self, fn, *a, **kw):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: fn(*a, **kw))
+
+    async def admin(self, name: str, prefix: str, **kw) -> dict:
+        return await self._bg(self.pc.admin, name, prefix, **kw)
+
+    # --- workload ---------------------------------------------------------
+
+    def _payload(self, oid: str, seq: int) -> bytes:
+        # string seed: random.Random hashes it stably (str.__hash__ is
+        # per-process randomized and would break the reproduce line)
+        rng = random.Random(f"{self.rseed}:{oid}:{seq}")
+        n = rng.randrange(512, 4096)
+        return bytes(rng.getrandbits(8) for _ in range(64)) * (n // 64)
+
+    async def _worker(self, oid: str, stop: asyncio.Event) -> None:
+        st = self.model[oid]
+        rng = random.Random(f"{self.rseed}:{oid}")
+        seq = 0
+        while not stop.is_set():
+            seq += 1
+            data = self._payload(oid, seq)
+            task = asyncio.ensure_future(self.io.write_full(oid, data))
+            try:
+                # shield: on timeout the write stays in flight with an
+                # UNKNOWN outcome — it may still land (even after later
+                # acked writes), so unknowns accumulate for the round
+                # instead of being cleared by the next ack
+                await asyncio.wait_for(asyncio.shield(task),
+                                       self.args.op_timeout)
+                st["acked"] = data
+            except asyncio.TimeoutError:
+                st["unknown"].append(data)
+                self.stragglers.append(task)
+            except OP_ERRORS as e:
+                st["unknown"].append(data)
+                self.notes.append(f"{oid} write {seq}: {e}")
+            if rng.random() < 0.3:
+                rtask = asyncio.ensure_future(self.io.read(oid))
+                try:
+                    # result intentionally unchecked here: the recorded
+                    # read is judged by the linearizability gate, which
+                    # knows what values were legal at that instant
+                    await asyncio.wait_for(asyncio.shield(rtask),
+                                           self.args.op_timeout)
+                except asyncio.TimeoutError:
+                    self.stragglers.append(rtask)
+                except OP_ERRORS:
+                    pass
+            await asyncio.sleep(rng.uniform(0.02, 0.08))
+
+    # --- topology helpers -------------------------------------------------
+
+    def _acting(self, oid: str) -> "list[int]":
+        pool = self.client.osdmap.pool_by_name(self.args.pool)
+        pg = self.client.osdmap.object_to_pg(pool.pool_id, oid)
+        _up, acting = self.client.osdmap.pg_to_up_acting_osds(
+            pool.pool_id, pg)
+        return [o for o in acting if o is not None and o >= 0]
+
+    async def _find_mon_leader(self) -> "int|None":
+        for r in self.pc.mon_addrs:
+            try:
+                st = await self.admin(f"mon.{r}", "status")
+            except Exception:
+                continue
+            if st.get("rank") == st.get("leader"):
+                return r
+        return None
+
+    async def _wait(self, what: str, pred, bound: float) -> None:
+        deadline = time.monotonic() + bound
+        while time.monotonic() < deadline:
+            if await pred():
+                return
+            await asyncio.sleep(0.25)
+        raise GateFailure(f"timed out after {bound:.0f}s waiting: {what}")
+
+    # --- nemeses ----------------------------------------------------------
+
+    async def _hold(self) -> None:
+        await asyncio.sleep(self.args.hold)
+
+    async def nem_kill_osd(self) -> None:
+        victim = self.rng.choice(self._acting(self.objects[0]))
+        self._log(f"nemesis: SIGKILL osd.{victim} mid-write")
+        await self._bg(self.pc.kill, f"osd.{victim}")
+        await self._hold()
+        self._log(f"heal: restart osd.{victim} from disk")
+        await self._bg(self.pc.revive_osd, victim)
+
+    async def nem_kill_mon_leader(self) -> None:
+        leader = await self._find_mon_leader()
+        if leader is None:
+            raise GateFailure("no mon leader to kill")
+        self._log(f"nemesis: SIGKILL mon quorum leader mon.{leader}")
+        await self._bg(self.pc.kill, f"mon.{leader}")
+        await self._hold()
+        self._log(f"heal: restart mon.{leader}")
+        await self._bg(self.pc.start_mon, leader)
+
+    async def nem_partition_primary(self) -> None:
+        acting = self._acting(self.objects[0])
+        primary, shards = acting[0], acting[1:]
+        self._log(f"nemesis: partition osd.{primary} (primary) from "
+                  f"shards {shards}, both directions")
+        for s in shards:
+            await self.admin(f"osd.{primary}", "injectnetfault set",
+                             peer=f"osd.{s}", dir="both",
+                             kind="partition")
+        await self._hold()
+        self._log(f"heal: clear fault rules on osd.{primary}")
+        await self.admin(f"osd.{primary}", "injectnetfault clear")
+
+    async def nem_isolate_client(self) -> None:
+        primary = self._acting(self.objects[0])[0]
+        self._log(f"nemesis: isolate client from primary osd.{primary}")
+        self.client.ms.injector.set_rule({
+            "peer": f"osd.{primary}", "dir": "both", "kind": "partition"})
+        await self._hold()
+        self._log("heal: clear client fault rules")
+        self.client.ms.injector.clear_rules()
+
+    async def nem_oneway_partition(self) -> None:
+        acting = self._acting(self.objects[0])
+        primary, victim = acting[0], acting[1]
+        self._log(f"nemesis: one-way blackhole osd.{primary} -> "
+                  f"osd.{victim} (sub-writes fail, replies still flow)")
+        await self.admin(f"osd.{primary}", "injectnetfault set",
+                         peer=f"osd.{victim}", dir="out",
+                         kind="partition")
+        # the asymmetry gate: the victim still beacons the mon, so the
+        # ONLY legal path to a mark-down is the primary's failure report
+        await self._wait(
+            f"failure-report mark_down of osd.{victim}",
+            lambda: self._is_down(victim), self.args.bound)
+        self._log(f"gate: osd.{victim} marked down by failure report")
+        self._log(f"heal: clear fault rules on osd.{primary}")
+        await self.admin(f"osd.{primary}", "injectnetfault clear")
+
+    async def _is_down(self, osd: int) -> bool:
+        return not self.client.osdmap.is_up(osd)
+
+    async def nem_slow_recovery(self) -> None:
+        acting = self._acting(self.objects[0])
+        victim, peers = acting[0], acting[1:]
+        self._log(f"nemesis: SIGKILL osd.{victim}; revive with slow "
+                  f"links from {peers}")
+        await self._bg(self.pc.kill, f"osd.{victim}")
+        await asyncio.sleep(1.0)
+        for p in peers:
+            await self.admin(f"osd.{p}", "injectnetfault set",
+                             peer=f"osd.{victim}", dir="both",
+                             kind="delay", delay=0.03, jitter=0.04)
+        await self._bg(self.pc.revive_osd, victim)
+        await self._hold()
+        self._log("heal: clear delay rules")
+        for p in peers:
+            await self.admin(f"osd.{p}", "injectnetfault clear")
+
+    # --- gates ------------------------------------------------------------
+
+    async def gate_reconverge(self) -> None:
+        async def all_up() -> bool:
+            if await self._find_mon_leader() is None:
+                return False
+            for i in range(self.args.osds):
+                try:
+                    st = await self.admin(f"osd.{i}", "status")
+                except Exception:
+                    return False
+                if not st.get("booted"):
+                    return False
+            return True
+        await self._wait("cluster reconvergence (all OSDs up+booted, "
+                         "mon leader elected)", all_up, self.args.bound)
+        self._log("gate: reconverged")
+
+    async def gate_readback(self) -> None:
+        deadline = time.monotonic() + self.args.bound
+        for oid in self.objects:
+            st = self.model[oid]
+            if st["acked"] is None and not st["unknown"]:
+                continue
+            got = None
+            while time.monotonic() < deadline:
+                try:
+                    got = await asyncio.wait_for(
+                        self.io.read(oid), self.args.op_timeout)
+                    break
+                except OP_ERRORS:
+                    await asyncio.sleep(0.5)
+            if got is None:
+                raise GateFailure(f"readback: {oid} unreadable after "
+                                  f"heal")
+            candidates = ([st["acked"]] if st["acked"] is not None
+                          else []) + st["unknown"]
+            # an empty-never-written object may legally read as absent
+            if st["acked"] is None:
+                candidates.append(b"")
+            if not any(got == c for c in candidates):
+                raise GateFailure(
+                    f"readback: {oid} holds a value the client never "
+                    f"wrote or a lost write ({len(got)}B, acked "
+                    f"{len(st['acked']) if st['acked'] is not None else 'none'}B, "
+                    f"{len(st['unknown'])} unknown-outcome writes)")
+        self._log("gate: readback clean")
+
+    def gate_linearize(self) -> None:
+        rec = history_mod.installed()
+        if rec is None:
+            raise GateFailure("history recorder never armed")
+        res = linearize.check(rec.to_history())
+        if not res.get("linearizable", False):
+            vio = res.get("violations") or []
+            raise GateFailure(
+                f"history NOT linearizable: {len(vio)} violation(s); "
+                f"first: {vio[0] if vio else '?'}")
+        self._log(f"gate: linearizable ({res.get('checked')} object(s) "
+                  f"checked, {res.get('skipped')} skipped)")
+
+    # --- round driver -----------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        print(f"  [{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+    async def run(self, nemesis: str) -> None:
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.pc = ProcCluster(
+            self.base_dir, n_mons=self.args.mons, n_osds=self.args.osds,
+            options=["osd_heartbeat_grace=2.0"])
+        await self._bg(self.pc.start)
+        cfg = Config()
+        cfg.set("ms_type", "async+tcp")
+        cfg.set("client_history_record", "-")
+        cfg.set("rados_osd_op_timeout", 2.0)
+        self.client = RadosClient(None, name="client.chaos", config=cfg,
+                                  mon_addrs=dict(self.pc.mon_addrs))
+        await self.client.connect("127.0.0.1:0")
+        await self.client.mon_command({
+            "prefix": "osd erasure-code-profile set",
+            "name": "chaos-prof",
+            "profile": {"plugin": "jax_rs", "k": "2", "m": "2"}})
+        await self.client.mon_command({
+            "prefix": "osd pool create", "name": self.args.pool,
+            "kwargs": {"type": "erasure", "pg_num": 2,
+                       "ec_profile": "chaos-prof", "stripe_unit": 256}})
+        await self.client.monc.wait_for_map()
+        self.io = self.client.io_ctx(self.args.pool)
+
+        stop = asyncio.Event()
+        workers = [asyncio.ensure_future(self._worker(o, stop))
+                   for o in self.objects]
+        try:
+            await asyncio.sleep(1.0)         # seed some pre-fault state
+            await getattr(self, f"nem_{nemesis}")()
+            await self.gate_reconverge()
+            await asyncio.sleep(1.0)         # post-heal writes on record
+        finally:
+            stop.set()
+            await asyncio.gather(*workers, return_exceptions=True)
+        if self.stragglers:
+            # give unknown-outcome ops a chance to complete on the
+            # healed cluster so the history carries their real endings
+            await asyncio.wait(self.stragglers, timeout=10.0)
+            for t in self.stragglers:
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*self.stragglers, return_exceptions=True)
+        await self.gate_readback()
+        self.gate_linearize()
+
+    async def teardown(self) -> None:
+        if self.client is not None:
+            try:
+                await asyncio.wait_for(self.client.shutdown(), 15.0)
+            except Exception:
+                pass
+        if self.pc is not None:
+            await self._bg(self.pc.stop)
+        history_mod.uninstall()
+
+
+async def _run_round(args, i: int) -> "tuple[bool, str]":
+    rseed = args.seed + i
+    nemesis = args.nemesis or NEMESES[rseed % len(NEMESES)]
+    base_dir = os.path.join(args.dir, f"round{i}")
+    print(f"round {i}: seed={rseed} nemesis={nemesis} "
+          f"({args.mons} mons, {args.osds} osds)", flush=True)
+    rnd = _Round(args, rseed, base_dir)
+    ok, why = True, ""
+    try:
+        await rnd.run(nemesis)
+    except GateFailure as e:
+        ok, why = False, str(e)
+    finally:
+        await rnd.teardown()
+    if ok:
+        print(f"round {i}: PASS", flush=True)
+        if not args.keep:
+            shutil.rmtree(base_dir, ignore_errors=True)
+    else:
+        print(f"round {i}: FAIL — {why}", flush=True)
+        print(f"  daemon logs kept under {base_dir}", flush=True)
+        print(f"  reproduce: PROC_CHAOS_SEED={rseed} python "
+              f"tools/proc_chaos.py --rounds 1 --mons {args.mons} "
+              f"--osds {args.osds} --objects {args.objects} "
+              f"--hold {args.hold}"
+              + (f" --nemesis {args.nemesis}" if args.nemesis else ""),
+              flush=True)
+    return ok, why
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="nemesis harness over a real-process cluster")
+    p.add_argument("--rounds", type=int, default=6,
+                   help="nemesis rounds (default 6: one full rotation)")
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("PROC_CHAOS_SEED", "1")),
+                   help="base seed; round i uses seed+i "
+                        "(env PROC_CHAOS_SEED)")
+    p.add_argument("--mons", type=int, default=3)
+    p.add_argument("--osds", type=int, default=5)
+    p.add_argument("--objects", type=int, default=4)
+    p.add_argument("--pool", default="chaos")
+    p.add_argument("--hold", type=float, default=4.0,
+                   help="seconds a fault stays injected")
+    p.add_argument("--bound", type=float, default=60.0,
+                   help="reconvergence / gate deadline (seconds)")
+    p.add_argument("--op-timeout", type=float, default=4.0,
+                   help="client-side unknown-outcome cutoff per op")
+    p.add_argument("--nemesis", choices=NEMESES,
+                   help="force one nemesis instead of rotating")
+    p.add_argument("--dir", default="",
+                   help="work dir (default: a fresh temp dir)")
+    p.add_argument("--keep", action="store_true",
+                   help="keep daemon logs/data of passing rounds too")
+    p.add_argument("--smoke", action="store_true",
+                   help="one bounded kill_osd round (CI smoke gate)")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.rounds = 1
+        args.nemesis = args.nemesis or "kill_osd"
+        args.objects = min(args.objects, 2)
+        args.hold = min(args.hold, 2.5)
+    if not args.dir:
+        args.dir = tempfile.mkdtemp(prefix="proc_chaos_")
+    os.makedirs(args.dir, exist_ok=True)
+
+    failures = []
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    try:
+        for i in range(args.rounds):
+            try:
+                ok, why = loop.run_until_complete(_run_round(args, i))
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                traceback.print_exc()
+                print(f"round {i}: harness error", flush=True)
+                return 2
+            if not ok:
+                failures.append((i, why))
+    finally:
+        loop.close()
+    if failures:
+        print(f"proc_chaos: {len(failures)}/{args.rounds} round(s) "
+              f"FAILED", flush=True)
+        return 1
+    print(f"proc_chaos: all {args.rounds} round(s) passed "
+          f"(seed {args.seed})", flush=True)
+    if not args.keep:
+        shutil.rmtree(args.dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
