@@ -25,9 +25,18 @@ from ..msg.messenger import Dispatcher, Messenger
 @register_message
 class MMgrReport(Message):
     """Daemon -> mgr: fields: daemon ("osd.0"), perf (collection dump),
-    status (free-form dict), epoch."""
+    status (free-form dict), epoch.  v2 appends the optional per-PG
+    stats block — ``pg_stats``: {"pool.pg": pg_stat record} for the PGs
+    this daemon is primary of (the pg_stat_t-riding-MPGStats analog).
+
+    Optionals are append-only and pg_stats is advisory — a v1 decoder
+    that skips the unknown optional still applies the perf/status
+    payload correctly, so COMPAT_VERSION stays 1 (unlike the batched
+    sub-write, whose content NEEDS the newer decode semantics)."""
     TYPE = "mgr_report"
-    FIELDS = ("daemon", "perf", "status", "epoch")
+    HEAD_VERSION = 2
+    COMPAT_VERSION = 1
+    FIELDS = ("daemon", "perf", "status", "epoch", "pg_stats?")
     REPLY = None
 
 
@@ -256,6 +265,18 @@ class PrometheusModule(HttpModule):
                                     else "counter")
                             lines.append(f"# TYPE {metric} {kind}")
                         lines.append(f'{metric}{{{label}}} {val}')
+        # cluster accounting series (PGMap): pg-state gauges, per-pool
+        # IO rates, recovery throughput, degraded objects.  getattr:
+        # harnesses render through duck-typed mgr stand-ins without a
+        # module registry.
+        pgmap = getattr(self.mgr, "modules", {}).get("pgmap")
+        if pgmap is not None:
+            lines.extend(pgmap.render_prometheus())
+            progress = self.mgr.modules.get("progress")
+            if progress is not None:
+                lines.append("# TYPE ceph_progress_events_active gauge")
+                lines.append(f"ceph_progress_events_active "
+                             f"{len(progress.dump()['events'])}")
         return "\n".join(lines) + "\n"
 
 
@@ -303,6 +324,9 @@ class MgrDaemon(Dispatcher):
         self.register_module(PrometheusModule)
         from .dashboard import DashboardModule
         from .pg_autoscaler import PgAutoscalerModule
+        from .pgmap import PGMapModule, ProgressModule
+        self.register_module(PGMapModule)
+        self.register_module(ProgressModule)
         self.register_module(PgAutoscalerModule)
         self.register_module(DashboardModule)
 
@@ -344,23 +368,57 @@ class MgrDaemon(Dispatcher):
                    lambda _c: {"num_reports": len(self.reports),
                                "modules": sorted(self.modules)},
                    "mgr status")
+        # the PGMap surfaces: what 'ceph pg dump / pg stat / df /
+        # osd perf / progress' serve mon-side, straight from the mgr
+        pgmap = self.modules["pgmap"]
+        progress = self.modules["progress"]
+        a.register("pg dump", lambda _c: pgmap.pg_dump(),
+                   "per-PG stats table + summary")
+        a.register("pg stat", lambda _c: pgmap.pg_summary(),
+                   "PG state histogram + degraded totals")
+        a.register("df", lambda _c: pgmap.df(),
+                   "per-pool storage + IO rates")
+        a.register("osd perf", lambda _c: pgmap.osd_perf(),
+                   "per-OSD latency digest")
+        a.register("pool rates", lambda _c: pgmap.pool_io_rates(),
+                   "per-pool client/recovery rates (raw)")
+        a.register("progress", lambda _c: progress.dump(),
+                   "active + recently completed progress events")
         from ..msg.messenger import register_netfault_commands
         register_netfault_commands(a, self.ms)
         a.start()
         self.admin_socket = a
 
     async def _tick_loop(self) -> None:
-        """Periodic module work (reference mgr tick): currently the
-        acting pg_autoscaler's apply pass."""
+        """Periodic module work (reference mgr tick): report expiry,
+        progress-event advancement, the acting pg_autoscaler's apply
+        pass, and the status digest push to the mons."""
         period = float(self.config.get("mgr_stats_period"))
         auto = self.modules.get("pg_autoscaler")
         while True:
             await asyncio.sleep(period)
-            if auto is not None:
-                try:
+            try:
+                # purge on the tick too: with the whole fleet dead no
+                # report ever arrives to trigger the ingest-side purge,
+                # and progress events must still advance/expire
+                self._purge_reports()
+                self.modules["progress"].tick()
+                if auto is not None:
                     await auto.maybe_apply()
-                except Exception as e:  # noqa: BLE001 — keep ticking
-                    dout("mgr", 0, f"mgr tick: {e}")
+                await self._push_digest()
+            except Exception as e:  # noqa: BLE001 — keep ticking
+                dout("mgr", 0, f"mgr tick: {e}")
+
+    async def _push_digest(self) -> None:
+        """Broadcast the PGMap/progress digest to every mon (reference
+        MMonMgrReport -> MgrStatMonitor): volatile per-mon state, so
+        each mon can serve 'ceph status' pgs:/io:/recovery: sections
+        without a paxos round."""
+        if self.monc is None:
+            return
+        digest = self.modules["pgmap"].digest()
+        digest["progress"] = self.modules["progress"].dump()
+        await self.monc.send_mgr_digest(digest)
 
     async def shutdown(self) -> None:
         for t in self._tasks:
@@ -388,20 +446,38 @@ class MgrDaemon(Dispatcher):
         top = self.op_tracker.create(
             f"mgr_report({msg['daemon']})",
             trace_id=f"{msg['daemon']}:{int(msg.get('epoch', 0))}")
-        self.reports[str(msg["daemon"])] = {
-            "ts": time.monotonic(), "perf": dict(msg.get("perf", {})),
+        name = str(msg["daemon"])
+        now = time.monotonic()
+        self.reports[name] = {
+            "ts": now, "perf": dict(msg.get("perf", {})),
             "status": dict(msg.get("status", {})),
             "epoch": int(msg.get("epoch", 0))}
-        # expire long-gone daemons: a decommissioned OSD must not pin
-        # health at WARN or inflate the autoscaler's PG budget forever
-        # (reports older than 60 periods are purged, not just stale)
+        pg_stats = msg.get("pg_stats")
+        if pg_stats:
+            self.modules["pgmap"].ingest(name, dict(pg_stats), now,
+                                         int(msg.get("epoch", 0)))
+            # react between ticks: a degraded spike opens its progress
+            # event on the very report that carried it
+            self.modules["progress"].tick()
+        self._purge_reports()
+        top.finish()
+        return True
+
+    def _purge_reports(self) -> None:
+        """Expire long-gone daemons: a decommissioned OSD must not pin
+        health at WARN or inflate the autoscaler's PG budget forever
+        (reports older than 60 periods are purged, not just stale).
+        The PGMap's forget hook rides along — a purged daemon's rate
+        window and orphaned PG rows die with its report, so 'ceph
+        status' io rates can never freeze at pre-death values."""
         horizon = 60.0 * float(self.config.get("mgr_stats_period"))
         now = time.monotonic()
+        pgmap = self.modules.get("pgmap")
         for name in [n for n, r in self.reports.items()
                      if now - r["ts"] > horizon]:
             del self.reports[name]
-        top.finish()
-        return True
+            if pgmap is not None:
+                pgmap.forget(name)
 
     # --- convenience ----------------------------------------------------------
 
@@ -412,43 +488,58 @@ class MgrDaemon(Dispatcher):
         return self.modules["prometheus"].port
 
 
+def _osd_report_fields(daemon) -> dict:
+    """The OSD's periodic report payload (reference DaemonServer
+    report handling), including the v2 per-PG stats block for PGs it
+    is primary of."""
+    fields = {
+        "daemon": f"osd.{daemon.whoami}",
+        "perf": daemon.perf_coll.dump(),
+        "status": {"up": daemon.up,
+                   "num_pgs": len(daemon.backends),
+                   "epoch": daemon.osdmap.epoch,
+                   # slow-op summary for the status module /
+                   # SLOW_OPS surfaces (reference DaemonState
+                   # health metrics riding MMgrReport)
+                   "slow_ops":
+                       daemon.op_tracker.slow_summary(),
+                   # clog per-severity counts + crash dump
+                   # tally (ceph_clog_messages / _crash series)
+                   "clog": dict(getattr(
+                       daemon, "clog").counts)
+                   if hasattr(daemon, "clog") else {},
+                   "crashes": {
+                       "total": len(daemon.crash.dumps),
+                       "recent": daemon.crash.recent_count()}
+                   if hasattr(daemon, "crash") else {},
+                   # pool geometry for the dashboard +
+                   # pg_autoscaler (reference: mgr consumes the
+                   # osdmap directly; here it rides the report)
+                   "pools": {
+                       p.name: {"type": p.type,
+                                "pg_num": p.pg_num,
+                                "size": p.size}
+                       for p in daemon.osdmap.pools.values()}},
+        "epoch": daemon.osdmap.epoch}
+    pg_stats = daemon.pg_stats_sample()
+    if pg_stats:
+        fields["pg_stats"] = pg_stats
+    return fields
+
+
 async def report_loop(daemon, mgr_addr: str) -> None:
-    """OSD/mon side: push MMgrReport every mgr_stats_period (reference
-    DaemonServer report handling); cancelled on daemon shutdown."""
+    """Daemon side: push MMgrReport every mgr_stats_period (reference
+    DaemonServer report handling); cancelled on daemon shutdown.
+    Daemons that aren't OSDs (the mon) provide ``build_mgr_report()``;
+    OSDs get the full payload incl. the per-PG stats block."""
     period = float(daemon.config.get("mgr_stats_period"))
-    name = f"osd.{daemon.whoami}"
+    build = getattr(daemon, "build_mgr_report", None)
     while True:
         try:
+            fields = build() if build is not None \
+                else _osd_report_fields(daemon)
             conn = daemon.ms.get_connection(mgr_addr)
-            await conn.send_message(MMgrReport({
-                "daemon": name,
-                "perf": daemon.perf_coll.dump(),
-                "status": {"up": daemon.up,
-                           "num_pgs": len(daemon.backends),
-                           "epoch": daemon.osdmap.epoch,
-                           # slow-op summary for the status module /
-                           # SLOW_OPS surfaces (reference DaemonState
-                           # health metrics riding MMgrReport)
-                           "slow_ops":
-                               daemon.op_tracker.slow_summary(),
-                           # clog per-severity counts + crash dump
-                           # tally (ceph_clog_messages / _crash series)
-                           "clog": dict(getattr(
-                               daemon, "clog").counts)
-                           if hasattr(daemon, "clog") else {},
-                           "crashes": {
-                               "total": len(daemon.crash.dumps),
-                               "recent": daemon.crash.recent_count()}
-                           if hasattr(daemon, "crash") else {},
-                           # pool geometry for the dashboard +
-                           # pg_autoscaler (reference: mgr consumes the
-                           # osdmap directly; here it rides the report)
-                           "pools": {
-                               p.name: {"type": p.type,
-                                        "pg_num": p.pg_num,
-                                        "size": p.size}
-                               for p in daemon.osdmap.pools.values()}},
-                "epoch": daemon.osdmap.epoch}))
+            await conn.send_message(MMgrReport(fields))
         except Exception as e:  # noqa: BLE001 — mgr down: keep trying
-            dout("mgr", 10, f"{name}: mgr report failed: {e}")
+            dout("mgr", 10, f"mgr report failed: {e}")
         await asyncio.sleep(period)

@@ -1,0 +1,364 @@
+"""PGMap + progress: the mgr-side cluster accounting plane.
+
+Reference: src/mon/PGMap.{h,cc} (pg_stat_t aggregation, per-pool IO
+rates from consecutive-report deltas) + src/pybind/mgr/progress (the
+bounded recovery-progress events ``ceph status`` renders).
+
+Daemons ship per-PG ``pg_stat`` records on the v2 MMgrReport optional;
+``PGMapModule.ingest`` folds them into a cluster map and derives rates
+from consecutive report deltas.  Three rules keep the numbers honest
+across daemon death and restarts:
+
+- **counter reset**: a restarted daemon's cumulative counters start
+  over, so a negative delta clamps to zero instead of poisoning the
+  rate window (reference PGMap::apply_incremental's same clamp);
+- **staleness**: only daemons passing the mgr's shared ``is_fresh``
+  rule contribute to cluster rates and degraded totals — a dead
+  daemon's last report stops mattering after 3 periods, not when the
+  60-period purge finally drops it;
+- **purge**: when the mgr expires a long-gone daemon's report it calls
+  ``forget`` here, dropping its rate state and any PG rows it was the
+  last reporter of (otherwise 'ceph status' io rates freeze at
+  pre-death values — the stats-vs-purge interaction).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .daemon import MgrModule
+
+# the cumulative pg_stat counters rates derive from
+_RATE_COUNTERS = ("rd_ops", "rd_bytes", "wr_ops", "wr_bytes",
+                  "recovery_ops", "recovery_bytes")
+
+
+def hist_pct(h: dict, q: float) -> int:
+    """q-th percentile upper bound from a log2-bucket histogram dump
+    ({"buckets": {upper_bound: count}, "count": n}) — the same shape
+    'perf dump' and the prometheus exporter consume."""
+    count = int(h.get("count", 0))
+    if count <= 0:
+        return 0
+    target = q * count
+    cum = 0
+    for ub in sorted(int(b) for b in h.get("buckets", {})):
+        cum += int(h["buckets"].get(ub, h["buckets"].get(str(ub), 0)))
+        if cum >= target:
+            return ub
+    return 0
+
+
+class PGMapModule(MgrModule):
+    """Aggregates per-PG stats from daemon reports into the cluster
+    view behind ``pg dump`` / ``pg stat`` / ``df`` / ``osd perf`` and
+    the status digest pushed to the mon."""
+
+    name = "pgmap"
+
+    def __init__(self, mgr) -> None:
+        super().__init__(mgr)
+        # pgid -> {"stat": record, "reporter": "osd.N", "ts", "epoch"}
+        self.pg_stats: "Dict[str, dict]" = {}
+        # daemon -> {"ts", "pools": {pool: {counter: cumulative}}}
+        self._prev: "Dict[str, dict]" = {}
+        # daemon -> {"ts", "pools": {pool: {counter_per_sec: rate}}}
+        self._rates: "Dict[str, dict]" = {}
+
+    # --- ingest ---------------------------------------------------------------
+
+    def ingest(self, daemon: str, pg_stats: dict, ts: float,
+               epoch: int) -> None:
+        for pgid, stat in pg_stats.items():
+            cur = self.pg_stats.get(pgid)
+            # latest-epoch-wins: after an interval change the NEW
+            # primary's row (higher epoch) retires the old reporter's;
+            # the same reporter always refreshes its own row
+            if (cur is None or cur["reporter"] == daemon
+                    or (epoch, ts) >= (cur["epoch"], cur["ts"])):
+                self.pg_stats[pgid] = {"stat": dict(stat),
+                                       "reporter": daemon,
+                                       "ts": ts, "epoch": epoch}
+        totals: "Dict[str, Dict[str, int]]" = {}
+        for pgid, stat in pg_stats.items():
+            pool = pgid.split(".", 1)[0]
+            t = totals.setdefault(pool,
+                                  {c: 0 for c in _RATE_COUNTERS})
+            for c in _RATE_COUNTERS:
+                t[c] += int(stat.get(c, 0))
+        prev = self._prev.get(daemon)
+        if prev is not None and ts > prev["ts"]:
+            dt = ts - prev["ts"]
+            rates: "Dict[str, Dict[str, float]]" = {}
+            for pool, tot in totals.items():
+                ptot = prev["pools"].get(pool, {})
+                rates[pool] = {
+                    # counter reset after a daemon restart shows up as
+                    # a negative delta: clamp to zero, never extrapolate
+                    c + "_per_sec":
+                        max(0, tot[c] - int(ptot.get(c, 0))) / dt
+                    for c in _RATE_COUNTERS}
+            self._rates[daemon] = {"ts": ts, "pools": rates}
+        self._prev[daemon] = {"ts": ts, "pools": totals}
+
+    def forget(self, daemon: str) -> None:
+        """Purge hook: a daemon expired from mgr.reports takes its rate
+        state and its orphaned PG rows with it."""
+        self._prev.pop(daemon, None)
+        self._rates.pop(daemon, None)
+        for pgid in [p for p, e in self.pg_stats.items()
+                     if e["reporter"] == daemon]:
+            del self.pg_stats[pgid]
+
+    # --- derived views --------------------------------------------------------
+
+    def _fresh(self) -> "set[str]":
+        return {n for n, rep in self.mgr.reports.items()
+                if self.mgr.is_fresh(rep)}
+
+    def pool_io_rates(self) -> "Dict[str, Dict[str, float]]":
+        """Cluster per-pool IO rates: the sum of each FRESH daemon's
+        last derived window (stale/dead daemons excluded immediately —
+        the satellite-2 rule)."""
+        fresh = self._fresh()
+        out: "Dict[str, Dict[str, float]]" = {}
+        for daemon, ent in self._rates.items():
+            if daemon not in fresh:
+                continue
+            for pool, r in ent["pools"].items():
+                agg = out.setdefault(
+                    pool, {c + "_per_sec": 0.0 for c in _RATE_COUNTERS})
+                for k, v in r.items():
+                    agg[k] = agg.get(k, 0.0) + float(v)
+        return out
+
+    def pg_summary(self) -> dict:
+        """State histogram + cluster degraded/misplaced/unfound totals.
+        Rows from stale reporters count as state 'stale' and are
+        excluded from the degraded totals (their numbers describe a
+        cluster that no longer exists)."""
+        fresh = self._fresh()
+        states: "Dict[str, int]" = {}
+        degraded = misplaced = unfound = objects = nbytes = 0
+        for ent in self.pg_stats.values():
+            st = ent["stat"]
+            live = ent["reporter"] in fresh
+            state = str(st.get("state", "unknown")) if live else "stale"
+            states[state] = states.get(state, 0) + 1
+            objects += int(st.get("objects", 0))
+            nbytes += int(st.get("bytes", 0))
+            if live:
+                degraded += int(st.get("degraded", 0))
+                misplaced += int(st.get("misplaced", 0))
+                unfound += int(st.get("unfound", 0))
+        return {"num_pgs": len(self.pg_stats), "states": states,
+                "objects": objects, "bytes": nbytes,
+                "degraded": degraded, "misplaced": misplaced,
+                "unfound": unfound}
+
+    def degraded_total(self) -> int:
+        return int(self.pg_summary()["degraded"])
+
+    def recovery_rates(self) -> "Dict[str, float]":
+        pools = self.pool_io_rates()
+        return {"recovery_bytes_per_sec":
+                    sum(r.get("recovery_bytes_per_sec", 0.0)
+                        for r in pools.values()),
+                "recovery_ops_per_sec":
+                    sum(r.get("recovery_ops_per_sec", 0.0)
+                        for r in pools.values())}
+
+    def pg_dump(self) -> dict:
+        now = time.monotonic()
+        fresh = self._fresh()
+        rows: "List[dict]" = []
+        for pgid in sorted(self.pg_stats,
+                           key=lambda p: tuple(int(x) for x
+                                               in p.split("."))):
+            ent = self.pg_stats[pgid]
+            st = dict(ent["stat"])
+            rows.append({"pgid": pgid,
+                         "state": (st.pop("state", "unknown")
+                                   if ent["reporter"] in fresh
+                                   else "stale"),
+                         "reporter": ent["reporter"],
+                         "age": round(now - ent["ts"], 1),
+                         "epoch": ent["epoch"], **st})
+        return {"pg_stats": rows, "summary": self.pg_summary()}
+
+    def df(self) -> dict:
+        """Per-pool storage + IO view (the 'ceph df' data source).
+        Stored bytes/objects keep the last-known value even from a
+        stale reporter (data doesn't evaporate with its reporter);
+        rates follow the freshness rule."""
+        pools: "Dict[str, dict]" = {}
+        for pgid, ent in self.pg_stats.items():
+            pool = pgid.split(".", 1)[0]
+            p = pools.setdefault(pool, {"objects": 0, "stored": 0,
+                                        "pgs": 0})
+            st = ent["stat"]
+            p["objects"] += int(st.get("objects", 0))
+            p["stored"] += int(st.get("bytes", 0))
+            p["pgs"] += 1
+        for pool, rates in self.pool_io_rates().items():
+            pools.setdefault(pool, {"objects": 0, "stored": 0,
+                                    "pgs": 0})["io"] = \
+                {k: round(v, 1) for k, v in rates.items()}
+        return {"pools": pools}
+
+    def osd_perf(self) -> dict:
+        """Per-OSD latency digest from the perf histograms already
+        riding the reports (reference 'ceph osd perf')."""
+        out: "Dict[str, dict]" = {}
+        for name, rep in sorted(self.mgr.reports.items()):
+            if not name.startswith("osd."):
+                continue
+            osd = rep.get("perf", {}).get(name, {})
+            row = {"fresh": self.mgr.is_fresh(rep)}
+            for label, counter in (("commit_lat_p99_us",
+                                    "op_w_commit_lat"),
+                                   ("queue_lat_p99_us",
+                                    "op_w_queue_lat"),
+                                   ("subop_rtt_p99_us", "subop_w_rtt")):
+                h = osd.get(counter)
+                if isinstance(h, dict) and "buckets" in h:
+                    row[label] = hist_pct(h, 0.99)
+            lag = osd.get("loop_lag_ms")
+            if isinstance(lag, dict) and "buckets" in lag:
+                row["loop_lag_p99_ms"] = hist_pct(lag, 0.99)
+            out[name] = row
+        return out
+
+    # --- exports --------------------------------------------------------------
+
+    def digest(self) -> dict:
+        """The compact summary pushed to the mon every period — the
+        data behind 'ceph status' pgs:/io:/recovery: sections and the
+        pg stat/df mon commands."""
+        period = float(self.mgr.config.get("mgr_stats_period"))
+        pools = {pool: {k: round(v, 1) for k, v in rates.items()}
+                 for pool, rates in self.pool_io_rates().items()}
+        return {"period": period,
+                "pg_summary": self.pg_summary(),
+                "pool_rates": pools,
+                "recovery": {k: round(v, 1) for k, v
+                             in self.recovery_rates().items()},
+                "df": self.df(),
+                "osd_perf": self.osd_perf()}
+
+    def render_prometheus(self) -> "List[str]":
+        """New frozen series for the exporter: pg-state gauges,
+        per-pool IO rates, recovery throughput, degraded objects.
+        Cluster-level series always emit (zero included) so the frozen
+        schema and alert exprs never see a gap; per-pool series appear
+        once a pool has reported PGs."""
+        summ = self.pg_summary()
+        rec = self.recovery_rates()
+        lines = ["# TYPE ceph_pg_total gauge",
+                 f"ceph_pg_total {summ['num_pgs']}",
+                 "# TYPE ceph_pgs_by_state gauge"]
+        for state in sorted(summ["states"]):
+            lines.append(f'ceph_pgs_by_state{{state="{state}"}} '
+                         f'{summ["states"][state]}')
+        for series, key in (("ceph_cluster_degraded_objects",
+                             "degraded"),
+                            ("ceph_cluster_misplaced_objects",
+                             "misplaced"),
+                            ("ceph_cluster_unfound_objects",
+                             "unfound")):
+            lines.append(f"# TYPE {series} gauge")
+            lines.append(f"{series} {summ[key]}")
+        for series, key in (("ceph_cluster_recovery_bytes_per_sec",
+                             "recovery_bytes_per_sec"),
+                            ("ceph_cluster_recovery_ops_per_sec",
+                             "recovery_ops_per_sec")):
+            lines.append(f"# TYPE {series} gauge")
+            lines.append(f"{series} {round(rec[key], 3)}")
+        pool_rows = self.df()["pools"]
+        for series in ("ceph_pool_objects", "ceph_pool_stored_bytes",
+                       "ceph_pool_rd_ops_per_sec",
+                       "ceph_pool_rd_bytes_per_sec",
+                       "ceph_pool_wr_ops_per_sec",
+                       "ceph_pool_wr_bytes_per_sec"):
+            lines.append(f"# TYPE {series} gauge")
+        rates = self.pool_io_rates()
+        for pool in sorted(pool_rows):
+            row = pool_rows[pool]
+            r = rates.get(pool, {})
+            lines.append(f'ceph_pool_objects{{pool="{pool}"}} '
+                         f'{row["objects"]}')
+            lines.append(f'ceph_pool_stored_bytes{{pool="{pool}"}} '
+                         f'{row["stored"]}')
+            for short, key in (("rd_ops", "rd_ops_per_sec"),
+                               ("rd_bytes", "rd_bytes_per_sec"),
+                               ("wr_ops", "wr_ops_per_sec"),
+                               ("wr_bytes", "wr_bytes_per_sec")):
+                lines.append(
+                    f'ceph_pool_{short}_per_sec{{pool="{pool}"}} '
+                    f'{round(r.get(key, 0.0), 3)}')
+        return lines
+
+
+class ProgressModule(MgrModule):
+    """Bounded recovery-progress events (reference mgr progress
+    module): a rise of the cluster degraded total from zero opens an
+    event, PGMap deltas advance its fraction (drained/initial), hitting
+    zero completes it, and completed events expire after a grace window
+    into a short history ring the harnesses assert against."""
+
+    name = "progress"
+
+    # completed events linger this many stats periods before moving to
+    # the history ring (still visible there — proc_chaos asserts on it)
+    GRACE_PERIODS = 6.0
+    HISTORY = 8
+
+    def __init__(self, mgr) -> None:
+        super().__init__(mgr)
+        self.events: "Dict[str, dict]" = {}
+        self.completed: "List[dict]" = []
+        self._seq = 0
+
+    def tick(self) -> None:
+        pgmap: "Optional[PGMapModule]" = self.mgr.modules.get("pgmap")
+        if pgmap is None:
+            return
+        now = time.monotonic()
+        deg = pgmap.degraded_total()
+        ev = next((e for e in self.events.values() if not e["done"]),
+                  None)
+        if deg > 0:
+            if ev is None:
+                self._seq += 1
+                stale = sorted(n for n, rep in self.mgr.reports.items()
+                               if not self.mgr.is_fresh(rep))
+                msg = f"Recovering {deg} degraded objects"
+                if stale:
+                    msg += f" ({', '.join(stale)} not reporting)"
+                self.events[f"recovery-{self._seq}"] = {
+                    "id": f"recovery-{self._seq}", "message": msg,
+                    "started": now, "initial": deg, "remaining": deg,
+                    "fraction": 0.0, "done": False, "done_ts": None}
+            else:
+                # more damage can surface mid-recovery (another osd
+                # dies): grow the denominator, never shrink it
+                ev["initial"] = max(int(ev["initial"]), deg)
+                ev["remaining"] = deg
+                ev["fraction"] = round(1.0 - deg / ev["initial"], 4)
+        elif ev is not None:
+            ev["remaining"] = 0
+            ev["fraction"] = 1.0
+            ev["done"] = True
+            ev["done_ts"] = now
+        grace = self.GRACE_PERIODS * float(
+            self.mgr.config.get("mgr_stats_period"))
+        for eid in [i for i, e in self.events.items()
+                    if e["done"] and now - e["done_ts"] > grace]:
+            self.completed.append(self.events.pop(eid))
+        del self.completed[:-self.HISTORY]
+
+    def dump(self) -> dict:
+        return {"events": sorted(self.events.values(),
+                                 key=lambda e: e["started"]),
+                "completed": list(self.completed)}

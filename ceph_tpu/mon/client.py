@@ -17,7 +17,8 @@ from ..msg.message import Message
 from ..msg.messenger import Dispatcher, Messenger
 from ..osd.osdmap import OSDMap
 from .messages import (MCrashReport, MLog, MMonCommand, MMonCommandReply,
-                       MMonSubscribe, MOSDBeacon, MOSDBoot, MOSDFailure)
+                       MMonMgrReport, MMonSubscribe, MOSDBeacon,
+                       MOSDBoot, MOSDFailure)
 
 EAGAIN = 11
 
@@ -202,6 +203,20 @@ class MonClient(Dispatcher):
                 continue
         if not sent:
             raise MonClientError("no mon reachable for crash post")
+
+    async def send_mgr_digest(self, digest: dict) -> None:
+        """Push the mgr's PGMap/progress digest (MMonMgrReport) to
+        every mon.  Volatile per-mon state — a miss just means that
+        mon serves slightly staler 'ceph status' sections until the
+        next period — so an empty send is not an error."""
+        for rank in sorted(self.mon_addrs):
+            try:
+                conn = self.ms.get_connection(self.mon_addrs[rank])
+                await conn.send_message(MMonMgrReport(
+                    {"digest": dict(digest),
+                     "epoch": self.osdmap.epoch}))
+            except (ConnectionError, OSError):
+                continue
 
     async def report_failure(self, reporter: int, failed: int) -> None:
         for rank in sorted(self.mon_addrs):

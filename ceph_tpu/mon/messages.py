@@ -75,6 +75,20 @@ class MOSDFailure(Message):
 
 
 @register_message
+class MMonMgrReport(Message):
+    """mgr -> mon: the PGMap/progress status digest behind 'ceph
+    status' pgs:/io:/recovery:/progress: sections and the pg stat /
+    pg dump / df / osd perf commands (reference MMonMgrReport.h ->
+    MgrStatMonitor).  Broadcast to every mon and stored VOLATILE
+    per-mon (like beacons, not paxos-replicated): any mon can serve
+    the sections, and a mon restart just waits one mgr period.
+    fields: digest (dict), epoch."""
+    TYPE = "mon_mgr_report"
+    FIELDS = ("digest", "epoch")
+    REPLY = None
+
+
+@register_message
 class MLog(Message):
     """Daemon -> mon cluster-log batch (reference MLog.h).  fields:
     entries: [{stamp, name, channel, prio, message, seq}].  Peons

@@ -62,10 +62,15 @@ class _MonTransport(PaxosTransport):
 
 class MonDaemon(Dispatcher):
     def __init__(self, rank: int, mon_addrs: "Dict[int, str]",
-                 config: "Optional[Config]" = None) -> None:
+                 config: "Optional[Config]" = None,
+                 mgr_addr: "Optional[str]" = None) -> None:
         self.rank = rank
         self.mon_addrs = dict(mon_addrs)
         self.config = config or Config()
+        # with a mgr, the mon reports itself too (perf-less status
+        # report: ceph_daemon_up must cover every fleet daemon) and
+        # receives the PGMap digest back for 'ceph status'
+        self.mgr_addr = mgr_addr
         self.ms = Messenger.create(f"mon.{rank}", self.config)
         self.ms.add_dispatcher(self)
         # op tracking + tracing on the mon too: 'ceph daemon mon.N
@@ -125,6 +130,12 @@ class MonDaemon(Dispatcher):
         self.paxos.spawn = self.crash.guard
         self.admin_socket = None
         self._tick_task: "Optional[asyncio.Task]" = None
+        self._mgr_task: "Optional[asyncio.Task]" = None
+        # latest mgr digest (MMonMgrReport): VOLATILE, like beacons —
+        # every mon gets the broadcast, so any mon serves the status
+        # sections; freshness-gated by the digest's own period
+        self.mgr_digest: "Optional[dict]" = None
+        self._mgr_digest_ts = 0.0
         from ..common.lockdep import DepLock
         self._cmd_lock = DepLock("mon.command")
         self._last_lease = time.monotonic()
@@ -143,8 +154,30 @@ class MonDaemon(Dispatcher):
         self._tick_task = self.crash.task(self._tick_loop(),
                                           "tick_loop")
         self._start_admin_socket()
+        if self.mgr_addr:
+            from ..mgr.daemon import report_loop
+            self._mgr_task = self.crash.task(
+                report_loop(self, self.mgr_addr), "mgr_report_loop")
         await self.elector.start_election()
         await self.crash.post_all()
+
+    def build_mgr_report(self) -> dict:
+        """The mon's periodic MMgrReport payload: no perf collection,
+        but enough status for ceph_daemon_up / slow-ops / clog / crash
+        coverage of the whole fleet."""
+        return {
+            "daemon": f"mon.{self.rank}",
+            "perf": {},
+            "status": {"up": self.running,
+                       "leader": self.elector.leader,
+                       "quorum": sorted(self.elector.quorum),
+                       "epoch": self.osdmap.epoch,
+                       "slow_ops": self.op_tracker.slow_summary(),
+                       "clog": dict(self.clog.counts),
+                       "crashes": {
+                           "total": len(self.crash.dumps),
+                           "recent": self.crash.recent_count()}},
+            "epoch": self.osdmap.epoch}
 
     def _start_admin_socket(self) -> None:
         path = str(self.config.get("admin_socket"))
@@ -181,6 +214,8 @@ class MonDaemon(Dispatcher):
         self.running = False
         if self._tick_task:
             self._tick_task.cancel()
+        if self._mgr_task:
+            self._mgr_task.cancel()
         await self.clog.stop()
         if self.admin_socket is not None:
             self.admin_socket.stop()
@@ -606,6 +641,11 @@ class MonDaemon(Dispatcher):
             self.last_beacon[int(msg["osd_id"])] = time.monotonic()
             self.osd_slow_ops[int(msg["osd_id"])] = dict(
                 msg.get("slow_ops") or {})
+        elif t == "mon_mgr_report":
+            # mgr PGMap/progress digest: volatile, latest-wins (every
+            # mon gets the broadcast; no paxos round for stats)
+            self.mgr_digest = dict(msg.get("digest") or {})
+            self._mgr_digest_ts = time.monotonic()
         elif t == "osd_failure":
             await self._handle_failure(msg)
         elif t == "log":
@@ -742,6 +782,17 @@ class MonDaemon(Dispatcher):
                 if not c.get("archived")
                 and now - float(c.get("stamp", 0.0)) < age]
 
+    def _fresh_mgr_digest(self) -> "Optional[dict]":
+        """The stored mgr digest, or None once it outlives 3 of the
+        mgr's own stats periods (same multiplier as the mgr's is_fresh
+        rule) — a dead mgr's numbers must not impersonate live state."""
+        if self.mgr_digest is None:
+            return None
+        period = float(self.mgr_digest.get("period", 5.0))
+        if time.monotonic() - self._mgr_digest_ts > 3.0 * period:
+            return None
+        return self.mgr_digest
+
     def _health(self, slow_summary: "tuple | None" = None
                 ) -> "tuple[str, list]":
         """One health ruleset feeding BOTH 'status' and 'health' — the
@@ -784,6 +835,23 @@ class MonDaemon(Dispatcher):
             checks.append({"check": "MON_QUORUM",
                            "severity": "HEALTH_ERR",
                            "message": "mon quorum at risk"})
+        digest = self._fresh_mgr_digest()
+        if digest is not None:
+            summ = digest.get("pg_summary", {})
+            deg = int(summ.get("degraded", 0))
+            unfound = int(summ.get("unfound", 0))
+            if deg:
+                checks.append({
+                    "check": "PG_DEGRADED", "severity": "HEALTH_WARN",
+                    "message": f"{deg} object copies degraded; "
+                               f"recovery in progress"})
+            if unfound:
+                checks.append({
+                    "check": "OBJECT_UNFOUND",
+                    "severity": "HEALTH_ERR",
+                    "message": f"{unfound} objects unfound (no "
+                               f"surviving shard set can reconstruct "
+                               f"them)"})
         status = ("HEALTH_ERR" if any(
             c["severity"] == "HEALTH_ERR" for c in checks)
             else "HEALTH_WARN" if checks else "HEALTH_OK")
@@ -1163,7 +1231,7 @@ class MonDaemon(Dispatcher):
             slow = self._slow_ops_summary()
             status, checks = self._health(slow)
             slow_n, slow_oldest, _d = slow
-            return 0, {
+            out = {
                 "mon": {"rank": self.rank, "quorum": self.elector.quorum,
                         "leader": self.elector.leader},
                 "osdmap": {"epoch": self.osdmap.epoch,
@@ -1177,9 +1245,51 @@ class MonDaemon(Dispatcher):
                 # the checks themselves ride along ('ceph -s' shows
                 # RECENT_CRASH / SLOW_OPS details, not just the color)
                 "checks": checks}
+            # data-plane sections from the mgr digest (reference 'ceph
+            # -s' pgs:/io:/recovery:/progress:): only while the digest
+            # is fresh — a dead mgr's last numbers must go dark, not
+            # masquerade as live IO
+            digest = self._fresh_mgr_digest()
+            if digest is not None:
+                summ = dict(digest.get("pg_summary", {}))
+                pools = digest.get("pool_rates", {})
+                io = {"rd_bytes_per_sec": 0.0, "wr_bytes_per_sec": 0.0,
+                      "rd_ops_per_sec": 0.0, "wr_ops_per_sec": 0.0}
+                for r in pools.values():
+                    for k in io:
+                        io[k] = round(io[k] + float(r.get(k, 0.0)), 1)
+                out["pgs"] = summ
+                out["io"] = io
+                out["recovery"] = digest.get("recovery", {})
+                prog = digest.get("progress", {})
+                if prog.get("events"):
+                    out["progress"] = prog["events"]
+            return 0, out
         if prefix == "health":
             status, checks = self._health()
             return 0, {"status": status, "checks": checks}
+        if prefix in ("pg stat", "pg dump", "df", "osd perf",
+                      "progress"):
+            # served from the mgr digest (MgrStatMonitor analog); a
+            # missing/stale digest answers with available=False rather
+            # than an error so pollers can just retry
+            digest = self._fresh_mgr_digest()
+            if digest is None:
+                return 0, {"available": False,
+                           "error": "no fresh mgr digest (mgr down "
+                                    "or no reports yet)"}
+            key = {"pg stat": "pg_summary", "df": "df",
+                   "osd perf": "osd_perf",
+                   "progress": "progress"}.get(prefix)
+            if key is not None:
+                return 0, {"available": True,
+                           key: digest.get(key, {})}
+            # pg dump: the digest carries the summary; the full per-PG
+            # table lives on the mgr admin socket ('daemon mgr pg dump')
+            return 0, {"available": True,
+                       "pg_summary": digest.get("pg_summary", {}),
+                       "pool_rates": digest.get("pool_rates", {}),
+                       "recovery": digest.get("recovery", {})}
         if prefix == "osd tree":
             # crush hierarchy + per-osd state (the 'ceph osd tree' view)
             nodes = []

@@ -80,6 +80,11 @@ WIRE_SPECS: "Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]" = {
     "ec_sub_read_reply": (("pgid", "shard", "from_osd", "tid",
                            "buffers_read", "lens", "attrs_read",
                            "errors"), ("omap_read",)),
+    # the stats plane: per-PG pg_stat_t records ride the periodic
+    # daemon report as an appended optional (v2); a v1 mgr skips the
+    # unknown optional and still gets the perf/status payload
+    "mgr_report": (("daemon", "perf", "status", "epoch"),
+                   ("pg_stats",)),
 }
 
 

@@ -56,6 +56,10 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("op", "client ops")
           .add_u64_counter("op_w", "client writes")
           .add_u64_counter("op_r", "client reads")
+          # client IO volume (reference l_osd_op_in_bytes/out_bytes):
+          # cephtop derives per-OSD MB/s from deltas of these
+          .add_u64_counter("op_in_bytes", "client write payload bytes")
+          .add_u64_counter("op_out_bytes", "client read bytes served")
           .add_u64_counter("subop_w", "ec sub writes served")
           .add_u64_counter("subop_r", "ec sub reads served")
           # batched sub-write dispatch: frames built per fan-out (one
@@ -1104,6 +1108,31 @@ class OSDDaemon(Dispatcher):
             out["net_faults"] = rules
         if self.mesh_plane is not None:
             out["mesh_plane"] = dict(self.mesh_plane.stats)
+        return out
+
+    def pg_stats_sample(self) -> dict:
+        """Per-PG pg_stat records for the PGs this OSD is PRIMARY of,
+        sampled by the mgr report loop (the pg_stat_t-riding-MPGStats
+        analog).  Primary-only keeps every PG reported exactly once
+        cluster-wide; after an interval change the new primary takes
+        over reporting and the mgr's latest-epoch-wins merge retires
+        the old row."""
+        out: dict = {}
+        for (pool, pg), be in list(self.backends.items()):
+            try:
+                if not be.is_primary():
+                    continue
+                stat = be.pg_stat()
+                up, acting = self.osdmap.pg_to_up_acting_osds(pool, pg)
+                # misplaced: object copies living on a shard the up
+                # mapping doesn't name (pg_temp remap in flight)
+                moved = sum(1 for u, a in zip(up, acting) if u != a)
+                stat["misplaced"] = stat["objects"] * moved
+                stat["up"] = list(up)
+                stat["acting"] = list(acting)
+                out[f"{pool}.{pg}"] = stat
+            except Exception as e:  # noqa: BLE001 — stats never wedge a report
+                dout("osd", 10, f"pg_stats sample {pool}.{pg}: {e}")
         return out
 
     def _start_admin_socket(self) -> None:
@@ -2250,6 +2279,10 @@ class OSDDaemon(Dispatcher):
                         out_bufs.append(data)
                     if not pieces:
                         outs.append({"op": "read", "dlen": 0})
+                    nread = sum(len(d) for _o, d in pieces)
+                    self.perf.inc("op_out_bytes", nread)
+                    be.stat_rd_ops += 1
+                    be.stat_rd_bytes += nread
                 elif name == "stat":
                     await be.wait_readable(oid)
                     outs.append({"op": "stat", "size": be.object_size(oid),
@@ -2276,6 +2309,9 @@ class OSDDaemon(Dispatcher):
                         "setxattr", name="cache.dirty",
                         value=b"1:" + _os.urandom(8).hex().encode()))
                 self.perf.inc("op_w")
+                self.perf.inc("op_in_bytes", len(msg.data))
+                be.stat_wr_ops += 1
+                be.stat_wr_bytes += len(msg.data)
                 if top:
                     top.mark("started_write")
                 version = await be.submit_transaction(

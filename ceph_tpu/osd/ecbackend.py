@@ -430,6 +430,20 @@ class ECBackend:
         # cumulative bytes this shard served to sub-reads (repair-I/O
         # accounting: clay repair must move less than full-chunk repair)
         self.sub_read_bytes = 0
+        # pg_stat accounting (reference pg_stat_t): cheap cumulative
+        # counters bumped at the existing data-path anchors — client-op
+        # admission on the primary, recovery push — and sampled by the
+        # mgr report loop together with the store-derived object/byte
+        # totals (pg_stat())
+        self.stat_rd_ops = 0
+        self.stat_rd_bytes = 0
+        self.stat_wr_ops = 0
+        self.stat_wr_bytes = 0
+        self.stat_recovery_ops = 0
+        self.stat_recovery_bytes = 0
+        # objects the last peering pass could not reconstruct from any
+        # surviving shard set (reference num_objects_unfound)
+        self.stat_unfound = 0
         # newest INTERVAL-START epoch a primary has peered this shard
         # at: sub-ops from primaries of OLDER intervals are rejected,
         # so a deposed primary can never complete (and ack) a write
@@ -757,6 +771,54 @@ class ECBackend:
                                              ObjectId(oid, shard)))
         except NotFound:
             return {}
+
+    def pg_stat(self) -> dict:
+        """Sampled pg_stat_t analog for the mgr report (primary only).
+
+        Object/byte totals come from the store at sample time (one
+        list + one OI attr read per object, once per mgr_stats_period);
+        the IO/recovery counters are the cumulative stat_* fields the
+        data-path anchors bump.  Degraded counts missing object COPIES:
+        ``peer_missing`` entries drain per push reply and
+        ``local_missing`` per applied push, so the mgr watches this
+        fall to zero as recovery proceeds."""
+        objects, stored = 0, 0
+        cid = self.coll(max(0, self.my_shard))
+        if self.store.collection_exists(cid):
+            for o in self.store.list_objects(cid):
+                if o.name == PGMETA_OID or o.generation != NO_GEN:
+                    continue
+                objects += 1
+                try:
+                    stored += ObjectInfo.decode(bytes(
+                        self.store.get_attr(cid, o, OI_KEY))).size
+                except (NotFound, KeyError, ValueError):
+                    pass
+        degraded = (len(self.local_missing)
+                    + sum(len(m) for m in self.peer_missing.values()))
+        if self.peering:
+            state = "peering"
+        elif self.active_acting is None:
+            state = "unknown"
+        else:
+            bits = ["active"]
+            if self.recovery_ops or self.degraded:
+                bits.append("recovering")
+            if degraded:
+                bits.append("degraded")
+            if len(bits) == 1:
+                bits.append("clean")
+            state = "+".join(bits)
+        return {"objects": objects, "bytes": stored,
+                "log_size": len(self.pg_log.entries),
+                "rd_ops": self.stat_rd_ops,
+                "rd_bytes": self.stat_rd_bytes,
+                "wr_ops": self.stat_wr_ops,
+                "wr_bytes": self.stat_wr_bytes,
+                "recovery_ops": self.stat_recovery_ops,
+                "recovery_bytes": self.stat_recovery_bytes,
+                "degraded": degraded, "unfound": self.stat_unfound,
+                "state": state}
 
     def omap_get(self, oid: str,
                  keys: "Optional[List[str]]" = None) -> "Dict[str, bytes]":
@@ -2921,6 +2983,11 @@ class ECBackend:
                 rop.done.set_result(None)
             return
         attrs = {k: v.hex() for k, v in rop.attrs.items()}
+        # recovery accounting at the push anchor: one recovery op per
+        # recovered head, bytes = reconstructed shard payloads shipped
+        self.stat_recovery_ops += 1
+        self.stat_recovery_bytes += sum(
+            len(rop.recovered[s]) for s in rop.waiting_on_pushes)
         local = []
         for shard in sorted(rop.waiting_on_pushes):
             fields = {
@@ -3774,6 +3841,7 @@ class ECBackend:
                     if cand in to_recover and cand not in claimed:
                         oid = cand
                         break
+                prio = oid is not None
                 if oid is None:
                     if not pending:
                         return
@@ -3784,6 +3852,14 @@ class ECBackend:
                 fut = self.degraded.get(oid)
                 if fut is None or fut.done():
                     continue
+                # pacing BEFORE the op, not after: the throttle must
+                # hold the object degraded for the sleep, or a handful
+                # of misses recovers inside one mgr_stats_period and
+                # no report ever witnesses the drain.  Client-blocked
+                # objects skip it — prioritized recovery exists to
+                # unblock I/O, not to meter it
+                if sleep_s and not prio:
+                    await asyncio.sleep(sleep_s)
                 try:
                     await self.recover_object(
                         oid, to_recover[oid],
@@ -3805,14 +3881,13 @@ class ECBackend:
                     # oid; nothing else removes degraded entries
                     # cephlint: disable=await-atomicity
                     self.degraded.pop(oid, None)
-                if sleep_s:
-                    await asyncio.sleep(sleep_s)
 
         if to_recover:
             n_workers = min(len(to_recover),
                             max(1, self.opt("osd_recovery_max_active", 3)))
             await asyncio.gather(*(worker() for _ in range(n_workers)))
         recovered, failed = counts["recovered"], counts["failed"]
+        self.stat_unfound = failed
         return {"status": "ok", "auth_head": list(auth_head),
                 "auth_shard": auth_shard, "recovered": recovered,
                 "failed": failed, "backfilled_shards": backfill_shards,

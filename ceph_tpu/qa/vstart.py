@@ -42,11 +42,15 @@ class ProcCluster:
 
     def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
                  options: "Optional[List[str]]" = None,
-                 asok: bool = True) -> None:
+                 asok: bool = True, mgr: bool = True) -> None:
         self.base_dir = base_dir
         self.options = list(options or [])
         self.mon_addrs: "Dict[int, str]" = {
             r: f"127.0.0.1:{free_port()}" for r in range(n_mons)}
+        # mgr address pre-allocated like the mon addrs so every daemon
+        # can be told where to report before the mgr process exists
+        self.mgr_addr = f"127.0.0.1:{free_port()}" if mgr else ""
+        self.mgr_prometheus_port = 0
         self.n_osds = n_osds
         self.procs: "Dict[str, subprocess.Popen]" = {}
         self.osd_logs: "Dict[str, object]" = {}
@@ -132,10 +136,10 @@ class ProcCluster:
     def start(self) -> None:
         os.makedirs(self.base_dir, exist_ok=True)
         for r in self.mon_addrs:
-            self._spawn(f"mon.{r}", [
-                "mon", "--rank", str(r), "--mon-addrs", self.mon_spec,
-                *sum((["-o", o] for o in self.options), [])])
+            self.start_mon(r)
         self.wait_for_quorum()
+        if self.mgr_addr:
+            self.start_mgr()
         for i in range(self.n_osds):
             self.start_osd(i)
 
@@ -160,17 +164,35 @@ class ProcCluster:
         raise RuntimeError(f"no mon quorum within {timeout}s")
 
     def start_osd(self, osd_id: int) -> dict:
+        mgr = ["--mgr", self.mgr_addr] if self.mgr_addr else []
         return self._spawn(f"osd.{osd_id}", [
             "osd", "--id", str(osd_id), "--mon-addrs", self.mon_spec,
             "--data", os.path.join(self.base_dir, f"osd.{osd_id}"),
+            *mgr,
             *sum((["-o", o] for o in self.options), [])])
 
     def start_mon(self, rank: int) -> dict:
         """(Re)spawn one mon at its original address (leader-kill
         recovery; mon state rebuilds from its peers' paxos log)."""
+        mgr = ["--mgr", self.mgr_addr] if self.mgr_addr else []
         return self._spawn(f"mon.{rank}", [
             "mon", "--rank", str(rank), "--mon-addrs", self.mon_spec,
+            *mgr,
             *sum((["-o", o] for o in self.options), [])])
+
+    def start_mgr(self) -> dict:
+        """(Re)spawn the mgr at its pre-allocated address.  The
+        prometheus port defaults to ephemeral (two fleets on one host
+        must not fight over 9283); the ready line reports the bound
+        port.  User -o options come later in argv, so an explicit
+        mgr_prometheus_port override wins."""
+        info = self._spawn("mgr", [
+            "mgr", "--addr", self.mgr_addr,
+            "--mon-addrs", self.mon_spec,
+            "-o", "mgr_prometheus_port=0",
+            *sum((["-o", o] for o in self.options), [])])
+        self.mgr_prometheus_port = int(info.get("prometheus_port", 0))
+        return info
 
     def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
         """kill -9 by default (reference thrasher kill_osd)."""

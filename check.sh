@@ -100,6 +100,19 @@ if [ "$pchaos_rc" -ne 0 ]; then
     exit "$pchaos_rc"
 fi
 
+echo "== scrape smoke (tools/scrape_smoke.py) =="
+# end-to-end metrics path over a real-process fleet: mons + mgr + osds
+# up, a paced write burst, then an HTTP scrape of the mgr's prometheus
+# endpoint mid-burst — one ceph_daemon_up series per subprocess daemon,
+# nonzero per-pool IO rates, and the PGMap-derived pool write rate
+# agreeing with the client's achieved rate within 15%
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py
+scrape_rc=$?
+if [ "$scrape_rc" -ne 0 ]; then
+    echo "scrape smoke FAILED (exit $scrape_rc)"
+    exit "$scrape_rc"
+fi
+
 echo "== tier-1 tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

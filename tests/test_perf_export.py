@@ -288,7 +288,10 @@ REQUIRED_PERF_COUNTERS = {
             "subop_w_frames",
             # critical-path attribution (PR 16): event-loop scheduling
             # lag samples (ms) + cpu time per message dispatch tick (us)
-            "loop_lag_ms", "daemon_cpu_attribution"},
+            "loop_lag_ms", "daemon_cpu_attribution",
+            # cluster accounting (PGMap PR): client IO byte counters
+            # behind the per-pool MB/s panels and cephtop rates
+            "op_in_bytes", "op_out_bytes"},
     "kernel": {"kernel_encode_lat", "kernel_decode_lat",
                "kernel_crc32c_lat", "kernel_encode_launches",
                "kernel_decode_launches", "kernel_crc32c_launches",
@@ -347,6 +350,24 @@ REQUIRED_PROM_SERIES = {
     # panel
     "ceph_net_faults_active", "ceph_net_fault_trips",
     "ceph_ms_reconnects", "ceph_ms_replayed_frames",
+    # cluster accounting (PGMap PR): client IO byte counters + the
+    # always-emitted cluster-level PGMap gauges — the grafana cluster
+    # row and the CephTpuDegradedStuck alert ride these
+    "ceph_op_in_bytes", "ceph_op_out_bytes",
+    "ceph_pg_total", "ceph_cluster_degraded_objects",
+    "ceph_cluster_misplaced_objects", "ceph_cluster_unfound_objects",
+    "ceph_cluster_recovery_bytes_per_sec",
+    "ceph_cluster_recovery_ops_per_sec",
+    "ceph_progress_events_active",
+}
+
+# per-pool PGMap series: appear once a pool has reported PGs, so the
+# frozen-schema test asserts them only after IO has created a backend
+REQUIRED_POOL_SERIES = {
+    "ceph_pool_objects", "ceph_pool_stored_bytes",
+    "ceph_pool_rd_ops_per_sec", "ceph_pool_rd_bytes_per_sec",
+    "ceph_pool_wr_ops_per_sec", "ceph_pool_wr_bytes_per_sec",
+    "ceph_pgs_by_state",
 }
 
 
@@ -397,10 +418,17 @@ def test_metric_schema_frozen(loop):
                 gname = f"osd.{osd.whoami}" if group == "osd" else group
                 missing = names - set(dump.get(gname, {}))
                 assert not missing, f"perf dump dropped {missing}"
+            # IO so a primary has a PG backend: per-pool PGMap series
+            # only exist once a pool's pg_stats have been reported
+            client = await c.client()
+            await client.io_ctx("p").write_full("o", b"x" * 1024)
             await asyncio.sleep(0.25)   # let every osd report
             body = await _http_get(c.mgr.prometheus_port())
             series = _parse_series(body)
             names = {n.split("{", 1)[0] for n in series}
             missing = REQUIRED_PROM_SERIES - names
             assert not missing, f"prometheus endpoint dropped {missing}"
+            missing = REQUIRED_POOL_SERIES - names
+            assert not missing, \
+                f"per-pool PGMap series missing after IO: {missing}"
     loop.run_until_complete(go())

@@ -1,0 +1,229 @@
+"""PGMap rate derivation + progress events (PR: cluster accounting).
+
+Unit-drives the mgr-side PGMapModule/ProgressModule with synthetic
+report ingests — no cluster — pinning the three rules that keep the
+derived numbers honest across daemon death and restart:
+
+- zero delta between consecutive reports -> zero rate (not NaN/stale);
+- a restarted daemon's counter reset (negative delta) clamps to zero;
+- a stale daemon's last report stops contributing to IO rates and
+  degraded totals immediately (the stats-vs-purge rule), and the
+  purge's ``forget`` drops its rate state and orphaned PG rows.
+
+Reference: src/mon/PGMap.cc apply_incremental's delta clamp +
+src/pybind/mgr/progress event lifecycle.
+"""
+
+import time
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.mgr.pgmap import PGMapModule, ProgressModule, hist_pct
+
+
+class FakeMgr:
+    """Duck-typed MgrDaemon: just the surface the PGMap modules use."""
+
+    def __init__(self, period: float = 1.0) -> None:
+        self.config = Config()
+        self.config.set("mgr_stats_period", period)
+        self.reports = {}
+        self.modules = {}
+
+    def is_fresh(self, rep: dict, mult: float = 3.0) -> bool:
+        period = float(self.config.get("mgr_stats_period"))
+        return time.monotonic() - rep["ts"] < mult * period
+
+    def report(self, name: str, age: float = 0.0) -> None:
+        self.reports[name] = {"ts": time.monotonic() - age,
+                              "perf": {}, "status": {}, "epoch": 1}
+
+
+def _stat(**kw) -> dict:
+    base = {"objects": 1, "bytes": 1024, "log_size": 1,
+            "rd_ops": 0, "rd_bytes": 0, "wr_ops": 0, "wr_bytes": 0,
+            "recovery_ops": 0, "recovery_bytes": 0,
+            "degraded": 0, "misplaced": 0, "unfound": 0,
+            "state": "active+clean"}
+    base.update(kw)
+    return base
+
+
+def _mk(period: float = 1.0):
+    mgr = FakeMgr(period)
+    pgmap = PGMapModule(mgr)
+    mgr.modules["pgmap"] = pgmap
+    return mgr, pgmap
+
+
+# ------------------------------------------------------- rate derivation
+
+def test_rates_from_consecutive_deltas():
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_ops=10, wr_bytes=1000)},
+                 ts=100.0, epoch=3)
+    assert pgmap.pool_io_rates() == {}          # one report: no window
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_ops=30, wr_bytes=5000)},
+                 ts=102.0, epoch=3)
+    rates = pgmap.pool_io_rates()["1"]
+    assert rates["wr_ops_per_sec"] == 10.0      # 20 ops / 2 s
+    assert rates["wr_bytes_per_sec"] == 2000.0  # 4000 B / 2 s
+
+
+def test_zero_delta_gives_zero_rate():
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    st = _stat(wr_ops=30, wr_bytes=5000, rd_ops=7, rd_bytes=700)
+    pgmap.ingest("osd.0", {"1.0": st}, ts=10.0, epoch=1)
+    pgmap.ingest("osd.0", {"1.0": dict(st)}, ts=11.0, epoch=1)
+    rates = pgmap.pool_io_rates()["1"]
+    assert all(v == 0.0 for v in rates.values()), rates
+
+
+def test_counter_reset_after_restart_clamps_to_zero():
+    """A revived daemon restarts its cumulative counters at zero; the
+    negative delta must clamp, never extrapolate a negative rate."""
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_ops=500, wr_bytes=99999,
+                                        rd_ops=40)},
+                 ts=10.0, epoch=1)
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_ops=3, wr_bytes=300)},
+                 ts=11.0, epoch=2)
+    rates = pgmap.pool_io_rates()["1"]
+    assert rates["wr_ops_per_sec"] == 0.0
+    assert rates["wr_bytes_per_sec"] == 0.0
+    assert rates["rd_ops_per_sec"] == 0.0
+    # the next clean window derives normally again
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_ops=13, wr_bytes=1300)},
+                 ts=12.0, epoch=2)
+    assert pgmap.pool_io_rates()["1"]["wr_ops_per_sec"] == 10.0
+
+
+def test_stale_reporter_excluded_from_rates_and_degraded():
+    mgr, pgmap = _mk(period=1.0)
+    mgr.report("osd.0")
+    mgr.report("osd.1", age=60.0)               # long stale
+    for d, pg in (("osd.0", "1.0"), ("osd.1", "1.1")):
+        pgmap.ingest(d, {pg: _stat(wr_bytes=0, degraded=0)},
+                     ts=10.0, epoch=1)
+        pgmap.ingest(d, {pg: _stat(wr_bytes=1000, degraded=5,
+                                   state="active+degraded")},
+                     ts=11.0, epoch=1)
+    # only the fresh daemon's window counts toward cluster rates
+    assert pgmap.pool_io_rates()["1"]["wr_bytes_per_sec"] == 1000.0
+    summ = pgmap.pg_summary()
+    assert summ["num_pgs"] == 2
+    assert summ["degraded"] == 5                # osd.1's 5 excluded
+    assert summ["states"].get("stale") == 1
+    # stored data does NOT evaporate with its reporter
+    assert summ["objects"] == 2
+
+
+def test_forget_drops_rate_state_and_orphan_rows():
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    mgr.report("osd.1")
+    pgmap.ingest("osd.0", {"1.0": _stat()}, ts=10.0, epoch=1)
+    pgmap.ingest("osd.0", {"1.0": _stat(wr_bytes=100)}, ts=11.0,
+                 epoch=1)
+    pgmap.ingest("osd.1", {"1.1": _stat()}, ts=10.0, epoch=1)
+    del mgr.reports["osd.0"]                    # the mgr purge path
+    pgmap.forget("osd.0")
+    assert "1.0" not in pgmap.pg_stats
+    assert pgmap.pool_io_rates() == {}          # its window died too
+    assert pgmap.pg_summary()["num_pgs"] == 1
+
+
+def test_latest_epoch_wins_pg_row():
+    """After an interval change the new primary's row (higher epoch)
+    retires the old reporter's; an older epoch cannot resurrect it."""
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    mgr.report("osd.1")
+    pgmap.ingest("osd.0", {"1.0": _stat(objects=5)}, ts=10.0, epoch=4)
+    pgmap.ingest("osd.1", {"1.0": _stat(objects=7)}, ts=11.0, epoch=6)
+    assert pgmap.pg_stats["1.0"]["reporter"] == "osd.1"
+    pgmap.ingest("osd.2", {"1.0": _stat(objects=9)}, ts=12.0, epoch=5)
+    assert pgmap.pg_stats["1.0"]["reporter"] == "osd.1"
+    assert pgmap.pg_stats["1.0"]["stat"]["objects"] == 7
+    # the current reporter always refreshes its own row
+    pgmap.ingest("osd.1", {"1.0": _stat(objects=8)}, ts=13.0, epoch=6)
+    assert pgmap.pg_stats["1.0"]["stat"]["objects"] == 8
+
+
+def test_pg_dump_and_df_views():
+    mgr, pgmap = _mk()
+    mgr.report("osd.0")
+    pgmap.ingest("osd.0", {"1.0": _stat(objects=3, bytes=3000),
+                           "1.1": _stat(objects=2, bytes=2000)},
+                 ts=10.0, epoch=2)
+    dump = pgmap.pg_dump()
+    assert [r["pgid"] for r in dump["pg_stats"]] == ["1.0", "1.1"]
+    assert dump["pg_stats"][0]["state"] == "active+clean"
+    df = pgmap.df()
+    assert df["pools"]["1"]["objects"] == 5
+    assert df["pools"]["1"]["stored"] == 5000
+    assert df["pools"]["1"]["pgs"] == 2
+
+
+def test_hist_pct_handles_str_and_int_bucket_keys():
+    h = {"count": 10, "buckets": {"7": 5, "127": 4, "1023": 1}}
+    assert hist_pct(h, 0.50) == 7
+    assert hist_pct(h, 0.99) == 1023
+    assert hist_pct({"count": 0, "buckets": {}}, 0.99) == 0
+
+
+# ------------------------------------------------------- progress events
+
+def _deg(pgmap_mgr, pgmap, n: int) -> None:
+    """Push the cluster degraded total to n via a fresh report."""
+    pgmap_mgr.report("osd.0")
+    pgmap.ingest("osd.0", {"1.0": _stat(degraded=n,
+                                        state="active+degraded"
+                                        if n else "active+clean")},
+                 ts=time.monotonic(), epoch=1)
+
+
+def test_progress_event_lifecycle():
+    mgr, pgmap = _mk(period=0.1)
+    progress = ProgressModule(mgr)
+    progress.GRACE_PERIODS = 1.0        # tiny grace window for the test
+    mgr.modules["progress"] = progress
+
+    progress.tick()                     # healthy: nothing opens
+    assert progress.dump() == {"events": [], "completed": []}
+
+    _deg(mgr, pgmap, 4)
+    progress.tick()
+    evs = progress.dump()["events"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert "4 degraded objects" in ev["message"]
+    assert ev["initial"] == 4 and not ev["done"]
+
+    _deg(mgr, pgmap, 2)                 # half drained
+    progress.tick()
+    ev = progress.dump()["events"][0]
+    assert ev["remaining"] == 2 and ev["fraction"] == 0.5
+
+    _deg(mgr, pgmap, 6)                 # more damage mid-recovery:
+    progress.tick()                     # denominator grows, same event
+    ev = progress.dump()["events"][0]
+    assert ev["initial"] == 6 and len(progress.dump()["events"]) == 1
+
+    _deg(mgr, pgmap, 0)                 # drained
+    progress.tick()
+    ev = progress.dump()["events"][0]
+    assert ev["done"] and ev["fraction"] == 1.0
+
+    time.sleep(0.15)                    # > GRACE_PERIODS * period
+    progress.tick()
+    d = progress.dump()
+    assert d["events"] == []            # expired into the history ring
+    assert len(d["completed"]) == 1 and d["completed"][0]["done"]
+
+    # a fresh degraded spike opens a NEW event, not a resurrection
+    _deg(mgr, pgmap, 3)
+    progress.tick()
+    assert progress.dump()["events"][0]["id"] != ev["id"]

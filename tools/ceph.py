@@ -8,6 +8,10 @@ daemon's admin socket (the 'ceph daemon <sock> <cmd>' form).
   python tools/ceph.py --mon 0=127.0.0.1:7101 status
   python tools/ceph.py --mon ... health
   python tools/ceph.py --mon ... osd tree
+  python tools/ceph.py --mon ... pg stat           # PGMap via the mgr
+  python tools/ceph.py --mon ... df
+  python tools/ceph.py --mon ... osd perf
+  python tools/ceph.py --mon ... progress
   python tools/ceph.py --mon ... osd pool create data \
       --kw type=erasure --kw pg_num=8 --kw ec_profile=myprof
   python tools/ceph.py --mon ... osd erasure-code-profile set myprof \
@@ -48,7 +52,9 @@ _PREFIXES = ["osd erasure-code-profile set", "osd erasure-code-profile get",
              "config get", "config set",
              "log last", "log",
              "crash ls", "crash info", "crash archive-all",
-             "crash archive"]
+             "crash archive",
+             # PGMap surfaces (served from the mgr digest on the mon)
+             "pg stat", "pg dump", "df", "osd perf", "progress"]
 
 
 def build_cmd(words: "list[str]", kwargs: dict) -> dict:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""ceph_daemon — run one mon or osd as a real OS process.
+"""ceph_daemon — run one mon, mgr or osd as a real OS process.
 
 The multi-process tier (reference: ceph_mon/ceph_osd binaries launched
 by vstart.sh / qa/standalone/ceph-helpers.sh): daemons talk over real
@@ -8,6 +8,8 @@ and respawned against the same data directory.
 
   python tools/ceph_daemon.py mon --rank 0 \
       --mon-addrs 0=127.0.0.1:7101,1=127.0.0.1:7102 --asok /run/ceph_tpu
+  python tools/ceph_daemon.py mgr --addr 127.0.0.1:7300 \
+      --mon-addrs 0=127.0.0.1:7101 --asok /run/ceph_tpu
   python tools/ceph_daemon.py osd --id 3 --addr 127.0.0.1:0 \
       --mon-addrs 0=127.0.0.1:7101 --data /tmp/osd3 [--mgr 127.0.0.1:7300]
 
@@ -78,10 +80,23 @@ async def run_mon(args) -> None:
     from ceph_tpu.mon.monitor import MonDaemon
 
     mon = MonDaemon(args.rank, parse_mon_addrs(args.mon_addrs),
-                    base_config(args))
+                    base_config(args), mgr_addr=args.mgr or None)
     await mon.init()
     print(json.dumps({"ready": True, "role": "mon", "rank": args.rank,
                       "addr": mon.ms.listen_addr}), flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_mgr(args) -> None:
+    from ceph_tpu.mgr.daemon import MgrDaemon
+
+    mgr = MgrDaemon(base_config(args), addr=args.addr,
+                    mon_addrs=parse_mon_addrs(args.mon_addrs)
+                    if args.mon_addrs else None)
+    await mgr.init()
+    print(json.dumps({"ready": True, "role": "mgr", "addr": mgr.addr,
+                      "prometheus_port": mgr.prometheus_port()}),
+          flush=True)
     await asyncio.Event().wait()
 
 
@@ -122,8 +137,21 @@ def main(argv=None) -> int:
     pm.add_argument("--asok", default="",
                     help="admin-socket dir (binds <dir>/<name>.asok "
                          "serving log dump / set-level / get-level)")
+    pm.add_argument("--mgr", default="",
+                    help="mgr address to report to (mon status reports "
+                         "feed ceph_daemon_up; the PGMap digest comes "
+                         "back on this channel)")
     pm.add_argument("-o", "--option", action="append",
                     help="config override key=value")
+    pg = sub.add_parser("mgr")
+    pg.add_argument("--addr", default="127.0.0.1:0")
+    pg.add_argument("--mon-addrs", default="",
+                    help="optional mon quorum (enables clog/crash "
+                         "posting and the status digest push)")
+    pg.add_argument("--asok", default="",
+                    help="admin-socket dir (binds <dir>/mgr.asok: "
+                         "pg dump / pg stat / df / osd perf / progress)")
+    pg.add_argument("-o", "--option", action="append")
     po = sub.add_parser("osd")
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--addr", default="127.0.0.1:0")
@@ -134,9 +162,9 @@ def main(argv=None) -> int:
                     help="admin-socket dir (binds <dir>/<name>.asok)")
     po.add_argument("-o", "--option", action="append")
     args = p.parse_args(argv)
+    runner = {"mon": run_mon, "mgr": run_mgr, "osd": run_osd}[args.role]
     try:
-        asyncio.run(run_mon(args) if args.role == "mon"
-                    else run_osd(args))
+        asyncio.run(runner(args))
     except KeyboardInterrupt:
         pass
     return 0

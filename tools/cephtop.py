@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""cephtop — daemonperf-style live console for a process fleet.
+
+Reference: the `ceph daemonperf` / `ceph -w` operator loop.  Polls the
+admin sockets of a vstart/proc_chaos subprocess fleet directly — no
+mon round-trip, works even while the quorum is unhappy — and renders
+one screen per interval:
+
+- a cluster header from the mgr's PGMap (pg states, degraded objects,
+  per-pool IO + recovery rates, active progress events);
+- one row per OSD with WINDOWED rates and percentiles (the delta
+  between consecutive polls, not lifetime averages): client op/s,
+  write/read MB/s, EC sub-writes/s, p99 commit latency, p99 event-loop
+  lag, mean WAL group-commit batch, p99 shard queue depth.
+
+A daemon that dies mid-poll renders as `down` and its stale numbers
+are dropped (the same counter-reset clamp the mgr's PGMap applies);
+on revive the first window after restart clamps negative deltas to 0.
+
+Usage:
+  python tools/cephtop.py --asok-dir /tmp/proc_chaos_x/round0/asok
+  python tools/cephtop.py '/tmp/fleet/asok/*.asok' --interval 2
+  python tools/cephtop.py --asok-dir ... --once --json   # one sample,
+      machine-readable (CI and scripts; no screen control codes)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.common.admin_socket import (AdminSocketError,  # noqa: E402
+                                          admin_command)
+from ceph_tpu.mgr.pgmap import hist_pct  # noqa: E402
+
+CLEAR = "\x1b[H\x1b[2J"
+
+
+def discover(patterns: "List[str]") -> "Dict[str, str]":
+    """Glob asok paths -> {daemon name: path} (re-run every interval:
+    fleet membership changes under a nemesis)."""
+    paths: "Dict[str, str]" = {}
+    for pat in patterns:
+        for p in sorted(globmod.glob(pat)):
+            name = os.path.basename(p)
+            if name.endswith(".asok"):
+                name = name[:-len(".asok")]
+            paths[name] = p
+    return paths
+
+
+def poll(paths: "Dict[str, str]", timeout: float) -> dict:
+    """One sweep over the fleet: OSD perf dumps, the mgr's cluster
+    views, and an up/down liveness bit per socket."""
+    osds: "Dict[str, dict]" = {}
+    mgr: "Optional[dict]" = None
+    up: "Dict[str, bool]" = {}
+    for name, path in sorted(paths.items()):
+        try:
+            if name.startswith("osd."):
+                osds[name] = admin_command(path, "perf dump",
+                                           timeout=timeout)
+            elif name == "mgr":
+                mgr = {"pg": admin_command(path, "pg stat",
+                                           timeout=timeout),
+                       "rates": admin_command(path, "pool rates",
+                                              timeout=timeout),
+                       "progress": admin_command(path, "progress",
+                                                 timeout=timeout)}
+            else:
+                admin_command(path, "status", timeout=timeout)
+            up[name] = True
+        except (OSError, AdminSocketError):
+            up[name] = False
+    return {"ts": time.monotonic(), "osds": osds, "mgr": mgr, "up": up}
+
+
+def hist_delta(cur, prev) -> "Optional[dict]":
+    """Windowed histogram: per-bucket count delta between two lifetime
+    dumps (negative deltas — daemon restarted — clamp to zero)."""
+    if not isinstance(cur, dict) or "buckets" not in cur:
+        return None
+    pb = prev.get("buckets", {}) if isinstance(prev, dict) else {}
+    buckets: "Dict[str, int]" = {}
+    for ub, n in cur.get("buckets", {}).items():
+        d = int(n) - int(pb.get(ub, 0))
+        if d > 0:
+            buckets[ub] = d
+    psum = float(prev.get("sum", 0.0)) if isinstance(prev, dict) else 0.0
+    return {"count": sum(buckets.values()),
+            "sum": max(float(cur.get("sum", 0.0)) - psum, 0.0),
+            "buckets": buckets}
+
+
+def snapshot(cur: dict, prev: dict) -> dict:
+    """Fold two polls into one renderable sample (rates = deltas/dt)."""
+    dt = max(cur["ts"] - prev["ts"], 1e-6)
+    rows: "List[dict]" = []
+    for name in sorted(cur["osds"],
+                       key=lambda n: int(n.split(".", 1)[1])):
+        # each OSD's counters live in its own perf group ("osd.N")
+        grp = cur["osds"][name].get(name, {})
+        pgrp = prev["osds"].get(name, {}).get(name, {})
+
+        def rate(c: str) -> float:
+            return max(0, int(grp.get(c, 0) or 0)
+                       - int(pgrp.get(c, 0) or 0)) / dt
+
+        row = {"daemon": name, "up": cur["up"].get(name, False),
+               "op_s": rate("op"),
+               "wr_mb_s": rate("op_in_bytes") / 1e6,
+               "rd_mb_s": rate("op_out_bytes") / 1e6,
+               "subop_s": rate("subop_w")}
+        commit = hist_delta(grp.get("op_w_commit_lat"),
+                            pgrp.get("op_w_commit_lat"))
+        row["commit_p99_ms"] = (hist_pct(commit, 0.99) / 1000.0
+                                if commit and commit["count"] else 0.0)
+        lag = hist_delta(grp.get("loop_lag_ms"), pgrp.get("loop_lag_ms"))
+        row["lag_p99_ms"] = (hist_pct(lag, 0.99)
+                             if lag and lag["count"] else 0)
+        wal = hist_delta(grp.get("osd_wal_group_commit_batch"),
+                         pgrp.get("osd_wal_group_commit_batch"))
+        row["wal_batch"] = (wal["sum"] / wal["count"]
+                            if wal and wal["count"] else 0.0)
+        shq = hist_delta(grp.get("osd_shard_queue_depth"),
+                         pgrp.get("osd_shard_queue_depth"))
+        row["shardq_p99"] = (hist_pct(shq, 0.99)
+                             if shq and shq["count"] else 0)
+        rows.append(row)
+
+    cluster: dict = {}
+    mgr = cur.get("mgr")
+    if mgr is not None:
+        pg = mgr.get("pg") or {}
+        rates = mgr.get("rates") or {}
+        io = {"rd_bytes_per_sec": 0.0, "wr_bytes_per_sec": 0.0,
+              "wr_ops_per_sec": 0.0, "recovery_bytes_per_sec": 0.0,
+              "recovery_ops_per_sec": 0.0}
+        for r in rates.values():
+            for k in io:
+                io[k] += float(r.get(k, 0.0))
+        cluster = {"pgs": pg, "io": io,
+                   "progress": (mgr.get("progress") or {}).get(
+                       "events", [])}
+    down = sorted(n for n, ok in cur["up"].items() if not ok)
+    return {"interval_s": round(dt, 3), "cluster": cluster,
+            "osds": rows, "daemons_up": sum(cur["up"].values()),
+            "daemons_total": len(cur["up"]), "down": down}
+
+
+def render(snap: dict) -> str:
+    lines = [f"cephtop  {time.strftime('%H:%M:%S')}  "
+             f"window {snap['interval_s']:.1f}s  daemons "
+             f"{snap['daemons_up']}/{snap['daemons_total']} up"
+             + (f"  DOWN: {', '.join(snap['down'])}" if snap["down"]
+                else "")]
+    cl = snap["cluster"]
+    if cl:
+        pg = cl.get("pgs") or {}
+        states = " ".join(f"{v} {k}" for k, v in
+                          sorted((pg.get("states") or {}).items()))
+        lines.append(
+            f"pgs: {pg.get('num_pgs', 0)} ({states or 'none'})  "
+            f"objects: {pg.get('objects', 0)}  "
+            f"degraded: {pg.get('degraded', 0)}  "
+            f"misplaced: {pg.get('misplaced', 0)}  "
+            f"unfound: {pg.get('unfound', 0)}")
+        io = cl.get("io") or {}
+        lines.append(
+            f"io: wr {io.get('wr_bytes_per_sec', 0.0) / 1e6:.2f} MB/s "
+            f"({io.get('wr_ops_per_sec', 0.0):.0f} op/s), "
+            f"rd {io.get('rd_bytes_per_sec', 0.0) / 1e6:.2f} MB/s; "
+            f"recovery {io.get('recovery_bytes_per_sec', 0.0) / 1e6:.2f}"
+            f" MB/s ({io.get('recovery_ops_per_sec', 0.0):.1f} op/s)")
+        for ev in cl.get("progress", []):
+            frac = float(ev.get("fraction", 0.0))
+            bar = "#" * int(frac * 20)
+            lines.append(f"progress: [{bar:<20}] {frac:5.1%}  "
+                         f"{ev.get('message', '')}")
+    lines.append("")
+    lines.append(f"{'daemon':<8} {'op/s':>7} {'wrMB/s':>7} {'rdMB/s':>7}"
+                 f" {'sub/s':>7} {'commit99':>9} {'lag99':>6} "
+                 f"{'walbat':>6} {'shq99':>5}")
+    for r in snap["osds"]:
+        if not r["up"]:
+            lines.append(f"{r['daemon']:<8} {'down':>7}")
+            continue
+        lines.append(
+            f"{r['daemon']:<8} {r['op_s']:>7.1f} {r['wr_mb_s']:>7.2f} "
+            f"{r['rd_mb_s']:>7.2f} {r['subop_s']:>7.1f} "
+            f"{r['commit_p99_ms']:>7.2f}ms {r['lag_p99_ms']:>4}ms "
+            f"{r['wal_batch']:>6.1f} {r['shardq_p99']:>5}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("asok", nargs="*",
+                   help="admin-socket glob(s), e.g. '/run/fleet/*.asok'")
+    p.add_argument("--asok-dir", default="",
+                   help="directory of .asok files (vstart asok dir)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between screens (default 1.0)")
+    p.add_argument("--once", action="store_true",
+                   help="print one sample and exit (uses a short "
+                        "internal window to derive rates)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output, no screen control")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-socket command timeout")
+    args = p.parse_args(argv)
+    patterns = list(args.asok)
+    if args.asok_dir:
+        patterns.append(os.path.join(args.asok_dir, "*.asok"))
+    if not patterns:
+        p.error("give --asok-dir or at least one asok glob")
+
+    prev = poll(discover(patterns), args.timeout)
+    try:
+        while True:
+            time.sleep(min(args.interval, 1.0) if args.once
+                       else args.interval)
+            cur = poll(discover(patterns), args.timeout)
+            snap = snapshot(cur, prev)
+            prev = cur
+            if args.json:
+                print(json.dumps(snap), flush=True)
+            else:
+                out = render(snap)
+                print((out if args.once else CLEAR + out), flush=True)
+            if args.once:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

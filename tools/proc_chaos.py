@@ -92,6 +92,9 @@ class _Round:
                       for o in self.objects}
         self.stragglers: "list[asyncio.Task]" = []
         self.notes: "list[str]" = []
+        # monotonic stamp taken right before the nemesis fires: the
+        # progress gate only accepts recovery events born after it
+        self.nemesis_start = 0.0
 
     # --- blocking cluster calls off the client loop -----------------------
 
@@ -304,6 +307,36 @@ class _Round:
                     f"{len(st['unknown'])} unknown-outcome writes)")
         self._log("gate: readback clean")
 
+    async def gate_progress(self) -> None:
+        """A kill_osd round must produce a recovery progress event on
+        the mgr (degraded objects were observed > 0) and drive it to
+        completion (observed draining back to 0).  Events born before
+        the nemesis don't count; completed events linger on the mgr a
+        few grace periods precisely so this gate can catch them."""
+        state = {"seen": None}
+
+        async def done() -> bool:
+            try:
+                prog = await self.admin("mgr", "progress")
+            except Exception:
+                return False
+            evs = list(prog.get("events", [])) + \
+                list(prog.get("completed", []))
+            for ev in evs:
+                if float(ev.get("started", 0.0)) < self.nemesis_start:
+                    continue
+                state["seen"] = ev
+                if ev.get("done"):
+                    return True
+            return False
+
+        await self._wait("recovery progress event (started after the "
+                         "kill) to fire and complete on the mgr",
+                         done, self.args.bound)
+        ev = state["seen"]
+        self._log(f"gate: progress event complete — "
+                  f"{ev.get('message')!r} (initial={ev.get('initial')})")
+
     def gate_linearize(self) -> None:
         rec = history_mod.installed()
         if rec is None:
@@ -317,6 +350,33 @@ class _Round:
         self._log(f"gate: linearizable ({res.get('checked')} object(s) "
                   f"checked, {res.get('skipped')} skipped)")
 
+    async def report_status(self) -> None:
+        """Embed the cluster's own accounting in the round report: the
+        final 'ceph status' digest sections plus the pg summary.  Best
+        effort — a missing digest is logged, not a gate failure (the
+        mgr is not itself a nemesis target yet)."""
+        try:
+            st = await self.client.mon_command({"prefix": "status"})
+        except Exception as e:
+            self._log(f"status: unavailable ({e})")
+            return
+        pgs = st.get("pgs") or {}
+        io = st.get("io") or {}
+        rec = st.get("recovery") or {}
+        states = ",".join(f"{v} {k}" for k, v in
+                          sorted((pgs.get("states") or {}).items()))
+        self._log(f"status: health={st.get('health')} "
+                  f"pgs={pgs.get('num_pgs')} [{states}] "
+                  f"objects={pgs.get('objects')} "
+                  f"degraded={pgs.get('degraded')} "
+                  f"misplaced={pgs.get('misplaced')} "
+                  f"unfound={pgs.get('unfound')}")
+        self._log(f"status: io wr={io.get('wr_bytes_per_sec', 0):.0f}B/s"
+                  f"/{io.get('wr_ops_per_sec', 0):.0f}op/s "
+                  f"rd={io.get('rd_bytes_per_sec', 0):.0f}B/s; "
+                  f"recovery="
+                  f"{rec.get('recovery_bytes_per_sec', 0):.0f}B/s")
+
     # --- round driver -----------------------------------------------------
 
     def _log(self, msg: str) -> None:
@@ -324,9 +384,15 @@ class _Round:
 
     async def run(self, nemesis: str) -> None:
         os.makedirs(self.base_dir, exist_ok=True)
+        # mgr_stats_period=0.25 + osd_recovery_sleep=0.5: the smoke
+        # round recovers only a handful of objects, so without pacing
+        # the drain would finish inside one report period and no report
+        # would ever carry degraded>0 — the progress gate needs to SEE
+        # the recovery in flight, not just its end state
         self.pc = ProcCluster(
             self.base_dir, n_mons=self.args.mons, n_osds=self.args.osds,
-            options=["osd_heartbeat_grace=2.0"])
+            options=["osd_heartbeat_grace=2.0", "mgr_stats_period=0.25",
+                     "osd_recovery_sleep=0.5"])
         await self._bg(self.pc.start)
         cfg = Config()
         cfg.set("ms_type", "async+tcp")
@@ -351,6 +417,7 @@ class _Round:
                    for o in self.objects]
         try:
             await asyncio.sleep(1.0)         # seed some pre-fault state
+            self.nemesis_start = time.monotonic()
             await getattr(self, f"nem_{nemesis}")()
             await self.gate_reconverge()
             await asyncio.sleep(1.0)         # post-heal writes on record
@@ -365,8 +432,14 @@ class _Round:
                 if not t.done():
                     t.cancel()
             await asyncio.gather(*self.stragglers, return_exceptions=True)
+        if nemesis == "kill_osd":
+            # the accounting gate: a SIGKILL'd-and-revived OSD must
+            # surface as a recovery progress event on the mgr — born
+            # after the kill, driven to done — BEFORE readback runs
+            await self.gate_progress()
         await self.gate_readback()
         self.gate_linearize()
+        await self.report_status()
 
     async def teardown(self) -> None:
         if self.client is not None:

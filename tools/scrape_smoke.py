@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""scrape_smoke — end-to-end check of the multiprocess metrics path.
+
+Boots a real-process fleet (qa/vstart.py: mons + mgr + OSDs on real
+sockets), runs a PACED write burst against an EC pool, scrapes the
+mgr's prometheus endpoint over HTTP mid-burst, and asserts the whole
+accounting pipeline held together:
+
+- one ``ceph_daemon_up{...} 1`` series per subprocess daemon (every
+  mon and OSD found its way to the mgr over MMgrReport);
+- the pool's PGMap-derived write throughput is nonzero AND agrees with
+  the client's achieved rate within ``--tolerance`` (default 15%) —
+  the rate-derivation acceptance check from the PG stats pipeline;
+- zero degraded objects on a healthy fleet.
+
+The burst is paced (fixed sleep between fixed-size writes) so any
+single report window is representative of the whole run — comparing a
+0.5 s PGMap window against a multi-second client average only means
+something when the rate is steady by construction.
+
+Usage:  python tools/scrape_smoke.py [--osds 3] [--duration ...]
+Exit codes: 0 = pass; 1 = assertion failed; 2 = harness error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.client.rados import RadosClient  # noqa: E402
+from ceph_tpu.qa.vstart import ProcCluster  # noqa: E402
+
+
+class SmokeFailure(Exception):
+    pass
+
+
+def scrape(port: int, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def series_value(text: str, name: str, **labels) -> "float | None":
+    """First sample of ``name{labels...}`` in exposition text (labels
+    matched in the exporter's emission order — single-label series)."""
+    want = name + ("{" + ",".join(f'{k}="{v}"' for k, v
+                                  in sorted(labels.items())) + "} "
+                   if labels else " ")
+    for line in text.splitlines():
+        if line.startswith(want):
+            return float(line[len(want):])
+    return None
+
+
+async def _bg(fn, *a, **kw):
+    return await asyncio.get_running_loop().run_in_executor(
+        None, lambda: fn(*a, **kw))
+
+
+async def run(args) -> None:
+    pc = ProcCluster(args.dir, n_mons=args.mons, n_osds=args.osds,
+                     options=["mgr_stats_period=0.5"])
+    client = None
+    try:
+        await _bg(pc.start)
+        if not pc.mgr_prometheus_port:
+            raise SmokeFailure("mgr did not report a prometheus port")
+        cfg = Config()
+        cfg.set("ms_type", "async+tcp")
+        client = RadosClient(None, name="client.scrape", config=cfg,
+                             mon_addrs=dict(pc.mon_addrs))
+        await client.connect("127.0.0.1:0")
+        await client.mon_command({
+            "prefix": "osd erasure-code-profile set",
+            "name": "scrape-prof",
+            "profile": {"plugin": "jax_rs", "k": "2", "m": "1"}})
+        await client.mon_command({
+            "prefix": "osd pool create", "name": args.pool,
+            "kwargs": {"type": "erasure", "pg_num": 2,
+                       "ec_profile": "scrape-prof",
+                       "stripe_unit": 256}})
+        await client.monc.wait_for_map()
+        io = client.io_ctx(args.pool)
+        pool_id = client.osdmap.pool_by_name(args.pool).pool_id
+
+        payload = bytes(range(256)) * 16            # 4 KiB per write
+        stop = asyncio.Event()
+        stats = {"bytes": 0}
+
+        async def burst() -> None:
+            seq = 0
+            while not stop.is_set():
+                seq += 1
+                await io.write_full(f"obj{seq % 8}", payload)
+                stats["bytes"] += len(payload)
+                await asyncio.sleep(args.pace)
+
+        task = asyncio.ensure_future(burst())
+        try:
+            # warmup: enough report periods for every daemon to derive
+            # a rate window before the measurement starts
+            await asyncio.sleep(args.warmup)
+            t0, b0 = time.monotonic(), stats["bytes"]
+            await asyncio.sleep(args.duration)
+            achieved = (stats["bytes"] - b0) / (time.monotonic() - t0)
+            # scrape while the burst is still running, so every
+            # daemon's last rate window lies fully inside it
+            text = await _bg(scrape, pc.mgr_prometheus_port)
+        finally:
+            stop.set()
+            await asyncio.gather(task, return_exceptions=True)
+
+        daemons = [f"mon.{r}" for r in pc.mon_addrs] + \
+            [f"osd.{i}" for i in range(pc.n_osds)]
+        for name in daemons:
+            n = text.count(f'ceph_daemon_up{{ceph_daemon="{name}"}}')
+            if n != 1:
+                raise SmokeFailure(
+                    f"expected exactly one ceph_daemon_up series for "
+                    f"{name}, found {n}")
+            if series_value(text, "ceph_daemon_up",
+                            ceph_daemon=name) != 1.0:
+                raise SmokeFailure(f"{name} not up in the scrape")
+        print(f"scrape_smoke: ceph_daemon_up == 1 for all "
+              f"{len(daemons)} daemons", flush=True)
+
+        wr = series_value(text, "ceph_pool_wr_bytes_per_sec",
+                          pool=str(pool_id))
+        if not wr or wr <= 0:
+            raise SmokeFailure(
+                f"per-pool write rate missing or zero (pool {pool_id}:"
+                f" {wr})")
+        err = abs(wr - achieved) / achieved
+        print(f"scrape_smoke: pool wr rate {wr:.0f} B/s vs client "
+              f"achieved {achieved:.0f} B/s ({err:.1%} apart)",
+              flush=True)
+        if err > args.tolerance:
+            raise SmokeFailure(
+                f"PGMap write rate {wr:.0f} B/s disagrees with the "
+                f"client's achieved {achieved:.0f} B/s by {err:.1%} "
+                f"(> {args.tolerance:.0%})")
+
+        deg = series_value(text, "ceph_cluster_degraded_objects")
+        if deg is None or deg != 0:
+            raise SmokeFailure(
+                f"healthy fleet reports degraded objects: {deg}")
+        pg_total = series_value(text, "ceph_pg_total")
+        if not pg_total:
+            raise SmokeFailure(f"ceph_pg_total missing/zero: {pg_total}")
+        print("scrape_smoke: PASS", flush=True)
+    finally:
+        if client is not None:
+            try:
+                await asyncio.wait_for(client.shutdown(), 15.0)
+            except Exception:
+                pass
+        await _bg(pc.stop)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="multiprocess metrics-path smoke "
+                    "(fleet up -> write burst -> scrape mgr)")
+    p.add_argument("--mons", type=int, default=1)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--pool", default="scrape")
+    p.add_argument("--warmup", type=float, default=2.0,
+                   help="seconds of burst before measuring")
+    p.add_argument("--duration", type=float, default=3.0,
+                   help="measurement window (seconds)")
+    p.add_argument("--pace", type=float, default=0.01,
+                   help="sleep between writes (steady-rate pacing)")
+    p.add_argument("--tolerance", type=float, default=0.15,
+                   help="max relative rate disagreement (0.15 = 15%%)")
+    p.add_argument("--dir", default="")
+    p.add_argument("--keep", action="store_true")
+    args = p.parse_args(argv)
+    if not args.dir:
+        args.dir = tempfile.mkdtemp(prefix="scrape_smoke_")
+    os.makedirs(args.dir, exist_ok=True)
+    try:
+        asyncio.run(run(args))
+    except SmokeFailure as e:
+        print(f"scrape_smoke: FAIL — {e}", flush=True)
+        print(f"  daemon logs under {args.dir}", flush=True)
+        return 1
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return 2
+    if not args.keep:
+        shutil.rmtree(args.dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,12 @@ Usage:
   python tools/trace.py attribution dumps/*.json
   python tools/trace.py export dumps/*.json --out trace.json
   python tools/trace.py summary dumps/*.json
+  python tools/trace.py attribution --asok '/run/fleet/asok/*.asok'
+
+``--asok`` drains live daemons directly: every admin socket matching
+the glob is sent 'trace dump' and the results merge with any file
+dumps on the command line — no intermediate JSON files needed when
+pointing at a vstart/proc_chaos fleet's asok directory.
 
 'export' writes Chrome trace-event JSON — load it in Perfetto
 (ui.perfetto.dev) or chrome://tracing; each daemon renders as a
@@ -257,7 +263,11 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("mode", choices=("tree", "attribution", "export",
                                     "summary"))
-    p.add_argument("dumps", nargs="+", help="trace dump JSON files")
+    p.add_argument("dumps", nargs="*", help="trace dump JSON files")
+    p.add_argument("--asok", default="",
+                   help="admin-socket glob: drain 'trace dump' from "
+                        "every matching live daemon and merge with "
+                        "any file dumps")
     p.add_argument("--trace", default="",
                    help="only this trace id (tree mode)")
     p.add_argument("--out", default="",
@@ -266,7 +276,26 @@ def main(argv=None) -> int:
                    help="machine-readable output")
     args = p.parse_args(argv)
 
-    trees = assemble(load_dumps(args.dumps))
+    sources: "List" = list(args.dumps)
+    if args.asok:
+        import glob as globmod
+
+        from ceph_tpu.common.admin_socket import (AdminSocketError,
+                                                  admin_command)
+        matched = sorted(globmod.glob(args.asok))
+        if not matched:
+            raise SystemExit(f"--asok: no sockets match {args.asok!r}")
+        for path in matched:
+            try:
+                sources.append(admin_command(path, "trace dump"))
+            except (OSError, AdminSocketError) as e:
+                # a daemon that died mid-sweep just contributes no
+                # spans; its peers' halves still assemble (as orphans)
+                print(f"trace: skipping {path}: {e}", file=sys.stderr)
+    if not sources:
+        p.error("give dump files and/or --asok")
+
+    trees = assemble(load_dumps(sources))
     if args.mode == "tree":
         picked = ({args.trace: trees[args.trace]} if args.trace
                   else trees)
