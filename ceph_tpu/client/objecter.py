@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import history as history_mod
+from ..common.buffer import BufferList, buffer_length
 from ..common.log import dout
 from ..msg.messenger import Dispatcher, Messenger, Policy
 from ..osd.messages import ENOENT, ESTALE, MOSDOp, MOSDOpReply, \
@@ -102,8 +103,21 @@ class Objecter(Dispatcher):
         # pulsed on every new osdmap epoch: wakes jitter-sleepers and
         # (via on_map_change) releases every parked op
         self._map_event = asyncio.Event()
+        # op batching (the shard-side batch contract one hop earlier):
+        # ready ops coalesce per (osd, pool, pg) into one multi-rider
+        # MOSDOp; the first rider lingers one window for company, a
+        # full bucket cuts immediately
+        self.batching = bool(ms.conf("objecter_op_batching"))
+        self.batch_max = max(1, int(ms.conf("objecter_op_batch_max")))
+        self.batch_window = float(
+            ms.conf("objecter_op_batch_window_us")) / 1e6
+        self._pending: "Dict[Tuple[int, int, int], list]" = {}
         self.stats = {"backoffs_received": 0, "unblocks_received": 0,
-                      "backoff_parks": 0, "map_wakeups": 0}
+                      "backoff_parks": 0, "map_wakeups": 0,
+                      # the batching ablation's client-hop numerator /
+                      # denominator: frames_per_op < 1 is the wire
+                      # amortization proof at the objecter hop
+                      "ops_sent": 0, "op_frames_sent": 0}
         # (pool_id, oid, watch_id) -> callback(oid, payload)
         self.watch_callbacks: "Dict[tuple, Any]" = {}
         # cephx: service ticket attached to every op; ``ticket_renewer``
@@ -346,11 +360,8 @@ class Objecter(Dispatcher):
                                    "parent": root.span_id}
             if self.ticket:
                 fields["ticket"] = self.ticket
-            msg = MOSDOp(fields, data)
             try:
-                conn = self.ms.get_connection(
-                    self.osdmap.get_addr(primary), Policy.lossy_client())
-                await conn.send_message(msg)
+                await self._send_op(primary, fields, data)
                 reply = await asyncio.wait_for(fut, self.op_timeout)
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_err = e
@@ -430,6 +441,134 @@ class Objecter(Dispatcher):
         raise ObjecterError(
             f"op on {oid} failed after {self.max_retries} tries: {last_err}")
 
+    # --- op batching (reference: the MOSDOp multi-op vector, applied
+    # --- across logical ops; mirrors the shard-side batch contract) ----------
+
+    async def _send_op(self, osd: int, fields: dict, data) -> None:
+        """Send one logical op's wire attempt, coalescing ready ops
+        per (osd, pool, pg) into one multi-rider frame.  The rider's
+        reply/error arrives through its ``_inflight`` future either
+        way; only a direct (batching-off) send raises here."""
+        if not self.batching or self.batch_max <= 1:
+            self.stats["ops_sent"] += 1
+            self.stats["op_frames_sent"] += 1
+            conn = self.ms.get_connection(
+                self.osdmap.get_addr(osd), Policy.lossy_client())
+            await conn.send_message(MOSDOp(fields, data))
+            return
+        key = (osd, int(fields["pool"]), int(fields["pg"]))
+        bucket = self._pending.get(key)
+        if bucket is not None:
+            # join the open window; a full bucket cuts NOW (the cap),
+            # else the first rider's pending linger flushes it
+            bucket.append((fields, data))
+            if len(bucket) >= self.batch_max:
+                await self._flush_bucket(key, bucket)
+            return
+        bucket = [(fields, data)]
+        self._pending[key] = bucket
+        try:
+            # linger for company: one event-loop yield by default (ops
+            # already runnable this tick coalesce; a lone op never
+            # waits a timer), a real timer when the window is set
+            if self.batch_window > 0:
+                await asyncio.sleep(self.batch_window)
+            else:
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            # first rider cancelled mid-linger (caller timeout): hand
+            # the flush to a detached task so riders that joined the
+            # window aren't orphaned until their own op timeouts; the
+            # callback drains the task result so a flush error (dead
+            # target) can't surface as an unretrieved-exception warning
+            task = asyncio.ensure_future(self._flush_bucket(key, bucket))
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
+            raise
+        await self._flush_bucket(key, bucket)
+
+    async def _flush_bucket(self, key: "Tuple[int, int, int]",
+                            bucket: list) -> None:
+        """Cut one window: a single rider wires EXACTLY as the legacy
+        per-op frame; multi-rider frames carry the batch vector at
+        compat 2.  Send failures fail every rider's parked wait — each
+        rider's own retry loop re-targets."""
+        if self._pending.get(key) is not bucket:
+            return              # already cut (cap flush raced the linger)
+        del self._pending[key]
+        self.stats["ops_sent"] += len(bucket)
+        if len(bucket) == 1:
+            msg = MOSDOp(bucket[0][0], bucket[0][1])
+        else:
+            msg = self._build_batched_op(key, bucket)
+        try:
+            conn = self.ms.get_connection(
+                self.osdmap.get_addr(key[0]), Policy.lossy_client())
+            await conn.send_message(msg)
+            self.stats["op_frames_sent"] += 1
+        except (ConnectionError, OSError) as e:
+            for fields, _data in bucket:
+                fut = self._inflight.get(int(fields["tid"]))
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+
+    def _build_batched_op(self, key: "Tuple[int, int, int]",
+                          bucket: list) -> MOSDOp:
+        _osd, pool, pg = key
+        batch: "List[dict]" = []
+        blob = BufferList()
+        for fields, data in bucket:
+            entry = {"tid": fields["tid"], "oid": fields["oid"],
+                     "ops": fields["ops"],
+                     "dlen": buffer_length(data)}
+            for k in ("reqid", "trace_id", "trace"):
+                if k in fields:
+                    entry[k] = fields[k]
+            batch.append(entry)
+            if len(data):
+                # zero-copy: each rider's payload is ADOPTED as a
+                # segment of the frame's BufferList, never concatenated
+                blob.append(data)
+        first = bucket[0][0]
+        fields = {"tid": first["tid"], "pool": pool, "pg": pg,
+                  "oid": first["oid"], "ops": [],
+                  "map_epoch": self.osdmap.epoch, "batch": batch}
+        # one wire span per frame: the first sampled rider's context
+        # rides the top level (the messenger stamps it); every rider
+        # keeps its own context in its batch entry for the per-rider
+        # server span
+        for f, _d in bucket:
+            if "trace" in f:
+                fields["trace"] = f["trace"]
+                break
+        if self.ticket:
+            # session-scoped: one ticket covers every rider
+            fields["ticket"] = self.ticket
+        msg = MOSDOp(fields, blob)
+        # semantics-bearing batch (the top-level ops list is empty):
+        # advertise the v2 floor so a pre-batching decoder rejects the
+        # frame instead of serving a zero-op request
+        msg.compat_version = 2
+        return msg
+
+    def _fan_out_reply(self, msg) -> None:
+        """Resolve each rider's wait from one batched reply: per-rider
+        errno/outs from the batch vector, read payloads sliced from
+        ``data`` in rider order (each rider's outs' dlens delimit)."""
+        off = 0
+        for entry in msg.get("batch", []):
+            outs = list(entry.get("outs", []))
+            n = sum(int(o.get("dlen", 0) or 0) for o in outs)
+            sub = msg.data[off:off + n] if n else b""
+            off += n
+            fields = {"tid": entry["tid"],
+                      "result": entry.get("result", 0), "outs": outs}
+            if "retry_auth" in entry:
+                fields["retry_auth"] = entry["retry_auth"]
+            fut = self._inflight.get(int(entry["tid"]))
+            if fut is not None and not fut.done():
+                fut.set_result(MOSDOpReply(fields, sub))
+
     async def ms_dispatch(self, conn, msg) -> bool:
         if msg.TYPE == "osd_backoff":
             key = (int(msg["pgid"][0]), int(msg["pgid"][1]))
@@ -440,12 +579,14 @@ class Objecter(Dispatcher):
                     rec = _Backoff(int(msg["id"]), key,
                                    str(msg.get("reason", "")), conn)
                     self.backoffs[key] = rec
-                # wake the blocked op's wait NOW (the block rides the
-                # reply path carrying the op's tid) so it parks on the
-                # event instead of riding out the full op timeout
-                fut = self._inflight.get(int(msg.get("tid", 0)))
-                if fut is not None and not fut.done():
-                    fut.set_result(msg)
+                # wake the blocked ops' waits NOW (the block rides the
+                # reply path carrying the frame's rider tids) so each
+                # parks on the event instead of riding out the full op
+                # timeout; a single-rider block carries only ``tid``
+                for t in (msg.get("tids") or [msg.get("tid", 0)]):
+                    fut = self._inflight.get(int(t))
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
             else:
                 self.stats["unblocks_received"] += 1
                 rec = self.backoffs.pop(key, None)
@@ -474,6 +615,9 @@ class Objecter(Dispatcher):
             return True
         if msg.TYPE != "osd_op_reply":
             return False
+        if msg.get("batch"):
+            self._fan_out_reply(msg)
+            return True
         fut = self._inflight.get(int(msg["tid"]))
         if fut is not None and not fut.done():
             fut.set_result(msg)

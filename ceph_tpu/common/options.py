@@ -325,7 +325,28 @@ OPTIONS: "dict[str, Option]" = _opts(
                            "early, so resend is event-driven, not "
                            "timer-bound", services=("client",)),
     Option("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED, min=1,
-           desc="max concurrent client ops", services=("client",)),
+           desc="max concurrent client ops; charged per LOGICAL op, "
+                "never per batched frame, so a window of coalesced "
+                "riders can never deadlock admission",
+           services=("client",)),
+    Option("objecter_op_batching", bool, True, LEVEL_ADVANCED,
+           desc="coalesce ready client ops per (osd, pg) into one "
+                "multi-op MOSDOp frame (the shard-side batch contract "
+                "one hop earlier); a batch of one wires exactly as the "
+                "legacy single frame",
+           see_also=("objecter_op_batch_max",
+                     "objecter_op_batch_window_us"),
+           services=("client",)),
+    Option("objecter_op_batch_max", int, 16, LEVEL_ADVANCED, min=1,
+           desc="max logical ops coalesced into one client-op frame; "
+                "a full bucket flushes immediately (1 = per-op frames, "
+                "the pre-batching behavior)", services=("client",)),
+    Option("objecter_op_batch_window_us", float, 0.0, LEVEL_ADVANCED,
+           min=0, desc="microseconds the first rider lingers for "
+                       "same-(osd, pg) company before its frame cuts "
+                       "(0 = one event-loop yield, coalescing whatever "
+                       "is already runnable; a lone op never waits a "
+                       "timer)", services=("client",)),
     Option("client_striper_stripe_unit", int, 64 << 10, LEVEL_ADVANCED,
            min=512, desc="default striper stripe unit",
            services=("client",)),

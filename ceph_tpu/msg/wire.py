@@ -62,9 +62,10 @@ class WireError(Exception):
 # check_specs() raises on it (tests/test_wire.py runs both).
 WIRE_SPECS: "Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]" = {
     "osd_op": (("tid", "pool", "pg", "oid", "ops", "map_epoch"),
-               ("reqid", "trace_id", "ticket", "internal", "trace")),
+               ("reqid", "trace_id", "ticket", "internal", "trace",
+                "batch")),
     "osd_op_reply": (("tid", "result", "outs"),
-                     ("retry_auth", "trace")),
+                     ("retry_auth", "trace", "batch")),
     # optionals are APPEND-ONLY (the version-skew contract): "batch" /
     # "tids" (batched sub-write dispatch) and "trace" (distributed
     # tracing context) ride behind the older ones

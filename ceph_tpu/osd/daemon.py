@@ -44,8 +44,8 @@ from .scheduler import CLIENT, ShardedOpWQ
 from .messages import (MECSubOpRead, MECSubOpReadReply, MECSubOpWrite,
                        MECSubOpWriteReply, MOSDBackoff, MOSDOp,
                        MOSDOpReply, MOSDPGPush, MOSDPGPushReply, MOSDPing,
-                       MOSDPingReply, MWatchNotify, pack_buffers,
-                       sub_write_tids, unpack_buffers)
+                       MOSDPingReply, MWatchNotify, osd_op_tids,
+                       pack_buffers, sub_write_tids, unpack_buffers)
 from .osdmap import OSDMap
 from ..common.throttle import Throttle
 
@@ -68,6 +68,13 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_u64_counter("subop_w_frames",
                            "ec sub-write frames built (one per shard "
                            "per batch)")
+          # objecter op batching, observed where it lands: frames
+          # received at the client hop (batched riders fold into one)
+          # — client_op_frames/op < 1 is the objecter-hop counterpart
+          # of the subop_w_frames amortization proof
+          .add_u64_counter("client_op_frames",
+                           "client-op frames received (batched riders "
+                           "fold into one)")
           .add_u64_counter("tier_promote", "cache-tier promotions")
           .add_u64_counter("tier_flush", "cache-tier flushes to base")
           .add_u64_counter("tier_evict", "cache-tier evictions")
@@ -107,6 +114,11 @@ def _osd_perf(coll: PerfCountersCollection, name: str) -> PerfCounters:
           .add_histogram("osd_op_batch_size",
                          "client ops coalesced per batched sub-write "
                          "issue (per PG-batch)", "ops")
+          # the objecter hop's coalescing, one hop earlier than
+          # osd_op_batch_size: riders per received client-op frame
+          .add_histogram("objecter_batch_size",
+                         "logical ops per received client-op frame",
+                         "ops")
           .add_histogram("osd_subwrite_batch_txns",
                          "transactions applied per batched sub-write "
                          "(shard side)", "txns")
@@ -1471,11 +1483,17 @@ class OSDDaemon(Dispatcher):
             return
         dout("osd", 10, f"osd.{self.whoami} backoff block pg {pgid} "
                         f"({reason}) tid {msg.get('tid')}")
+        fields = {"op": "block", "pgid": list(pgid), "id": bid,
+                  "reason": reason, "tid": msg.get("tid"),
+                  "epoch": self.osdmap.epoch}
+        tids = osd_op_tids(msg)
+        if len(tids) > 1:
+            # one backoff parks the whole batched frame: list every
+            # rider so the client wakes each parked wait (tid stays
+            # the first rider's for pre-batching clients)
+            fields["tids"] = tids
         try:
-            await conn.send_message(MOSDBackoff({
-                "op": "block", "pgid": list(pgid), "id": bid,
-                "reason": reason, "tid": msg.get("tid"),
-                "epoch": self.osdmap.epoch}))
+            await conn.send_message(MOSDBackoff(fields))
         except (ConnectionError, OSError):
             # re-fetch after the send await: the record set may have
             # been released (and even re-registered) while the send was
@@ -1868,7 +1886,13 @@ class OSDDaemon(Dispatcher):
         at DEQUEUE instead (_handle_client_op), as the reference does
         in do_op."""
         pgid = (int(msg["pool"]), int(msg["pg"]))
-        took = False
+        # batched frames charge admission per LOGICAL op (rider), not
+        # per frame — the queue watermark bounds ops, and a 16-rider
+        # frame is 16 ops of work however few frames carried them
+        riders = len(msg.get("batch") or ()) or 1
+        self.perf.inc("client_op_frames")
+        self.perf.hinc("objecter_batch_size", riders)
+        took = 0
         internal = bool(msg.get("internal"))
         if self._backoff_enabled() and not internal:
             # the high-watermark is runtime-mutable ('config set
@@ -1878,7 +1902,8 @@ class OSDDaemon(Dispatcher):
             if high != self.op_throttle.max:
                 self.op_throttle.reset_max(high)
             if high > 0:
-                took = self.op_throttle.get_or_fail(1)
+                took = riders if self.op_throttle.get_or_fail(riders) \
+                    else 0
                 if not took:
                     # queue past the high-watermark: shed the op via
                     # backoff instead of letting it age toward the
@@ -1916,11 +1941,22 @@ class OSDDaemon(Dispatcher):
             name="client_op")
 
     async def _handle_client_op(self, conn, msg: MOSDOp,
-                                took: bool = False) -> None:
-        """The shard work item: runs with a slot already granted by the
-        shard's scheduler (crash-wrapped by the WQ's task factory — a
-        client-op handler dying unhandled is exactly the post-mortem
-        case; the client just times out)."""
+                                took: int = 0) -> None:
+        """The shard work item: runs with admission units already
+        granted (one per rider; crash-wrapped by the WQ's task factory
+        — a client-op handler dying unhandled is exactly the
+        post-mortem case; the client just times out)."""
+        if msg.get("batch"):
+            # batched frame: one work item, one dequeue-time backoff
+            # decision, one reply — the frame-amortization the
+            # objecter paid a linger window for
+            try:
+                await self._handle_client_batch(conn, msg)
+            finally:
+                if took:
+                    self.op_throttle.put(int(took))
+                self._maybe_release_queue_backoffs()
+            return
         ops = ",".join(o.get("op", "?") for o in msg.get("ops", []))
         top = self.op_tracker.create(
             f"osd_op({msg.get('reqid', '')} {msg.get('oid', '')} [{ops}])",
@@ -1970,7 +2006,7 @@ class OSDDaemon(Dispatcher):
                 if tspan is not None:
                     tspan.finish()
                 if took:
-                    self.op_throttle.put(1)
+                    self.op_throttle.put(int(took))
                 self._maybe_release_queue_backoffs()
 
     # op name -> required osd permission: mutations 'w', class exec 'x',
@@ -2058,6 +2094,109 @@ class OSDDaemon(Dispatcher):
                     "parent": str(tr["parent"])}
         return None
 
+    async def _run_one_rider(self, conn, rfields: dict, rmsg: MOSDOp
+                             ) -> "Tuple[int, List[dict], List, dict]":
+        """One batch rider with its own tracker / server span / errno
+        verdict — the same observability a single-op frame gets."""
+        opnames = ",".join(o.get("op", "?") for o in rfields["ops"])
+        top = self.op_tracker.create(
+            f"osd_op({rfields.get('reqid', '')} "
+            f"{rfields.get('oid', '')} [{opnames}])",
+            trace_id=str(rfields.get("trace_id", "")))
+        tr = rfields.get("trace")
+        tspan = None
+        if self.tracer.enabled and isinstance(tr, dict) \
+                and tr.get("parent"):
+            tspan = self.tracer.start_span(
+                "osd:op", str(tr.get("id", "")),
+                parent=str(tr["parent"]),
+                tags={"osd": self.whoami,
+                      "oid": str(rfields.get("oid", ""))})
+        self.perf.inc("op")
+        self._inflight_client_ops += 1
+        with top:
+            try:
+                top.mark("reached_pg")
+                return await self._execute_client_op(conn, rmsg, top,
+                                                     tspan)
+            finally:
+                self._inflight_client_ops -= 1
+                if tspan is not None:
+                    tspan.finish()
+
+    async def _handle_client_batch(self, conn, msg: MOSDOp) -> None:
+        """Serve one batched client-op frame: dequeue-time backoff
+        decided ONCE for the whole frame (every rider targets the same
+        PG), riders executed CONCURRENTLY — chained per object so two
+        riders on one oid still apply in submit order, while riders on
+        distinct objects overlap and feed the backend's own sub-write
+        coalescing (sequential riders would serialize each rider's
+        full commit RTT and starve the PG-batch pipeline) — and ONE
+        batched reply carrying the per-rider vector (read payloads
+        concatenated in rider order; each rider's outs' dlens
+        delimit its slice)."""
+        pgid = (int(msg["pool"]), int(msg["pg"]))
+        if self._backoff_enabled():
+            reason = self._want_backoff(pgid)
+            if reason is not None:
+                bid = self._register_backoff(conn, pgid, reason)
+                await self._send_backoff(conn, pgid, msg, reason, bid)
+                return
+        if self._split_task is not None and not self._split_task.done():
+            # a pg_num split is consuming the new map: ops wait so they
+            # never land in a collection mid-move
+            await self._split_task
+        riders: "List[Tuple[dict, MOSDOp]]" = []
+        doff = 0
+        for rider in msg.get("batch", []):
+            rfields = {"tid": rider["tid"], "pool": pgid[0],
+                       "pg": pgid[1], "oid": rider.get("oid", ""),
+                       "ops": list(rider.get("ops", [])),
+                       "map_epoch": msg.get("map_epoch")}
+            for k in ("reqid", "trace_id", "trace"):
+                if k in rider:
+                    rfields[k] = rider[k]
+            if msg.get("ticket") is not None:
+                # session-scoped: the frame's one ticket covers every
+                # rider (same client principal)
+                rfields["ticket"] = msg["ticket"]
+            dlen = int(rider.get("dlen", 0) or 0)
+            rmsg = MOSDOp(rfields, msg.data[doff:doff + dlen]
+                          if dlen else b"")
+            doff += dlen
+            riders.append((rfields, rmsg))
+        results: "List" = [None] * len(riders)
+        chains: "Dict[str, List[int]]" = {}
+        for i, (rfields, _r) in enumerate(riders):
+            chains.setdefault(str(rfields["oid"]), []).append(i)
+
+        async def run_chain(idxs: "List[int]") -> None:
+            for i in idxs:
+                rfields, rmsg = riders[i]
+                results[i] = await self._run_one_rider(conn, rfields,
+                                                       rmsg)
+        await asyncio.gather(*(run_chain(idxs)
+                               for idxs in chains.values()))
+        entries: "List[dict]" = []
+        bufs: "List" = []
+        for (rfields, _r), (result, outs, out_bufs, extra) \
+                in zip(riders, results):
+            entries.append({"tid": rfields["tid"], "result": result,
+                            "outs": outs, **extra})
+            bufs.extend(out_bufs)
+        _lens, blob = pack_buffers(bufs)
+        fields = {"tid": msg["tid"], "result": 0, "outs": [],
+                  "batch": entries}
+        rt = self._reply_trace(msg)
+        if rt:
+            fields["trace"] = rt
+        reply = MOSDOpReply(fields, blob)
+        # the per-rider verdict vector is semantics-bearing (top-level
+        # outs is empty): a pre-batching objecter must reject, not
+        # resolve rider 0 with an empty success
+        reply.compat_version = 2
+        await conn.send_message(reply)
+
     async def _do_client_op(self, conn, msg: MOSDOp, top=None,
                             tspan=None) -> None:
         self.perf.inc("op")
@@ -2067,12 +2206,26 @@ class OSDDaemon(Dispatcher):
             await self._split_task
         self._inflight_client_ops += 1
         try:
-            await self._do_client_op_inner(conn, msg, top, tspan)
+            result, outs, out_bufs, extra = \
+                await self._execute_client_op(conn, msg, top, tspan)
         finally:
             self._inflight_client_ops -= 1
+        _lens, blob = pack_buffers(out_bufs)
+        fields = {"tid": msg["tid"], "result": result, "outs": outs,
+                  **extra}
+        rt = self._reply_trace(msg)
+        if rt:
+            fields["trace"] = rt
+        await conn.send_message(MOSDOpReply(fields, blob))
 
-    async def _do_client_op_inner(self, conn, msg: MOSDOp,
-                                  top=None, tspan=None) -> None:
+    async def _execute_client_op(self, conn, msg: MOSDOp, top=None,
+                                 tspan=None) \
+            -> "Tuple[int, List[dict], List, dict]":
+        """Execute one logical client op and RETURN its verdict —
+        ``(result, outs, out_bufs, extra_reply_fields)`` — instead of
+        sending the reply, so the single-op path and the batched path
+        share every check and op handler and differ only in how the
+        reply frame is assembled."""
         pgid = (int(msg["pool"]), int(msg["pg"]))
         oid = msg["oid"]
         if oid and pgid[0] in self.osdmap.pools:
@@ -2083,26 +2236,14 @@ class OSDDaemon(Dispatcher):
                 # client targeted with a pre-split map: make it refresh
                 # and resend (reference: ops from an older interval are
                 # requeued/ESTALEd, never served on the wrong PG)
-                fields = {"tid": msg["tid"], "result": -ESTALE,
-                          "outs": [{"error": "wrong pg for object "
-                                             "(map changed?)"}]}
-                rt = self._reply_trace(msg)
-                if rt:
-                    fields["trace"] = rt
-                await conn.send_message(MOSDOpReply(fields))
-                return
+                return -ESTALE, [{"error": "wrong pg for object "
+                                           "(map changed?)"}], [], {}
         # size guards (reference OSD::op_is_too_big: osd_max_write_size
         # on the mutation payload, osd_object_max_size on the resulting
         # extent) — EFBIG at admission, never a half-applied monster op
         too_big = self._op_too_big(msg)
         if too_big:
-            fields = {"tid": msg["tid"], "result": -EFBIG,
-                      "outs": [{"error": too_big}]}
-            rt = self._reply_trace(msg)
-            if rt:
-                fields["trace"] = rt
-            await conn.send_message(MOSDOpReply(fields))
-            return
+            return -EFBIG, [{"error": too_big}], [], {}
         deny = self._check_osd_caps(msg)
         if deny is not None and "generation" in deny[0] \
                 and self.monc is not None:
@@ -2111,14 +2252,8 @@ class OSDDaemon(Dispatcher):
             await self._refresh_service_keys()
             deny = self._check_osd_caps(msg)
         if deny is not None:
-            fields = {"tid": msg["tid"], "result": -EACCES,
-                      "retry_auth": deny[1],
-                      "outs": [{"error": deny[0]}]}
-            rt = self._reply_trace(msg)
-            if rt:
-                fields["trace"] = rt
-            await conn.send_message(MOSDOpReply(fields))
-            return
+            return -EACCES, [{"error": deny[0]}], [], \
+                {"retry_auth": deny[1]}
         be = self._get_backend(pgid)
         be.last_epoch = self.osdmap.epoch
         be.pool_snap_seq = self.osdmap.get_pool(pgid[0]).snap_seq
@@ -2351,9 +2486,4 @@ class OSDDaemon(Dispatcher):
             else:
                 result = -EIO
             outs.append({"error": str(e)})
-        _lens, blob = pack_buffers(out_bufs)
-        fields = {"tid": msg["tid"], "result": result, "outs": outs}
-        rt = self._reply_trace(msg)
-        if rt:
-            fields["trace"] = rt
-        await conn.send_message(MOSDOpReply(fields, blob))
+        return result, outs, out_bufs, {}

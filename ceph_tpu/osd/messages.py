@@ -66,26 +66,63 @@ class MOSDOp(Message):
     fields: tid, pool, pg, oid, ops=[{op, off, len, name?, dlen?}...],
     map_epoch.  Bulk write payloads concatenated in ``data`` in op order
     (each write op's dlen says how much it consumes).
+
+    BATCHED form (one frame per (osd, pg) objecter linger window — the
+    reference's MOSDOp multi-op vector, applied across LOGICAL ops):
+    ``batch`` is a list of per-rider ``{tid, oid, ops, dlen, reqid?,
+    trace_id?, trace?}`` dicts in submit order; their payloads consume
+    the shared ``data`` segments in order (each rider's ``dlen`` says
+    how much), the top-level tid/oid are the first rider's, and the
+    top-level ``ops`` is empty.  The session ticket rides once, at the
+    top level.  A batch of one is wired EXACTLY as the legacy single
+    form (no ``batch`` field, compat 1).  Multi-rider frames encode
+    with compat_version 2: ``batch`` is semantics-BEARING (the
+    top-level ops list is empty), so a v1 decoder must REJECT the
+    frame, not skip the optional and serve a zero-op request.
     """
     TYPE = "osd_op"
+    HEAD_VERSION = 2     # v2: the batched multi-rider vector
+    COMPAT_VERSION = 1   # single-rider frames decode everywhere
     FIELDS = ("tid", "pool", "pg", "oid", "ops", "map_epoch",
               "reqid?",        # client retry-dedup id (rides pg log)
               "trace_id?",     # root span for the op's sub-op tree
               "ticket?",       # cephx service ticket
               "internal?",     # cluster-internal op (copy_from reads)
-              "trace?")        # {id, span, parent?} trace context
+              "trace?",        # {id, span, parent?} trace context
+              "batch?")        # per-rider [{tid, oid, ops, dlen, ...}]
     REPLY = "osd_op_reply"
 
 
 @register_message
 class MOSDOpReply(Message):
     """fields: tid, result (errno-style, 0=ok), outs=[{...}] per-op output
-    metadata; read payloads concatenated in ``data``."""
+    metadata; read payloads concatenated in ``data``.
+
+    BATCHED form (answers a batched MOSDOp in ONE frame): ``batch`` is
+    a per-rider ``{tid, result, outs, retry_auth?}`` list in rider
+    order; read payloads concatenate in ``data`` in the same order
+    (each rider's outs' dlens delimit its slice), the top-level tid is
+    the first rider's and the top-level outs is empty.  Same skew
+    contract as the request: batched replies encode compat_version 2
+    so a pre-batching objecter rejects rather than resolving rider 0
+    with an empty result."""
     TYPE = "osd_op_reply"
+    HEAD_VERSION = 2     # v2: the batched per-rider verdict vector
+    COMPAT_VERSION = 1   # single-rider replies decode everywhere
     FIELDS = ("tid", "result", "outs",
               "retry_auth?",   # EACCES refinement: fresh ticket may fix
-              "trace?")        # trace context echoed for the reply leg
+              "trace?",        # trace context echoed for the reply leg
+              "batch?")        # per-rider [{tid, result, outs, ...}]
     REPLY = None
+
+
+def osd_op_tids(msg) -> "List[int]":
+    """Every logical-op tid a (possibly batched) MOSDOp carries, in
+    rider order — the tids one reply (or one backoff) must answer."""
+    batch = msg.get("batch")
+    if batch:
+        return [int(r["tid"]) for r in batch]
+    return [int(msg["tid"])]
 
 
 # --- EC sub ops (primary <-> shard) ------------------------------------------
@@ -322,9 +359,13 @@ class MOSDBackoff(Message):
     fields: op ('block'|'unblock'), pgid, id (per-OSD backoff id),
     reason ('peering'|'split'|'queue'), epoch, and — block only — tid of
     the op that tripped it, so the client wakes exactly that op's wait
-    instead of letting it ride out the full op timeout."""
+    instead of letting it ride out the full op timeout.  ``tids``
+    (batched client ops): every rider tid the blocked frame carried —
+    one backoff parks the whole batch, and the client wakes every
+    listed rider's wait (tid stays the first rider's, so a pre-batching
+    client still wakes at least that one)."""
     TYPE = "osd_backoff"
-    FIELDS = ("op", "pgid", "id", "reason", "epoch", "tid?")
+    FIELDS = ("op", "pgid", "id", "reason", "epoch", "tid?", "tids?")
     REPLY = None
 
 

@@ -41,6 +41,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import crc32c as crc_ops
 from ..ops import gf8, gf_jax
 
+try:                                  # jax >= 0.4.31 top-level alias
+    _shard_map = jax.shard_map
+except AttributeError:                # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(n_devices: int, shard_size: int) -> Mesh:
     """(pg, shard) mesh over the first n_devices; shard axis = k+m."""
@@ -105,7 +110,7 @@ class DistributedEC:
         C = self._G[k:]
 
         @functools.partial(
-            jax.shard_map, mesh=self.mesh,
+            _shard_map, mesh=self.mesh,
             in_specs=P("pg", "shard", None),
             out_specs=(P("pg", "shard", None), P("pg", "shard")),
         )
@@ -152,7 +157,7 @@ class DistributedEC:
         R = gf8.gf_matmul(self._G, D)
 
         @functools.partial(
-            jax.shard_map, mesh=self.mesh,
+            _shard_map, mesh=self.mesh,
             in_specs=P("pg", "shard", None),
             out_specs=P("pg", "shard", None),
         )
@@ -223,7 +228,7 @@ def sharded_fused_encode_step(mesh: Mesh, C: np.ndarray):
             d4.shape[0], k, W))
         return par3.reshape(d4.shape[0], m, S, sw), crcs
 
-    step = jax.shard_map(
+    step = _shard_map(
         local, mesh=mesh,
         in_specs=P(pg_axes, None, None, None),
         out_specs=(P(pg_axes, None, None, None), P(pg_axes, None)))

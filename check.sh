@@ -86,6 +86,23 @@ if [ "$lg_rc" -ne 0 ]; then
     exit "$lg_rc"
 fi
 
+echo "== loadgen --proc smoke (tools/loadgen.py --proc --audit) =="
+# the same open-loop generator against a REAL-process fleet (one OS
+# process per mon/mgr/OSD over tcp sockets): one bounded row plus the
+# post-load WGL linearizability audit of the recorded client history.
+# The offered rate is sized for a 1-core CI host (the fleet timeshares
+# one core — the row's host block says so loudly); the gate is that
+# the socket path serves a floor at all and the audit comes back green
+# with zero inconclusive objects.  (frames/op < 1 at the objecter hop
+# is gated by the chaos_check --proc leg.)
+env JAX_PLATFORMS=cpu python tools/loadgen.py --proc --smoke --audit \
+    --rates 15 --min-achieved 8
+plg_rc=$?
+if [ "$plg_rc" -ne 0 ]; then
+    echo "loadgen --proc smoke FAILED (exit $plg_rc)"
+    exit "$plg_rc"
+fi
+
 echo "== proc_chaos smoke (tools/proc_chaos.py) =="
 # one bounded nemesis round against a REAL-process cluster (mon/osd
 # subprocesses over tcp): SIGKILL an acting-set OSD mid-write, heal,

@@ -168,6 +168,11 @@ def test_queue_pressure_sheds_and_releases_at_low_watermark(loop):
         cfg = Config()
         cfg.set("osd_backoff_queue_high", 2)
         cfg.set("osd_backoff_queue_low", 1)
+        # client batching would coalesce the same-tick burst into one
+        # multi-rider frame the empty throttle admits wholesale
+        # (oversized-first-taker); this test is about the OSD shed
+        # path, so keep one frame per op
+        cfg.set("objecter_op_batching", False)
         async with MiniCluster(n_osds=4, config=cfg) as c:
             c.create_ec_pool("p", {"plugin": "jax_rs", "k": "2",
                                    "m": "1"}, pg_num=1, stripe_unit=64)

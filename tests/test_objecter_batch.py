@@ -1,0 +1,332 @@
+"""Objecter multi-op batching — the client-side batch contract.
+
+The contract (mirrors PR 9's shard-side batching, applied at the
+client hop): ready ops targeting the same (osd, pg) coalesce into ONE
+multi-rider MOSDOp — one wire frame, one OSD dispatch, one batched
+reply fanned back out per rider — while every *logical* op keeps its
+own tid, reqid, retry loop, and linearizability record.  These tests
+pin the invariants the perf must not cost:
+
+- coalescing respects the window and the size cap, and NEVER mixes
+  (osd, pg) targets in one frame,
+- a batch-of-one wires exactly as the legacy single-op frame (no
+  batch field, compat 1) — lone ops pay zero skew risk,
+- per-rider verdicts are independent: one rider's errno cannot leak
+  into its neighbours,
+- a retry after a lost rider resends ONLY the unacked rider (acked
+  riders must not double-apply),
+- a pre-batching decoder REJECTS a multi-rider frame (compat 2)
+  instead of serving it as a zero-op request,
+- admission charges per logical op, never per frame: a full window of
+  parked riders cannot deadlock the flush,
+- rider payloads ride the frame zero-copy (bytes_copied == 0).
+
+Marked cephsan: batch formation is schedule-dependent; correctness
+must not be.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.common import buffer as buffer_mod
+from ceph_tpu.common.config import Config
+from ceph_tpu.msg import message as message_mod
+from ceph_tpu.msg.message import MessageError, decode_message
+from ceph_tpu.osd import daemon as osd_daemon_mod
+from ceph_tpu.osd.messages import MOSDOp, osd_op_tids
+from ceph_tpu.qa.cluster import MiniCluster
+
+pytestmark = pytest.mark.cephsan
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _capture_frames(client):
+    """Record every MOSDOp the objecter puts on the wire (all conns)."""
+    sent = []
+    real_get = client.objecter.ms.get_connection
+
+    def get_conn(addr, policy=None):
+        conn = real_get(addr, policy)
+        if not getattr(conn, "_batch_test_tap", False):
+            conn._batch_test_tap = True
+            real_send = conn.send_message
+
+            async def send(msg):
+                if msg.TYPE == "osd_op":
+                    sent.append(msg)
+                return await real_send(msg)
+            conn.send_message = send
+        return conn
+    client.objecter.ms.get_connection = get_conn
+    return sent
+
+
+class TestCoalescing:
+    def test_concurrent_ops_coalesce_into_one_frame(self, loop):
+        """Ops runnable in the same window wire as ONE multi-rider
+        frame; every rider completes and reads back correct."""
+        async def go():
+            async with MiniCluster(3) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                sent = _capture_frames(client)
+                blobs = {f"o{i}": bytes([i + 1]) * 256 for i in range(6)}
+                await asyncio.gather(*[io.write_full(k, v)
+                                       for k, v in blobs.items()])
+                st = client.objecter.stats
+                assert st["ops_sent"] == 6
+                assert st["op_frames_sent"] == 1
+                assert len(sent) == 1 and len(sent[0]["batch"]) == 6
+                assert osd_op_tids(sent[0]) == [
+                    r["tid"] for r in sent[0]["batch"]]
+                for k, v in blobs.items():
+                    assert await io.read(k) == v
+        loop.run_until_complete(go())
+
+    def test_cap_cuts_window(self, loop):
+        """A full bucket cuts NOW: no frame ever carries more than
+        objecter_op_batch_max riders."""
+        async def go():
+            cfg = Config()
+            cfg.set("objecter_op_batch_max", 4)
+            # a real window so the cap (not the linger tick) does the
+            # cutting for the first frames
+            cfg.set("objecter_op_batch_window_us", 20000)
+            async with MiniCluster(3, config=cfg) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                await io.write_full("warm", b"w" * 64)   # settle peering
+                sent = _capture_frames(client)
+                await asyncio.gather(*[io.write_full(f"o{i}", b"z" * 64)
+                                       for i in range(10)])
+                sizes = [len(m.get("batch") or ()) or 1 for m in sent]
+                tids = {t for m in sent for t in osd_op_tids(m)}
+                assert len(tids) == 10
+                assert max(sizes) <= 4
+                assert len(sent) >= 3          # ceil(10 / 4)
+        loop.run_until_complete(go())
+
+    def test_only_same_osd_pg_share_a_frame(self, loop):
+        """Riders never cross (osd, pg): a frame's riders all hash to
+        the frame's own placement group."""
+        async def go():
+            async with MiniCluster(3) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=8,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                sent = _capture_frames(client)
+                names = [f"o{i}" for i in range(24)]
+                await asyncio.gather(*[io.write_full(n, b"q" * 64)
+                                       for n in names])
+                pool = c.osdmap.pool_by_name("b")
+                assert sum(len(m.get("batch") or ()) or 1
+                           for m in sent) == 24
+                for m in sent:
+                    for rider in (m.get("batch") or [dict(m.fields)]):
+                        assert c.osdmap.object_to_pg(
+                            pool.pool_id, rider["oid"]) == m["pg"]
+                # multiple PGs were actually exercised, and coalescing
+                # still happened within them
+                assert len({m["pg"] for m in sent}) > 1
+                assert len(sent) < 24
+        loop.run_until_complete(go())
+
+    def test_batch_of_one_wires_as_legacy_frame(self, loop):
+        """A lone rider is indistinguishable from a pre-batching
+        client on the wire: no batch field, compat 1."""
+        async def go():
+            async with MiniCluster(3) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                sent = _capture_frames(client)
+                await io.write_full("solo", b"s" * 128)
+                assert len(sent) == 1
+                msg = sent[0]
+                assert msg.get("batch") is None
+                assert getattr(msg, "compat_version",
+                               MOSDOp.COMPAT_VERSION) == 1
+                # and the encoded frame decodes with no batch either
+                header, data = msg.encode()
+                got = decode_message(header, data)
+                assert got.get("batch") is None
+        loop.run_until_complete(go())
+
+
+class TestPerRiderVerdicts:
+    def test_mixed_errnos_fan_out_independently(self, loop):
+        """One frame, one rider succeeding and one failing: each
+        logical op gets ITS OWN verdict."""
+        async def go():
+            async with MiniCluster(3) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                await io.write_full("present", b"p" * 200)
+                st0 = dict(client.objecter.stats)
+                # omap on an EC pool is a definitive per-op errno
+                # (reference: EC pools store no omap)
+                ok, denied = await asyncio.gather(
+                    io.read("present"), io.omap_set("present", {"k": b"v"}),
+                    return_exceptions=True)
+                st = client.objecter.stats
+                # they shared one frame...
+                assert st["ops_sent"] - st0["ops_sent"] == 2
+                assert st["op_frames_sent"] - st0["op_frames_sent"] == 1
+                # ...but kept their own verdicts
+                assert ok == b"p" * 200
+                assert isinstance(denied, Exception)
+                assert getattr(denied, "errno", None) == 5  # EIO
+        loop.run_until_complete(go())
+
+
+class TestRetry:
+    def test_retry_resends_only_unacked_riders(self, loop):
+        """A rider whose ack is lost retries ALONE: its acked
+        neighbour neither resends nor double-applies."""
+        async def go():
+            cfg = Config()
+            cfg.set("rados_osd_op_timeout", 0.4)
+            cfg.set("objecter_retry_backoff", 0.01)
+            async with MiniCluster(3, config=cfg) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                dropped = []
+                real = osd_daemon_mod.OSDDaemon._handle_client_batch
+
+                async def drop_tail(self, conn, msg):
+                    # first multi-rider frame: serve rider 0, lose the
+                    # rest (their payloads trail rider 0's in data)
+                    if not dropped and len(msg.get("batch") or ()) > 1:
+                        dropped.extend(
+                            r["tid"] for r in msg["batch"][1:])
+                        msg.fields["batch"] = list(msg["batch"][:1])
+                    return await real(self, conn, msg)
+                osd_daemon_mod.OSDDaemon._handle_client_batch = drop_tail
+                try:
+                    sent = _capture_frames(client)
+                    await asyncio.gather(
+                        io.write_full("a", b"a" * 128),
+                        io.write_full("b", b"b" * 128))
+                finally:
+                    osd_daemon_mod.OSDDaemon._handle_client_batch = real
+                assert dropped, "no multi-rider frame was cut"
+                resends = sent[1:]
+                assert resends, "dropped rider never resent"
+                resent_tids = [t for m in resends
+                               for t in osd_op_tids(m)]
+                # ONLY the unacked rider went back on the wire
+                assert set(resent_tids) == set(dropped)
+                assert await io.read("a") == b"a" * 128
+                assert await io.read("b") == b"b" * 128
+        loop.run_until_complete(go())
+
+
+class TestVersionSkew:
+    def test_multi_rider_frame_rejected_by_prebatching_decoder(
+            self, loop, monkeypatch):
+        """The batch vector is semantics-bearing (top-level ops is
+        empty): a decoder that predates it must REJECT the frame, not
+        misapply it as a zero-op request.  Simulated by decoding
+        against the v1 class floor."""
+        msg = MOSDOp({"tid": 1, "pool": 1, "pg": 0, "oid": "a",
+                      "ops": [], "map_epoch": 3,
+                      "batch": [{"tid": 1, "oid": "a",
+                                 "ops": [{"op": "write_full",
+                                          "dlen": 2}], "dlen": 2},
+                                {"tid": 2, "oid": "b",
+                                 "ops": [{"op": "write_full",
+                                          "dlen": 2}], "dlen": 2}]},
+                     b"xxyy")
+        msg.compat_version = 2
+        header, data = msg.encode()
+        # today's decoder accepts it whole
+        got = decode_message(header, data)
+        assert len(got["batch"]) == 2
+        # yesterday's decoder (HEAD_VERSION 1) refuses it whole
+        monkeypatch.setattr(MOSDOp, "HEAD_VERSION", 1)
+        with pytest.raises(MessageError, match="compat"):
+            decode_message(header, data)
+
+    def test_batch_is_append_only_optional(self):
+        """A legacy frame (no batch) still decodes against today's
+        spec — the field grew append-only."""
+        msg = MOSDOp({"tid": 9, "pool": 1, "pg": 0, "oid": "o",
+                      "ops": [{"op": "read", "off": 0, "length": 8}],
+                      "map_epoch": 3}, b"")
+        header, data = msg.encode()
+        got = decode_message(header, data)
+        assert got.fields == msg.fields
+        assert getattr(got, "compat_version", 1) == 1
+
+
+class TestAdmission:
+    def test_full_window_of_parked_riders_cannot_deadlock(self, loop):
+        """objecter_inflight_ops < batch_max: the window can never
+        fill, and the linger (not the cap) must still cut it — every
+        op completes."""
+        async def go():
+            cfg = Config()
+            cfg.set("objecter_inflight_ops", 2)
+            cfg.set("objecter_op_batch_max", 8)
+            cfg.set("objecter_op_batch_window_us", 20000)
+            async with MiniCluster(3, config=cfg) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                await asyncio.wait_for(
+                    asyncio.gather(*[io.write_full(f"o{i}", b"w" * 64)
+                                     for i in range(10)]),
+                    timeout=30)
+                st = client.objecter.stats
+                assert st["ops_sent"] == 10
+                # admission (2) throttles below the cap (8): no frame
+                # ever saw a full window, yet nothing hung
+                assert st["op_frames_sent"] >= 2
+        loop.run_until_complete(go())
+
+
+class TestZeroCopy:
+    def test_batched_rider_payloads_copy_nothing(self, loop):
+        """Rider payloads are ADOPTED as frame segments: the whole
+        coalesced write path moves zero payload bytes."""
+        async def go():
+            async with MiniCluster(3) as c:
+                c.create_ec_pool("b", {"plugin": "jax_rs", "k": "2",
+                                       "m": "1"}, pg_num=1,
+                                 stripe_unit=64)
+                client = await c.client()
+                io = client.io_ctx("b")
+                await io.write_full("warm", b"w" * 128)
+                before = buffer_mod.STATS["bytes_copied"]
+                await asyncio.gather(*[io.write_full(f"z{i}", b"q" * 256)
+                                       for i in range(8)])
+                assert buffer_mod.STATS["bytes_copied"] == before
+                st = client.objecter.stats
+                assert st["op_frames_sent"] < st["ops_sent"]
+        loop.run_until_complete(go())

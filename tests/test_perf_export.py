@@ -286,6 +286,10 @@ REQUIRED_PERF_COUNTERS = {
             # frames counter behind the frames/op < 1 claim
             "osd_op_batch_size", "osd_subwrite_batch_txns",
             "subop_w_frames",
+            # objecter multi-op batching (client hop): riders per
+            # received client-op frame + the frame counter behind the
+            # client-side frames/op < 1 claim
+            "objecter_batch_size", "client_op_frames",
             # critical-path attribution (PR 16): event-loop scheduling
             # lag samples (ms) + cpu time per message dispatch tick (us)
             "loop_lag_ms", "daemon_cpu_attribution",
@@ -339,6 +343,10 @@ REQUIRED_PROM_SERIES = {
     "ceph_osd_op_batch_size_bucket",
     "ceph_osd_subwrite_batch_txns_bucket",
     "ceph_subop_w_frames",
+    # objecter multi-op batching: riders-per-client-frame histogram +
+    # received-frame counter — the grafana client-batching panel
+    "ceph_objecter_batch_size_bucket",
+    "ceph_client_op_frames",
     # per-daemon host attribution (PR 16): loop scheduling lag + cpu
     # per dispatch tick — the grafana loop-lag/critical-path panels
     "ceph_loop_lag_ms_bucket", "ceph_loop_lag_ms_count",

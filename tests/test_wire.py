@@ -207,6 +207,58 @@ class TestCodecRoundTrip:
             decode_message(bytes(hdr) + payload)
 
 
+class TestBatchedClientOpWire:
+    def test_batched_osd_op_roundtrip(self):
+        """The objecter's multi-rider frame (batch vector + compat 2)
+        survives the flat codec bit-faithfully; tids fan out from the
+        batch; the backoff tids vector round-trips too."""
+        from ceph_tpu.osd.messages import (MOSDBackoff, MOSDOp,
+                                           MOSDOpReply, osd_op_tids)
+        op = MOSDOp({"tid": 11, "pool": 2, "pg": 3, "oid": "a",
+                     "ops": [], "map_epoch": 9,
+                     "batch": [{"tid": 11, "oid": "a", "dlen": 3,
+                                "ops": [{"op": "write_full",
+                                         "dlen": 3}],
+                                "reqid": "c:11"},
+                               {"tid": 12, "oid": "b", "dlen": 2,
+                                "ops": [{"op": "write_full",
+                                         "dlen": 2}],
+                                "reqid": "c:12"}]},
+                    BufferList(b"xyzpq"))
+        op.compat_version = 2
+        header, data = op.encode()
+        got = decode_message(header, data)
+        assert got.fields == op.fields
+        assert osd_op_tids(got) == [11, 12]
+        assert bytes(got.data) == b"xyzpq"
+
+        reply = MOSDOpReply({"tid": 11, "result": 0, "outs": [],
+                             "batch": [{"tid": 11, "result": 0,
+                                        "outs": [{"op": "commit",
+                                                  "dlen": 0}]},
+                                       {"tid": 12, "result": -5,
+                                        "outs": [{"error": "eio",
+                                                  "dlen": 0}]}]})
+        reply.compat_version = 2
+        header, data = reply.encode()
+        rgot = decode_message(header, data)
+        assert rgot.fields == reply.fields
+
+        bk = MOSDBackoff({"op": "block", "pgid": [2, 3], "id": 4,
+                          "reason": "peering", "epoch": 9, "tid": 11,
+                          "tids": [11, 12]})
+        header, data = bk.encode()
+        bgot = decode_message(header, data)
+        assert bgot["tids"] == [11, 12]
+        assert osd_op_tids(bk) == [11]  # no batch: top-level tid
+
+    def test_single_op_tids_helper(self):
+        from ceph_tpu.osd.messages import MOSDOp, osd_op_tids
+        m = MOSDOp({"tid": 5, "pool": 1, "pg": 0, "oid": "o",
+                    "ops": [{"op": "read"}], "map_epoch": 1}, b"")
+        assert osd_op_tids(m) == [5]
+
+
 class TestVersionSkew:
     def test_newer_compat_rejected(self):
         class MPingV9(Message):

@@ -476,7 +476,9 @@ def _proc_leg(args) -> int:
     (tools/proc_chaos.py — mon/osd subprocesses over tcp, admin-socket
     driven injectnetfault rules), seeds derived from --seed so the
     chaos invocation replays end to end; a failing round prints its
-    own PROC_CHAOS_SEED reproduce line."""
+    own PROC_CHAOS_SEED reproduce line.  Every round also gates on
+    objecter-hop batching staying live (frames/op < 1) — connection
+    churn must not silently degrade every frame to batch-of-one."""
     from tools import proc_chaos
     base = args.seed * 31 + 1
     print(f"== proc_chaos leg ({args.proc} nemesis round(s), "
@@ -485,8 +487,8 @@ def _proc_leg(args) -> int:
                           "--seed", str(base)])
     if rc != 0:
         print("chaos_check: proc_chaos leg FAILED (lost write, "
-              "non-linearizable history, failed reconvergence, or "
-              "harness error)", file=sys.stderr)
+              "non-linearizable history, failed reconvergence, inert "
+              "objecter batching, or harness error)", file=sys.stderr)
     return rc
 
 

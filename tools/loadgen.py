@@ -49,6 +49,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import perf_histogram  # noqa: E402 (tools/perf_histogram.py)
 from osd_bench import _merged_histograms  # noqa: E402
+from procfleet import ProcFleet, host_report  # noqa: E402
 
 from ceph_tpu.common.config import Config  # noqa: E402
 from ceph_tpu.qa.cluster import MiniCluster  # noqa: E402
@@ -61,7 +62,7 @@ def _pct(sorted_vals, q: float) -> float:
     return float(sorted_vals[i])
 
 
-async def run_point(cluster, ios, payloads, rate: float,
+async def run_point(collect_hists, ios, payloads, rate: float,
                     seconds: float, objects: int) -> dict:
     """One offered-load point: Poisson arrivals at ``rate`` op/s for
     ``seconds``, every op an independent task on a rotating session."""
@@ -112,7 +113,7 @@ async def run_point(cluster, ios, payloads, rate: float,
     drain_elapsed = loop.time() - t_start
 
     lats.sort()
-    hists = _merged_histograms(cluster.osds.values())
+    hists = await collect_hists()
     stage = {f"{group}.{cname}": {
                  **perf_histogram.percentiles(h), "count": h["count"]}
              for group, counters in sorted(hists.items())
@@ -137,17 +138,164 @@ async def run_point(cluster, ios, payloads, rate: float,
     }
 
 
-def _trace_report(cluster, clients) -> "tuple[dict, str]":
-    """Assemble the run's tracer dumps (in-process: every daemon's
-    buffer is reachable directly) into per-op trees and attribute the
+def _trace_report_from(dumps) -> "tuple[dict, str]":
+    """Assemble tracer dumps into per-op trees and attribute the
     critical path — returns (JSON-able report, printable table)."""
     import trace as trace_tool  # tools/trace.py (path set up above)
-    trees = trace_tool.assemble(trace_tool.load_dumps(
-        [o.tracer.dump() for o in cluster.osds.values()]
-        + [cl.tracer.dump() for cl in clients]))
+    trees = trace_tool.assemble(trace_tool.load_dumps(dumps))
     report = dict(trace_tool.completeness(trees),
                   **trace_tool.aggregate_attribution(trees))
     return report, trace_tool.attribution_table(trees)
+
+
+def _trace_report(cluster, clients) -> "tuple[dict, str]":
+    """In-process variant: every daemon's buffer is reachable directly."""
+    return _trace_report_from(
+        [o.tracer.dump() for o in cluster.osds.values()]
+        + [cl.tracer.dump() for cl in clients])
+
+
+def _audit_history() -> dict:
+    """Post-load linearizability audit over the armed client-op
+    history (common/history.py): the sweep's acked/unknown outcomes
+    must admit a sequential order.  Inconclusive objects (checker
+    budget blown) are REPORTED, never silently counted as passes."""
+    from ceph_tpu.common import history as history_mod
+    from tools.cephsan import linearize  # noqa: E402
+    rec = history_mod.installed()
+    if rec is None:
+        return {"ran": False, "reason": "history recorder never armed"}
+    res = linearize.check(rec.to_history())
+    return {
+        "ran": True,
+        "linearizable": bool(res.get("linearizable", False)),
+        "objects_checked": res.get("checked", 0),
+        "objects_inconclusive": res.get("skipped", 0),
+        "violations": len(res.get("violations") or []),
+    }
+
+
+async def run_proc(args) -> dict:
+    """The multi-process leg: the same open-loop generator driven at a
+    REAL fleet (one OS process per daemon, tcp sockets) — wall-clock
+    rows plus the per-process CPU attribution that names where the
+    time goes when wall-clock can't (oversubscribed hosts)."""
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    client_opts = list(args.opt)
+    if args.trace:
+        client_opts.append(f"osd_trace_sample_rate={args.trace}")
+        client_opts.append("osd_trace_buffer_size=200000")
+    daemon_opts = list(args.opt)
+    if args.trace:
+        daemon_opts.append(f"osd_trace_sample_rate={args.trace}")
+        daemon_opts.append("osd_trace_buffer_size=200000")
+    fleet = ProcFleet(
+        osds=args.osds, sessions=args.sessions,
+        pool={"plugin": "jax_rs", "k": str(args.k), "m": str(args.m),
+              "technique": args.technique},
+        pool_name="loadgen", pg_num=args.pgs,
+        stripe_unit=args.stripe_unit, options=daemon_opts,
+        client_options=client_opts, record_history=args.audit)
+    async with fleet:
+        host = host_report(len(fleet.pc.procs))
+        if host["oversubscribed"]:
+            print(f"loadgen --proc: {host['warning']}", file=sys.stderr)
+        rng = np.random.default_rng(0)
+        payloads = [rng.integers(0, 256, args.size, dtype=np.uint8)
+                    .tobytes() for _ in range(4)]
+        ios = fleet.ios
+
+        warm_stop = time.monotonic() + args.warm_seconds
+        wi = 0
+        while wi < 3 or time.monotonic() < warm_stop:
+            await asyncio.gather(*(
+                ios[(wi + j) % len(ios)].write_full(
+                    f"warm-{j}", payloads[j % len(payloads)])
+                for j in range(min(16, len(ios)))))
+            wi += 1
+
+        rows = []
+        for rate in rates:
+            cands = []
+            for _ in range(max(1, args.repeat)):
+                await fleet.perf_reset()
+                ob0 = fleet.objecter_stats()
+                cpu0 = fleet.cpu_snapshot()
+                cand = await run_point(fleet.merged_histograms, ios,
+                                       payloads, rate, args.seconds,
+                                       args.objects)
+                cand["cpu_attribution"] = fleet.cpu_attribution(
+                    cpu0, ops=cand["completed"])
+                ob1 = fleet.objecter_stats()
+                sent = ob1.get("ops_sent", 0) - ob0.get("ops_sent", 0)
+                frames = (ob1.get("op_frames_sent", 0)
+                          - ob0.get("op_frames_sent", 0))
+                cand["objecter"] = {
+                    "ops_sent": sent, "op_frames_sent": frames,
+                    "frames_per_op": round(frames / sent, 4)
+                    if sent else 0.0}
+                cands.append(cand)
+            cands.sort(key=lambda r: r["achieved_op_s"])
+            row = cands[len(cands) // 2]
+            if len(cands) > 1:
+                row["repeat"] = {
+                    "n": len(cands),
+                    "achieved_op_s_min": cands[0]["achieved_op_s"],
+                    "achieved_op_s_max": cands[-1]["achieved_op_s"],
+                    "p99_ms_all": sorted(r["p99_ms"] for r in cands),
+                }
+            rows.append(row)
+            print(json.dumps(
+                {k: v for k, v in row.items()
+                 if k != "stage_percentiles"}), file=sys.stderr)
+
+        trace_attr = None
+        if args.trace:
+            dumps = [cl.tracer.dump() for cl in fleet.clients]
+            for name in fleet.daemon_names():
+                if name.startswith("osd."):
+                    try:
+                        dumps.append(await fleet.admin(name,
+                                                       "trace dump"))
+                    except Exception:  # noqa: BLE001 — daemon gone
+                        pass
+            trace_attr, table = _trace_report_from(dumps)
+            print(table, file=sys.stderr)
+
+        audit = None
+        if args.audit:
+            audit = _audit_history()
+            print(f"loadgen --proc audit: {json.dumps(audit)}",
+                  file=sys.stderr)
+
+        return {
+            "metric": "osd_open_loop_latency_vs_load",
+            "mode": "multi_process",
+            "host": host,
+            "opts": dict(kv.partition("=")[::2] for kv in args.opt),
+            "store": "proc",
+            "sessions": args.sessions,
+            "size": args.size,
+            "ec": {"k": args.k, "m": args.m,
+                   "stripe_unit": args.stripe_unit},
+            "rows": rows,
+            "trace_attribution": trace_attr,
+            "linearizability": audit,
+            "methodology": {
+                "fleet": "qa/vstart.py ProcCluster: one OS process per "
+                         "mon/mgr/OSD over real tcp sockets; clients "
+                         "are in-process sessions of this generator",
+                "cpu_attribution": "utime+stime deltas from "
+                                   "/proc/<pid>/stat per daemon, "
+                                   "sampled around each point — the "
+                                   "honest signal when processes > "
+                                   "cores makes wall-clock a "
+                                   "scheduler benchmark",
+                "arrivals": "Poisson (exponential inter-arrival, "
+                            "seeded rng), issued as independent tasks "
+                            "— completions never gate arrivals",
+            },
+        }
 
 
 async def run(args) -> dict:
@@ -189,6 +337,17 @@ async def run(args) -> dict:
                 for j in range(min(16, len(ios)))))
             wi += 1
 
+        async def collect():
+            return _merged_histograms(c.osds.values())
+
+        def _obj_stats():
+            tot = {}
+            for cl in c.clients:
+                for k, v in cl.objecter.stats.items():
+                    if k in ("ops_sent", "op_frames_sent"):
+                        tot[k] = tot.get(k, 0) + v
+            return tot
+
         rows = []
         for rate in rates:
             # --repeat N: median-of-N points (by achieved op/s) with
@@ -198,8 +357,18 @@ async def run(args) -> dict:
             for _ in range(max(1, args.repeat)):
                 for osd in c.osds.values():
                     osd.perf_coll.reset()
-                cands.append(await run_point(c, ios, payloads, rate,
-                                             args.seconds, args.objects))
+                ob0 = _obj_stats()
+                cand = await run_point(collect, ios, payloads, rate,
+                                       args.seconds, args.objects)
+                ob1 = _obj_stats()
+                sent = ob1.get("ops_sent", 0) - ob0.get("ops_sent", 0)
+                frames = (ob1.get("op_frames_sent", 0)
+                          - ob0.get("op_frames_sent", 0))
+                cand["objecter"] = {
+                    "ops_sent": sent, "op_frames_sent": frames,
+                    "frames_per_op": round(frames / sent, 4)
+                    if sent else 0.0}
+                cands.append(cand)
             cands.sort(key=lambda r: r["achieved_op_s"])
             row = cands[len(cands) // 2]
             if len(cands) > 1:
@@ -287,7 +456,22 @@ def main() -> None:
     p.add_argument("--smoke", action="store_true",
                    help="CI gate: tiny sweep, nonzero exit when the "
                         "generator is closed-loop-bound or ops fail")
+    p.add_argument("--proc", action="store_true",
+                   help="drive a REAL process fleet (qa/vstart.py: one "
+                        "OS process per daemon, tcp sockets) instead "
+                        "of the in-process MiniCluster; rows grow "
+                        "per-process CPU attribution and a host "
+                        "honesty block")
+    p.add_argument("--audit", action="store_true",
+                   help="--proc only: arm the client-op history "
+                        "recorder and run the linearizability audit "
+                        "(tools/cephsan/linearize.py) after the "
+                        "sweep; in --smoke mode a non-linearizable "
+                        "history fails the gate")
     args = p.parse_args()
+    if args.audit and not args.proc:
+        p.error("--audit requires --proc (the in-process path is "
+                "audited by chaos_check/cephsan already)")
     if args.smoke:
         # an explicit --min-achieved keeps the caller's offered rate:
         # check.sh drives the smoke ABOVE the pre-batching knee and
@@ -296,7 +480,11 @@ def main() -> None:
             args.rates = "200"
         args.seconds, args.warm_seconds = 2.0, 1.0
         args.sessions, args.osds, args.size = 32, 3, 16 * 1024
-    res = asyncio.run(run(args))
+        if args.proc:
+            # a real fleet boots in seconds, not microseconds — keep
+            # the CI smoke bounded: fewer sessions, a small rate
+            args.sessions = 8
+    res = asyncio.run(run_proc(args) if args.proc else run(args))
     print(json.dumps(res if not args.smoke else {
         "metric": res["metric"],
         "rows": [{k: v for k, v in r.items()
@@ -332,6 +520,14 @@ def main() -> None:
                       f"{ta.get('traces')}, stages="
                       f"{sorted(s for s, v in st.items() if v > 0)})",
                       file=sys.stderr)
+        if args.audit and ok:
+            la = res.get("linearizability") or {}
+            ok = (la.get("ran", False)
+                  and la.get("linearizable", False)
+                  and la.get("objects_checked", 0) > 0)
+            if not ok:
+                print(f"loadgen smoke: linearizability audit failed: "
+                      f"{json.dumps(la)}", file=sys.stderr)
         sys.exit(0 if ok else 1)
 
 

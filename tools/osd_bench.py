@@ -38,6 +38,130 @@ from ceph_tpu.common.config import Config  # noqa: E402
 from ceph_tpu.qa.cluster import MiniCluster  # noqa: E402
 
 
+async def run_proc(args) -> dict:
+    """--proc: the same closed-loop clients driven at a REAL process
+    fleet (qa/vstart.py, one OS process per daemon, tcp sockets).
+    In-process internals (encode service, WAL, cork stats) live in
+    other processes here; the row instead carries what only this mode
+    can measure — per-process CPU attribution — plus the admin-socket
+    perf surface (stage histograms, batching counters)."""
+    from procfleet import ProcFleet, host_report
+    shared = int(getattr(args, "shared_clients", 0) or args.clients)
+    shared = max(1, min(shared, args.clients))
+    fleet = ProcFleet(
+        osds=args.osds, sessions=shared,
+        pool={"plugin": "jax_rs", "k": str(args.k), "m": str(args.m),
+              "technique": args.technique},
+        pool_name="bench", pg_num=args.pgs,
+        stripe_unit=args.stripe_unit,
+        options=list(getattr(args, "opt", [])),
+        client_options=list(getattr(args, "opt", [])))
+    async with fleet:
+        host = host_report(len(fleet.pc.procs))
+        if host["oversubscribed"]:
+            print(f"osd_bench --proc: {host['warning']}",
+                  file=sys.stderr)
+        rng = np.random.default_rng(0)
+        payloads = [rng.integers(0, 256, args.size, dtype=np.uint8)
+                    .tobytes() for _ in range(4)]
+        ios = [fleet.ios[i % shared] for i in range(args.clients)]
+
+        warm_stop = time.monotonic() + args.warm_seconds
+
+        async def warm(ci: int) -> None:
+            i = 0
+            while i < 3 or time.monotonic() < warm_stop:
+                await ios[ci].write_full(f"warm-{ci}",
+                                         payloads[i % len(payloads)])
+                i += 1
+        await asyncio.gather(*(warm(i) for i in range(args.clients)))
+
+        async def one_round() -> dict:
+            await fleet.perf_reset()
+            ob0 = fleet.objecter_stats()
+            cpu0 = fleet.cpu_snapshot()
+            stop = time.monotonic() + args.seconds
+            totals = {"ops": 0, "bytes": 0}
+
+            async def client_loop(ci: int) -> None:
+                i = 0
+                while time.monotonic() < stop:
+                    await ios[ci].write_full(f"obj-{ci}-{i % 16}",
+                                             payloads[i % len(payloads)])
+                    totals["ops"] += 1
+                    totals["bytes"] += args.size
+                    i += 1
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(client_loop(i)
+                                   for i in range(args.clients)))
+            elapsed = time.monotonic() - t0
+            cpu = fleet.cpu_attribution(cpu0, ops=totals["ops"])
+            ob1 = fleet.objecter_stats()
+            sent = ob1.get("ops_sent", 0) - ob0.get("ops_sent", 0)
+            frames = (ob1.get("op_frames_sent", 0)
+                      - ob0.get("op_frames_sent", 0))
+            counters = await fleet.merged_counters()
+            hists = await fleet.merged_histograms()
+            pcts = {f"{group}.{cname}": {
+                        **perf_histogram.percentiles(h),
+                        "count": h["count"],
+                        "unit": ("us" if cname.endswith("_lat")
+                                 or cname.endswith("rtt") else "n")}
+                    for group, counters_ in sorted(hists.items())
+                    for cname, h in sorted(counters_.items())
+                    if h.get("count")}
+            print(perf_histogram.format_histograms(hists),
+                  file=sys.stderr)
+            batching = {
+                "client_ops_sent": sent,
+                "client_op_frames_sent": frames,
+                "client_frames_per_op": round(frames / sent, 4)
+                if sent else 0.0,
+                "osd_client_op_frames": counters.get("osd", {}).get(
+                    "client_op_frames", 0),
+                "subwrite_frames": counters.get("osd", {}).get(
+                    "subop_w_frames", 0),
+            }
+            for name in ("objecter_batch_size", "osd_op_batch_size",
+                         "osd_subwrite_batch_txns"):
+                h = pcts.get(f"osd.{name}")
+                if h:
+                    batching[f"{name}_p50"] = h["p50"]
+                    batching[f"{name}_p99"] = h["p99"]
+            return {
+                "metric": "osd_write_path",
+                "mode": "multi_process",
+                "host": host,
+                "opts": dict(kv.partition("=")[::2]
+                             for kv in getattr(args, "opt", [])),
+                "seconds": round(elapsed, 3),
+                "ops": totals["ops"],
+                "op_per_s": round(totals["ops"] / elapsed, 1)
+                if elapsed else 0.0,
+                "client_GiB_per_s": round(
+                    totals["bytes"] / elapsed / 2**30, 3)
+                if elapsed else 0.0,
+                "store": "proc",
+                "cpu_attribution": cpu,
+                "batching": batching,
+                "latency_percentiles": pcts,
+            }
+
+        rows = []
+        for _ in range(max(1, args.repeat)):
+            rows.append(await one_round())
+        rows.sort(key=lambda r: r["op_per_s"])
+        row = rows[len(rows) // 2]
+        row["repeat"] = {
+            "n": len(rows),
+            "op_per_s_all": sorted(r["op_per_s"] for r in rows),
+            "op_per_s_min": rows[0]["op_per_s"],
+            "op_per_s_max": rows[-1]["op_per_s"],
+        }
+        return row
+
+
 def _merged_histograms(osds) -> dict:
     """Merge every daemon's histogram counters (buckets/sum/count add)
     so the percentiles reflect the whole cluster's op population."""
@@ -77,10 +201,18 @@ async def run(args) -> dict:
         rng = np.random.default_rng(0)
         payloads = [rng.integers(0, 256, args.size, dtype=np.uint8)
                     .tobytes() for _ in range(4)]
+        # --shared-clients K folds the qd loops onto K RadosClient
+        # connections (round-robin): qd32 on ONE objecter is where
+        # client-hop multi-op coalescing is measurable — one
+        # connection per loop (the default) keeps every objecter at
+        # qd1 and can never form a multi-op frame
+        shared = int(getattr(args, "shared_clients", 0) or args.clients)
+        shared = max(1, min(shared, args.clients))
         clients = []
-        for _ in range(args.clients):
+        for _ in range(shared):
             clients.append(await c.client())
-        ios = [cl.io_ctx("bench") for cl in clients]
+        ios = [clients[i % shared].io_ctx("bench")
+               for i in range(args.clients)]
 
         # warmup: populate the jit cache for the batch shapes the timed
         # phase will hit (first compile is 1-40s depending on backend).
@@ -125,6 +257,15 @@ async def run(args) -> dict:
             section), so --repeat rounds are self-contained and the
             median row is internally consistent."""
             reset_counters()
+
+            def obj_sum() -> dict:
+                tot: dict = {}
+                for cl in clients:
+                    for k, v in cl.objecter.stats.items():
+                        tot[k] = tot.get(k, 0) + v
+                return tot
+
+            obj0 = obj_sum()
             stop = time.monotonic() + args.seconds
             totals = {"ops": 0, "bytes": 0}
 
@@ -204,7 +345,15 @@ async def run(args) -> dict:
                     if h.get("count")}
             print(perf_histogram.format_histograms(hists),
                   file=sys.stderr)
+            obj1 = obj_sum()
+            cl_ops = obj1.get("ops_sent", 0) - obj0.get("ops_sent", 0)
+            cl_frames = (obj1.get("op_frames_sent", 0)
+                         - obj0.get("op_frames_sent", 0))
             batching = {
+                "client_ops_sent": cl_ops,
+                "client_op_frames_sent": cl_frames,
+                "client_frames_per_op": round(cl_frames / cl_ops, 4)
+                if cl_ops else 0.0,
                 "subwrite_frames": frames,
                 "subwrite_frames_per_op": round(frames / ops_done, 2),
             }
@@ -267,6 +416,11 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--osds", type=int, default=12)
     p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--shared-clients", type=int, default=0,
+                   help="fold the qd loops onto this many client "
+                        "connections (0 = one per loop); 1 puts the "
+                        "whole qd on one objecter, the shape where "
+                        "client-hop op batching engages")
     p.add_argument("--seconds", type=float, default=5.0)
     p.add_argument("--repeat", type=int, default=1,
                    help="run the timed phase N times (same warmed "
@@ -299,8 +453,15 @@ def main() -> None:
                         "(1 = every op) and report critical-path "
                         "attribution ('trace_attribution' in the JSON "
                         "row + a table on stderr)")
+    p.add_argument("--proc", action="store_true",
+                   help="drive a REAL process fleet (qa/vstart.py: "
+                        "one OS process per daemon, tcp sockets); the "
+                        "row carries per-process CPU attribution and "
+                        "a host honesty block instead of in-process "
+                        "internals")
     args = p.parse_args()
-    print(json.dumps(asyncio.run(run(args))))
+    print(json.dumps(asyncio.run(
+        run_proc(args) if args.proc else run(args))))
 
 
 if __name__ == "__main__":

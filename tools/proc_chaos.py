@@ -350,6 +350,26 @@ class _Round:
         self._log(f"gate: linearizable ({res.get('checked')} object(s) "
                   f"checked, {res.get('skipped')} skipped)")
 
+    def gate_batching(self) -> None:
+        """Objecter-hop batching must survive the nemesis: over a whole
+        round of concurrent per-object workers, at least some ops must
+        have coalesced into multi-op frames (frames/op < 1).  Catches a
+        regression that silently degrades every frame to batch-of-one
+        under connection churn."""
+        st = dict(self.client.objecter.stats)
+        ops = st.get("ops_sent", 0)
+        frames = st.get("op_frames_sent", 0)
+        if ops < 20:        # a starved round proves nothing either way
+            self._log(f"gate: batching skipped ({ops} wire ops)")
+            return
+        ratio = frames / ops
+        if ratio >= 1.0:
+            raise GateFailure(
+                f"objecter batching inert under chaos: {frames} frames "
+                f"for {ops} wire ops (frames/op={ratio:.3f}, want < 1)")
+        self._log(f"gate: objecter batching live — {frames} frames / "
+                  f"{ops} wire ops (frames/op={ratio:.3f})")
+
     async def report_status(self) -> None:
         """Embed the cluster's own accounting in the round report: the
         final 'ceph status' digest sections plus the pg summary.  Best
@@ -398,6 +418,10 @@ class _Round:
         cfg.set("ms_type", "async+tcp")
         cfg.set("client_history_record", "-")
         cfg.set("rados_osd_op_timeout", 2.0)
+        # a few ms of client-side linger so the paced worker loops
+        # (20-80 ms apart) still coalesce into multi-op frames — the
+        # batching gate below asserts frames/op < 1 over the round
+        cfg.set("objecter_op_batch_window_us", 5000.0)
         self.client = RadosClient(None, name="client.chaos", config=cfg,
                                   mon_addrs=dict(self.pc.mon_addrs))
         await self.client.connect("127.0.0.1:0")
@@ -439,6 +463,7 @@ class _Round:
             await self.gate_progress()
         await self.gate_readback()
         self.gate_linearize()
+        self.gate_batching()
         await self.report_status()
 
     async def teardown(self) -> None:

@@ -47,6 +47,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 K, M = 8, 3
 SEGS = 16                 # 32 KiB chunks (matches MESH_SCALING)
@@ -63,6 +64,10 @@ def worker(idx: int, nprocs: int, port: int, cores_per: int) -> None:
     from ceph_tpu.utils.platform import honor_jax_platforms_env
     honor_jax_platforms_env()   # the TPU plugin overrides the env var
     import jax
+    if nprocs > 1:
+        # the CPU backend only runs multi-process computations over a
+        # collectives transport; gloo is the in-tree one
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=nprocs,
                                process_id=idx)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -133,13 +138,32 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-procs", type=int, default=8)
     ap.add_argument("--cores-per", type=int, default=8)
+    ap.add_argument("--strict-cores", action="store_true",
+                    help="refuse (exit 2) instead of annotating any "
+                         "point whose fleet exceeds the usable cores")
     args = ap.parse_args()
-    avail = len(os.sched_getaffinity(0))
+    # host honesty: same affinity-aware core detection the --proc bench
+    # harness uses, so every artifact row carries the host reality
+    from procfleet import host_report, usable_cores
+    avail = usable_cores()
     cores_per = args.cores_per if avail >= 2 * args.cores_per else 1
     rows = []
     n = 1
     while n <= args.max_procs:
-        rows.append(run_point(n, cores_per))
+        rep = host_report(n * cores_per)
+        if rep["oversubscribed"]:
+            if args.strict_cores:
+                print(f"refusing oversubscribed point procs={n}: "
+                      f"{rep['warning']} (drop --strict-cores to "
+                      f"annotate instead)", file=sys.stderr)
+                sys.exit(2)
+            print(f"WARNING procs={n}: {rep['warning']}",
+                  file=sys.stderr)
+        row = run_point(n, cores_per)
+        row["oversubscribed"] = rep["oversubscribed"]
+        if rep["oversubscribed"]:
+            row["wall_clock_note"] = rep["warning"]
+        rows.append(row)
         n *= 2
     base_cpu = rows[0]["cpu_ms_per_MiB"]
     base_gibs = rows[0]["gibs"]
@@ -152,6 +176,7 @@ def main() -> None:
     out = {
         "platform": "cpu-multiprocess (jax.distributed, 1 device/proc)",
         "cpus_available": avail,
+        "host": host_report(args.max_procs * cores_per),
         "k": K, "m": M, "chunk_bytes": SEGS * 512 * 4,
         "per_proc_batch": PER_PROC_B,
         "rows": rows,

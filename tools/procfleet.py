@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""procfleet — shared harness for benching against a REAL process fleet.
+
+MiniCluster co-hosts every daemon on one event loop, so its numbers
+measure the protocol with zero scheduling interference — and zero
+parallelism.  This module gives loadgen/osd_bench a second back end:
+a qa/vstart.py ProcCluster (one OS process per mon/mgr/OSD, real tcp
+sockets) plus the measurement plumbing the in-process path gets for
+free:
+
+- client sessions: N independent RadosClients over async+tcp,
+- per-process CPU attribution from /proc/<pid>/stat (utime+stime
+  deltas per daemon, sampled around each measured point) — the data
+  that NAMES the residual floor instead of guessing at it,
+- cluster perf/histogram dumps over the admin sockets (merged with
+  the same bucket-add semantics as the in-process path),
+- host honesty: the real usable core count rides every artifact row,
+  and a fleet larger than the host is LOUDLY annotated — a 12-process
+  "scaling" run on 1 core measures the scheduler, not the cluster.
+
+Used by: tools/loadgen.py --proc, tools/osd_bench.py --proc,
+tools/proc_scaling.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import tempfile
+import time
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.client.rados import RadosClient  # noqa: E402
+from ceph_tpu.qa.vstart import ProcCluster  # noqa: E402
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+
+
+def usable_cores() -> int:
+    """The cores THIS process may actually run on — affinity-aware
+    (a cgroup/taskset-restricted CI runner lies through cpu_count)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def host_report(n_procs: int) -> dict:
+    """Honesty block for artifact rows: fleet size vs host reality."""
+    cores = usable_cores()
+    rep = {
+        "usable_cores": cores,
+        "cpu_count": os.cpu_count() or 1,
+        "fleet_processes": n_procs,
+        "oversubscribed": n_procs > cores,
+    }
+    if rep["oversubscribed"]:
+        rep["warning"] = (
+            f"{n_procs} daemon processes on {cores} usable core(s): "
+            f"wall-clock rows measure kernel scheduling, not fleet "
+            f"parallelism — per-process CPU attribution is the honest "
+            f"signal here")
+    return rep
+
+
+def proc_cpu_seconds(pid: int) -> float:
+    """utime+stime of one process from /proc/<pid>/stat, in seconds."""
+    with open(f"/proc/{pid}/stat", "rb") as f:
+        stat = f.read().decode("ascii", "replace")
+    # field 2 (comm) may contain spaces/parens: split after the LAST ')'
+    rest = stat.rsplit(")", 1)[1].split()
+    utime, stime = int(rest[11]), int(rest[12])
+    return (utime + stime) / _CLK_TCK
+
+
+class ProcFleet:
+    """One real-process cluster + N tcp client sessions, context-managed.
+
+    async with ProcFleet(osds=3, sessions=8, pool={...}) as fleet:
+        await fleet.ios[0].write_full("o", b"x")
+        cpu0 = fleet.cpu_snapshot()
+        ... measured work ...
+        attrib = fleet.cpu_attribution(cpu0)
+    """
+
+    def __init__(self, osds: int = 3, mons: int = 1,
+                 sessions: int = 8, pool: "dict|None" = None,
+                 pool_name: str = "bench", pg_num: int = 8,
+                 stripe_unit: int = 16 * 1024,
+                 options: "list[str]|None" = None,
+                 client_options: "list[str]|None" = None,
+                 record_history: bool = False,
+                 base_dir: "str|None" = None) -> None:
+        self.n_osds = osds
+        self.n_mons = mons
+        self.n_sessions = sessions
+        self.pool_profile = pool or {"plugin": "jax_rs", "k": "2",
+                                     "m": "1"}
+        self.pool_name = pool_name
+        self.pg_num = pg_num
+        self.stripe_unit = stripe_unit
+        self.options = list(options or [])
+        self.client_options = list(client_options or [])
+        self.record_history = record_history
+        self._own_dir = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="procfleet_")
+        self.pc: "ProcCluster|None" = None
+        self.clients: "list[RadosClient]" = []
+        self.ios: list = []
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def _bg(self, fn, *a, **kw):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: fn(*a, **kw))
+
+    async def start(self) -> "ProcFleet":
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.pc = ProcCluster(self.base_dir, n_mons=self.n_mons,
+                              n_osds=self.n_osds, options=self.options)
+        await self._bg(self.pc.start)
+        cfg = Config()
+        cfg.set("ms_type", "async+tcp")
+        if self.record_history:
+            cfg.set("client_history_record", "-")
+        for kv in self.client_options:
+            key, _, val = kv.partition("=")
+            cfg.set(key.strip(), val.strip())
+        admin = RadosClient(None, name="client.admin", config=cfg,
+                            mon_addrs=dict(self.pc.mon_addrs))
+        await admin.connect("127.0.0.1:0")
+        self.clients.append(admin)
+        prof_name = f"{self.pool_name}-prof"
+        await admin.mon_command({
+            "prefix": "osd erasure-code-profile set", "name": prof_name,
+            "profile": dict(self.pool_profile)})
+        res = await admin.mon_command({
+            "prefix": "osd pool create", "name": self.pool_name,
+            "kwargs": {"type": "erasure", "pg_num": self.pg_num,
+                       "ec_profile": prof_name,
+                       "stripe_unit": self.stripe_unit}})
+        if res.get("rc", 0) != 0:
+            raise RuntimeError(f"pool create failed: {res}")
+        await admin.monc.wait_for_map()
+        for i in range(self.n_sessions):
+            cl = RadosClient(None, name=f"client.lg{i}", config=cfg,
+                             mon_addrs=dict(self.pc.mon_addrs))
+            await cl.connect("127.0.0.1:0")
+            await cl.monc.wait_for_map()
+            self.clients.append(cl)
+            self.ios.append(cl.io_ctx(self.pool_name))
+        return self
+
+    async def stop(self) -> None:
+        for cl in self.clients:
+            try:
+                await asyncio.wait_for(cl.shutdown(), 10.0)
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+        if self.pc is not None:
+            await self._bg(self.pc.stop)
+        if self.record_history:
+            from ceph_tpu.common import history as history_mod
+            history_mod.uninstall()
+        if self._own_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+    async def __aenter__(self) -> "ProcFleet":
+        try:
+            return await self.start()
+        except BaseException:
+            await self.stop()
+            raise
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # --- daemons ----------------------------------------------------------
+
+    def daemon_names(self) -> "list[str]":
+        return sorted(self.pc.procs.keys())
+
+    async def admin(self, name: str, prefix: str, **kw) -> dict:
+        return await self._bg(self.pc.admin, name, prefix, **kw)
+
+    # --- CPU attribution --------------------------------------------------
+
+    def cpu_snapshot(self) -> dict:
+        """Per-daemon cumulative CPU seconds (utime+stime), plus this
+        client process's own — taken synchronously so a point's before/
+        after pair brackets exactly the measured interval."""
+        snap = {"client_process": time.process_time()}
+        for name, proc in self.pc.procs.items():
+            if proc.poll() is not None:
+                continue
+            try:
+                snap[name] = proc_cpu_seconds(proc.pid)
+            except (OSError, IndexError, ValueError):
+                continue
+        return snap
+
+    def cpu_attribution(self, before: dict, ops: int = 0) -> dict:
+        """Delta against a prior snapshot: per-daemon CPU seconds, the
+        total, and (with ops) per-op CPU — the number that still means
+        something on an oversubscribed host."""
+        after = self.cpu_snapshot()
+        per = {name: round(after.get(name, 0.0) - t0, 4)
+               for name, t0 in before.items()}
+        total = round(sum(per.values()), 4)
+        out = {"per_daemon_cpu_s": dict(sorted(per.items())),
+               "total_cpu_s": total}
+        if ops:
+            out["cpu_ms_per_op"] = round(total / ops * 1e3, 4)
+            out["per_daemon_cpu_ms_per_op"] = {
+                name: round(v / ops * 1e3, 4)
+                for name, v in sorted(per.items())}
+            top = max(per.items(), key=lambda kv: kv[1], default=None)
+            if top is not None:
+                out["top_cpu_daemon"] = top[0]
+        return out
+
+    # --- perf plumbing ----------------------------------------------------
+
+    async def perf_reset(self) -> None:
+        for name in self.daemon_names():
+            if name.startswith("osd."):
+                try:
+                    await self.admin(name, "perf reset")
+                except Exception:  # noqa: BLE001 — daemon may be down
+                    pass
+
+    async def merged_histograms(self) -> dict:
+        """Cluster-merged perf histograms over the admin sockets —
+        same fold as osd_bench._merged_histograms on the in-process
+        path (per-daemon groups -> one logical 'osd' group)."""
+        merged: dict = {}
+        for name in self.daemon_names():
+            if not name.startswith("osd."):
+                continue
+            try:
+                dump = await self.admin(name, "perf histogram dump")
+            except Exception:  # noqa: BLE001 — daemon may be down
+                continue
+            for group, counters in dump.items():
+                gkey = "osd" if group.startswith("osd.") else group
+                mg = merged.setdefault(gkey, {})
+                for cname, h in counters.items():
+                    agg = mg.setdefault(cname, {"count": 0, "sum": 0.0,
+                                                "buckets": {}})
+                    agg["count"] += int(h.get("count", 0))
+                    agg["sum"] += float(h.get("sum", 0.0))
+                    for ub, n in h.get("buckets", {}).items():
+                        agg["buckets"][ub] = \
+                            agg["buckets"].get(ub, 0) + int(n)
+        return merged
+
+    async def merged_counters(self) -> dict:
+        """Cluster-summed scalar perf counters ('osd' group)."""
+        out: dict = {}
+        for name in self.daemon_names():
+            if not name.startswith("osd."):
+                continue
+            try:
+                dump = await self.admin(name, "perf dump")
+            except Exception:  # noqa: BLE001 — daemon may be down
+                continue
+            for group, counters in dump.items():
+                gkey = "osd" if group.startswith("osd.") else group
+                g = out.setdefault(gkey, {})
+                for cname, v in counters.items():
+                    if isinstance(v, (int, float)):
+                        g[cname] = g.get(cname, 0) + v
+        return out
+
+    def objecter_stats(self) -> dict:
+        """Summed client-side objecter stats across every session —
+        the client half of the frames/op ablation."""
+        tot: dict = {}
+        for cl in self.clients:
+            for k, v in cl.objecter.stats.items():
+                tot[k] = tot.get(k, 0) + v
+        if tot.get("ops_sent"):
+            tot["frames_per_op"] = round(
+                tot.get("op_frames_sent", 0) / tot["ops_sent"], 4)
+        return tot
