@@ -85,13 +85,16 @@ def as_u8_array(data) -> np.ndarray:
 def concat_u8(parts, length: "Optional[int]" = None) -> np.ndarray:
     """Concatenate buffers (BufferList / ndarray / bytes) into one
     uint8 array, truncated or zero-padded to ``length`` when given.
-    A single exact-fit buffer passes through as a view (no copy) —
-    the aligned full-chunk read common case."""
+    A single buffer covering ``length`` passes through as a view (no
+    copy) — the aligned full-chunk read common case; a truncating
+    single-buffer call returns a slice view of the same backing store.
+    Multi-part reconstruction materializes once and is counted in
+    STATS (note_copy) like every other bulk materialization."""
     arrs = [as_u8_array(p) for p in parts]
     total = sum(a.size for a in arrs)
     n = total if length is None else int(length)
-    if len(arrs) == 1 and arrs[0].size == n:
-        return arrs[0]
+    if len(arrs) == 1 and arrs[0].size >= n:
+        return arrs[0] if arrs[0].size == n else arrs[0][:n]
     out = np.zeros(n, dtype=np.uint8)
     pos = 0
     for a in arrs:
@@ -100,6 +103,7 @@ def concat_u8(parts, length: "Optional[int]" = None) -> np.ndarray:
         take = min(a.size, n - pos)
         out[pos:pos + take] = a[:take]
         pos += take
+    note_copy(pos)
     return out
 
 

@@ -74,12 +74,13 @@ class FileStore(ObjectStore):
         return bytes([_ALGO_TAGS[algo]]) + comp
 
     def _unframe(self, row: bytes) -> bytes:
+        # rows are sqlite BLOBs, already bytes — no defensive rewrap
         if len(row) >= BLOCK:
-            return bytes(row)
+            return row
         algo = _TAG_ALGOS.get(row[0])
         if algo is None:
-            return bytes(row)      # short legacy tail block
-        return self._codec(algo).decompress(bytes(row[1:]))
+            return row             # short legacy tail block
+        return self._codec(algo).decompress(row[1:])
 
     # --- lifecycle -----------------------------------------------------------
 

@@ -47,7 +47,7 @@ def crc32c_py(data: bytes, seed: int = 0) -> int:
     """Pure-python/numpy bytewise crc32c (slow; fallback + golden model)."""
     tbl = _table()
     c = np.uint32(~np.uint32(seed) & _ALL_ONES)
-    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    arr = np.frombuffer(data, dtype=np.uint8)
     for b in arr:
         c = tbl[(c ^ b) & np.uint32(0xFF)] ^ (c >> np.uint32(8))
     return int(~c & _ALL_ONES)

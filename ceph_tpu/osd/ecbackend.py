@@ -2742,7 +2742,7 @@ class ECBackend:
             logical = ecutil.decode_concat(self.sinfo, self.codec,
                                            shards)
         lo = off - start
-        return bytes(logical[lo:lo + length].tobytes())
+        return logical[lo:lo + length].tobytes()
 
     # ============================================================== RECOVERY
 

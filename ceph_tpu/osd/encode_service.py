@@ -104,8 +104,12 @@ class EncodeService:
         through the shared batch queue when the codec has a device path.
         """
         self.stats["requests"] += 1
-        arr = (np.frombuffer(bytes(data), dtype=np.uint8)
-               if not isinstance(data, np.ndarray) else data.reshape(-1))
+        if isinstance(data, np.ndarray):
+            arr = data.reshape(-1)
+        elif hasattr(data, "to_array"):
+            arr = data.to_array()       # BufferList: view when single-segment
+        else:
+            arr = np.frombuffer(data, dtype=np.uint8)
         shards = sinfo.split_to_shards(arr)          # (k, W)
         W = shards.shape[1]
         enc_dev = getattr(codec, "encode_device", None)

@@ -144,7 +144,7 @@ class MeshDataPlane:
         """
         shards, _s = self._handles[handle]
         self.stats["takes"] += 1
-        return bytes(np.asarray(shards[idx, shard]).tobytes())
+        return np.asarray(shards[idx, shard]).tobytes()
 
     def release(self, handle: int) -> None:
         self._handles.pop(handle, None)

@@ -15,6 +15,15 @@ set -o pipefail
 cd "$(dirname "$0")"
 
 echo "== cephlint (tools/cephlint) =="
+# the shipped baseline must be EMPTY: all 16 checkers (including the
+# interprocedural hot-path-copy / buffer-escape / lock-across-rpc
+# tier) gate at zero findings — accepted sites live as pragmas or
+# sanctions.py entries with named invariants, never as baseline debt
+python - <<'EOF' || exit 1
+import json
+b = json.load(open("tools/cephlint/baseline.json"))
+assert b == [], f"shipped baseline must be empty, has {len(b)} entries"
+EOF
 lint_json="$(mktemp -t cephlint.XXXXXX.json)"
 trap 'rm -f "$lint_json"' EXIT
 python -m tools.cephlint ceph_tpu --format=json > "$lint_json"

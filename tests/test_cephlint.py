@@ -1,8 +1,11 @@
 """cephlint — the AST invariant checker (tools/cephlint).
 
-Each of the nine checkers must fire on a seeded violation, pragmas and
-the baseline must silence them, and — the tier-1 gate — the real tree
-must scan clean with the shipped (empty) baseline.
+Each of the sixteen checkers must fire on a seeded violation, pragmas
+and the baseline must silence them, and — the tier-1 gate — the real
+tree must scan clean with the shipped (empty) baseline.  The three
+interprocedural checkers (hot-path-copy, buffer-escape,
+lock-across-rpc) additionally get cross-file cache-invalidation,
+sanction-table, ``--diff`` mode, and wall-clock budget coverage.
 """
 
 import json
@@ -1001,3 +1004,359 @@ def test_stale_pragma_prune_preserves_trailing_comment(tmp_path):
     assert "disable=blocking-call" in src
     import ast as _ast
     _ast.parse(src)
+
+
+# ------------------------------------------------ interprocedural layer
+
+
+def test_hot_path_copy_fires_through_helper_chain(tmp_path):
+    """A deliberate to_bytes on the sub-read reply path, one helper
+    deep; an unreachable copy is NOT a finding."""
+    p = write(tmp_path, "hp.py", """
+        import numpy as np
+
+        class Backend:
+            async def handle_sub_read_reply(self, msg):
+                return self._stage(msg)
+
+            def _stage(self, msg):
+                return self._bl.to_bytes()        # reachable: finding
+
+            async def handle_sub_write(self, msg):
+                return helper(msg)
+
+        def helper(m):
+            return np.concatenate([m.a, m.b])     # reachable: finding
+
+        def cold(m):
+            return bytes(m)                       # unreachable: quiet
+    """)
+    found = run_checks([p], checks=["hot-path-copy"])
+    assert len(found) == 2, found
+    callees = sorted(f.extra["callee"] for f in found)
+    assert callees == [".to_bytes()", "np.concatenate"]
+    chains = {tuple(f.extra["chain"]) for f in found}
+    assert ("Backend.handle_sub_read_reply", "Backend._stage") in chains
+    assert ("Backend.handle_sub_write", "helper") in chains
+
+
+def test_hot_path_copy_pragma_and_sanction_silence(tmp_path, monkeypatch):
+    from tools.cephlint import sanctions as sanctions_mod
+    p = write(tmp_path, "hp2.py", """
+        class Backend:
+            async def handle_sub_read(self, msg):
+                a = self._bl.to_bytes()   # cephlint: disable=hot-path-copy
+                b = self._bl.rebuild()
+                return a, b
+    """)
+    found = run_checks([p], checks=["hot-path-copy"])
+    assert [f.extra["callee"] for f in found] == [".rebuild()"]
+    monkeypatch.setattr(sanctions_mod, "HOT_PATH_COPY", [
+        ("hp2.py", "Backend.handle_sub_read", ".rebuild()",
+         "test invariant: rebuild feeds a fixture")])
+    assert run_checks([p], checks=["hot-path-copy"]) == []
+
+
+def test_stale_sanction_reported_only_when_file_scanned(tmp_path,
+                                                        monkeypatch):
+    from tools.cephlint import sanctions as sanctions_mod
+    p = write(tmp_path, "hp3.py", """
+        class Backend:
+            async def handle_sub_read(self, msg):
+                return msg
+    """)
+    # entry for a file NOT in this scan: not judged
+    monkeypatch.setattr(sanctions_mod, "HOT_PATH_COPY", [
+        ("some/other.py", "X.y", "bytes()", "irrelevant here")])
+    assert run_checks([p], checks=["hot-path-copy"]) == []
+    # entry for THIS file that matches nothing: stale
+    monkeypatch.setattr(sanctions_mod, "HOT_PATH_COPY", [
+        ("hp3.py", "Backend.handle_sub_read", "bytes()", "gone")])
+    found = run_checks([p], checks=["hot-path-copy"])
+    assert len(found) == 1 and "stale sanction" in found[0].message
+
+
+def test_buffer_escape_cross_function_and_ordering(tmp_path):
+    p = write(tmp_path, "esc.py", """
+        class Sess:
+            async def flush(self):
+                await self.conn.send_message(self._buf)
+
+            def late(self):
+                self._buf.append(b"x")            # finding: escaped attr
+
+        class Ok:
+            async def send(self):
+                self._b.append(b"x")              # before handoff: fine
+                await self.conn.send_message(self._b)
+
+        class Bad2:
+            async def send(self):
+                await self.conn.send_message(self._b)
+                self._b.append(b"y")              # after handoff: finding
+    """)
+    found = run_checks([p], checks=["buffer-escape"])
+    attrs = sorted(f.extra["attr"] for f in found)
+    assert attrs == ["Bad2._b", "Sess._buf"], found
+
+
+def test_buffer_escape_one_level_through_helper(tmp_path):
+    p = write(tmp_path, "esc2.py", """
+        class Deep:
+            async def send(self):
+                await self.conn.send_message(self._b)
+
+            def touch(self):
+                scribble(self._b)                 # helper mutates param
+
+        def scribble(bl):
+            bl.append(b"z")
+    """)
+    found = run_checks([p], checks=["buffer-escape"])
+    assert len(found) == 1 and found[0].extra["attr"] == "Deep._b"
+    assert "via scribble" in found[0].message
+
+
+def test_buffer_escape_sanction_and_pragma(tmp_path, monkeypatch):
+    from tools.cephlint import sanctions as sanctions_mod
+    body = """
+        class Sess:
+            async def flush(self):
+                await self.conn.send_message(self._buf)
+
+            def late(self):
+                self._buf.append(b"x"){pragma}
+    """
+    p = write(tmp_path, "esc3.py",
+              body.format(pragma="   # cephlint: disable=buffer-escape"))
+    assert run_checks([p], checks=["buffer-escape"]) == []
+    p = write(tmp_path, "esc4.py", body.format(pragma=""))
+    monkeypatch.setattr(sanctions_mod, "BUFFER_ESCAPE", [
+        ("esc4.py", "Sess.late", "attr:_buf",
+         "test invariant: protocol orders late() before flush()")])
+    assert run_checks([p], checks=["buffer-escape"]) == []
+
+
+def test_lock_across_rpc_through_helper_and_bare_future(tmp_path):
+    p = write(tmp_path, "rpc.py", """
+        from ceph_tpu.common.lockdep import DepLock
+
+        class Peer:
+            def __init__(self):
+                self._lock = DepLock("test.lock")
+
+            async def caller(self):
+                async with self._lock:
+                    await self._helper()          # finding: helper sends
+
+            async def _helper(self):
+                await self.conn.send_message(1)
+
+            async def waiter(self, fut):
+                async with self._lock:
+                    await fut                     # finding: bare future
+
+            async def direct(self):
+                async with self._lock:
+                    await self.conn.send_message(1)   # lock-order's beat
+
+            async def unlocked(self):
+                await self._helper()              # no lock: fine
+    """)
+    found = run_checks([p], checks=["lock-across-rpc"])
+    assert len(found) == 2, found
+    by_extra = {f.extra.get("callee", f.extra.get("expr")) for f in found}
+    assert by_extra == {"_helper", "fut"}
+    assert all(f.extra["locks"] == ["test.lock"] for f in found)
+
+
+def test_lock_across_rpc_sanction_names_the_lock(tmp_path, monkeypatch):
+    from tools.cephlint import sanctions as sanctions_mod
+    p = write(tmp_path, "rpc2.py", """
+        from ceph_tpu.common.lockdep import DepLock
+
+        class Peer:
+            def __init__(self):
+                self._lock = DepLock("test.lock")
+
+            async def caller(self):
+                async with self._lock:
+                    await self._helper()
+
+            async def _helper(self):
+                await self.conn.send_message(1)
+    """)
+    monkeypatch.setattr(sanctions_mod, "LOCK_ACROSS_RPC", [
+        ("rpc2.py", "Peer.caller", "test.lock",
+         "test invariant: this lock IS the serialization point")])
+    assert run_checks([p], checks=["lock-across-rpc"]) == []
+
+
+def test_cross_file_cache_invalidation_reruns_interprocedural(tmp_path):
+    """Editing a CALLEE re-runs the interprocedural checks with the
+    caller's summary served from cache — the new cross-file finding
+    must appear (summaries ride the same content-sha cache as facts)."""
+    caller = write(tmp_path, "caller.py", """
+        class B:
+            async def handle_sub_read(self, m):
+                return helper_entry(m)
+    """)
+    callee = write(tmp_path, "callee.py", """
+        def helper_entry(m):
+            return m
+    """)
+    cache = str(tmp_path / "cache.json")
+    l1 = Linter(checks=["hot-path-copy"], cache_path=cache)
+    assert l1.run([caller, callee], ReportContext()) == []
+    # the callee grows a copy; the caller file is untouched (cached)
+    (tmp_path / "callee.py").write_text(textwrap.dedent("""
+        def helper_entry(m):
+            return m.to_bytes()
+    """))
+    l2 = Linter(checks=["hot-path-copy"], cache_path=cache)
+    found = l2.run([caller, callee], ReportContext())
+    assert len(found) == 1
+    assert found[0].path == callee
+    assert found[0].extra["chain"] == ["B.handle_sub_read",
+                                       "helper_entry"]
+
+
+# ------------------------------------------------ --diff mode
+
+
+def _git(tmp_path, *args):
+    subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                   capture_output=True)
+
+
+def test_changed_vs_ref_modified_plus_untracked(tmp_path):
+    from tools.cephlint.driver import changed_vs_ref
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@example.com")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    (tmp_path / "notes.txt").write_text("not python\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "a.py").write_text("x = 2\n")
+    (tmp_path / "b.py").write_text("y = 1\n")
+    (tmp_path / "notes.txt").write_text("still not python\n")
+    changed = changed_vs_ref("HEAD", repo_root=str(tmp_path))
+    assert sorted(changed) == ["a.py", "b.py"]
+    with pytest.raises(ValueError):
+        changed_vs_ref("no-such-ref", repo_root=str(tmp_path))
+
+
+def test_diff_mode_restricts_findings_to_changed_files(tmp_path):
+    """Only changed files report (and only their pragmas are judged),
+    but summaries still cover the whole tree, so an interprocedural
+    finding in a changed file still sees unchanged callers."""
+    caller = write(tmp_path, "caller.py", """
+        class B:
+            async def handle_sub_read(self, m):
+                return helper_entry(m)
+    """)
+    callee = write(tmp_path, "callee.py", """
+        import time
+
+        def helper_entry(m):
+            time.sleep(1)
+            return m.to_bytes()
+
+        async def also_blocking():
+            time.sleep(1)
+    """)
+    other = write(tmp_path, "other.py", """
+        import time
+
+        async def unrelated():
+            time.sleep(1)
+    """)
+    cache = str(tmp_path / "cache.json")
+    # full run: async blocking-calls in callee+other, the cross-file copy
+    l1 = Linter(checks=["hot-path-copy", "blocking-call"],
+                cache_path=cache)
+    full = l1.run([caller, callee, other], ReportContext())
+    assert len(full) == 3
+    # diff run: only the callee changed — other.py's finding filtered,
+    # the interprocedural chain (rooted in UNCHANGED caller.py) kept
+    l2 = Linter(checks=["hot-path-copy", "blocking-call"],
+                cache_path=cache)
+    part = l2.run([caller, callee, other], ReportContext(),
+                  changed_only={callee})
+    assert sorted(f.check for f in part) == [
+        "blocking-call", "hot-path-copy"]
+    assert all(f.path == callee for f in part)
+    chain = [f for f in part if f.check == "hot-path-copy"][0]
+    assert chain.extra["chain"][0] == "B.handle_sub_read"
+
+
+def test_cli_diff_mode_end_to_end(tmp_path):
+    import os
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@example.com")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "a.py").write_text(
+        "import time\n\n\nasync def f():\n    time.sleep(1)\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    # nothing changed vs HEAD -> exit 0 without linting
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.cephlint", ".", "--diff", "HEAD",
+         "--no-cache", "--no-baseline"],
+        cwd=tmp_path, env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no python files changed" in r.stdout
+    # a changed file lints; the committed-but-unchanged one would too,
+    # but only the changed file may report
+    (tmp_path / "b.py").write_text(
+        "import time\n\n\nasync def g():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.cephlint", ".", "--diff", "HEAD",
+         "--format=json", "--no-cache", "--no-baseline"],
+        cwd=tmp_path, env=env, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["count"] == 1
+    assert out["findings"][0]["path"].endswith("b.py")
+    # bad ref -> usage error
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.cephlint", ".", "--diff",
+         "no-such-ref", "--no-cache", "--no-baseline"],
+        cwd=tmp_path, env=env, capture_output=True, text=True)
+    assert r.returncode == 2
+
+
+# ------------------------------------------------ wall-clock budgets
+
+
+def test_warm_full_tree_lint_within_budget(tmp_path):
+    """ISSUE 20 acceptance: warm full-tree lint <= 10s (pre-commit
+    viability).  The cold run populates the cache; the warm run pays
+    only mtime/sha checks + the report phase (incl. the whole-tree
+    call graph)."""
+    import time as _time
+    cache = str(tmp_path / "cache.json")
+    lint_paths([REPO_TREE], cache_path=cache)          # cold populate
+    t0 = _time.monotonic()
+    found, _sup = lint_paths([REPO_TREE], cache_path=cache)
+    dt = _time.monotonic() - t0
+    assert found == []
+    assert dt <= 10.0, f"warm full-tree lint took {dt:.1f}s (> 10s)"
+
+
+def test_diff_lint_within_budget(tmp_path):
+    """ISSUE 20 acceptance: --diff lint <= 2s with a warm cache —
+    unchanged files' facts and summaries come straight from the cache
+    without re-reading them."""
+    import time as _time
+    cache = str(tmp_path / "cache.json")
+    lint_paths([REPO_TREE], cache_path=cache)          # warm it
+    t0 = _time.monotonic()
+    found, _sup = lint_paths(
+        [REPO_TREE], cache_path=cache,
+        changed_only={f"{REPO_TREE}/osd/ecbackend.py"})
+    dt = _time.monotonic() - t0
+    assert found == []
+    assert dt <= 2.0, f"--diff lint took {dt:.1f}s (> 2s)"

@@ -500,6 +500,76 @@ class TestZeroCopyWritePath:
         loop.run_until_complete(go())
 
 
+class TestZeroCopyReadReconstruct:
+    """STATS pins for the sub-read reply path (ecbackend
+    _reconstruct_extent): decode inputs stack received chunk slices
+    through concat_u8 — a single exact-fit chunk is a VIEW, and the
+    whole read performs exactly one counted materialization: the
+    client-facing bytes return."""
+
+    def test_concat_u8_single_exact_fit_is_view(self):
+        base = np.arange(512, dtype=np.uint8)
+        before = dict(buffer_mod.STATS)
+        out = buffer_mod.concat_u8([base], 512)
+        after = dict(buffer_mod.STATS)
+        assert np.shares_memory(out, base)
+        assert after["bytes_copied"] == before["bytes_copied"]
+        assert after["copy_calls"] == before["copy_calls"]
+
+    def test_concat_u8_truncating_single_part_is_view(self):
+        base = np.arange(512, dtype=np.uint8)
+        before = dict(buffer_mod.STATS)
+        out = buffer_mod.concat_u8([base], 100)
+        after = dict(buffer_mod.STATS)
+        assert out.size == 100 and np.shares_memory(out, base)
+        assert after["bytes_copied"] == before["bytes_copied"]
+
+    def test_concat_u8_multi_part_counts_one_copy(self):
+        parts = [np.full(256, i, dtype=np.uint8) for i in range(3)]
+        before = dict(buffer_mod.STATS)
+        out = buffer_mod.concat_u8(parts, 768)
+        after = dict(buffer_mod.STATS)
+        assert out.size == 768
+        assert after["bytes_copied"] - before["bytes_copied"] == 768
+        assert after["copy_calls"] - before["copy_calls"] == 1
+        # zero-padding past the parts is not a buffer copy
+        before = dict(buffer_mod.STATS)
+        padded = buffer_mod.concat_u8(parts[:1], 1024)
+        after = dict(buffer_mod.STATS)
+        assert padded.size == 1024 and not padded[256:].any()
+        assert after["bytes_copied"] - before["bytes_copied"] == 256
+
+    def test_aligned_read_materializes_exactly_once(self, loop):
+        """Sub-read reply -> decode -> client: the single exact-fit
+        chunk passthrough keeps concat_u8 silent; the one counted copy
+        is the client-facing bytes contract.  A decode-input copy
+        regression (concat_u8 materializing per chunk) doubles the
+        delta and fails here."""
+        async def go():
+            cluster = MiniCluster(4)
+            cluster.create_ec_pool(
+                "zcr", {"plugin": "jax_rs", "k": "2", "m": "1"},
+                pg_num=2, stripe_unit=512)
+            async with cluster:
+                client = await cluster.client()
+                io = client.io_ctx("zcr")
+                data = bytes(range(256)) * 16          # 4096 = 4 stripes
+                await io.write_full("obj", data)
+                await io.read("obj")                   # jit + map warm
+                before = dict(buffer_mod.STATS)
+                got = await io.read("obj")
+                after = dict(buffer_mod.STATS)
+                assert got == data
+                copied = after["bytes_copied"] - before["bytes_copied"]
+                calls = after["copy_calls"] - before["copy_calls"]
+                assert (copied, calls) == (len(data), 1), (
+                    f"aligned read materialized {copied} bytes in "
+                    f"{calls} copies — expected exactly the client "
+                    f"bytes return ({len(data)} in 1); the sub-read "
+                    f"reply / decode-input path regressed")
+        loop.run_until_complete(go())
+
+
 class TestCrcResendCache:
     def test_reframing_same_payload_hits_crc_cache(self):
         """A client retry re-frames the SAME BufferList: the second

@@ -1,4 +1,4 @@
-"""Checker registry — the thirteen invariants, by check id."""
+"""Checker registry — the sixteen invariants, by check id."""
 
 from .base import Checker, Module, ReportContext  # noqa: F401
 from .aliasing import BufferAliasChecker
@@ -6,10 +6,13 @@ from .atomicity import AwaitAtomicityChecker, IterMutateChecker
 from .blocking import BlockingCallChecker
 from .dispatch import DispatchCoverageChecker
 from .epochs import EpochMonotonicityChecker
+from .escape import BufferEscapeChecker
+from .hotpath import HotPathCopyChecker
 from .kernels import KernelPurityChecker
 from .locks import LockOrderChecker
 from .messages import MsgSymmetryChecker
 from .options import OptionsChecker
+from .rpclocks import LockAcrossRpcChecker
 from .spans import SpanBalanceChecker
 from .tasks import FireAndForgetChecker
 from .timeouts import ReplyTimeoutChecker
@@ -19,6 +22,8 @@ ALL_CHECKERS = (BlockingCallChecker, FireAndForgetChecker,
                 KernelPurityChecker, AwaitAtomicityChecker,
                 IterMutateChecker, BufferAliasChecker,
                 DispatchCoverageChecker, ReplyTimeoutChecker,
-                EpochMonotonicityChecker, SpanBalanceChecker)
+                EpochMonotonicityChecker, SpanBalanceChecker,
+                HotPathCopyChecker, BufferEscapeChecker,
+                LockAcrossRpcChecker)
 
 CHECKERS = {c.name: c for c in ALL_CHECKERS}

@@ -38,6 +38,10 @@ class Module:
 class Checker:
     name = ""                # check id used in pragmas/baseline/output
     description = ""
+    # interprocedural checkers set this: the driver then computes (and
+    # caches) per-file function summaries and hands the whole-tree map
+    # to report() via ReportContext.summaries
+    needs_summaries = False
 
     def collect(self, module: Module) -> dict:
         raise NotImplementedError
@@ -50,8 +54,14 @@ class Checker:
 @dataclass
 class ReportContext:
     """Knobs the driver threads into report() — runtime artifacts to
-    cross-check against (lockdep dumps), tuning lists."""
+    cross-check against (lockdep dumps), tuning lists, and the
+    whole-tree interprocedural layer."""
     lockdep_dump: "Optional[dict]" = None     # runtime lockdep graph JSON
+    # path -> function-summary dict (tools/cephlint/summaries.py);
+    # populated by the driver whenever an active checker declares
+    # ``needs_summaries`` — the interprocedural checkers build their
+    # call graph from this instead of collecting their own facts
+    summaries: "Optional[Dict[str, dict]]" = None
 
 
 # --- shared AST helpers -------------------------------------------------------

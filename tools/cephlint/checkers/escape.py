@@ -1,0 +1,174 @@
+"""buffer-escape — freeze-on-handoff made static, across functions.
+
+The runtime half (cephsan ``sanitizer.handoff()``) seals a BufferList
+the moment it crosses ``send_message`` / ``queue_transaction``: the
+bytes may sit in a corked messenger queue or an unsynced WAL batch, so
+mutating them afterwards corrupts the consumer's copy — but the
+runtime only catches the schedules the tests drive.  This checker
+catches the pattern statically and *interprocedurally*: a buffer-ish
+value (a ``self`` attribute or a parameter, one taint level through
+``substr``/``view``/slices and message constructors) that
+
+- crosses a handoff boundary in one function, and
+- is mutated (``mutable_view()``, ``append``/``append_zero``,
+  subscript/augmented stores, numpy in-place methods) in ANOTHER
+  function — same class, another file, wherever the summary layer
+  sees the same ``(class, attr)`` — or later in the same function,
+
+is a finding at the mutation site, naming the handoff site.  The
+cross-function case cannot be ordered statically, so it is reported
+conservatively: if a protocol invariant orders the mutation strictly
+before the handoff, sanction it in sanctions.BUFFER_ESCAPE (or pragma
+the line) naming that invariant.
+
+One interprocedural level also flows through calls: a function that
+hands off its *parameter* transfers the escape to every caller's
+argument (``self._bl`` passed into a helper that sends it), and
+likewise for parameter mutations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .. import sanctions
+from ..findings import Finding
+from ..summaries import CallGraph
+from .base import Checker, Module, ReportContext
+
+_EXEMPT_SUFFIXES = ("common/buffer.py", "common/sanitizer.py")
+
+
+class BufferEscapeChecker(Checker):
+    name = "buffer-escape"
+    description = ("buffer handed off (send_message/queue_transaction) "
+                   "in one function, mutated in another")
+    needs_summaries = True
+
+    def collect(self, module: Module) -> dict:
+        return {}                    # facts live in the summary layer
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        summaries = ctx.summaries or {}
+        graph = CallGraph(summaries)
+
+        # (class, attr) -> [(path, qual, line, boundary)]
+        escapes: "Dict[Tuple[str, str], List[tuple]]" = {}
+        # (class, attr) -> [(path, qual, line, what, context)]
+        mutations: "Dict[Tuple[str, str], List[tuple]]" = {}
+
+        def note_escape(cls: str, attr: str, site: tuple) -> None:
+            escapes.setdefault((cls, attr), []).append(site)
+
+        def note_mutation(cls: str, attr: str, site: tuple) -> None:
+            mutations.setdefault((cls, attr), []).append(site)
+
+        def param_token(callee_fn: dict, key) -> "str | None":
+            """Callee-side token for an argument position/kwarg."""
+            if isinstance(key, int):
+                params = callee_fn.get("params", ())
+                if key < len(params):
+                    return f"param:{params[key]}"
+                return None
+            return f"param:{key}"
+
+        # pass 1: direct facts + one interprocedural level through
+        # calls whose callee hands off / mutates its parameter
+        for path, s in summaries.items():
+            for qual, fn in s.get("functions", {}).items():
+                cls = fn.get("cls", "")
+                for h in fn.get("handoffs", ()):
+                    for tok in h["args"]:
+                        if tok.startswith("attr:") and cls:
+                            note_escape(cls, tok[5:],
+                                        (path, qual, h["line"],
+                                         h["boundary"]))
+                for m in fn.get("mutations", ()):
+                    tok = m["target"]
+                    if tok.startswith("attr:") and cls:
+                        note_mutation(cls, tok[5:],
+                                      (path, qual, m["line"],
+                                       m["what"], m["context"]))
+                for call in fn.get("calls", ()):
+                    if not call.get("args"):
+                        continue
+                    for cpath, cqual in graph.resolve(path, qual, call):
+                        callee = graph.fn(cpath, cqual)
+                        if callee is None:
+                            continue
+                        callee_handoff_toks = {
+                            t for h in callee.get("handoffs", ())
+                            for t in h["args"]}
+                        callee_mut_toks = {
+                            m["target"]
+                            for m in callee.get("mutations", ())}
+                        for key, tok in call["args"]:
+                            if not (tok.startswith("attr:") and cls):
+                                continue
+                            ptok = param_token(callee, key)
+                            if ptok is None:
+                                continue
+                            if ptok in callee_handoff_toks:
+                                note_escape(cls, tok[5:],
+                                            (path, qual, call["line"],
+                                             f"via {cqual}"))
+                            if ptok in callee_mut_toks:
+                                note_mutation(
+                                    cls, tok[5:],
+                                    (path, qual, call["line"],
+                                     f"via {cqual}", call["context"]))
+
+        out: "List[Finding]" = []
+        used: "set[int]" = set()
+        seen: "set[tuple]" = set()
+        for key, muts in sorted(mutations.items()):
+            esc = escapes.get(key)
+            if not esc:
+                continue
+            cls, attr = key
+            for (mpath, mqual, mline, what, mctx) in muts:
+                if mpath.replace("\\", "/").endswith(_EXEMPT_SUFFIXES):
+                    continue
+                # same-function: only a mutation AFTER the handoff is a
+                # hazard (construct-then-send is the normal pattern);
+                # cross-function: unordered, conservatively reported
+                cited = [e for e in esc
+                         if (e[0], e[1]) != (mpath, mqual) or
+                         e[2] < mline]
+                if not cited:
+                    continue
+                hit = sanctions.match(sanctions.BUFFER_ESCAPE, mpath,
+                                      mqual, f"attr:{attr}")
+                if hit is not None:
+                    used.add(hit[0])
+                    continue
+                fp = (mpath, mline, attr)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                epath, equal, eline, boundary = cited[0]
+                out.append(Finding(
+                    check=self.name, path=mpath, line=mline,
+                    context=mctx,
+                    extra={"attr": f"{cls}.{attr}",
+                           "handoff": f"{epath}:{eline}"},
+                    message=f"{what} mutates {cls}.{attr}, which "
+                            f"crosses a handoff boundary "
+                            f"({boundary}) in {equal} at "
+                            f"{epath}:{eline} — after the handoff "
+                            f"those bytes belong to the consumer "
+                            f"(corked frame / unsynced WAL); mutate "
+                            f"before handing off, .copy() first, or "
+                            f"sanction/pragma naming the ordering "
+                            f"invariant"))
+        for i in sanctions.stale_entries(sanctions.BUFFER_ESCAPE, used,
+                                         summaries.keys()):
+            suffix, fq, tok, _why = sanctions.BUFFER_ESCAPE[i]
+            out.append(Finding(
+                check=self.name, path="tools/cephlint/sanctions.py",
+                line=0, context=f"BUFFER_ESCAPE[{i}]",
+                message=f"stale sanction: ({suffix!r}, {fq!r}, "
+                        f"{tok!r}) matches no finding although the "
+                        f"file was scanned; delete the entry"))
+        return out

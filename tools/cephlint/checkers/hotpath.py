@@ -1,0 +1,111 @@
+"""hot-path-copy — static totalization of the ``bytes_copied == 0`` pin.
+
+The runtime contract (tests/test_wire.py TestZeroCopyWritePath, buffer
+STATS) proves the paths the tests happen to drive copy nothing.  This
+checker proves the *complement*: starting from the hot-path entrypoint
+roots — ``handle_sub_write`` / ``handle_sub_read`` /
+``handle_sub_read_reply`` / ``handle_sub_write_reply`` on any backend,
+the Objecter submit/reply path, and the EncodeService pipeline — it
+walks the whole-tree call graph (tools/cephlint/summaries.py) and
+reports every reachable copy-introducing call:
+
+    .to_bytes()  .rebuild()  .rebuild_aligned()  concat_u8()
+    np.concatenate  bytes(<arg>)  b"".join
+
+Each finding carries the shortest root call chain — the exact
+burn-down list ROADMAP item 2's zero-copy read work consumes.  A site
+that must stay (a client-reply materialization, a cold error path)
+is either sanctioned in tools/cephlint/sanctions.py:HOT_PATH_COPY with
+a named invariant, or pragma'd at the line.  ``common/buffer.py``
+itself is exempt — its method bodies ARE the copy primitives; the
+finding belongs at the caller.
+
+Sanction entries that stop matching while their file is still scanned
+are reported (stale-sanction discipline, same as stale pragmas).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .. import sanctions
+from ..findings import Finding
+from ..summaries import CallGraph
+from .base import Checker, Module, ReportContext
+
+# entrypoint roots: "*.name" = any function/method of that name,
+# "Class.name" = that qualname only.  Reviewed alongside the sanction
+# table — adding a hot-path entrypoint means adding its root here.
+ROOTS = (
+    "*.handle_sub_write",
+    "*.handle_sub_read",
+    "*.handle_sub_read_reply",
+    "*.handle_sub_write_reply",
+    "Objecter.op_submit",          # client submit path (covers _op_submit,
+    "Objecter._send_op",           # bucket flush, wire encode via graph)
+    "Objecter._fan_out_reply",     # client reply path
+    "EncodeService.encode",        # device encode pipeline
+    "EncodeService._run_batch",
+)
+
+# chains terminate at ownership / dispatch boundaries: past
+# queue_transaction the bytes belong to the objectstore (freeze-on-
+# handoff — the durable-media materialization there is its own
+# contract), and past ms_dispatch the remote side's handlers are
+# themselves roots (handle_sub_*).  The local serialization path
+# (send_message -> _frame -> wire encode) stays in scope.
+STOP_AT = frozenset({"queue_transaction", "ms_dispatch"})
+
+_EXEMPT_SUFFIX = "common/buffer.py"
+
+
+class HotPathCopyChecker(Checker):
+    name = "hot-path-copy"
+    description = ("copy-introducing call reachable from a hot-path "
+                   "root (sub-read/sub-write/objecter/encode)")
+    needs_summaries = True
+
+    def collect(self, module: Module) -> dict:
+        return {}                    # facts live in the summary layer
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        summaries = ctx.summaries or {}
+        graph = CallGraph(summaries)
+        chains = graph.reachable(graph.match_roots(ROOTS),
+                                 stop_names=STOP_AT)
+        out: "List[Finding]" = []
+        used: "set[int]" = set()
+        for (path, qual), chain in sorted(chains.items()):
+            if path.replace("\\", "/").endswith(_EXEMPT_SUFFIX):
+                continue
+            fn = graph.fn(path, qual)
+            for copy in fn.get("copies", ()):
+                hit = sanctions.match(sanctions.HOT_PATH_COPY, path,
+                                      qual, copy["callee"])
+                if hit is not None:
+                    used.add(hit[0])
+                    continue
+                via = " -> ".join(chain)
+                out.append(Finding(
+                    check=self.name, path=path, line=copy["line"],
+                    context=copy["context"],
+                    extra={"chain": chain, "callee": copy["callee"]},
+                    message=f"{copy['callee']} is reachable from "
+                            f"hot-path root {chain[0]!r} (chain: {via})"
+                            f" — the zero-copy contract wants received "
+                            f"slices threaded through, not "
+                            f"materialized; fix it, or sanction it in "
+                            f"sanctions.HOT_PATH_COPY / pragma the "
+                            f"line, naming the protecting invariant"))
+        for i in sanctions.stale_entries(sanctions.HOT_PATH_COPY, used,
+                                         summaries.keys()):
+            suffix, fq, callee, _why = sanctions.HOT_PATH_COPY[i]
+            out.append(Finding(
+                check=self.name, path="tools/cephlint/sanctions.py",
+                line=0, context=f"HOT_PATH_COPY[{i}]",
+                message=f"stale sanction: ({suffix!r}, {fq!r}, "
+                        f"{callee!r}) matches no finding although the "
+                        f"file was scanned — the copy site was fixed "
+                        f"or moved; delete the entry"))
+        return out

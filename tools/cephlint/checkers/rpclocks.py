@@ -1,0 +1,167 @@
+"""lock-across-rpc — the lexical locks checker extended to call chains.
+
+The lock-order checker flags ``await send_message(...)`` lexically
+inside an ``async with DepLock`` block — but the head-of-line deadlock
+PR 17 found at runtime (a dispatch handler awaiting a round-trip while
+holding the link) hid behind ONE helper call: the lock body awaited a
+tidy-looking method, and the send lived inside it.  This checker
+closes that hole with the summary layer's call graph:
+
+- an *RPC suspension primitive* is an awaited messenger send
+  (``send_message``/``send``/...) or a bare ``await`` of a future-ish
+  expression (``await rop.done``, ``await fut`` — an unbounded reply
+  wait; ``wait_for``-bounded awaits are calls and don't count),
+- a function *suspends on RPC* if it contains a primitive or awaits a
+  call that resolves (tree-wide) to a function that does,
+- a finding is any awaited call made while a DepLock is lexically held
+  whose callee suspends on RPC — the helper chain down to the
+  primitive site is named — plus the direct case the lexical checker
+  never covered: a bare future await under a DepLock.
+
+Direct sends under a lock stay lock-order findings (one finding per
+hazard, one checker per shape).  Sanctions
+(sanctions.LOCK_ACROSS_RPC, keyed by DepLock class) or line pragmas
+name the serialization-point / bounded-watchdog invariant where
+holding is deliberate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .. import sanctions
+from ..findings import Finding
+from ..summaries import CallGraph, SEND_NAMES
+from .base import Checker, Module, ReportContext
+
+
+class LockAcrossRpcChecker(Checker):
+    name = "lock-across-rpc"
+    description = ("awaiting a messenger send / reply future through "
+                   "a helper chain while holding a DepLock")
+    needs_summaries = True
+
+    def collect(self, module: Module) -> dict:
+        return {}                    # facts live in the summary layer
+
+    def report(self, facts: "Dict[str, dict]", ctx: ReportContext
+               ) -> "List[Finding]":
+        summaries = ctx.summaries or {}
+        graph = CallGraph(summaries)
+        lock_attrs = graph.lock_attrs        # attr -> DepLock classes
+
+        # fixpoint: which functions suspend on RPC, with a witness
+        # chain fragment for the evidence in the message
+        suspend: "Dict[Tuple[str, str], str]" = {}
+        for path, s in summaries.items():
+            for qual, fn in s.get("functions", {}).items():
+                key = (path, qual)
+                if fn.get("sends"):
+                    ln = fn["sends"][0]["line"]
+                    suspend[key] = f"{qual} sends at {path}:{ln}"
+                elif fn.get("bare_awaits"):
+                    b = fn["bare_awaits"][0]
+                    suspend[key] = (f"{qual} awaits {b['expr']} at "
+                                    f"{path}:{b['line']}")
+        # reverse propagation through awaited calls
+        changed = True
+        while changed:
+            changed = False
+            for path, s in summaries.items():
+                for qual, fn in s.get("functions", {}).items():
+                    key = (path, qual)
+                    if key in suspend:
+                        continue
+                    for call in fn.get("calls", ()):
+                        if not call["awaited"]:
+                            continue
+                        for callee in graph.resolve(path, qual, call):
+                            if callee in suspend and callee != key:
+                                suspend[key] = suspend[callee]
+                                changed = True
+                                break
+                        if key in suspend:
+                            break
+
+        out: "List[Finding]" = []
+        used: "set[int]" = set()
+
+        def dep_locks(attrs: "List[str]") -> "List[str]":
+            return sorted({c for a in attrs
+                           for c in lock_attrs.get(a, ())})
+
+        for path, s in sorted(summaries.items()):
+            for qual, fn in s.get("functions", {}).items():
+                # direct bare future await under a DepLock
+                for b in fn.get("bare_awaits", ()):
+                    classes = dep_locks(b["locks"])
+                    if not classes:
+                        continue
+                    if self._sanctioned(path, qual, classes, used):
+                        continue
+                    out.append(Finding(
+                        check=self.name, path=path, line=b["line"],
+                        context=b["context"],
+                        extra={"locks": classes, "expr": b["expr"]},
+                        message=f"await {b['expr']} while holding "
+                                f"DepLock {', '.join(classes)}: an "
+                                f"unbounded reply/future wait under a "
+                                f"lock is how head-of-line deadlocks "
+                                f"start — resolve it outside the "
+                                f"lock, bound it with wait_for, or "
+                                f"sanction/pragma naming the "
+                                f"resolver invariant"))
+                # awaited helper that suspends on RPC, under a DepLock
+                for call in fn.get("calls", ()):
+                    if not call["awaited"]:
+                        continue
+                    classes = dep_locks(call["locks"])
+                    if not classes:
+                        continue
+                    if call["n"] in SEND_NAMES:
+                        continue              # lock-order's finding
+                    witness = None
+                    for callee in graph.resolve(path, qual, call):
+                        if callee in suspend and \
+                                callee != (path, qual):
+                            witness = suspend[callee]
+                            break
+                    if witness is None:
+                        continue
+                    if self._sanctioned(path, qual, classes, used):
+                        continue
+                    out.append(Finding(
+                        check=self.name, path=path, line=call["line"],
+                        context=call["context"],
+                        extra={"locks": classes, "callee": call["n"],
+                               "witness": witness},
+                        message=f"await {call['d']}(...) while "
+                                f"holding DepLock "
+                                f"{', '.join(classes)} suspends on "
+                                f"the messenger through a helper "
+                                f"({witness}) — a send/reply can park "
+                                f"on peer backpressure for seconds; "
+                                f"release the lock first, or "
+                                f"sanction/pragma naming why this "
+                                f"lock must span the round trip"))
+        for i in sanctions.stale_entries(sanctions.LOCK_ACROSS_RPC,
+                                         used, summaries.keys()):
+            suffix, fq, lock, _why = sanctions.LOCK_ACROSS_RPC[i]
+            out.append(Finding(
+                check=self.name, path="tools/cephlint/sanctions.py",
+                line=0, context=f"LOCK_ACROSS_RPC[{i}]",
+                message=f"stale sanction: ({suffix!r}, {fq!r}, "
+                        f"{lock!r}) matches no finding although the "
+                        f"file was scanned; delete the entry"))
+        return out
+
+    @staticmethod
+    def _sanctioned(path: str, qual: str, classes: "List[str]",
+                    used: "set[int]") -> bool:
+        for cls in classes:
+            hit = sanctions.match(sanctions.LOCK_ACROSS_RPC, path,
+                                  qual, cls)
+            if hit is not None:
+                used.add(hit[0])
+                return True
+        return False

@@ -16,7 +16,7 @@ from typing import Optional
 from . import VERSION
 from . import baseline as baseline_mod
 from .checkers import ALL_CHECKERS
-from .driver import Linter, lint_paths
+from .driver import Linter, changed_vs_ref, lint_paths
 from .findings import Finding
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -50,6 +50,14 @@ def main(argv: "Optional[list]" = None) -> int:
                     help="disable the per-file fact cache")
     ap.add_argument("--cache", default=DEFAULT_CACHE,
                     help="fact cache path")
+    ap.add_argument("--diff", default="", metavar="REF",
+                    help="lint only files changed vs a git ref "
+                         "(plus untracked files); unchanged files' "
+                         "facts and function summaries come straight "
+                         "from the cache without re-reading them, so "
+                         "interprocedural checks still see the whole "
+                         "tree — fast pre-commit mode; the full run "
+                         "stays the CI gate")
     ap.add_argument("--lockdep-dump", default="",
                     help="JSON from 'lockdep dump --format=json' on a "
                          "daemon admin socket; observed runtime edges "
@@ -80,6 +88,16 @@ def main(argv: "Optional[list]" = None) -> int:
             return 2
 
     cache = None if args.no_cache else args.cache
+    changed_only = None
+    if args.diff:
+        try:
+            changed_only = changed_vs_ref(args.diff)
+        except ValueError as e:
+            print(f"cephlint: {e}", file=sys.stderr)
+            return 2
+        if not changed_only:
+            print(f"cephlint: no python files changed vs {args.diff}")
+            return 0
     try:
         if args.write_baseline:
             linter = Linter(checks=checks, cache_path=cache)
@@ -107,7 +125,8 @@ def main(argv: "Optional[list]" = None) -> int:
         baseline_path = None if args.no_baseline else args.baseline
         findings, suppressed = lint_paths(
             args.paths, checks=checks, baseline_path=baseline_path,
-            cache_path=cache, lockdep_dump=lockdep_dump)
+            cache_path=cache, lockdep_dump=lockdep_dump,
+            changed_only=changed_only)
     except ValueError as e:
         print(f"cephlint: {e}", file=sys.stderr)
         return 2

@@ -17,10 +17,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import baseline as baseline_mod
 from . import pragmas as pragmas_mod
+from . import summaries as summaries_mod
 from .checkers import ALL_CHECKERS, CHECKERS, Module, ReportContext
 from .findings import Finding
 
-_CACHE_SCHEMA = 2     # v2: pragma_records (stale-pragma detection)
+_CACHE_SCHEMA = 3     # v3: function summaries (interprocedural layer)
 
 
 def discover(paths: "Sequence[str]") -> "List[str]":
@@ -51,6 +52,7 @@ class Linter:
             raise ValueError(f"unknown check(s): {', '.join(unknown)} "
                              f"(known: {', '.join(sorted(CHECKERS))})")
         self.checkers = [CHECKERS[n]() for n in names]
+        self.want_summaries = any(c.needs_summaries for c in self.checkers)
         self.cache_path = cache_path
         self._cache: "Dict[str, dict]" = {}
         self._cache_dirty = False
@@ -67,9 +69,23 @@ class Linter:
 
     # --- per-file phase -------------------------------------------------------
 
-    def _collect_file(self, path: str) -> "Optional[dict]":
-        """-> {"sha": ..., "facts": {check: facts}, "pragmas": [...],
-        "file_pragmas": [...]} or None on unreadable file."""
+    def _collect_file(self, path: str,
+                      trust_cache: bool = False) -> "Optional[dict]":
+        """-> {"sha": ..., "facts": {check: facts}, "summary": ...,
+        "pragmas": [...], "file_pragmas": [...]} or None on unreadable
+        file.  ``trust_cache`` (the --diff fast path) returns a
+        complete cached entry without re-reading the file at all — the
+        caller asserts the file is unchanged vs the diff ref."""
+        cached = self._cache.get(path)
+        want = {c.name for c in self.checkers}
+
+        def complete(entry: "Optional[dict]") -> bool:
+            return entry is not None and \
+                want <= set(entry.get("facts", {})) and \
+                (not self.want_summaries or "summary" in entry)
+
+        if trust_cache and complete(cached):
+            return cached
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
@@ -80,10 +96,8 @@ class Linter:
             return None
         sha = hashlib.sha1(
             (f"v{_CACHE_SCHEMA}:" + source).encode()).hexdigest()
-        cached = self._cache.get(path)
-        want = {c.name for c in self.checkers}
         if cached is not None and cached.get("sha") == sha and \
-                want <= set(cached.get("facts", {})):
+                complete(cached):
             return cached
         try:
             tree = ast.parse(source, filename=path)
@@ -97,6 +111,8 @@ class Linter:
         facts = {}
         for checker in self.checkers:
             facts[checker.name] = checker.collect(module)
+        summary = summaries_mod.summarize(module) \
+            if self.want_summaries else None
         records = pragmas_mod.extract_records(source)
         per_line: "Dict[int, Set[str]]" = {}
         file_wide: "Set[str]" = set()
@@ -111,9 +127,13 @@ class Linter:
                              for k, v in per_line.items()},
                  "file_pragmas": sorted(file_wide),
                  "pragma_records": records}
+        if summary is not None:
+            entry["summary"] = summary
         if cached is not None and cached.get("sha") == sha:
             # extend a cache entry produced by a narrower --checks run
             entry["facts"] = {**cached.get("facts", {}), **facts}
+            if summary is None and "summary" in cached:
+                entry["summary"] = cached["summary"]
         self._cache[path] = entry
         self._cache_dirty = True
         return entry
@@ -131,24 +151,42 @@ class Linter:
     # --- whole-tree phase -----------------------------------------------------
 
     def run(self, paths: "Sequence[str]",
-            ctx: "Optional[ReportContext]" = None
+            ctx: "Optional[ReportContext]" = None,
+            changed_only: "Optional[Set[str]]" = None
             ) -> "List[Finding]":
+        """``changed_only`` (the --diff mode) restricts *reported*
+        findings and stale-pragma judgement to those files, and trusts
+        complete cache entries for every other file without re-reading
+        it — the whole-tree summary/fact maps still cover every file,
+        so interprocedural checks see callers and callees either way.
+        """
         ctx = ctx or ReportContext()
         files = discover(paths)
         entries: "Dict[str, dict]" = {}
         for path in files:
-            entry = self._collect_file(path)
+            trust = changed_only is not None and path not in changed_only
+            entry = self._collect_file(path, trust_cache=trust)
             if entry is not None:
                 entries[path] = entry
         # drop cache rows for files that no longer exist on this scan's
         # roots is NOT done: the cache may serve multiple roots
         self._save_cache()
 
+        if self.want_summaries and ctx.summaries is None:
+            ctx.summaries = {p: e["summary"] for p, e in entries.items()
+                             if "summary" in e}
+
         findings: "List[Finding]" = list(self.errors)
         for checker in self.checkers:
             facts = {p: e["facts"][checker.name]
-                     for p, e in entries.items()}
+                     for p, e in entries.items()
+                     if checker.name in e.get("facts", {})}
             findings.extend(checker.report(facts, ctx))
+
+        if changed_only is not None:
+            findings = [f for f in findings if f.path in changed_only]
+            entries = {p: e for p, e in entries.items()
+                       if p in changed_only}
 
         # stale-pragma detection runs against the PRE-suppression
         # findings: a pragma is live iff the check it disables still
@@ -273,16 +311,42 @@ class Linter:
         return rewritten
 
 
+def changed_vs_ref(ref: str, repo_root: str = ".") -> "Set[str]":
+    """Python files changed vs a git ref (diff + untracked), as
+    normalized paths relative to ``repo_root`` — the --diff mode's
+    changed set.  Raises ValueError when git can't resolve the ref."""
+    import subprocess
+    out: "Set[str]" = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            cwd=repo_root, capture_output=True, text=True, check=True)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--", "*.py"],
+            cwd=repo_root, capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise ValueError(f"--diff {ref}: git failed: {detail.strip()}")
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        line = line.strip()
+        if line:
+            out.add(os.path.normpath(line))
+    return out
+
+
 def lint_paths(paths: "Sequence[str]",
                checks: "Optional[Iterable[str]]" = None,
                baseline_path: "Optional[str]" = None,
                cache_path: "Optional[str]" = None,
-               lockdep_dump: "Optional[dict]" = None
+               lockdep_dump: "Optional[dict]" = None,
+               changed_only: "Optional[Set[str]]" = None
                ) -> "Tuple[List[Finding], int]":
     """Convenience one-call API (tests, chaos_check --lint, check.sh):
     -> (non-baselined findings, baseline-suppressed count)."""
     linter = Linter(checks=checks, cache_path=cache_path)
-    findings = linter.run(paths, ReportContext(lockdep_dump=lockdep_dump))
+    findings = linter.run(paths, ReportContext(lockdep_dump=lockdep_dump),
+                          changed_only=changed_only)
     if baseline_path and os.path.exists(baseline_path):
         bl = baseline_mod.load(baseline_path)
         return baseline_mod.apply(findings, bl)

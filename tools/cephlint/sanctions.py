@@ -1,0 +1,229 @@
+"""Reviewed sanction table for the interprocedural checkers.
+
+A *sanction* is the whole-tree analog of a line pragma: a reviewed
+entry saying "this copy site IS reachable from a hot-path root and we
+accept it, because <invariant>".  Pragmas mark the site in the code;
+sanctions mark it here, where the whole burn-down list is reviewable
+in one place (ROADMAP item 2 works this table down to empty as the
+zero-copy read path lands).
+
+Each entry: ``(path_suffix, function_qual, callee, invariant)``.
+
+- ``path_suffix``  — matched against the finding's path with
+  ``endswith`` (posix separators),
+- ``function_qual`` — the summary qualname containing the call
+  ("Class.method" or bare function name),
+- ``callee``       — the copy label exactly as reported
+  (".to_bytes()", "bytes()", "np.concatenate", 'b"".join', ...),
+- ``invariant``    — the protecting invariant, in prose.  Entries
+  without a real invariant don't belong here; fix the code instead.
+
+An entry that stops matching any finding while its file is still being
+scanned is itself reported (stale-sanction) so the table can't rot —
+same discipline as stale pragmas.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+# --- hot-path-copy ------------------------------------------------------------
+# Copy sites reachable from the sub-read/sub-write/objecter/encode
+# roots that are sanctioned to stay, each naming its invariant.  This
+# IS ROADMAP item 2's burn-down list for the read path: entries marked
+# [read-path burn-down] are the ones the zero-copy batched-read PR
+# deletes as it lands.
+HOT_PATH_COPY: "List[Tuple[str, str, str, str]]" = [
+    # -- history recorder: armed only under cephmc / the
+    # client_history_record option; the production path never calls it
+    ("client/objecter.py", "_blob_bytes", ".to_bytes()",
+     "history recording only — armed by cephmc/client_history_record, "
+     "never on the production path"),
+    ("client/objecter.py", "_blob_bytes", "bytes()",
+     "history recording only — armed by cephmc/client_history_record, "
+     "never on the production path"),
+    ("common/history.py", "HistoryRecorder.invoke", "bytes()",
+     "history recording only — recorder is armed by tooling, not "
+     "production config"),
+    ("common/history.py", "HistoryRecorder.complete", "bytes()",
+     "history recording only — recorder is armed by tooling, not "
+     "production config"),
+    ("common/history.py", "_digest", "bytes()",
+     "history recording only — sha1 digest input for linearizability "
+     "audits"),
+    # -- codec boundaries: compressors contract to return independent
+    # bytes and the C codecs need one contiguous input; only frames /
+    # blocks that opted into compression pay it
+    ("compressor/__init__.py", "NoneCompressor.compress", "bytes()",
+     "codec contract returns independent bytes; the none codec is the "
+     "passthrough golden model"),
+    ("compressor/__init__.py", "ZlibCompressor.compress", "bytes()",
+     "C codec needs one contiguous input; paid only by opted-in frames"),
+    ("compressor/__init__.py", "ZstdCompressor.compress", "bytes()",
+     "C codec needs one contiguous input; paid only by opted-in frames"),
+    ("compressor/__init__.py", "_Ext.compress", "bytes()",
+     "C codec needs one contiguous input; paid only by opted-in frames"),
+    ("msg/messenger.py", "Connection._frame", ".to_bytes()",
+     "compression (>=1KiB opt-in frames) and AEAD sealing consume one "
+     "contiguous plaintext — the copy is the price of ratio/secrecy; "
+     "plain frames ride BufferList segments untouched"),
+    # -- wire envelope: header TLV fields are bounded small metadata;
+    # the data segment rides the BufferList outside the header
+    ("msg/wire.py", "_enc_value", "bytes()",
+     "header TLV field materialization — bounded metadata, the data "
+     "segment never passes through the TLV encoder"),
+    ("msg/wire.py", "_dec_value", "bytes()",
+     "header TLV field materialization — bounded metadata"),
+    ("msg/wire.py", "encode_header", "bytes()",
+     "header envelope assembly — bounded metadata"),
+    ("msg/wire.py", "decode_header", "bytes()",
+     "header envelope parse — bounded metadata"),
+    ("msg/wire.py", "decode_fields", "bytes()",
+     "named-TLV field name parse — bounded metadata"),
+    ("msg/wire.py", "copy_value", "bytes()",
+     "loopback delivery deep-copies fields to preserve wire isolation "
+     "semantics (a remote peer would get real serialization)"),
+    ("msg/messenger.py", "Connection._read_loop", "bytes()",
+     "control frames (__ack/__banner/__auth) are tiny JSON envelopes, "
+     "not the data path"),
+    # -- attr/omap metadata: bounded values (hinfo, snapset, omap
+    # entries), not data extents; bytes() also pins the sqlite row
+    # buffer to an owned immutable value at the DB boundary
+    ("objectstore/filestore.py", "FileStore.get_attr", "bytes()",
+     "attr values are bounded metadata pinned to owned bytes at the "
+     "sqlite boundary"),
+    ("objectstore/filestore.py", "FileStore.get_attrs", "bytes()",
+     "attr values are bounded metadata pinned at the sqlite boundary"),
+    ("objectstore/filestore.py", "FileStore.omap_get", "bytes()",
+     "omap values are bounded metadata pinned at the sqlite boundary"),
+    ("kv/keyvaluedb.py", "SqliteDB.iterator", "bytes()",
+     "kv iterator yields owned immutable values at the sqlite "
+     "boundary — omap/meta rows, not data extents"),
+    ("objectstore/transaction.py", "Transaction.omap_setkeys", "bytes()",
+     "txn admission captures an owned immutable copy of omap values "
+     "(freeze-on-handoff: the caller may reuse its dict)"),
+    ("objectstore/memstore.py", "MemStore.read", "bytes()",
+     "memstore reads return an isolated snapshot by contract — "
+     "writers mutate the backing array in place under the store lock"),
+    # -- FFI / coefficient math: contiguity requirements and tiny
+    # coefficient matrices, not data-proportional copies
+    ("ops/crc32c.py", "crc32c", "bytes()",
+     "native FFI needs one contiguous bytes object; callers pass "
+     "per-segment views and the crc cache makes repeats free"),
+    ("ops/gf8.py", "gf_matrix_invert", "np.concatenate",
+     "k x k Galois matrix augmentation — coefficients, not data"),
+    ("parallel/plane.py", "MeshDataPlane._generator", "np.concatenate",
+     "(k+m) x k generator matrix assembly — coefficients, not data"),
+    # -- encode/decode staging: the encode contract returns the
+    # contiguous (k+m, W) shard matrix; decode_concat returns the
+    # contiguous logical extent.  [read-path burn-down] entries are
+    # deleted as ROADMAP item 2's zero-copy batched read lands.
+    ("osd/encode_service.py", "EncodeService._host_encode",
+     "np.concatenate",
+     "encode contract returns the (k+m, W) shard matrix; one staging "
+     "concat per stripe, rows are sliced as views downstream"),
+    ("osd/encode_service.py", "EncodeService._run_batch",
+     "np.concatenate",
+     "device batch completion assembles data+parity rows once per "
+     "stripe; rows are sliced as views downstream"),
+    ("ec/interface.py", "ErasureCodeInterface.decode_concat",
+     "np.concatenate",
+     "[read-path burn-down] decode_concat materializes the logical "
+     "extent once; zero-copy read will thread shard views through"),
+    ("ec/plugins/lrc.py", "ErasureCodeLrc.decode_concat",
+     "np.concatenate",
+     "[read-path burn-down] LRC decode_concat materializes the "
+     "logical extent once, same contract as the interface default"),
+    ("osd/ecbackend.py", "ECBackend._reconstruct_extent", "concat_u8()",
+     "single exact-fit chunk returns a zero-copy view (STATS-pinned "
+     "by tests); multi-part reconstruction is the one counted "
+     "decode-input copy"),
+    # -- sub-read serving: [read-path burn-down] the reply currently
+    # materializes store rows into bytes for the sub-read reply
+    # message; the zero-copy batched-read PR threads store views into
+    # the reply BufferList and deletes these
+    ("osd/ecbackend.py", "ECBackend.handle_sub_read", 'b"".join',
+     "[read-path burn-down] clay sub-chunk runs joined for the reply; "
+     "zero-copy read threads store views through"),
+    ("osd/ecbackend.py", "ECBackend.handle_sub_read", "bytes()",
+     "[read-path burn-down] sub-read reply materializes store rows; "
+     "zero-copy read threads store views through"),
+]
+
+# --- buffer-escape ------------------------------------------------------------
+# (path_suffix, function_qual, target_token, invariant): a buffer that
+# crosses a handoff boundary and is mutated elsewhere, where a named
+# protocol invariant orders the mutation strictly before the handoff.
+BUFFER_ESCAPE: "List[Tuple[str, str, str, str]]" = [
+]
+
+# --- lock-across-rpc ----------------------------------------------------------
+# (path_suffix, function_qual, lock_cls, invariant): an awaited helper
+# chain that suspends on the messenger while a DepLock is held, where
+# the lock IS the serialization point or the wait is bounded by a
+# named watchdog.
+LOCK_ACROSS_RPC: "List[Tuple[str, str, str, str]]" = [
+    ("cephfs/mds.py", "MDSDaemon.ms_dispatch", "mds.op",
+     "MDS op serialization: the reference MDS executes one op at a "
+     "time; the reply is sent after release and no peer (mon/objecter "
+     "side) ever takes mds.op, so no cycle is possible"),
+    ("mon/monitor.py", "MonDaemon._handle_command", "mon.command",
+     "command dispatch is single-flight by design; paxos round trips "
+     "under it are bounded by the election/lease watchdogs and never "
+     "re-enter mon.command"),
+    ("osd/daemon.py", "OSDDaemon._exec_cls", "ecbackend.cls",
+     "cls read-modify-write atomicity: the commit must be durable "
+     "before the next cls method or plain write admits; commit fan-in "
+     "is bounded by the pipeline contract and failed by "
+     "_drain_in_flight on interval change"),
+    ("osd/ecbackend.py", "ECBackend.submit_transaction", "ecbackend.cls",
+     "brief hold across pipeline admission only — closes the "
+     "cls-vs-plain-write lost-update window; admission is local "
+     "backpressure, the sub-write fan-out runs on the pump after "
+     "release"),
+    ("osd/ecbackend.py", "ECBackend._issue_pump", "ecbackend.pipeline",
+     "the pump mirrors the reference's check_ops under the PG lock: "
+     "issue order IS the pipeline order; sub-write sends enqueue on "
+     "local connections and replies fan in outside the lock"),
+    ("osd/ecbackend.py", "ECBackend.peer", "ecbackend.peer",
+     "peering is single-flight per PG; the peer lock is the interval "
+     "guard and the run is bounded by the 3-attempt interval-change "
+     "loop"),
+    ("rbd/image.py", "Image.acquire_lock", "rbd.image_state",
+     "exclusive-lock handshake: watch->lock->probe must complete "
+     "atomically w.r.t. local state transitions; peers are mon/osd "
+     "which never take image_state, and every wait is a bounded "
+     "objecter op"),
+    ("rbd/image.py", "Image._renew_watch", "rbd.image_state",
+     "watch renewal swaps the liveness signal under the state lock so "
+     "a competing acquirer never observes a watcher gap; bounded "
+     "objecter ops only"),
+    ("rbd/image.py", "Image.release_lock", "rbd.image_state",
+     "unlock must revoke watch+lock atomically w.r.t. local state; "
+     "bounded objecter ops only"),
+]
+
+
+def match(table: "List[Tuple[str, str, str, str]]", path: str,
+          qual: str, key: str) -> "Tuple[int, str] | None":
+    """-> (entry index, invariant) for the first matching entry."""
+    norm = path.replace("\\", "/")
+    for i, (suffix, fq, k, why) in enumerate(table):
+        if norm.endswith(suffix) and fq == qual and k == key:
+            return i, why
+    return None
+
+
+def stale_entries(table: "List[Tuple[str, str, str, str]]",
+                  used: "set[int]", scanned_paths) -> "List[int]":
+    """Entry indices that matched nothing although their file WAS in
+    this scan (an unscanned file is not judged — unit scans over tmp
+    trees must not false-stale the real table)."""
+    out = []
+    norm = [p.replace("\\", "/") for p in scanned_paths]
+    for i, (suffix, _fq, _k, _why) in enumerate(table):
+        if i in used:
+            continue
+        if any(p.endswith(suffix) for p in norm):
+            out.append(i)
+    return out

@@ -1,0 +1,754 @@
+"""Function summaries + call graph — cephlint's interprocedural layer.
+
+The per-file collect phase (cached on content sha, exactly like checker
+facts) additionally emits one *function summary* per def: call edges
+(with the DepLocks lexically held at each call site and whether the
+call is awaited), copy-introducing facts (``to_bytes``, ``concat_u8``,
+``rebuild``/``rebuild_aligned``, ``np.concatenate``, ``bytes()``,
+``b"".join``), BufferList handoff/mutation facts with one level of
+param/attr taint, and direct messenger-send / bare-future awaits.  The
+whole-tree report phase unions the summaries into a :class:`CallGraph`
+and the three interprocedural checkers (hot-path-copy, buffer-escape,
+lock-across-rpc) run on it.
+
+Call resolution is deliberately over-approximate — a static *guarantee*
+checker must never lose an edge — but noise-controlled:
+
+- ``self.m()`` resolves through the caller's class and its in-tree
+  bases only (an in-tree class hierarchy is closed; a miss means the
+  base is out of tree and the edge is dropped, not widened),
+- ``self.attr.m()`` / ``local.m()`` resolve through one level of
+  receiver type inference (``self.attr = ClassName(...)`` constructor
+  assignments, ``local = ClassName(...)`` bindings, parameter
+  annotations),
+- a bare ``f()`` resolves to module-level functions named ``f``
+  (same file first),
+- anything else falls back to *every* function with that method name
+  tree-wide, except names in :data:`NOISE_NAMES` (dict/list/str
+  builtins that would otherwise pull the whole tree into every root).
+
+Summaries are plain JSON so the driver's fact cache holds them; the
+schema version rides the cache schema (driver._CACHE_SCHEMA).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+# local copies of checkers.base's AST helpers: importing checkers.base
+# here would cycle (checkers/__init__ imports the interprocedural
+# checkers, which import this module)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return f"{dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return f"{dotted(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{dotted(node.value)}[]"
+    return "?"
+
+
+def terminal_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+# awaited calls with these terminal names suspend on the messenger —
+# the lock-across-rpc primitives (superset of locks.py's _SEND_NAMES)
+SEND_NAMES = {"send_message", "send", "sendall", "_send_mon",
+              "_send_election", "_send_ctrl", "_transmit", "send_crash"}
+
+# sanitizer.handoff() ownership boundaries — a BufferList crossing one
+# of these belongs to the consumer from that line on
+HANDOFF_NAMES = {"send_message", "queue_transaction"}
+
+# copy-introducing calls (the bytes_copied == 0 contract's enemies)
+COPY_ATTR_CALLS = {"to_bytes", "rebuild", "rebuild_aligned", "concat_u8"}
+COPY_NAME_CALLS = {"concat_u8"}
+
+# numpy in-place mutators (same set the buffer-aliasing checker uses)
+INPLACE_CALLS = {"fill", "sort", "put", "partition", "byteswap",
+                 "resize", "setfield"}
+# structural BufferList mutators — appending to a handed-off list
+# changes what the consumer will encode
+BL_MUTATORS = {"append", "append_zero", "mutable_view"} | INPLACE_CALLS
+
+# receiver names that are stdlib / third-party modules: calls through
+# them never resolve into the tree (subprocess.run must not become
+# Workload.run)
+STDLIB_RECEIVERS = {
+    "np", "numpy", "jnp", "jax", "os", "sys", "io", "re", "json",
+    "time", "math", "struct", "hashlib", "hmac", "zlib", "base64",
+    "binascii", "random", "secrets", "socket", "select", "shutil",
+    "subprocess", "asyncio", "itertools", "functools", "collections",
+    "heapq", "bisect", "copy", "pickle", "uuid", "tempfile", "stat",
+    "errno", "signal", "threading", "traceback", "contextlib",
+    "logging", "statistics", "weakref", "gc", "inspect", "types",
+    "dataclasses", "enum", "pathlib", "glob", "fnmatch", "string",
+    "textwrap", "unicodedata", "array", "mmap", "fcntl", "ctypes",
+    "tokenize", "ast", "operator", "urllib", "http", "platform",
+}
+
+# call targets the graph never descends into: logging sinks — their
+# bodies are cold formatting, not data path (copies in the *arguments*
+# are still the caller's own facts)
+STOP_DESCENT = {"dout", "derr", "log", "audit", "debug", "warning",
+                "error", "info", "exception"}
+
+# method names never resolved tree-wide when the receiver type is
+# unknown: dict/list/set/str/asyncio builtins whose tree-wide
+# homonyms would pull unrelated subsystems into every call chain.
+# encode/decode/read/write are deliberately NOT here — they are the
+# hot path's real verbs.
+NOISE_NAMES = {
+    "get", "items", "keys", "values", "setdefault", "update", "pop",
+    "popleft", "popitem", "add", "discard", "remove", "clear",
+    "extend", "insert", "index", "count", "sort", "reverse", "copy",
+    "join", "split", "rsplit", "strip", "lstrip", "rstrip", "format",
+    "startswith", "endswith", "replace", "lower", "upper", "hex",
+    "isdigit", "append", "appendleft", "wait", "set", "is_set",
+    "done", "cancel", "cancelled", "result", "exception",
+    "set_result", "set_exception", "release", "acquire", "locked",
+    "put_nowait", "get_nowait", "qsize", "empty", "full", "most_common",
+    "total_seconds", "timestamp", "isoformat", "group", "groups",
+    "match", "search", "findall", "sub", "finditer", "close", "flush",
+    "seek", "tell", "fileno", "readline", "readlines", "writelines",
+}
+
+
+def _token(node: ast.AST, params: "Set[str]",
+           aliases: "Dict[str, str]") -> "Optional[str]":
+    """Taint token for an expression: ``self.X`` -> "attr:X", a
+    parameter name -> "param:NAME", a one-level local alias of either
+    -> its source token.  None for anything else."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"attr:{node.attr}"
+    if isinstance(node, ast.Name):
+        if node.id in aliases:
+            return aliases[node.id]
+        if node.id in params:
+            return f"param:{node.id}"
+    return None
+
+
+def _taint_source(expr: ast.AST, params: "Set[str]",
+                  aliases: "Dict[str, str]") -> "Optional[str]":
+    """Token an assignment RHS aliases, one level deep: the bare
+    token, a zero-copy derivation of it (``.substr()``/``.view()``/
+    ``[a:b]`` share backing stores), or a constructor call carrying it
+    as an argument (``MFoo(data=self.X)`` aliases ``self.X``)."""
+    tok = _token(expr, params, aliases)
+    if tok is not None:
+        return tok
+    if isinstance(expr, ast.Call):
+        func = expr.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in ("substr", "view", "to_array", "to_u32"):
+            return _token(func.value, params, aliases)
+        # constructor-ish call (Uppercase terminal): any tainted arg
+        # taints the result — the message object carries the buffer
+        name = terminal_attr(func)
+        if name[:1].isupper():
+            for arg in list(expr.args) + [k.value for k in expr.keywords]:
+                tok = _token(arg, params, aliases)
+                if tok is not None:
+                    return tok
+    if isinstance(expr, ast.Subscript):          # bl[a:b] substr alias
+        return _token(expr.value, params, aliases)
+    return None
+
+
+def _ann_type(ann: ast.AST) -> str:
+    """Class name an annotation denotes: ``Foo``, ``mod.Foo``,
+    ``"Foo"`` string forms, and ``Optional[Foo]`` unwrapped."""
+    if isinstance(ann, ast.Subscript):
+        if terminal_attr(ann.value) == "Optional":
+            return _ann_type(ann.slice)
+        return ""
+    t = terminal_attr(ann)
+    if not t and isinstance(ann, ast.Constant) and \
+            isinstance(ann.value, str):
+        t = ann.value.strip("\"' ").split(".")[-1]
+    return t
+
+
+def _annotated_params(node: "ast.FunctionDef | ast.AsyncFunctionDef"
+                      ) -> "Dict[str, str]":
+    """param name -> annotated in-tree-looking (Uppercase) class."""
+    out: "Dict[str, str]" = {}
+    a = node.args
+    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+        if arg.annotation is None:
+            continue
+        t = _ann_type(arg.annotation)
+        if t[:1].isupper():
+            out[arg.arg] = t
+    return out
+
+
+def _ctor_name(expr: ast.AST) -> "Optional[str]":
+    """Class name when ``expr`` constructs one: ``Foo(...)`` /
+    ``mod.Foo(...)`` -> "Foo"; classmethod factories
+    ``Foo.from_config(...)`` -> "Foo"."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = terminal_attr(expr.func)
+    if name[:1].isupper():
+        return name
+    if isinstance(expr.func, ast.Attribute):     # Foo.from_config(...)
+        owner = terminal_attr(expr.func.value)
+        if owner[:1].isupper():
+            return owner
+    return None
+
+
+class _FunctionSummarizer:
+    """One walk over a function body, tracking lexically held locks."""
+
+    def __init__(self, module, qual: str, cls: "Optional[str]",
+                 node: "ast.FunctionDef | ast.AsyncFunctionDef") -> None:
+        self.module = module
+        self.node = node
+        args = node.args
+        self.params = {a.arg for a in
+                       args.posonlyargs + args.args + args.kwonlyargs
+                       if a.arg != "self"}
+        self.aliases: "Dict[str, str]" = {}
+        self.local_types: "Dict[str, str]" = dict(_annotated_params(node))
+        ordered = [a.arg for a in args.posonlyargs + args.args
+                   if a.arg != "self"]
+        self.summary = {
+            "name": node.name,
+            "cls": cls or "",
+            "line": node.lineno,
+            "params": ordered,             # positional order, sans self
+            "kwonly": [a.arg for a in args.kwonlyargs],
+            "async": isinstance(node, ast.AsyncFunctionDef),
+            "calls": [],       # resolvable call edges
+            "copies": [],      # copy-introducing facts
+            "sends": [],       # awaited direct messenger sends
+            "bare_awaits": [], # awaits of a non-call (future-ish) expr
+            "handoffs": [],    # send_message/queue_transaction args
+            "mutations": [],   # BufferList mutation facts
+        }
+
+    def run(self) -> dict:
+        self._visit(self.node.body, held=[])
+        return self.summary
+
+    # --- statement walk, tracking held locks --------------------------------
+
+    def _visit(self, stmts: "Sequence[ast.stmt]",
+               held: "List[str]") -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue                      # separate summary / scope
+            if isinstance(stmt, ast.AsyncWith):
+                attrs = [terminal_attr(item.context_expr)
+                         for item in stmt.items]
+                for item in stmt.items:
+                    self._scan_exprs([item.context_expr], held)
+                self._visit(stmt.body, held + [a for a in attrs if a])
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._note_assign(stmt)
+            elif isinstance(stmt, ast.AugAssign):
+                self._note_store(stmt.target, "augmented assignment")
+            self._scan_exprs(self._header_exprs(stmt), held)
+            for body in self._inner_bodies(stmt):
+                self._visit(body, held)
+
+    _BODY_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+    @classmethod
+    def _inner_bodies(cls, stmt: ast.stmt):
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(stmt, field, None)
+            if body:
+                yield body
+        for handler in getattr(stmt, "handlers", ()):
+            yield handler.body
+
+    @classmethod
+    def _header_exprs(cls, stmt: ast.stmt):
+        for field, value in ast.iter_fields(stmt):
+            if field in cls._BODY_FIELDS:
+                continue
+            if isinstance(value, ast.expr):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    # --- assignment bookkeeping (taint + receiver types + stores) -----------
+
+    def _note_assign(self, stmt: ast.Assign) -> None:
+        src = _taint_source(stmt.value, self.params, self.aliases)
+        ctor = _ctor_name(stmt.value)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                if src is not None:
+                    self.aliases[tgt.id] = src
+                else:
+                    self.aliases.pop(tgt.id, None)
+                if ctor is not None:
+                    self.local_types[tgt.id] = ctor
+                else:
+                    self.local_types.pop(tgt.id, None)
+            elif isinstance(tgt, ast.Subscript):
+                self._note_store(tgt, "subscript store")
+
+    def _note_store(self, tgt: ast.AST, what: str) -> None:
+        if not isinstance(tgt, ast.Subscript):
+            return
+        tok = _token(tgt.value, self.params, self.aliases)
+        if tok is not None:
+            self.summary["mutations"].append({
+                "target": tok, "line": tgt.lineno, "what": what,
+                "context": self.module.context(tgt.lineno)})
+
+    # --- expression scan (calls, copies, awaits) ----------------------------
+
+    def _scan_exprs(self, exprs, held: "List[str]") -> None:
+        stack: "List[Tuple[ast.AST, bool]]" = [(e, False) for e in exprs]
+        while stack:
+            node, awaited = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Await):
+                if isinstance(node.value, ast.Call):
+                    stack.append((node.value, True))
+                else:
+                    if isinstance(node.value, (ast.Name, ast.Attribute)):
+                        self.summary["bare_awaits"].append({
+                            "expr": dotted(node.value),
+                            "line": node.lineno, "locks": list(held),
+                            "context": self.module.context(node.lineno)})
+                    stack.append((node.value, False))
+                continue
+            if isinstance(node, ast.Call):
+                self._note_call(node, awaited, held)
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, False))
+
+    def _note_call(self, node: ast.Call, awaited: bool,
+                   held: "List[str]") -> None:
+        func = node.func
+        name = terminal_attr(func)
+        d = dotted(func)
+        line = node.lineno
+        ctx = self.module.context(line)
+
+        # copy-introducing facts
+        copy_label = None
+        if isinstance(func, ast.Attribute):
+            if func.attr in COPY_ATTR_CALLS:
+                copy_label = f".{func.attr}()"
+            elif func.attr == "concatenate" and \
+                    terminal_attr(func.value) in ("np", "numpy"):
+                copy_label = "np.concatenate"
+            elif func.attr == "join" and \
+                    isinstance(func.value, ast.Constant) and \
+                    isinstance(func.value.value, bytes):
+                copy_label = 'b"".join'
+        elif isinstance(func, ast.Name):
+            if func.id in COPY_NAME_CALLS:
+                copy_label = f"{func.id}()"
+            elif func.id == "bytes" and node.args:
+                copy_label = "bytes()"
+        if copy_label is not None:
+            self.summary["copies"].append({
+                "callee": copy_label, "line": line, "context": ctx})
+
+        # direct messenger sends (awaited — a sync send doesn't park)
+        if awaited and name in SEND_NAMES:
+            self.summary["sends"].append({
+                "line": line, "locks": list(held), "call": d,
+                "context": ctx})
+
+        # handoff boundaries with one-level arg taint
+        if name in HANDOFF_NAMES:
+            toks = []
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                tok = _taint_source(arg, self.params, self.aliases)
+                if tok is not None:
+                    toks.append(tok)
+            self.summary["handoffs"].append({
+                "boundary": name, "line": line, "args": toks,
+                "context": ctx})
+
+        # BufferList mutators on attr/param receivers
+        if isinstance(func, ast.Attribute) and name in BL_MUTATORS:
+            tok = _token(func.value, self.params, self.aliases)
+            if tok is not None:
+                self.summary["mutations"].append({
+                    "target": tok, "line": line, "what": f".{name}()",
+                    "context": ctx})
+
+        # the call edge itself, with receiver hints for resolution
+        receiver = ""
+        recv_kind = ""
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                recv_kind, receiver = "self", ""
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                recv_kind, receiver = "self_attr", base.attr
+            elif isinstance(base, ast.Name):
+                if base.id in self.local_types:
+                    recv_kind, receiver = "typed", self.local_types[base.id]
+                elif base.id[:1].isupper():
+                    recv_kind, receiver = "typed", base.id
+                else:
+                    recv_kind, receiver = "unknown", base.id
+            else:
+                recv_kind, receiver = "unknown", ""
+        elif isinstance(func, ast.Name):
+            recv_kind, receiver = "bare", ""
+        else:
+            return                             # call on a call/subscript
+        args = []
+        for i, arg in enumerate(node.args):
+            tok = _taint_source(arg, self.params, self.aliases)
+            if tok is not None:
+                args.append([i, tok])
+        for k in node.keywords:
+            if k.arg is None:
+                continue
+            tok = _taint_source(k.value, self.params, self.aliases)
+            if tok is not None:
+                args.append([k.arg, tok])
+        self.summary["calls"].append({
+            "n": name, "d": d, "line": line, "awaited": awaited,
+            "recv": recv_kind, "recv_name": receiver,
+            "locks": list(held), "args": args, "context": ctx})
+
+
+def summarize(module) -> dict:
+    """Whole-file summary: every function's summary keyed by qualname
+    (``Class.method`` / bare name; nested defs ``outer.inner``), class
+    shapes (bases + constructor-inferred attribute types), and DepLock
+    attribute definitions."""
+    functions: "Dict[str, dict]" = {}
+    classes: "Dict[str, dict]" = {}
+    lock_defs: "List[dict]" = []
+
+    def walk_into(node: ast.AST, cls: "Optional[str]",
+                  prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                bases = [terminal_attr(b) for b in child.bases]
+                classes.setdefault(child.name, {
+                    "bases": [b for b in bases if b],
+                    "attr_types": {}, "methods": []})
+                walk_into(child, child.name, child.name + ".")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                if cls is not None:
+                    classes[cls]["methods"].append(child.name)
+                functions[qual] = _FunctionSummarizer(
+                    module, qual, cls, child).run()
+                # nested defs summarized under their own quals, not as
+                # part of the enclosing body (separate execution ctx)
+                walk_into(child, None, qual + ".")
+            elif isinstance(child, ast.Assign):
+                _note_toplevel_assign(child, cls, classes, lock_defs)
+                walk_into(child, cls, prefix)
+            else:
+                walk_into(child, cls, prefix)
+
+    def _note_toplevel_assign(stmt, cls, classes, lock_defs) -> None:
+        if not isinstance(stmt.value, ast.Call):
+            return
+        if terminal_attr(stmt.value.func) == "DepLock":
+            lock_cls = None
+            if stmt.value.args and \
+                    isinstance(stmt.value.args[0], ast.Constant) and \
+                    isinstance(stmt.value.args[0].value, str):
+                lock_cls = stmt.value.args[0].value
+            for tgt in stmt.targets:
+                attr = terminal_attr(tgt)
+                if attr and lock_cls:
+                    lock_defs.append({"attr": attr, "cls": lock_cls})
+
+    # class attr types need a second pass over method bodies:
+    # self.X = ClassName(...) and self.X = <annotated param> anywhere
+    # in the class; plus DI-style cross-object wiring
+    # (``client.objecter.op_tracker = OpTracker.from_config(...)``)
+    # recorded attr-name-wide for the CallGraph's last-resort lookup
+    walk_into(module.tree, None, "")
+    di_attr_types: "Dict[str, List[str]]" = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            ctor = _ctor_name(node.value)
+            if ctor:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and not (
+                            isinstance(tgt.value, ast.Name) and
+                            tgt.value.id == "self"):
+                        lst = di_attr_types.setdefault(tgt.attr, [])
+                        if ctor not in lst:
+                            lst.append(ctor)
+        if not isinstance(node, ast.ClassDef):
+            continue
+        shape = classes.get(node.name)
+        if shape is None:
+            continue
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            ann = _annotated_params(meth)
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                ctor = _ctor_name(sub.value)
+                if ctor is None and isinstance(sub.value, ast.Name):
+                    ctor = ann.get(sub.value.id)   # self.store = store
+                for tgt in sub.targets:
+                    if ctor and isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        shape["attr_types"].setdefault(tgt.attr, ctor)
+                # DepLock defs inside methods
+                if isinstance(sub.value, ast.Call) and \
+                        terminal_attr(sub.value.func) == "DepLock":
+                    cls_arg = sub.value.args[0] if \
+                        sub.value.args else None
+                    if isinstance(cls_arg, ast.Constant) and \
+                            isinstance(cls_arg.value, str):
+                        for tgt in sub.targets:
+                            attr = terminal_attr(tgt)
+                            if attr:
+                                lock_defs.append({"attr": attr,
+                                                  "cls": cls_arg.value})
+    return {"functions": functions, "classes": classes,
+            "lock_defs": lock_defs, "di_attr_types": di_attr_types}
+
+
+class CallGraph:
+    """Whole-tree call graph over per-file summaries.
+
+    ``resolve(path, qual, call)`` -> list of (path, qual) callees;
+    ``reachable(roots)`` -> {(path, qual): chain} BFS closure with the
+    shortest root chain per function (the burn-down list's "how did we
+    get here" evidence).
+    """
+
+    def __init__(self, summaries: "Dict[str, dict]") -> None:
+        self.summaries = summaries
+        # method name -> [(path, qual)]
+        self.by_name: "Dict[str, List[Tuple[str, str]]]" = {}
+        # bare module-level function name -> [(path, qual)]
+        self.modlevel: "Dict[str, List[Tuple[str, str]]]" = {}
+        # class name -> [(path, shape)] (same name may repeat per file)
+        self.classes: "Dict[str, List[Tuple[str, dict]]]" = {}
+        # base class name -> direct subclass names (virtual dispatch)
+        self.subclasses: "Dict[str, Set[str]]" = {}
+        # DI wiring: attr name -> ctor classes assigned cross-object
+        self.di_attr_types: "Dict[str, List[str]]" = {}
+        self.lock_attrs: "Dict[str, Set[str]]" = {}
+        for path, s in summaries.items():
+            for qual, fn in s.get("functions", {}).items():
+                self.by_name.setdefault(fn["name"], []).append(
+                    (path, qual))
+                if not fn["cls"] and "." not in qual:
+                    self.modlevel.setdefault(fn["name"], []).append(
+                        (path, qual))
+            for cname, shape in s.get("classes", {}).items():
+                self.classes.setdefault(cname, []).append((path, shape))
+                for base in shape.get("bases", ()):
+                    self.subclasses.setdefault(base, set()).add(cname)
+            for attr, ctors in s.get("di_attr_types", {}).items():
+                lst = self.di_attr_types.setdefault(attr, [])
+                for c in ctors:
+                    if c not in lst:
+                        lst.append(c)
+            for d in s.get("lock_defs", ()):
+                self.lock_attrs.setdefault(d["attr"], set()).add(d["cls"])
+
+    def fn(self, path: str, qual: str) -> "Optional[dict]":
+        return self.summaries.get(path, {}).get(
+            "functions", {}).get(qual)
+
+    # --- resolution ---------------------------------------------------------
+
+    def _mro_names(self, cls: str, seen: "Optional[Set[str]]" = None
+                   ) -> "List[str]":
+        seen = seen if seen is not None else set()
+        if cls in seen:
+            return []
+        seen.add(cls)
+        out = [cls]
+        for _path, shape in self.classes.get(cls, ()):
+            for base in shape.get("bases", ()):
+                out.extend(self._mro_names(base, seen))
+        return out
+
+    def _method_in(self, cls: str, name: str
+                   ) -> "List[Tuple[str, str]]":
+        out = []
+        for c in self._mro_names(cls):
+            for path, shape in self.classes.get(c, ()):
+                if name in shape.get("methods", ()):
+                    out.append((path, f"{c}.{name}"))
+            if out:
+                break                      # nearest MRO level wins
+        return out
+
+    def _sub_names(self, cls: str, seen: "Optional[Set[str]]" = None
+                   ) -> "List[str]":
+        seen = seen if seen is not None else set()
+        out: "List[str]" = []
+        for sc in sorted(self.subclasses.get(cls, ())):
+            if sc in seen:
+                continue
+            seen.add(sc)
+            out.append(sc)
+            out.extend(self._sub_names(sc, seen))
+        return out
+
+    def _method_virtual(self, cls: str, name: str
+                        ) -> "List[Tuple[str, str]]":
+        """Static binding (nearest MRO level) PLUS every override in a
+        transitive subclass — the receiver may be any of them."""
+        out = list(self._method_in(cls, name))
+        quals = {q for _p, q in out}
+        for sc in self._sub_names(cls):
+            for path, shape in self.classes.get(sc, ()):
+                q = f"{sc}.{name}"
+                if name in shape.get("methods", ()) and q not in quals:
+                    out.append((path, q))
+                    quals.add(q)
+        return out
+
+    def _attr_type(self, cls: str, attr: str) -> "Optional[str]":
+        for c in self._mro_names(cls):
+            for _path, shape in self.classes.get(c, ()):
+                t = shape.get("attr_types", {}).get(attr)
+                if t:
+                    return t
+        return None
+
+    def resolve(self, path: str, qual: str, call: dict
+                ) -> "List[Tuple[str, str]]":
+        name = call["n"]
+        kind = call["recv"]
+        if name in STOP_DESCENT:
+            return []                   # logging sinks are not edges
+        if kind == "unknown" and call["recv_name"] in STDLIB_RECEIVERS:
+            return []                   # subprocess.run != Workload.run
+        caller_cls = self.fn(path, qual)["cls"] if \
+            self.fn(path, qual) else ""
+        if kind == "self" and caller_cls:
+            return self._method_virtual(caller_cls, name)
+        if kind == "self_attr" and caller_cls:
+            t = self._attr_type(caller_cls, call["recv_name"])
+            if t is None:
+                di = self.di_attr_types.get(call["recv_name"], ())
+                if len(di) == 1:       # unambiguous DI wiring
+                    t = di[0]
+            if t:
+                hits = self._method_virtual(t, name)
+                if hits:
+                    return hits
+            return self._fallback(name)
+        if kind == "typed":
+            hits = self._method_virtual(call["recv_name"], name)
+            if hits:
+                return hits
+            return self._fallback(name)
+        if kind == "bare":
+            same_file = [(p, q) for p, q in self.modlevel.get(name, ())
+                         if p == path]
+            if same_file:
+                return same_file
+            return list(self.modlevel.get(name, ()))
+        return self._fallback(name)
+
+    # an unknown-receiver homonym this common carries no information —
+    # resolving it would connect everything to everything (``init`` has
+    # 13 in-tree definitions, ``encode`` 14).  Typed / self / DI paths
+    # are unaffected; the hot verbs stay covered because their real
+    # call sites have typed receivers (``msg: Message`` -> msg.encode).
+    FALLBACK_FANOUT_CAP = 5
+
+    def _fallback(self, name: str) -> "List[Tuple[str, str]]":
+        if name in NOISE_NAMES:
+            return []
+        hits = self.by_name.get(name, ())
+        if len(hits) > self.FALLBACK_FANOUT_CAP:
+            return []
+        return list(hits)
+
+    # --- reachability -------------------------------------------------------
+
+    def match_roots(self, patterns: "Sequence[str]"
+                    ) -> "List[Tuple[str, str]]":
+        """Root functions for qual patterns: ``Class.method`` exact,
+        ``*.method`` any class/module-level function of that name."""
+        out: "List[Tuple[str, str]]" = []
+        for pat in patterns:
+            cls, _, meth = pat.rpartition(".")
+            if cls == "*":
+                out.extend(self.by_name.get(meth, ()))
+            else:
+                for path, s in self.summaries.items():
+                    if pat in s.get("functions", {}):
+                        out.append((path, pat))
+        # stable dedup
+        seen: "Set[Tuple[str, str]]" = set()
+        uniq = []
+        for key in out:
+            if key not in seen:
+                seen.add(key)
+                uniq.append(key)
+        return uniq
+
+    def reachable(self, roots: "Sequence[Tuple[str, str]]",
+                  stop_names: "frozenset | set" = frozenset()
+                  ) -> "Dict[Tuple[str, str], List[str]]":
+        """BFS closure: {(path, qual): [root qual, ..., qual]} with the
+        shortest call chain recorded for evidence.  ``stop_names``
+        terminates chains at ownership/dispatch boundaries (e.g.
+        ``queue_transaction``: past the handoff the bytes belong to the
+        consumer, which has its own roots and contracts)."""
+        chains: "Dict[Tuple[str, str], List[str]]" = {}
+        frontier: "List[Tuple[str, str]]" = []
+        for key in roots:
+            if key not in chains and self.fn(*key) is not None:
+                chains[key] = [key[1]]
+                frontier.append(key)
+        while frontier:
+            nxt: "List[Tuple[str, str]]" = []
+            for path, qual in frontier:
+                fn = self.fn(path, qual)
+                if fn is None:
+                    continue
+                for call in fn.get("calls", ()):
+                    if call["n"] in stop_names:
+                        continue
+                    for callee in self.resolve(path, qual, call):
+                        if callee in chains or \
+                                self.fn(*callee) is None:
+                            continue
+                        chains[callee] = chains[(path, qual)] + \
+                            [callee[1]]
+                        nxt.append(callee)
+            frontier = nxt
+        return chains
